@@ -1,0 +1,1 @@
+"""Flax models with logical partitioning: attention, feed-forward, transformer."""

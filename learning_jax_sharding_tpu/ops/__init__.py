@@ -1,0 +1,1 @@
+"""Attention and math ops: dense attention, Pallas flash attention, ring attention."""

@@ -1,0 +1,89 @@
+"""HLO introspection: make GSPMD's implicit collective choices machine-checkable.
+
+The reference *narrates* which collective XLA inserts for each sharding pattern
+(`/root/reference/case1a.py:57-59` "AllReduce", `/root/reference/case1b.py:55-57`
+"AllGather") — prose claims, never verified, and in two files the banners are
+swapped (SURVEY.md §8). This module turns those claims into assertions: compile
+a function with real input shardings and count the collective ops in the
+optimized HLO.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable
+
+import jax
+
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+)
+
+# Instruction form: `  %name = bf16[4,4]{1,0} all-reduce(...)`, or async
+# `%s = (f32[...], f32[...]) all-gather-start(...)` whose tuple-typed result
+# contains spaces. Matching on `= <type> <op>(` avoids counting occurrences
+# inside fusion/computation names; `-done` ops are deliberately excluded so an
+# async pair counts once.
+_INSTR_RE = re.compile(
+    r"=\s+(?:\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+)
+
+
+def compiled_hlo(fn: Callable, *args, **kwargs) -> str:
+    """Optimized (post-GSPMD-partitioning) HLO text of ``jit(fn)`` on ``args``.
+
+    ``args`` should already carry their shardings (e.g. via ``device_put``)
+    so the partitioner sees the same placements the runtime would.
+    """
+    jitted = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
+    return jitted.lower(*args, **kwargs).compile().as_text()
+
+
+def collective_counts(hlo_or_fn, *args, **kwargs) -> dict[str, int]:
+    """Count collective instructions per op kind.
+
+    Accepts either an HLO text string or a function plus example args
+    (compiled via :func:`compiled_hlo`).
+
+    Returns a dict like ``{"all-reduce": 1, "all-gather": 0, ...}``.
+    """
+    text = hlo_or_fn if isinstance(hlo_or_fn, str) else compiled_hlo(hlo_or_fn, *args, **kwargs)
+    counts = {op: 0 for op in COLLECTIVE_OPS}
+    for m in _INSTR_RE.finditer(text):
+        counts[m.group(1)] += 1
+    return counts
+
+
+def assert_collectives(
+    fn_or_hlo,
+    *args,
+    expect: dict[str, int] | None = None,
+    forbid: tuple[str, ...] = (),
+    require: tuple[str, ...] = (),
+    **kwargs,
+) -> dict[str, int]:
+    """Assert which collectives GSPMD inserted.
+
+    Args:
+        expect: exact per-op counts (ops not listed are unconstrained).
+        forbid: op kinds that must not appear at all.
+        require: op kinds that must appear at least once.
+
+    Returns the full count dict for further inspection.
+    """
+    counts = collective_counts(fn_or_hlo, *args, **kwargs)
+    if expect:
+        for op, n in expect.items():
+            if counts.get(op, 0) != n:
+                raise AssertionError(f"expected {n} × {op}, got {counts.get(op, 0)}; all={counts}")
+    for op in forbid:
+        if counts.get(op, 0):
+            raise AssertionError(f"forbidden collective {op} present: {counts}")
+    for op in require:
+        if not counts.get(op, 0):
+            raise AssertionError(f"required collective {op} absent: {counts}")
+    return counts

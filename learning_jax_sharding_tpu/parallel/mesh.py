@@ -1,0 +1,177 @@
+"""Mesh construction over real TPU topology or emulated CPU devices.
+
+This is layer L1 of the framework (see SURVEY.md §1). The reference builds its
+meshes ad hoc at the top of each script (`/root/reference/case1a.py:15`,
+`/root/reference/case6_attention.py:155-156`) after forcing emulated host
+devices via ``XLA_FLAGS`` (`/root/reference/case1a.py:2-3`). Here both concerns
+become real API:
+
+* :func:`build_mesh` — an ICI-topology-aware mesh over whatever devices exist
+  (real TPU chips in production, emulated CPU devices in tests).
+* :func:`force_emulated_devices` — the reference's device-count hack as a
+  checked, documented function usable before the backend initializes.
+
+Axis-name conventions used throughout the framework:
+
+* ``"data"``  — batch (data-parallel) axis.
+* ``"model"`` — tensor/model-parallel axis.
+* extra axes (``"fsdp"``, ``"seq"``, ``"stage"``, ``"expert"``) are supported by
+  :func:`build_mesh`; the logical-axis layer maps onto whatever names the mesh
+  declares.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import re
+import warnings
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+#: Default 2D mesh axis names, matching the reference's
+#: ``Mesh(..., ('data', 'model'))`` (`/root/reference/case6_attention.py:155-156`).
+DEFAULT_AXIS_NAMES: tuple[str, ...] = (DATA_AXIS, MODEL_AXIS)
+
+
+def force_emulated_devices(n: int, *, platform: str = "cpu") -> None:
+    """Force ``n`` emulated host devices, before the JAX backend initializes.
+
+    The reference does this with a raw env-var assignment that must precede
+    ``import jax`` (`/root/reference/case1a.py:2-3`). JAX only reads the flag
+    when the backend client is created, so it is enough to set it before the
+    first device access — which lets this live in a function instead of a
+    module preamble.
+
+    Note: in this environment a plugin intercepts platform selection, so the
+    ``jax.config`` update (not just the env var) is required to actually land
+    on the emulated CPU backend.
+
+    Raises:
+        RuntimeError: if the backend is already initialized with a different
+            device count (the flag would be silently ignored).
+    """
+    flag = f"--xla_force_host_platform_device_count={n}"
+    existing = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" in existing:
+        updated = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, existing
+        )
+    else:
+        updated = (existing + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = updated
+    jax.config.update("jax_platforms", platform)
+    devices = jax.devices()
+    if len(devices) != n:
+        raise RuntimeError(
+            f"requested {n} emulated {platform} devices but backend already "
+            f"initialized with {len(devices)}; call force_emulated_devices() "
+            "before any other JAX device access in the process"
+        )
+
+
+def _infer_shape(n_devices: int, ndim: int) -> tuple[int, ...]:
+    """Pick a balanced ``ndim``-D factorization of ``n_devices``.
+
+    Prefers near-square factorizations (e.g. 8 → (2, 4), 16 → (4, 4)) so that
+    both mesh axes get parallelism by default.
+    """
+    if ndim == 1:
+        return (n_devices,)
+    if ndim != 2:
+        raise ValueError(f"automatic shape inference supports 1D/2D, got ndim={ndim}")
+    best = (1, n_devices)
+    for a in range(1, int(math.isqrt(n_devices)) + 1):
+        if n_devices % a == 0:
+            best = (a, n_devices // a)
+    return best
+
+
+def build_mesh(
+    shape: Sequence[int] | None = None,
+    axis_names: Sequence[str] = DEFAULT_AXIS_NAMES,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` over the available devices.
+
+    On TPU, ``mesh_utils.create_device_mesh`` orders devices so neighboring
+    mesh coordinates are ICI neighbors — collectives along a mesh axis then
+    ride the intra-slice interconnect rather than hopping hosts. On CPU
+    emulation the ordering is arbitrary but the mesh is shape-identical, which
+    is what the tests rely on.
+
+    Args:
+        shape: mesh shape, e.g. ``(2, 4)``. ``None`` infers a balanced shape
+            over all devices with ``len(axis_names)`` dimensions.
+        axis_names: one name per mesh dimension.
+        devices: explicit device list (defaults to ``jax.devices()``).
+
+    Returns:
+        A ``Mesh`` usable as a context manager and in ``NamedSharding``.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = _infer_shape(len(devices), len(axis_names))
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} rank != axis_names {tuple(axis_names)} rank")
+    n = math.prod(shape)
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    if n < len(devices):
+        devices = devices[:n]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError, NotImplementedError) as e:
+        # create_device_mesh can reject odd topologies (e.g. emulated devices
+        # with no coords); a plain reshape is semantically identical but loses
+        # ICI-aware ordering, so on real accelerators that downgrade must be
+        # loud — collectives would silently hop hosts otherwise.
+        if devices[0].platform != "cpu":
+            warnings.warn(
+                f"create_device_mesh failed on {devices[0].platform} ({e}); "
+                "falling back to arbitrary device order — mesh axes may not "
+                "follow ICI topology",
+                stacklevel=2,
+            )
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def single_device_mesh(axis_names: Sequence[str] = DEFAULT_AXIS_NAMES) -> Mesh:
+    """Degenerate mesh with every axis of size 1 on the default device.
+
+    Lets every sharded program in the framework run unchanged on one chip —
+    the bring-up path for the single-TPU environment (SURVEY.md §7 step 6).
+    """
+    shape = (1,) * len(axis_names)
+    return Mesh(np.asarray([jax.devices()[0]]).reshape(shape), tuple(axis_names))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description, for configs and checkpoint metadata.
+
+    The reference hard-codes mesh shapes inline (`/root/reference/case1a.py:15`,
+    `/root/reference/case6_attention.py:155`); this is the config-system
+    equivalent (SURVEY.md §5 "Config / flag system").
+    """
+
+    shape: tuple[int, ...]
+    axis_names: tuple[str, ...] = DEFAULT_AXIS_NAMES
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        return build_mesh(self.shape, self.axis_names, devices=devices)
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.shape)

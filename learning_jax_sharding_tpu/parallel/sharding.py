@@ -1,0 +1,168 @@
+"""Sharding specification, placement, and shard introspection (layer L2).
+
+The reference expresses placements through the removed ``PositionalSharding``
+algebra — ``sharding.replicate(...)`` / ``sharding.reshape(...)``
+(`/root/reference/case1a.py:15,24,30`) — and probes results through the removed
+``Array.device_buffers`` (`/root/reference/case1a.py:35-55`). This module
+rebuilds both on the modern, TPU-native API surface:
+
+* placement: ``NamedSharding`` + ``PartitionSpec`` helpers that reproduce every
+  placement the positional algebra produced in cases 1a–4 (equivalences
+  verified by execution, SURVEY.md §8);
+* introspection: ``Array.addressable_shards``-based probes that turn the
+  reference's inline prints/asserts into reusable assertions.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+Axes = str | Sequence[str] | None
+
+# ---------------------------------------------------------------------------
+# Placement helpers
+# ---------------------------------------------------------------------------
+
+
+def mesh_sharding(mesh: Mesh, *axes: Axes) -> NamedSharding:
+    """``NamedSharding(mesh, PartitionSpec(*axes))`` — the framework's one way
+    to spell a placement.
+
+    Generalizes the reference's local helper of the same name
+    (`/root/reference/case5_attention_dense.py:85-86`).
+    """
+    return NamedSharding(mesh, P(*axes))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully replicated placement: every device holds the whole array.
+
+    Positional-algebra equivalent: ``sharding.replicate()`` with all axes kept
+    (`/root/reference/case1a.py:24` replicates over mesh-X).
+    """
+    return NamedSharding(mesh, P())
+
+
+def shard_dims(mesh: Mesh, ndim: int, **dim_axes: int) -> NamedSharding:
+    """Shard selected array dims over named mesh axes, replicate the rest.
+
+    ``shard_dims(mesh, 2, x=0, y=1)`` shards dim 0 over mesh axis ``x`` and
+    dim 1 over ``y`` — the fully-2D-sharded placement of
+    `/root/reference/case3_fully_sharded.py:23,29`.
+
+    Args:
+        mesh: target mesh.
+        ndim: rank of the array being placed.
+        **dim_axes: ``axis_name=array_dim`` pairs. Multiple mesh axes may map
+            to the same array dim; they combine into a tuple entry (the
+            ``PositionalSharding.reshape`` trick of
+            `/root/reference/case1a.py:30`, where one 16-long dim is split
+            4-way using both mesh axes).
+    """
+    spec: list[Axes] = [None] * ndim
+    for axis_name, dim in dim_axes.items():
+        if axis_name not in mesh.axis_names:
+            raise ValueError(f"mesh has no axis {axis_name!r}: {mesh.axis_names}")
+        if not 0 <= dim < ndim:
+            raise ValueError(f"array dim {dim} out of range for ndim={ndim}")
+        cur = spec[dim]
+        if cur is None:
+            spec[dim] = axis_name
+        elif isinstance(cur, tuple):
+            spec[dim] = cur + (axis_name,)
+        else:
+            spec[dim] = (cur, axis_name)
+    return NamedSharding(mesh, P(*spec))
+
+
+def row_sharded(mesh: Mesh, axis: str, *, ndim: int = 2) -> NamedSharding:
+    """Shard dim 0 (rows) over ``axis`` — the data-parallel operand placement
+    of `/root/reference/case4_gspmd_ff.py:46`."""
+    return shard_dims(mesh, ndim, **{axis: 0})
+
+
+def col_sharded(mesh: Mesh, axis: str, *, ndim: int = 2) -> NamedSharding:
+    """Shard the last dim (columns) over ``axis`` — the tensor-parallel weight
+    placement of `/root/reference/case4_gspmd_ff.py:49`."""
+    return shard_dims(mesh, ndim, **{axis: ndim - 1})
+
+
+def put(x: jax.Array | np.ndarray, sharding: NamedSharding) -> jax.Array:
+    """``jax.device_put`` under the framework's name, for symmetry."""
+    return jax.device_put(x, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Shard introspection — the reference's probes as reusable API
+# ---------------------------------------------------------------------------
+
+
+def shard_shapes(x: jax.Array) -> list[tuple[int, ...]]:
+    """Per-device shard shapes, in ``addressable_shards`` order.
+
+    Replaces the removed ``np.array(A.device_buffers[i]).shape`` probes
+    (`/root/reference/case1a.py:35-46`).
+    """
+    return [s.data.shape for s in x.addressable_shards]
+
+
+def shard_arrays(x: jax.Array) -> list[np.ndarray]:
+    """Materialize every addressable shard on host."""
+    return [np.asarray(s.data) for s in x.addressable_shards]
+
+
+def unique_shard_count(x: jax.Array) -> int:
+    """Number of distinct shard contents across devices.
+
+    ``1`` means fully replicated (every device holds identical data —
+    the reference proves this with pairwise ``np.array_equal`` loops,
+    `/root/reference/case1a.py:53-62`); ``len(devices)`` means fully
+    distinct tiles (`/root/reference/case3_fully_sharded.py:58-60`).
+    """
+    seen: list[np.ndarray] = []
+    for arr in shard_arrays(x):
+        if not any(a.shape == arr.shape and np.array_equal(a, arr) for a in seen):
+            seen.append(arr)
+    return len(seen)
+
+
+def is_fully_replicated(x: jax.Array) -> bool:
+    """True if every device holds the full array."""
+    return bool(x.is_fully_replicated)
+
+
+def assert_shard_shape(x: jax.Array, expected: tuple[int, ...]) -> None:
+    """Assert every addressable shard has shape ``expected``.
+
+    The reusable form of the inline asserts at `/root/reference/case1a.py:36,43`
+    and analogues in every case file.
+    """
+    shapes = set(shard_shapes(x))
+    if shapes != {tuple(expected)}:
+        raise AssertionError(f"expected uniform shard shape {tuple(expected)}, got {shapes}")
+
+
+def assert_replicated(x: jax.Array, full: np.ndarray | None = None) -> None:
+    """Assert full replication; optionally check shards equal ``full``.
+
+    Covers the reference's replication oracles (`/root/reference/case1a.py:39-46`
+    compare each shard against the host array).
+    """
+    if not is_fully_replicated(x):
+        raise AssertionError(f"array is not fully replicated: sharding={x.sharding}")
+    if full is not None:
+        for arr in shard_arrays(x):
+            if not np.allclose(arr, full):
+                raise AssertionError("replicated shard differs from reference array")
+
+
+def visualize(x: jax.Array) -> None:
+    """ASCII sharding layout — ``jax.debug.visualize_array_sharding`` as used
+    throughout the reference (`/root/reference/case1a.py:26,32,51`)."""
+    jax.debug.visualize_array_sharding(x)

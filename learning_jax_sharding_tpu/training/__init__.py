@@ -1,0 +1,1 @@
+"""Sharded-init / train_step / apply pipeline (layer L5)."""

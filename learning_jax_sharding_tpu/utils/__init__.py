@@ -1,0 +1,1 @@
+"""Benchmarking, profiling, checkpointing, and debug utilities (layer L6)."""

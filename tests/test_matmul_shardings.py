@@ -1,0 +1,184 @@
+"""Cases 1a/1b/2/3/4 as machine-checked tests.
+
+Each reference case file asserts per-shard shapes and narrates (in prose) which
+collective GSPMD inserts. Here both become assertions: shard-shape oracles from
+SURVEY.md §8 (verified by execution against the reference semantics) plus HLO
+collective checks the reference never had. Reference cites per test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.parallel import (
+    assert_collectives,
+    assert_replicated,
+    assert_shard_shape,
+    col_sharded,
+    mesh_sharding,
+    put,
+    replicated,
+    row_sharded,
+    shard_dims,
+    shard_shapes,
+    unique_shard_count,
+)
+
+
+def _dot(a, b):
+    return jax.lax.dot(a, b)
+
+
+def _operands(rng, m=4, k=16, n=4):
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    return a, b
+
+
+class TestCase1a:
+    """Contraction-dim sharding on both operands → partial products → AllReduce.
+
+    Reference: `/root/reference/case1a.py` (A replicated over X / split 4-way on
+    inner dim over Y, `:24`; B inner dim split 4-way, `:30`; shard shapes
+    asserted at `:36,:43`; AllReduce + replicated C narrated at `:57-62`).
+    """
+
+    def test_shard_shapes_and_result(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, y=1))  # A(4,16): inner dim 4-way over Y
+        b = put(b_np, shard_dims(mesh24, 2, y=0))  # B(16,4): inner dim 4-way over Y
+        assert_shard_shape(a, (4, 4))
+        assert_shard_shape(b, (4, 4))
+        c = jax.jit(_dot)(a, b)
+        np.testing.assert_allclose(np.asarray(c), a_np @ b_np, rtol=1e-5)
+        # C is fully replicated after the AllReduce (case1a.py:60-62).
+        assert_replicated(c, a_np @ b_np)
+        assert unique_shard_count(c) == 1
+
+    def test_allreduce_inserted(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, y=1))
+        b = put(b_np, shard_dims(mesh24, 2, y=0))
+        assert_collectives(_dot, a, b, require=("all-reduce",), forbid=("all-gather",))
+
+
+class TestCase1b:
+    """Mismatched contraction shardings → AllGather.
+
+    Reference: `/root/reference/case1b.py` (A dim1 split 4-way over Y `:24`;
+    B dim0 split 2-way over X `:30`; shard shapes `:36,:42`; AllGather narrated
+    at `:55-57`; C replicated, verified by execution in SURVEY.md §8).
+    """
+
+    def test_shard_shapes_and_result(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, y=1))   # (4,4) shards
+        b = put(b_np, shard_dims(mesh24, 2, x=0))   # (8,4) shards
+        assert_shard_shape(a, (4, 4))
+        assert_shard_shape(b, (8, 4))
+        c = jax.jit(_dot)(a, b)
+        np.testing.assert_allclose(np.asarray(c), a_np @ b_np, rtol=1e-5)
+        assert_replicated(c)
+
+    def test_allgather_inserted(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, y=1))
+        b = put(b_np, shard_dims(mesh24, 2, x=0))
+        assert_collectives(_dot, a, b, require=("all-gather",))
+
+
+class TestCase2:
+    """Outer-axes sharding: no contraction-dim conflict → sharded output.
+
+    Reference: `/root/reference/case2.py` (A fully sharded over both axes `:23`,
+    shard (2,4) `:34-35`; B dim0 over X `:29`; C row-sharded over X, replicated
+    over Y — shard (2,4) asserted `:52`, cross-X shards differ `:59`).
+    """
+
+    def test_shard_shapes_and_result(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, x=0, y=1))  # (2,4) shards
+        b = put(b_np, shard_dims(mesh24, 2, x=0))       # (8,4) shards
+        assert_shard_shape(a, (2, 4))
+        assert_shard_shape(b, (8, 4))
+        c = jax.jit(_dot)(a, b)
+        np.testing.assert_allclose(np.asarray(c), a_np @ b_np, rtol=1e-5)
+        assert_shard_shape(c, (2, 4))
+        # 2 distinct row-blocks, each replicated 4× over Y (case2.py:48-59).
+        assert unique_shard_count(c) == 2
+
+
+class TestCase3:
+    """Both operands fully 2D-sharded → fully sharded output, zero redundancy.
+
+    This is the sharding pattern underlying FSDP/ZeRO shown on a single matmul
+    (SURVEY.md §2.4). Reference: `/root/reference/case3_fully_sharded.py`
+    (A `:23` shard (2,4); B `:29` shard (8,1) `:41`; C shard (2,1) `:52`;
+    every device holds a distinct tile `:58-60`).
+    """
+
+    def test_shard_shapes_and_result(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, shard_dims(mesh24, 2, x=0, y=1))
+        b = put(b_np, shard_dims(mesh24, 2, x=0, y=1))
+        assert_shard_shape(a, (2, 4))
+        assert_shard_shape(b, (8, 1))
+        c = jax.jit(_dot)(a, b)
+        np.testing.assert_allclose(np.asarray(c), a_np @ b_np, rtol=1e-5)
+        assert_shard_shape(c, (2, 1))
+        assert unique_shard_count(c) == 8  # distinct tile per device
+
+
+class TestCase4:
+    """GSPMD §3.2: DP operand × TP operand → combined data+model parallelism.
+
+    Reference: `/root/reference/case4_gspmd_ff.py` (einsum warmup `:26-33`;
+    A row-split over X `:46` shard (2,16); B col-split over Y `:49` shard
+    (16,1); C fully 2D-sharded (2,1) with no collective needed `:52-58`).
+    """
+
+    def test_batched_einsum(self, rng):
+        a = rng.standard_normal((8, 4, 16)).astype(np.float32)
+        b = rng.standard_normal((8, 16, 4)).astype(np.float32)
+        c = jnp.einsum("ABC,ACD->ABD", a, b)
+        assert c.shape == (8, 4, 4)  # case4_gspmd_ff.py:32
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4)
+
+    def test_dp_mp_ff_projection(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, row_sharded(mesh24, "x"))
+        b = put(b_np, col_sharded(mesh24, "y"))
+        assert_shard_shape(a, (2, 16))
+        assert_shard_shape(b, (16, 1))
+        c = jax.jit(_dot)(a, b)
+        np.testing.assert_allclose(np.asarray(c), a_np @ b_np, rtol=1e-5)
+        assert_shard_shape(c, (2, 1))
+
+    def test_no_collective_needed(self, mesh24, rng):
+        a_np, b_np = _operands(rng)
+        a = put(a_np, row_sharded(mesh24, "x"))
+        b = put(b_np, col_sharded(mesh24, "y"))
+        assert_collectives(
+            _dot, a, b, forbid=("all-reduce", "all-gather", "reduce-scatter")
+        )
+
+
+class TestShardingHelpers:
+    def test_replicated(self, mesh24, rng):
+        x = put(rng.standard_normal((4, 4)).astype(np.float32), replicated(mesh24))
+        assert_replicated(x)
+        assert shard_shapes(x) == [(4, 4)] * 8
+
+    def test_tupled_axes_split(self, mesh24, rng):
+        # One array dim split 8-way using BOTH mesh axes — the NamedSharding
+        # equivalent of PositionalSharding.reshape (case1a.py:30, SURVEY §7).
+        x = put(rng.standard_normal((16, 4)).astype(np.float32),
+                mesh_sharding(mesh24, ("x", "y"), None))
+        assert_shard_shape(x, (2, 4))
+
+    def test_shard_dims_validation(self, mesh24):
+        with pytest.raises(ValueError):
+            shard_dims(mesh24, 2, bogus=0)
+        with pytest.raises(ValueError):
+            shard_dims(mesh24, 2, x=5)
