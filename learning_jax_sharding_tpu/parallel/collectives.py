@@ -1,0 +1,164 @@
+"""Explicit collective matmuls — the teaching layer GSPMD keeps implicit.
+
+The reference never calls a collective; XLA's SPMD partitioner inserts them
+from sharding annotations, and each case file merely *narrates* the choice
+(`/root/reference/case1a.py:57-59` AllReduce, `/root/reference/case1b.py:55-57`
+AllGather, `/root/reference/case2.py:57` / `case3_fully_sharded.py:57` /
+`case4_gspmd_ff.py:52-58` none). This module makes those narrations literal:
+each function computes the same product as its case's implicit-GSPMD matmul,
+but with the collective written out via ``jax.shard_map`` + ``lax`` primitives.
+
+On TPU these collectives lower to ICI transfers (intra-slice) / DCN
+(cross-slice) — the same wires the implicit versions use; the point of this
+layer is pedagogy plus an escape hatch for manual scheduling (e.g. the
+latency-hiding ring matmul, which GSPMD cannot express).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def psum_matmul(a: jax.Array, b: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
+    """Case-1a made explicit: contraction dim of both operands sharded over
+    ``axis`` → local partial matmuls + AllReduce → replicated output.
+
+    Implicit counterpart: `/root/reference/case1a.py:49` with the shardings at
+    `:24,:30`; the AllReduce this writes out is the one narrated at `:57-59`.
+    """
+
+    def local(a_blk, b_blk):
+        return lax.psum(a_blk @ b_blk, axis)
+
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P(None, axis), P(axis, None)), out_specs=P()
+    )(a, b)
+
+
+def allgather_matmul(
+    a: jax.Array,
+    b: jax.Array,
+    *,
+    mesh: Mesh,
+    a_axis: str | None = None,
+    b_axis: str | None = None,
+) -> jax.Array:
+    """Case-1b made explicit: mismatched contraction shardings → AllGather the
+    shards back to full operands, then one local matmul → replicated output.
+
+    Implicit counterpart: `/root/reference/case1b.py:46-57` (A's contraction
+    dim split over Y, B's over X; GSPMD resolves the mismatch by gathering).
+
+    ``check_vma=False``: after ``all_gather`` every device provably holds the
+    same full operands, but shard_map's static replication checker cannot see
+    that, so the replicated ``out_specs`` must opt out of the check.
+    """
+
+    def local(a_blk, b_blk):
+        a_full = lax.all_gather(a_blk, a_axis, axis=1, tiled=True) if a_axis else a_blk
+        b_full = lax.all_gather(b_blk, b_axis, axis=0, tiled=True) if b_axis else b_blk
+        return a_full @ b_full
+
+    in_specs = (P(None, a_axis), P(b_axis, None))
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=P(), check_vma=False
+    )(a, b)
+
+
+def reduce_scatter_matmul(
+    a: jax.Array, b: jax.Array, *, mesh: Mesh, axis: str, scatter_dim: int = 0
+) -> jax.Array:
+    """Contraction-sharded matmul whose partial sums are reduce-scattered
+    instead of all-reduced → output arrives sharded over ``axis``.
+
+    No reference case does this (the reference's outputs are replicated or
+    tile-sharded with no reduction); it is the memory-optimal half of case 1a
+    and the building block of overlapped TP matmuls — included because on TPU
+    a ReduceScatter costs half an AllReduce and the output often wants to stay
+    sharded anyway (SURVEY.md §2.5).
+    """
+
+    def local(a_blk, b_blk):
+        return lax.psum_scatter(
+            a_blk @ b_blk, axis, scatter_dimension=scatter_dim, tiled=True
+        )
+
+    out_spec = [None, None]
+    out_spec[scatter_dim] = axis
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P(None, axis), P(axis, None)), out_specs=P(*out_spec)
+    )(a, b)
+
+
+def dp_tp_matmul(a: jax.Array, b: jax.Array, *, mesh: Mesh, dp_axis: str, tp_axis: str) -> jax.Array:
+    """Case-4 made explicit: data-parallel rows × tensor-parallel columns.
+
+    Each device multiplies its (rows/dp, K) block by its (K, cols/tp) block;
+    the output is born fully 2D-sharded and **no collective is needed** — the
+    explicit form of `/root/reference/case4_gspmd_ff.py:52-58` (GSPMD §3.2).
+    """
+
+    def local(a_blk, b_blk):
+        return a_blk @ b_blk
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(dp_axis, None), P(None, tp_axis)),
+        out_specs=P(dp_axis, tp_axis),
+    )(a, b)
+
+
+def ring_allgather_matmul(
+    a: jax.Array, b: jax.Array, *, mesh: Mesh, axis: str
+) -> jax.Array:
+    """Latency-hiding ring matmul: overlap each AllGather step with compute.
+
+    B is row(contraction)-sharded over ``axis`` and is **never materialized
+    whole on any device**: instead of gathering it up front (case-1b style),
+    each device multiplies the B shard it currently holds while ``ppermute``
+    rotates the shards around the ring — after ``n`` steps every device has
+    accumulated the full product. A is replicated (each device slices the
+    column block matching its current B shard), so the memory saving is on B
+    and the win is comm/compute overlap: each hop is a neighbor ICI transfer
+    running concurrently with the MXU work — the "collective matmul" pattern
+    GSPMD cannot schedule explicitly.
+
+    Returns the replicated product (same result/placement as case 1a/1b).
+    """
+    n = mesh.shape[axis]
+
+    def local(a_blk, b_blk):
+        # a_blk: (M, K/n) — this device's contraction slice of A.
+        # b_blk: (K/n, N) — the matching slice of B, rotated each step.
+        idx = lax.axis_index(axis)
+
+        def step(i, carry):
+            acc, b_cur = carry
+            # Which contraction slice are we holding at step i? Device d holds
+            # slice (d + i) mod n after i forward rotations.
+            k = (idx + i) % n
+            a_slice = lax.dynamic_slice_in_dim(
+                a_blk, k * b_cur.shape[0], b_cur.shape[0], axis=1
+            )
+            acc = acc + a_slice @ b_cur
+            b_nxt = lax.ppermute(
+                b_cur, axis, [((j + 1) % n, j) for j in range(n)]
+            )
+            return acc, b_nxt
+
+        acc0 = jnp.zeros((a_blk.shape[0], b_blk.shape[1]), dtype=a_blk.dtype)
+        acc, _ = lax.fori_loop(0, n, step, (acc0, b_blk))
+        return acc
+
+    # Keep A fully replicated per device along the non-contraction axes but
+    # give each device ALL of A's columns (we slice locally per step); B is
+    # row-sharded and rotated. out is device-invariant after the full ring.
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(P(), P(axis, None)), out_specs=P(), check_vma=False
+    )(a, b)

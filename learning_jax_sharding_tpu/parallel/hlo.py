@@ -75,6 +75,9 @@ def assert_collectives(
 
     Returns the full count dict for further inspection.
     """
+    for op in (*(expect or ()), *forbid, *require):
+        if op not in COLLECTIVE_OPS:
+            raise ValueError(f"unknown collective op {op!r}; valid: {COLLECTIVE_OPS}")
     counts = collective_counts(fn_or_hlo, *args, **kwargs)
     if expect:
         for op, n in expect.items():

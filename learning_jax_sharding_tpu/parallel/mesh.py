@@ -60,7 +60,9 @@ def force_emulated_devices(n: int, *, platform: str = "cpu") -> None:
             device count (the flag would be silently ignored).
     """
     flag = f"--xla_force_host_platform_device_count={n}"
+    had_flags = "XLA_FLAGS" in os.environ
     existing = os.environ.get("XLA_FLAGS", "")
+    prev_platform = jax.config.jax_platforms
     if "--xla_force_host_platform_device_count" in existing:
         updated = re.sub(
             r"--xla_force_host_platform_device_count=\d+", flag, existing
@@ -71,6 +73,12 @@ def force_emulated_devices(n: int, *, platform: str = "cpu") -> None:
     jax.config.update("jax_platforms", platform)
     devices = jax.devices()
     if len(devices) != n:
+        # Don't leak the failed configuration into process env / subprocesses.
+        if had_flags:
+            os.environ["XLA_FLAGS"] = existing
+        else:
+            del os.environ["XLA_FLAGS"]
+        jax.config.update("jax_platforms", prev_platform)
         raise RuntimeError(
             f"requested {n} emulated {platform} devices but backend already "
             f"initialized with {len(devices)}; call force_emulated_devices() "
@@ -128,6 +136,11 @@ def build_mesh(
     if n > len(devices):
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     if n < len(devices):
+        warnings.warn(
+            f"mesh shape {shape} uses only {n} of {len(devices)} devices; "
+            "the rest stay idle",
+            stacklevel=2,
+        )
         devices = devices[:n]
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)

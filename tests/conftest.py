@@ -31,6 +31,16 @@ def mesh22():
     return build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
 
 
-@pytest.fixture(scope="session")
+@pytest.fixture()
 def rng():
+    # Function-scoped: every test sees the same deterministic stream
+    # regardless of execution order.
     return np.random.default_rng(0)
+
+
+def matmul_operands(rng, m=4, k=16, n=4):
+    """The A(4,16)·B(16,4) operand pair of cases 1a-4
+    (`/root/reference/case1a.py:17-18`), shared across test modules."""
+    a = rng.standard_normal((m, k)).astype(np.float32)
+    b = rng.standard_normal((k, n)).astype(np.float32)
+    return a, b

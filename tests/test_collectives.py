@@ -1,0 +1,96 @@
+"""Explicit shard_map collectives vs numpy and vs the implicit-GSPMD results.
+
+Each explicit form must (a) match the dense product, (b) actually contain its
+named collective in the compiled HLO — turning the reference's prose
+narrations (`/root/reference/case1a.py:57-59`, `case1b.py:55-57`) into checked
+facts about our own explicit implementations too.
+"""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from tests.conftest import matmul_operands
+
+from learning_jax_sharding_tpu.parallel import (
+    assert_collectives,
+    assert_shard_shape,
+    collective_counts,
+)
+from learning_jax_sharding_tpu.parallel.collectives import (
+    allgather_matmul,
+    dp_tp_matmul,
+    psum_matmul,
+    reduce_scatter_matmul,
+    ring_allgather_matmul,
+)
+
+
+
+
+class TestPsumMatmul:
+    def test_matches_dense(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        c = psum_matmul(a, b, mesh=mesh24, axis="y")
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-5)
+
+    def test_emits_allreduce(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        fn = partial(psum_matmul, mesh=mesh24, axis="y")
+        assert_collectives(fn, a, b, require=("all-reduce",))
+
+
+class TestAllGatherMatmul:
+    def test_matches_dense(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        c = allgather_matmul(a, b, mesh=mesh24, a_axis="y", b_axis="x")
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-5)
+
+    def test_emits_allgather(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        fn = partial(allgather_matmul, mesh=mesh24, a_axis="y", b_axis="x")
+        assert_collectives(fn, a, b, require=("all-gather",))
+
+
+class TestReduceScatterMatmul:
+    def test_matches_dense_and_sharded_output(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        c = reduce_scatter_matmul(a, b, mesh=mesh24, axis="y")
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-5)
+        # Output arrives row-sharded over y (4-way on dim 0 of (4,4)).
+        assert_shard_shape(c, (1, 4))
+
+    def test_emits_reduce_scatter(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        fn = partial(reduce_scatter_matmul, mesh=mesh24, axis="y")
+        counts = collective_counts(fn, a, b)
+        # XLA may lower psum_scatter as reduce-scatter or as all-reduce +
+        # dynamic-slice; on TPU it is reduce-scatter. Accept either lowering
+        # but require that a reduction collective exists.
+        assert counts["reduce-scatter"] + counts["all-reduce"] >= 1, counts
+
+
+class TestDpTpMatmul:
+    def test_matches_dense_no_collective(self, mesh24, rng):
+        a, b = matmul_operands(rng)
+        c = dp_tp_matmul(a, b, mesh=mesh24, dp_axis="x", tp_axis="y")
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-5)
+        assert_shard_shape(c, (2, 1))  # born fully 2D-sharded (case4 oracle)
+        fn = partial(dp_tp_matmul, mesh=mesh24, dp_axis="x", tp_axis="y")
+        assert_collectives(
+            fn, a, b, forbid=("all-reduce", "all-gather", "reduce-scatter")
+        )
+
+
+class TestRingAllGatherMatmul:
+    def test_matches_dense(self, mesh24, rng):
+        a, b = matmul_operands(rng, m=8, k=16, n=8)
+        c = ring_allgather_matmul(a, b, mesh=mesh24, axis="y")
+        # Ring accumulation reorders the K-dim sum; allow absolute slack too.
+        np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_emits_collective_permute(self, mesh24, rng):
+        a, b = matmul_operands(rng, m=8, k=16, n=8)
+        fn = partial(ring_allgather_matmul, mesh=mesh24, axis="y")
+        assert_collectives(fn, a, b, require=("collective-permute",))

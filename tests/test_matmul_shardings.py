@@ -9,6 +9,8 @@ collective checks the reference never had. Reference cites per test.
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from tests.conftest import matmul_operands
 import pytest
 
 from learning_jax_sharding_tpu.parallel import (
@@ -30,10 +32,6 @@ def _dot(a, b):
     return jax.lax.dot(a, b)
 
 
-def _operands(rng, m=4, k=16, n=4):
-    a = rng.standard_normal((m, k)).astype(np.float32)
-    b = rng.standard_normal((k, n)).astype(np.float32)
-    return a, b
 
 
 class TestCase1a:
@@ -45,7 +43,7 @@ class TestCase1a:
     """
 
     def test_shard_shapes_and_result(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, y=1))  # A(4,16): inner dim 4-way over Y
         b = put(b_np, shard_dims(mesh24, 2, y=0))  # B(16,4): inner dim 4-way over Y
         assert_shard_shape(a, (4, 4))
@@ -57,7 +55,7 @@ class TestCase1a:
         assert unique_shard_count(c) == 1
 
     def test_allreduce_inserted(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, y=1))
         b = put(b_np, shard_dims(mesh24, 2, y=0))
         assert_collectives(_dot, a, b, require=("all-reduce",), forbid=("all-gather",))
@@ -72,7 +70,7 @@ class TestCase1b:
     """
 
     def test_shard_shapes_and_result(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, y=1))   # (4,4) shards
         b = put(b_np, shard_dims(mesh24, 2, x=0))   # (8,4) shards
         assert_shard_shape(a, (4, 4))
@@ -82,7 +80,7 @@ class TestCase1b:
         assert_replicated(c)
 
     def test_allgather_inserted(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, y=1))
         b = put(b_np, shard_dims(mesh24, 2, x=0))
         assert_collectives(_dot, a, b, require=("all-gather",))
@@ -97,7 +95,7 @@ class TestCase2:
     """
 
     def test_shard_shapes_and_result(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, x=0, y=1))  # (2,4) shards
         b = put(b_np, shard_dims(mesh24, 2, x=0))       # (8,4) shards
         assert_shard_shape(a, (2, 4))
@@ -119,7 +117,7 @@ class TestCase3:
     """
 
     def test_shard_shapes_and_result(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, shard_dims(mesh24, 2, x=0, y=1))
         b = put(b_np, shard_dims(mesh24, 2, x=0, y=1))
         assert_shard_shape(a, (2, 4))
@@ -146,7 +144,7 @@ class TestCase4:
         np.testing.assert_allclose(np.asarray(c), a @ b, rtol=1e-4)
 
     def test_dp_mp_ff_projection(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, row_sharded(mesh24, "x"))
         b = put(b_np, col_sharded(mesh24, "y"))
         assert_shard_shape(a, (2, 16))
@@ -156,7 +154,7 @@ class TestCase4:
         assert_shard_shape(c, (2, 1))
 
     def test_no_collective_needed(self, mesh24, rng):
-        a_np, b_np = _operands(rng)
+        a_np, b_np = matmul_operands(rng)
         a = put(a_np, row_sharded(mesh24, "x"))
         b = put(b_np, col_sharded(mesh24, "y"))
         assert_collectives(
