@@ -1,0 +1,118 @@
+"""Logically partitioned multi-head attention (the case-5/6 model, L4).
+
+Rebuilds the reference's ``FlaxAttention``
+(`/root/reference/case6_attention.py:42-143`, minimal form
+`/root/reference/case5_attention_dense.py:41-71`) as a framework module:
+
+* Q/K/V projections with logical kernel axes ``(EMBED, HEADS)`` and output
+  projection ``(HEADS, EMBED)`` — matching `case6_attention.py:56-90`, so the
+  case-6 parity oracles hold (Wq (640,512) → shard (320,512) under the
+  reference rules on a 2×2 mesh, SURVEY.md §8);
+* activation sharding constraints between every stage
+  (`case6_attention.py:105-116,137,141`), expressed with honest axis names
+  (``SEQ`` for the sequence dim — see logical.py's design note);
+* fp32 softmax upcast (`case6_attention.py:121-130`) via ``ops.attention``;
+* selectable attention backend: dense einsum attention (reference semantics),
+  or the Pallas flash kernel for long sequences.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
+from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
+
+
+class MultiHeadAttention(nn.Module):
+    """Multi-head self-attention with logical partitioning.
+
+    Attributes:
+        features: residual-stream width M (the reference's M=640,
+            `/root/reference/case6_attention.py:151`).
+        num_heads: attention heads N (reference: 8, `case6_attention.py:44`).
+        head_dim: per-head width H (reference: 64, `case6_attention.py:45`).
+        dropout_rate: output dropout (reference: 0.1, `case6_attention.py:91`).
+        causal: apply a causal mask (reference attention is bidirectional;
+            the case-7 transformer sets this True).
+        dtype: computation dtype (bf16 on TPU for MXU throughput; softmax
+            still runs fp32 via the op).
+        param_dtype: parameter storage dtype.
+        attn_fn: attention backend taking (q, k, v, mask=...) shaped
+            (B, S, N, H); defaults to the dense einsum op.
+    """
+
+    features: int
+    num_heads: int = 8
+    head_dim: int = 64
+    dropout_rate: float = 0.0
+    causal: bool = False
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    attn_fn: Optional[Callable] = None
+
+    @property
+    def inner_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    def _proj(self, name: str) -> nn.Dense:
+        # Kernel (M, N*H) carries logical axes (EMBED, HEADS): under the
+        # reference rules EMBED→model splits its rows
+        # (`/root/reference/case6_attention.py:56-59`); under Megatron-style
+        # rules HEADS→model splits its columns.
+        return nn.Dense(
+            self.inner_dim,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, HEADS)),
+            name=name,
+        )
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        b, s, m = x.shape
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+
+        q = self._proj("query")(x)
+        k = self._proj("key")(x)
+        v = self._proj("value")(x)
+        # Projections emerge (B, S, N*H); constrain before the head split
+        # (the reference constrains the same three activations,
+        # `case6_attention.py:105-116`, but names dim 1 'embed').
+        q = nn.with_logical_constraint(q, (BATCH, SEQ, HEADS))
+        k = nn.with_logical_constraint(k, (BATCH, SEQ, HEADS))
+        v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS))
+
+        q = q.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.num_heads, self.head_dim)
+        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        q = nn.with_logical_constraint(q, (BATCH, SEQ, HEADS, KV))
+        k = nn.with_logical_constraint(k, (BATCH, SEQ, HEADS, KV))
+        v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS, KV))
+
+        mask = causal_mask(s) if self.causal else None
+        attn = self.attn_fn or dot_product_attention
+        out = attn(q, k, v, mask=mask)
+        out = nn.with_logical_constraint(out, (BATCH, SEQ, HEADS, KV))
+        out = out.reshape(b, s, self.inner_dim)
+
+        # Output projection (N*H, M) with logical (HEADS, EMBED)
+        # (`case6_attention.py:83-90`).
+        out = nn.Dense(
+            self.features,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(self.kernel_init, (HEADS, EMBED)),
+            name="out",
+        )(out)
+        out = nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
+        if self.dropout_rate > 0.0:
+            out = nn.Dropout(rate=self.dropout_rate, deterministic=deterministic)(out)
+        return out

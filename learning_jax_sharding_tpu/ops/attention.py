@@ -1,0 +1,74 @@
+"""Dense (fully materialized) multi-head attention op.
+
+The compute core of the reference's case 6: two einsums with an fp32-upcast
+softmax between them (`/root/reference/case6_attention.py:121-133`). Kept as a
+standalone functional op so the model layer can swap backends (dense here,
+Pallas flash attention or ring attention elsewhere in ``ops/``) without
+touching parameter logic.
+
+Scores materialize as (B, N, Q, K) — fine up to a few thousand tokens, O(S²)
+memory beyond that; the flash/ring backends exist for the long-context regime
+the reference cannot reach (SURVEY.md §2.4 "Context parallelism").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    scale: float | None = None,
+    mask: jax.Array | None = None,
+    softmax_dtype: jnp.dtype = jnp.float32,
+) -> jax.Array:
+    """Scaled dot-product attention over (batch, seq, heads, head_dim) inputs.
+
+    Args:
+        q: queries ``(B, Q, N, H)``.
+        k: keys ``(B, K, N, H)``.
+        v: values ``(B, K, N, H)``.
+        scale: score scale; defaults to ``H ** -0.5``. (The reference computes
+            a scale but never applies it — `/root/reference/case5_attention_dense.py:50`
+            is unused; here scaling is on by default and explicit.)
+        mask: optional boolean mask broadcastable to ``(B, N, Q, K)``; True
+            keeps, False masks to -inf.
+        softmax_dtype: dtype for score softmax. The fp32 upcast for bf16
+            stability follows `/root/reference/case6_attention.py:121-130`.
+
+    Returns:
+        ``(B, Q, N, H)`` attention output in ``q.dtype``.
+    """
+    out_dtype = q.dtype
+    head_dim = q.shape[-1]
+    scale = head_dim**-0.5 if scale is None else scale
+
+    q = q.astype(softmax_dtype) * jnp.asarray(scale, softmax_dtype)
+    k = k.astype(softmax_dtype)
+    # (B,Q,N,H) x (B,K,N,H) -> (B,N,Q,K): the reference's first einsum
+    # ("b t n h, b f n h -> b n f t", case6_attention.py:125) up to operand
+    # order / letter naming.
+    scores = jnp.einsum("bqnh,bknh->bnqk", q, k)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
+    weights = jax.nn.softmax(scores, axis=-1)
+    # (B,N,Q,K) x (B,K,N,H) -> (B,Q,N,H): the second einsum
+    # ("b n f t, b t n h -> b f n h", case6_attention.py:133).
+    out = jnp.einsum("bnqk,bknh->bqnh", weights.astype(out_dtype), v.astype(out_dtype))
+    return out
+
+
+def causal_mask(q_len: int, k_len: int | None = None) -> jax.Array:
+    """Lower-triangular causal mask ``(1, 1, Q, K)`` (True = attend).
+
+    Not present in the reference (its attention is fully bidirectional); the
+    composed transformer (case 7) trains causally, so it lives here.
+    """
+    k_len = q_len if k_len is None else k_len
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(k_len)[None, :]
+    return (j <= i)[None, None, :, :]

@@ -1,0 +1,165 @@
+"""Sharded-init / train_step / apply pipeline (L5).
+
+The reference's central training pattern, promoted to API
+(`/root/reference/case6_attention.py:171-237`):
+
+1. build the TrainState **abstractly** with ``jax.eval_shape`` — no device
+   memory touched (`case6_attention.py:189`);
+2. read logical specs off the abstract tree and map them through the rules to
+   real shardings (`case6_attention.py:190-191`);
+3. jit the real init with those shardings as ``out_shardings`` — parameters
+   and optimizer moments are **born sharded**, never materialized replicated
+   (`case6_attention.py:192-196`);
+4. jit ``train_step`` / ``apply_fn`` with matching in/out shardings so each
+   step is one SPMD executable with all collectives inside
+   (`case6_attention.py:206-215,229-232`).
+
+Additions over the reference: donation of the incoming state (in-place buffer
+reuse — on TPU this halves peak optimizer-state HBM), a loss that is actually
+returned (the reference's train_step discards it, SURVEY.md §5 "Metrics"), and
+mesh/rules handled by one context helper instead of repeated ``with`` pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding
+
+from learning_jax_sharding_tpu.parallel.logical import (
+    Rules,
+    activate,
+    tree_shardings,
+)
+
+TrainState = train_state.TrainState
+
+
+def default_loss(y: jax.Array) -> jax.Array:
+    """The reference's loss: ``y.sum()`` (`/root/reference/case6_attention.py:210-211`).
+
+    A stand-in that exercises the full backward; real tasks supply their own.
+    """
+    return jnp.sum(y)
+
+
+def sharded_train_state(
+    model: Any,
+    optimizer: optax.GradientTransformation,
+    x: jax.Array,
+    rngs: dict[str, jax.Array],
+    mesh: Mesh,
+    rules: Rules,
+) -> tuple[TrainState, Any]:
+    """Create a TrainState whose every leaf is born sharded.
+
+    Args:
+        model: a Flax module with logically partitioned params.
+        optimizer: optax transformation (reference uses Adam(1e-3),
+            `/root/reference/case6_attention.py:181`).
+        x: sample input, already placed with its sharding (its placement is
+            what the jitted init sees as ``in_shardings``).
+        rngs: init PRNG keys, e.g. ``{"params": key}``.
+        mesh: device mesh.
+        rules: logical→mesh rules.
+
+    Returns:
+        ``(state, state_shardings)`` — the sharded TrainState and the matching
+        sharding tree (reused as in/out shardings for the step functions).
+    """
+
+    def boxed_init(rngs, x):
+        variables = model.init(rngs, x)
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=optimizer
+        )
+
+    def init_fn(rngs, x):
+        # The logical axis names live in flax's LogicallyPartitioned boxes;
+        # they are read off the *abstract* tree below, so the real state can
+        # carry plain arrays (unboxed) — optimizer and step functions then see
+        # ordinary pytrees.
+        return nn.meta.unbox(boxed_init(rngs, x))
+
+    with activate(mesh, rules):
+        abstract = jax.eval_shape(boxed_init, rngs, x)
+        state_shardings = tree_shardings(abstract, mesh, rules)
+        jit_init = jax.jit(
+            init_fn,
+            in_shardings=(NamedSharding(mesh, jax.sharding.PartitionSpec()), x.sharding),
+            out_shardings=state_shardings,
+        )
+        state = jit_init(rngs, x)
+    return state, state_shardings
+
+
+def make_train_step(
+    state_shardings: Any,
+    x_sharding: NamedSharding,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    loss_fn: Callable[[jax.Array], jax.Array] = default_loss,
+    donate_state: bool = True,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
+    """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
+
+    Mirrors `/root/reference/case6_attention.py:206-215` with two fixes: the
+    loss is returned (not discarded) and the incoming state is donated so
+    parameter/moment buffers are updated in place.
+    """
+
+    def step(state: TrainState, x: jax.Array):
+        def loss_of_params(params):
+            y = state.apply_fn({"params": params}, x)
+            return loss_fn(y)
+
+        loss, grads = jax.value_and_grad(loss_of_params)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_shardings, x_sharding),
+        out_shardings=(state_shardings, NamedSharding(mesh, jax.sharding.PartitionSpec())),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+    def run(state: TrainState, x: jax.Array):
+        with activate(mesh, rules):
+            return jitted(state, x)
+
+    run.jitted = jitted  # expose for lowering/HLO inspection
+    return run
+
+
+def make_apply_fn(
+    state_shardings: Any,
+    x_sharding: NamedSharding,
+    mesh: Mesh,
+    rules: Rules,
+) -> Callable[[TrainState, jax.Array], jax.Array]:
+    """Build the jitted forward: ``apply_fn(state, x) -> y``, y sharded like x.
+
+    Mirrors `/root/reference/case6_attention.py:229-232`.
+    """
+
+    def fwd(state: TrainState, x: jax.Array):
+        return state.apply_fn({"params": state.params}, x)
+
+    jitted = jax.jit(
+        fwd,
+        in_shardings=(state_shardings, x_sharding),
+        out_shardings=x_sharding,
+    )
+
+    def run(state: TrainState, x: jax.Array):
+        with activate(mesh, rules):
+            return jitted(state, x)
+
+    run.jitted = jitted
+    return run
