@@ -1,0 +1,283 @@
+"""Composed transformer (case 7): the FF + attention blocks as one model.
+
+The reference stops at a standalone attention module
+(`/root/reference/case6_attention.py:42-143`) and a standalone GSPMD
+feed-forward matmul (`/root/reference/case4_gspmd_ff.py:36-58`); the driver's
+north star composes them into "a minimal transformer training step under a 2D
+(data × model) mesh … ≥45% MFU" (`/root/repo/BASELINE.json`). This module is
+that composition:
+
+* :class:`FeedForward` — the case-4 DP×MP projection as a module: up-kernel
+  logically ``(EMBED, MLP)`` (column-parallel), down-kernel ``(MLP, EMBED)``
+  (row-parallel) — under ``RULES_DP_TP`` each token crosses the model axis
+  once per block, the GSPMD §3.2 pattern;
+* :class:`TransformerBlock` — pre-LayerNorm attention + FF with residuals;
+* :class:`Transformer` — token embedding, N blocks (optionally rematerialized),
+  final norm, logits head: the 125M-parameter flagship configuration of
+  `BASELINE.json` ("case4+case6 composed 125M transformer").
+
+Everything is dtype-parameterized: bf16 compute / fp32 params is the TPU MXU
+sweet spot and the default for benchmarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
+from learning_jax_sharding_tpu.parallel.logical import (
+    BATCH,
+    EMBED,
+    HIDDEN,
+    MLP,
+    SEQ,
+    VOCAB,
+)
+
+
+class FeedForward(nn.Module):
+    """Position-wise FF: up-project → GELU → down-project.
+
+    The case-4 feed-forward (`/root/reference/case4_gspmd_ff.py:36-58`) grown
+    into a real module: with MLP→model rules the up-projection is
+    column-parallel and the down-projection row-parallel, so its output
+    arrives as partial sums that GSPMD all-reduces (or reduce-scatters under
+    sequence sharding) — one collective per block, the minimum for TP.
+    """
+
+    features: int
+    hidden: int
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        h = nn.Dense(
+            self.hidden,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, MLP)),
+            name="up",
+        )(x)
+        h = nn.with_logical_constraint(h, (BATCH, SEQ, HIDDEN))
+        h = nn.gelu(h)
+        out = nn.Dense(
+            self.features,
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=nn.with_logical_partitioning(self.kernel_init, (MLP, EMBED)),
+            name="down",
+        )(h)
+        return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN block: x + Attn(LN(x)); x + FF(LN(x)).
+
+    The composition BASELINE.json names "case4+case6": case-6's logically
+    partitioned attention and case-4's DP×MP feed-forward, joined by residuals
+    and LayerNorms (neither exists in the reference).
+    """
+
+    features: int
+    num_heads: int
+    head_dim: int
+    hidden: int
+    dropout_rate: float = 0.0
+    causal: bool = True
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        h = nn.LayerNorm(
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
+            name="ln_attn",
+        )(x)
+        x = x + MultiHeadAttention(
+            features=self.features,
+            num_heads=self.num_heads,
+            head_dim=self.head_dim,
+            dropout_rate=self.dropout_rate,
+            causal=self.causal,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            attn_fn=self.attn_fn,
+            name="attn",
+        )(h, deterministic=deterministic)
+        h = nn.LayerNorm(
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
+            name="ln_ff",
+        )(x)
+        x = x + FeedForward(
+            features=self.features,
+            hidden=self.hidden,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="ff",
+        )(h)
+        return nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Model hyperparameters (the reference hard-codes its dims inline,
+    `/root/reference/case6_attention.py:149-151`; SURVEY.md §5 asks for a
+    config object)."""
+
+    vocab_size: int = 50304          # GPT-2 vocab rounded up to a 128 multiple
+    num_layers: int = 12
+    features: int = 768
+    num_heads: int = 12
+    head_dim: int = 64
+    hidden: int = 3072
+    max_seq_len: int = 1024
+    dropout_rate: float = 0.0
+    causal: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = False              # rematerialize each block's activations
+    attn_fn: Optional[Callable] = None
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        per_block = (
+            4 * self.features * self.num_heads * self.head_dim  # qkv + out
+            + 2 * self.features * self.hidden                   # ff up + down
+            + 4 * self.features                                  # 2 LN scale+bias
+        )
+        embed = self.vocab_size * self.features + self.max_seq_len * self.features
+        head = self.features * self.vocab_size
+        return embed + self.num_layers * per_block + 2 * self.features + head
+
+
+#: The BASELINE.json flagship: "case4+case6 composed 125M transformer".
+#: 12 × 768 × 12 heads ≈ 124M parameters at GPT-2-small shape.
+CONFIG_125M = TransformerConfig()
+
+#: Small config for tests and the emulated-CPU dry run.
+CONFIG_TINY = TransformerConfig(
+    vocab_size=256,
+    num_layers=2,
+    features=64,
+    num_heads=4,
+    head_dim=16,
+    hidden=128,
+    max_seq_len=64,
+    dtype=jnp.float32,
+)
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM: embed → N blocks → final LN → logits.
+
+    Token embedding carries logical ``(VOCAB, EMBED)``; the logits head
+    ``(EMBED, VOCAB)`` — under TP rules mapping VOCAB→model the head is
+    column-parallel, keeping the big vocab matmul sharded.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        cfg = self.config
+        b, s = tokens.shape
+        if s > cfg.max_seq_len:
+            raise ValueError(f"sequence length {s} exceeds max_seq_len {cfg.max_seq_len}")
+
+        embed = nn.Embed(
+            cfg.vocab_size,
+            cfg.features,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (VOCAB, EMBED)
+            ),
+            name="tok_embed",
+        )
+        pos_embed = self.param(
+            "pos_embed",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (SEQ, EMBED)
+            ),
+            (cfg.max_seq_len, cfg.features),
+            cfg.param_dtype,
+        )
+        x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+
+        block_cls = TransformerBlock
+        if cfg.remat:
+            # Trade FLOPs for HBM: recompute each block's activations in the
+            # backward instead of storing them (SURVEY.md's remat note; key to
+            # fitting long sequences).
+            block_cls = nn.remat(TransformerBlock, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block_cls(
+                features=cfg.features,
+                num_heads=cfg.num_heads,
+                head_dim=cfg.head_dim,
+                hidden=cfg.hidden,
+                dropout_rate=cfg.dropout_rate,
+                causal=cfg.causal,
+                dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                attn_fn=cfg.attn_fn,
+                name=f"block_{i}",
+            )(x, deterministic=deterministic)
+
+        x = nn.LayerNorm(
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
+            name="ln_out",
+        )(x)
+        logits = nn.Dense(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(stddev=0.02), (EMBED, VOCAB)
+            ),
+            name="lm_head",
+        )(x)
+        # Keep the vocab dim sharded (VOCAB→model under TP rules): replicating
+        # logits here would all-gather ~0.8 GB/device at the 125M bench shape
+        # and the cross-entropy reductions partition fine.
+        return nn.with_logical_constraint(logits, (BATCH, SEQ, VOCAB))
+
+
+def next_token_loss(logits: jax.Array, batch: dict) -> jax.Array:
+    """Causal-LM loss: mean cross-entropy over all S positions.
+
+    ``batch["targets"]`` must ALREADY be the inputs shifted left by one (the
+    data pipeline's job — see ``tests/test_transformer.py::_batch``); no shift
+    happens here. Computed in fp32 regardless of compute dtype (same stability
+    reasoning as the reference's softmax upcast,
+    `/root/reference/case6_attention.py:121-122`).
+    """
+    logits = logits.astype(jnp.float32)
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["targets"]
+    ).mean()

@@ -38,6 +38,7 @@ KV = "kv"          # per-head feature dim (the reference's 'kv',
                    # `/root/reference/case5_attention_dense.py:61-63`)
 HIDDEN = "hidden"  # feed-forward hidden features
 MLP = "mlp"        # alias kept distinct for gated-FF variants
+VOCAB = "vocab"    # embedding rows / logits columns
 STAGE = "stage"    # pipeline stage (stretch, not in reference)
 EXPERT = "expert"  # MoE expert (stretch, not in reference)
 
@@ -60,6 +61,7 @@ RULES_DP_TP: Rules = (
     (HEADS, "model"),
     (HIDDEN, "model"),
     (MLP, "model"),
+    (VOCAB, "model"),
 )
 
 #: DP×TP plus intentional sequence sharding over the model axis between

@@ -40,12 +40,21 @@ from learning_jax_sharding_tpu.parallel.logical import (
 TrainState = train_state.TrainState
 
 
-def default_loss(y: jax.Array) -> jax.Array:
+def default_loss(y: jax.Array, batch: Any) -> jax.Array:
     """The reference's loss: ``y.sum()`` (`/root/reference/case6_attention.py:210-211`).
 
-    A stand-in that exercises the full backward; real tasks supply their own.
+    A stand-in that exercises the full backward; real tasks supply their own
+    ``loss_fn(y, batch)`` (e.g. next-token cross-entropy against
+    ``batch["targets"]``).
     """
+    del batch
     return jnp.sum(y)
+
+
+def _inputs_of(batch: Any) -> jax.Array:
+    """A batch is either the bare input array (the reference's convention) or
+    a dict with an ``"inputs"`` entry (plus e.g. ``"targets"``)."""
+    return batch["inputs"] if isinstance(batch, dict) else batch
 
 
 def sharded_train_state(
@@ -104,20 +113,37 @@ def make_train_step(
     mesh: Mesh,
     rules: Rules,
     *,
-    loss_fn: Callable[[jax.Array], jax.Array] = default_loss,
+    loss_fn: Callable[[jax.Array, Any], jax.Array] = default_loss,
     donate_state: bool = True,
-) -> Callable[[TrainState, jax.Array], tuple[TrainState, jax.Array]]:
+    dropout_rng: jax.Array | None = None,
+) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
     Mirrors `/root/reference/case6_attention.py:206-215` with two fixes: the
     loss is returned (not discarded) and the incoming state is donated so
     parameter/moment buffers are updated in place.
+
+    ``x_sharding`` must match the batch structure (a single sharding for a
+    bare-array batch, or a dict of shardings for a dict batch).
+
+    ``dropout_rng``: pass a PRNG key to train with dropout active — the model
+    is then applied with ``deterministic=False`` and a per-step key folded in
+    from ``state.step`` (the model must accept a ``deterministic`` kwarg, as
+    all framework models do). Left ``None``, dropout stays off.
     """
 
-    def step(state: TrainState, x: jax.Array):
+    def step(state: TrainState, batch: Any):
         def loss_of_params(params):
-            y = state.apply_fn({"params": params}, x)
-            return loss_fn(y)
+            if dropout_rng is not None:
+                y = state.apply_fn(
+                    {"params": params},
+                    _inputs_of(batch),
+                    deterministic=False,
+                    rngs={"dropout": jax.random.fold_in(dropout_rng, state.step)},
+                )
+            else:
+                y = state.apply_fn({"params": params}, _inputs_of(batch))
+            return loss_fn(y, batch)
 
         loss, grads = jax.value_and_grad(loss_of_params)(state.params)
         return state.apply_gradients(grads=grads), loss
@@ -129,9 +155,9 @@ def make_train_step(
         donate_argnums=(0,) if donate_state else (),
     )
 
-    def run(state: TrainState, x: jax.Array):
+    def run(state: TrainState, batch: Any):
         with activate(mesh, rules):
-            return jitted(state, x)
+            return jitted(state, batch)
 
     run.jitted = jitted  # expose for lowering/HLO inspection
     return run

@@ -36,9 +36,9 @@ B, S, M = 8, 256, 640
 HEADS_N, HEAD_DIM = 8, 64
 
 
-def _setup(mesh22, rules, seq_rule_axes=(BATCH, SEQ, EMBED)):
+def _setup(mesh22, rules):
     model = MultiHeadAttention(features=M, num_heads=HEADS_N, head_dim=HEAD_DIM)
-    x_sharding = logical_sharding(mesh22, rules, *seq_rule_axes)
+    x_sharding = logical_sharding(mesh22, rules, BATCH, SEQ, EMBED)
     x = put(np.random.default_rng(1).standard_normal((B, S, M)).astype(np.float32),
             x_sharding)
     rngs = {"params": jax.random.key(0)}
@@ -135,7 +135,8 @@ class TestCase6Parity:
         )
         target = jnp.ones((B, S, M), jnp.float32)
 
-        def mse(y):
+        def mse(y, batch):
+            del batch
             return jnp.mean((y - target) ** 2)
 
         step = make_train_step(

@@ -1,0 +1,160 @@
+"""Case-7 composed transformer: sharded end-to-end training on a 2D mesh.
+
+The north-star composition (`/root/repo/BASELINE.json`): case-4 FF + case-6
+attention in one block, trained under data×model rules. Tests run the tiny
+config on the emulated mesh; the 125M flagship runs in bench.py on hardware.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    CONFIG_TINY,
+    Transformer,
+    TransformerConfig,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import (
+    assert_shard_shape,
+    collective_counts,
+    mesh_sharding,
+    put,
+    shard_shapes,
+)
+from learning_jax_sharding_tpu.parallel.logical import (
+    RULES_DP_TP,
+    activate,
+)
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+
+def _batch(mesh, cfg, b=8, s=32, seed=0):
+    rng = np.random.default_rng(seed)
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    return {
+        "inputs": put(tokens[:, :-1], sh),
+        "targets": put(tokens[:, 1:], sh),
+    }
+
+
+def _setup(mesh, cfg=CONFIG_TINY, b=8, s=32):
+    model = Transformer(cfg)
+    batch = _batch(mesh, cfg, b=b, s=s)
+    state, state_shardings = sharded_train_state(
+        model, optax.adamw(3e-4), batch["inputs"], {"params": jax.random.key(0)},
+        mesh, RULES_DP_TP,
+    )
+    batch_shardings = {k: v.sharding for k, v in batch.items()}
+    step = make_train_step(
+        state_shardings, batch_shardings, mesh, RULES_DP_TP, loss_fn=next_token_loss
+    )
+    return model, batch, state, state_shardings, step
+
+
+class TestTransformer:
+    def test_param_count_125m(self):
+        # BASELINE.json flagship: "composed 125M transformer".
+        assert 120e6 < CONFIG_125M.param_count < 165e6
+
+    def test_forward_shapes_and_tp_sharding(self, mesh22):
+        cfg = CONFIG_TINY
+        model, batch, state, _, _ = _setup(mesh22)
+        # FF up-kernel (EMBED, MLP): MLP→model splits columns (128 → 64).
+        up = state.params["block_0"]["ff"]["up"]["kernel"]
+        assert up.shape == (cfg.features, cfg.hidden)
+        assert_shard_shape(up, (cfg.features, cfg.hidden // 2))
+        # QKV kernel (EMBED, HEADS): HEADS→model splits columns.
+        wq = state.params["block_0"]["attn"]["query"]["kernel"]
+        assert_shard_shape(wq, (cfg.features, cfg.num_heads * cfg.head_dim // 2))
+        # Embedding (VOCAB, EMBED): VOCAB→model splits rows.
+        emb = state.params["tok_embed"]["embedding"]
+        assert_shard_shape(emb, (cfg.vocab_size // 2, cfg.features))
+
+    def test_training_descends(self, mesh22):
+        _, batch, state, _, step = _setup(mesh22)
+        losses = []
+        for _ in range(10):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        # Initial loss should be near uniform-prediction entropy ln(V).
+        assert abs(losses[0] - np.log(CONFIG_TINY.vocab_size)) < 1.0
+
+    def test_step_is_single_spmd_program_with_collectives(self, mesh22):
+        _, batch, state, _, step = _setup(mesh22)
+        with activate(mesh22, RULES_DP_TP):
+            counts = collective_counts(
+                step.jitted.lower(state, batch).compile().as_text()
+            )
+        # DP grad sync + TP activation reductions must be inside the step.
+        assert counts["all-reduce"] >= 1, counts
+
+    def test_remat_matches_no_remat(self, mesh22):
+        cfg = CONFIG_TINY
+        cfg_remat = TransformerConfig(**{**cfg.__dict__, "remat": True})
+        model, batch, state, _, step = _setup(mesh22, cfg)
+        _, _, state_r, _, step_r = _setup(mesh22, cfg_remat)
+        _, loss = step(state, batch)
+        _, loss_r = step_r(state_r, batch)
+        np.testing.assert_allclose(float(loss), float(loss_r), rtol=1e-5)
+
+    def test_causality(self, mesh22):
+        """Changing future tokens must not change past logits."""
+        cfg = CONFIG_TINY
+        model, batch, state, _, _ = _setup(mesh22)
+        tokens = np.asarray(batch["inputs"])
+        with activate(mesh22, RULES_DP_TP):
+            logits1 = model.apply({"params": state.params}, jnp.asarray(tokens))
+            tokens2 = tokens.copy()
+            tokens2[:, 16:] = (tokens2[:, 16:] + 1) % cfg.vocab_size
+            logits2 = model.apply({"params": state.params}, jnp.asarray(tokens2))
+        np.testing.assert_allclose(
+            np.asarray(logits1[:, :16]), np.asarray(logits2[:, :16]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_dropout_active_when_rng_given(self, mesh22):
+        """With dropout_rng the step runs deterministic=False and per-step
+        folded keys — two steps from the same state must see different
+        dropout masks (different losses on identical data)."""
+        cfg = TransformerConfig(**{**CONFIG_TINY.__dict__, "dropout_rate": 0.5})
+        model = Transformer(cfg)
+        batch = _batch(mesh22, cfg)
+        state, state_sh = sharded_train_state(
+            model, optax.adamw(3e-4), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        batch_sh = {k: v.sharding for k, v in batch.items()}
+        step = make_train_step(
+            state_sh, batch_sh, mesh22, RULES_DP_TP, loss_fn=next_token_loss,
+            dropout_rng=jax.random.key(7), donate_state=False,
+        )
+        _, loss0 = step(state, batch)
+        state1, _ = step(state, batch)
+        _, loss1 = step(state1, batch)  # state.step advanced → new mask
+        step_det = make_train_step(
+            state_sh, batch_sh, mesh22, RULES_DP_TP, loss_fn=next_token_loss,
+            donate_state=False,
+        )
+        _, loss_det = step_det(state, batch)
+        # dropout changes the loss vs deterministic, and masks differ by step
+        assert float(loss0) != float(loss_det)
+        assert float(loss0) != float(loss1)
+
+    def test_seq_len_guard(self, mesh22):
+        cfg = CONFIG_TINY
+        model = Transformer(cfg)
+        tokens = jnp.zeros((2, cfg.max_seq_len + 1), jnp.int32)
+        try:
+            model.init({"params": jax.random.key(0)}, tokens)
+            raise AssertionError("expected ValueError for overlong sequence")
+        except ValueError as e:
+            assert "max_seq_len" in str(e)
