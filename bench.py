@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Round benchmark: case-6 attention throughput on real TPU hardware.
+
+Prints ONE JSON line:
+    {"metric": "case6_attention_tflops_per_chip", "value": N,
+     "unit": "TFLOP/s/chip", "vs_baseline": R}
+
+* The workload is the reference's case-6 configuration — multi-head attention
+  at B=8, S=256, M=640, 8 heads × 64 (`/root/reference/case6_attention.py:44-45,
+  149-151`) — measured with a correct harness (warmup excluded, devices
+  synced; the reference's own loop at `case6_attention.py:234-238` has neither).
+* ``value`` is this framework's TPU-native path: bf16 compute, fp32-upcast
+  softmax, K forward applications chained inside one jitted program so device
+  time, not dispatch latency, is measured.
+* ``vs_baseline`` compares against a reference-faithful baseline implementation
+  (fp32 compute, same math) timed with the same correct harness in the same
+  run — the reference publishes no numbers of its own (BASELINE.md).
+
+Extra context (125M composed-transformer train-step MFU, the BASELINE.json
+north star) goes to stderr so stdout stays one machine-readable line.
+"""
+
+import json
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import (
+    BATCH,
+    EMBED,
+    RULES_DP_TP,
+    SEQ,
+    logical_sharding,
+)
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+from learning_jax_sharding_tpu.utils.bench import (
+    compiled_flops,
+    device_peak_flops,
+    measure,
+)
+
+# Reference case-6 dims (`/root/reference/case6_attention.py:44-45,149-151`).
+B, S, M = 8, 256, 640
+NUM_HEADS, HEAD_DIM = 8, 64
+CHAIN = 32  # forward applications chained per jitted call
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _chained_apply(model, params, x0, n):
+    """n chained forwards in one program: x_{i+1} = normalize(f(x_i)).
+
+    Chaining defeats loop-invariant hoisting (each iteration depends on the
+    last); the rms normalization (negligible FLOPs next to the matmuls) keeps
+    magnitudes stable across repeated un-normalized attention blocks.
+    """
+
+    def body(_, x):
+        y = model.apply({"params": params}, x)
+        return (y * jax.lax.rsqrt(jnp.mean(jnp.square(y)) + 1e-6)).astype(x0.dtype)
+
+    x0 = x0.astype(model.dtype)
+    return jax.lax.fori_loop(0, n, body, x0)
+
+
+def bench_attention(dtype, label):
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    model = MultiHeadAttention(
+        features=M, num_heads=NUM_HEADS, head_dim=HEAD_DIM, dtype=dtype
+    )
+    x = put(
+        np.random.default_rng(0).standard_normal((B, S, M)).astype(np.float32),
+        mesh_sharding(mesh, "data", None, None),
+    )
+    params = model.init({"params": jax.random.key(0)}, x)["params"]
+    import flax.linen as nn
+
+    params = nn.meta.unbox(params)
+
+    single = jax.jit(lambda p, x: model.apply({"params": p}, x))
+    flops_single = compiled_flops(single, params, x)
+    chained = jax.jit(partial(_chained_apply, model, n=CHAIN))
+    result = measure(
+        chained, params, x,
+        flops=(flops_single * CHAIN) if flops_single else None,
+        n_devices=1,
+    )
+    per_iter = result.seconds_per_iter / CHAIN
+    tflops = (flops_single / per_iter / 1e12) if flops_single else None
+    msg = f"[bench] {label}: {per_iter * 1e6:.1f} us/forward"
+    if tflops:
+        msg += f", {tflops:.2f} TFLOP/s/chip"
+    _log(msg)
+    return tflops
+
+
+def bench_transformer_125m():
+    """North-star context: composed 125M transformer train step, MFU."""
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    cfg = CONFIG_125M
+    model = Transformer(cfg)
+    b, s = 8, 1024
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        model, optax.adamw(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh, RULES_DP_TP,
+        loss_fn=next_token_loss, donate_state=False,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import activate
+
+    with activate(mesh, RULES_DP_TP):
+        flops = compiled_flops(step.jitted, state, batch)
+    result = measure(step, state, batch, flops=flops, n_devices=1)
+    msg = f"[bench] 125M transformer train step: {result.seconds_per_iter * 1e3:.1f} ms/step"
+    if result.tflops_per_chip is not None:
+        msg += f", {result.tflops_per_chip:.1f} TFLOP/s/chip"
+    if result.mfu is not None:
+        msg += f", MFU={result.mfu:.1%}"
+    _log(msg)
+    return result
+
+
+def main():
+    dev = jax.devices()[0]
+    _log(f"[bench] device: {dev.device_kind} ({dev.platform}), "
+         f"peak bf16 {device_peak_flops(dev)}")
+
+    ours = bench_attention(jnp.bfloat16, "case6 attention (ours, bf16)")
+    baseline = bench_attention(jnp.float32, "case6 attention (reference-faithful, fp32)")
+
+    try:
+        bench_transformer_125m()
+    except Exception as e:  # context only — never break the headline line
+        _log(f"[bench] 125M transformer bench skipped: {type(e).__name__}: {e}")
+
+    vs_baseline = (ours / baseline) if (ours and baseline) else None
+    print(json.dumps({
+        "metric": "case6_attention_tflops_per_chip",
+        "value": round(ours, 3) if ours else None,
+        "unit": "TFLOP/s/chip",
+        "vs_baseline": round(vs_baseline, 3) if vs_baseline else None,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
