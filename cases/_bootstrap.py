@@ -1,0 +1,10 @@
+"""Make the repo root importable when a case runs as ``python cases/caseN.py``
+(the framework is also installable via ``pip install -e .``; the cases must
+work from a bare checkout)."""
+
+import pathlib
+import sys
+
+_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

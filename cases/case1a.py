@@ -1,0 +1,68 @@
+"""Case 1a — contraction-dim sharding on both operands → AllReduce.
+
+Rebuild of `/root/reference/case1a.py` on the framework: A(4,16) is split
+4-way on its inner dim over mesh-Y (replicated over X), B(16,4) likewise on
+its inner dim, so each device holds a (4,4)×(4,4) partial product and XLA
+GSPMD inserts an AllReduce to sum them — here *proved* from the compiled HLO,
+not narrated (the reference's banner at `case1a.py:10` even mislabels the
+collective; SURVEY.md §8).
+
+Run: ``python cases/case1a.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import numpy as np
+
+from learning_jax_sharding_tpu.parallel import (
+    assert_collectives,
+    assert_replicated,
+    assert_shard_shape,
+    build_mesh,
+    put,
+    shard_dims,
+    visualize,
+)
+
+
+def main():
+    mesh = build_mesh((2, 4), ("x", "y"))
+    rng = np.random.default_rng(0)
+    a_host = rng.standard_normal((4, 16)).astype(np.float32)
+    b_host = rng.standard_normal((16, 4)).astype(np.float32)
+
+    # A: inner (contraction) dim split 4-way over Y, replicated over X
+    # (reference: sharding.replicate(axis=0, keepdims=True), case1a.py:24).
+    a = put(a_host, shard_dims(mesh, 2, y=1))
+    print("A(4,16) — inner dim split over Y:")
+    visualize(a)
+    assert_shard_shape(a, (4, 4))
+
+    # B: contraction dim split 4-way (reference: sharding.reshape(4,2)
+    # .replicate(axis=1), case1a.py:30 — the NamedSharding way needs no
+    # reshape trick).
+    b = put(b_host, shard_dims(mesh, 2, y=0))
+    print("B(16,4) — contraction dim split over Y:")
+    visualize(b)
+    assert_shard_shape(b, (4, 4))
+
+    c = jax.jit(jax.lax.dot)(a, b)
+    print("C = A·B:")
+    visualize(c)
+
+    # Every device computed a partial (4,4) product; the AllReduce summed
+    # them, so C is fully replicated and numerically exact.
+    assert_replicated(c, a_host @ b_host)
+    counts = assert_collectives(
+        jax.lax.dot, a, b, require=("all-reduce",), forbid=("all-gather",)
+    )
+    print(f"collectives in compiled HLO: {counts}")
+    print("PASS: contraction-sharded matmul → AllReduce → replicated C")
+
+
+if __name__ == "__main__":
+    main()

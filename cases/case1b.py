@@ -1,0 +1,59 @@
+"""Case 1b — mismatched contraction shardings → AllGather.
+
+Rebuild of `/root/reference/case1b.py`: A's contraction dim is split over
+mesh-Y while B's is split over mesh-X. No device pairing lines the shards up,
+so GSPMD gathers operand shards back before multiplying — an AllGather, proved
+from the HLO (the reference's banner at `case1b.py:15` says "AllReduce"; the
+banners of 1a/1b are swapped, SURVEY.md §8).
+
+Run: ``python cases/case1b.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import numpy as np
+
+from learning_jax_sharding_tpu.parallel import (
+    assert_collectives,
+    assert_replicated,
+    assert_shard_shape,
+    build_mesh,
+    put,
+    shard_dims,
+    visualize,
+)
+
+
+def main():
+    mesh = build_mesh((2, 4), ("x", "y"))
+    rng = np.random.default_rng(0)
+    a_host = rng.standard_normal((4, 16)).astype(np.float32)
+    b_host = rng.standard_normal((16, 4)).astype(np.float32)
+
+    a = put(a_host, shard_dims(mesh, 2, y=1))  # contraction dim over Y
+    print("A(4,16) — inner dim split over Y:")
+    visualize(a)
+    assert_shard_shape(a, (4, 4))
+
+    b = put(b_host, shard_dims(mesh, 2, x=0))  # contraction dim over X (mismatch!)
+    print("B(16,4) — contraction dim split over X:")
+    visualize(b)
+    assert_shard_shape(b, (8, 4))
+
+    c = jax.jit(jax.lax.dot)(a, b)
+    print("C = A·B:")
+    visualize(c)
+
+    assert_replicated(c)
+    np.testing.assert_allclose(np.asarray(c), a_host @ b_host, rtol=1e-5)
+    counts = assert_collectives(jax.lax.dot, a, b, require=("all-gather",))
+    print(f"collectives in compiled HLO: {counts}")
+    print("PASS: mismatched contraction shardings → AllGather → replicated C")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Case 3 — both operands fully 2D-sharded → fully sharded output (FSDP pattern).
+
+Rebuild of `/root/reference/case3_fully_sharded.py`: A and B both sharded over
+both mesh axes; the output lands fully sharded too — every device holds a
+distinct (2,1) tile, zero redundancy anywhere. This is the placement pattern
+underlying FSDP/ZeRO, shown on a single matmul (SURVEY.md §2.4). The
+reference leaves a ``pdb.set_trace()`` at its end (`case3_fully_sharded.py:61`);
+this version ends with assertions instead.
+
+Run: ``python cases/case3_fully_sharded.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import numpy as np
+
+from learning_jax_sharding_tpu.parallel import (
+    assert_shard_shape,
+    build_mesh,
+    put,
+    shard_dims,
+    unique_shard_count,
+    visualize,
+)
+
+
+def main():
+    mesh = build_mesh((2, 4), ("x", "y"))
+    rng = np.random.default_rng(0)
+    a_host = rng.standard_normal((4, 16)).astype(np.float32)
+    b_host = rng.standard_normal((16, 4)).astype(np.float32)
+
+    a = put(a_host, shard_dims(mesh, 2, x=0, y=1))
+    print("A(4,16) — fully sharded:")
+    visualize(a)
+    assert_shard_shape(a, (2, 4))
+
+    b = put(b_host, shard_dims(mesh, 2, x=0, y=1))
+    print("B(16,4) — fully sharded:")
+    visualize(b)
+    assert_shard_shape(b, (8, 1))
+
+    c = jax.jit(jax.lax.dot)(a, b)
+    print("C = A·B:")
+    visualize(c)
+
+    np.testing.assert_allclose(np.asarray(c), a_host @ b_host, rtol=1e-5)
+    assert_shard_shape(c, (2, 1))
+    assert unique_shard_count(c) == 8, "every device must hold a distinct tile"
+    print("PASS: fully-sharded operands → fully-sharded C, zero redundancy")
+
+
+if __name__ == "__main__":
+    main()

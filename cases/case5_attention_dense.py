@@ -1,0 +1,93 @@
+"""Case 5 — logical partitioning introduced on a single projection.
+
+Rebuild of `/root/reference/case5_attention_dense.py`: a minimal module with
+one Dense kernel carrying logical axes ``(embed, kv)``, pushed through the
+sharded-init pipeline. The lesson is what the *rules* do: the reference ships
+with the ``('kv','model')`` rule commented out (`case5_attention_dense.py:111`)
+so the kernel stays replicated on its kv dim — here both variants run so the
+effect of mapping vs not mapping an axis is visible side by side.
+
+Run: ``python cases/case5_attention_dense.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import flax.linen as nn
+import jax
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.parallel import build_mesh, put, shard_shapes, visualize
+from learning_jax_sharding_tpu.parallel.logical import (
+    BATCH,
+    EMBED,
+    KV,
+    SEQ,
+    logical_sharding,
+)
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+B, S, M = 8, 256, 640
+INNER = 8 * 64  # heads × head_dim, the reference's Wq output width
+
+
+class QProjection(nn.Module):
+    """The reference's minimal FlaxAttention: just the Q projection
+    (`/root/reference/case5_attention_dense.py:41-71`), with its unused
+    inner_dim/scale fields dropped (SURVEY.md §8 quirks)."""
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        q = nn.Dense(
+            INNER,
+            use_bias=False,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.lecun_normal(), (EMBED, KV)
+            ),
+            name="query",
+        )(x)
+        return nn.with_logical_constraint(q, (BATCH, SEQ, None))
+
+
+def run(rules, label):
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    x = put(
+        np.random.default_rng(0).standard_normal((B, S, M)).astype(np.float32),
+        logical_sharding(mesh, rules, BATCH, SEQ, EMBED),
+    )
+    state, state_sh = sharded_train_state(
+        QProjection(), optax.adam(1e-3), x, {"params": jax.random.key(0)}, mesh, rules
+    )
+    wq = state.params["query"]["kernel"]
+    print(f"[{label}] rules={rules}")
+    print(f"  Wq {wq.shape} -> shard {shard_shapes(wq)[0]}")
+    visualize(wq)
+    step = make_train_step(state_sh, x.sharding, mesh, rules)
+    state, loss = step(state, x)
+    print(f"  one train step OK, loss={float(loss):.3f}")
+    return shard_shapes(wq)[0]
+
+
+def main():
+    # Reference configuration: 'kv' NOT mapped (the commented-out rule at
+    # `case5_attention_dense.py:111`) — Wq replicated on its kv columns,
+    # split on embed rows.
+    shard_a = run(((BATCH, "data"), (EMBED, "model")), "kv unmapped (reference)")
+    assert shard_a == (M // 2, INNER)
+
+    # With the kv rule enabled the same kernel also splits its columns.
+    shard_b = run(((BATCH, "data"), (EMBED, None), (KV, "model")), "kv -> model")
+    assert shard_b == (M, INNER // 2)
+
+    print("PASS: logical rules control kernel placement without touching the model")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Case 6 — fully sharded multi-head attention: init/train/apply + benchmark.
+
+Rebuild of `/root/reference/case6_attention.py`: the complete logically
+partitioned MHA (8 heads × 64 on M=640) on a (2,2) data×model mesh —
+parameters born sharded, jitted train step, jitted apply, and the timing loop
+done right (the reference's loop at `case6_attention.py:234-238` includes
+compile time and never syncs; this one uses the framework's warmup+sync
+harness and reports TFLOP/s).
+
+Run: ``python cases/case6_attention.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
+from learning_jax_sharding_tpu.parallel import build_mesh, put, shard_shapes, visualize
+from learning_jax_sharding_tpu.parallel.logical import (
+    BATCH,
+    EMBED,
+    RULES_DP_TP_SP,
+    SEQ,
+    logical_sharding,
+)
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_apply_fn,
+    make_train_step,
+    sharded_train_state,
+)
+from learning_jax_sharding_tpu.utils.bench import measure
+
+B, S, M = 8, 256, 640  # reference dims (`case6_attention.py:149-151`)
+
+
+def main():
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    rules = RULES_DP_TP_SP  # dp + tp + intentional sequence sharding
+    model = MultiHeadAttention(
+        features=M, num_heads=8, head_dim=64, dropout_rate=0.1
+    )
+
+    x_sharding = logical_sharding(mesh, rules, BATCH, SEQ, EMBED)
+    x = put(
+        np.random.default_rng(0).standard_normal((B, S, M)).astype(np.float32),
+        x_sharding,
+    )
+    print(f"x{x.shape} shard: {shard_shapes(x)[0]}  (batch over data, seq over model)")
+    visualize(jnp.squeeze(x[:, :, 0]))
+
+    state, state_sh = sharded_train_state(
+        model, optax.adam(1e-3), x, {"params": jax.random.key(0)}, mesh, rules
+    )
+    wq = state.params["query"]["kernel"]
+    print(f"Wq {wq.shape} shard: {shard_shapes(wq)[0]}  (born sharded)")
+
+    step = make_train_step(state_sh, x_sharding, mesh, rules)
+    for i in range(3):
+        state, loss = step(state, x)
+        print(f"train step {i}: loss={float(loss):.2f}")
+
+    apply_fn = make_apply_fn(state_sh, x_sharding, mesh, rules)
+    y = apply_fn(state, x)
+    print(f"y{y.shape} shard: {shard_shapes(y)[0]}")
+    assert shard_shapes(y)[0] == (B // 2, S // 2, M)
+
+    result = measure(apply_fn, state, x, min_time=0.3)
+    t = result.tflops_per_chip
+    print(
+        f"apply: {result.seconds_per_iter * 1e3:.2f} ms/iter"
+        + (f", {t:.2f} TFLOP/s/chip" if t else "")
+    )
+    print("PASS: sharded MHA init/train/apply on the data×model mesh")
+
+
+if __name__ == "__main__":
+    main()
