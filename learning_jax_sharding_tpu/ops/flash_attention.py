@@ -1,0 +1,424 @@
+"""Flash attention as a Pallas (Mosaic) TPU kernel, with custom VJP.
+
+The reference materializes full (B, N, S, S) attention scores
+(`/root/reference/case6_attention.py:125-127`), which caps sequence length at
+a few thousand tokens (SURVEY.md §2.4 "Context parallelism: absent"). This
+kernel is the TPU-native fix: scores are computed blockwise in VMEM with an
+online softmax, so HBM traffic is O(S·H) instead of O(S²) and the S² work
+streams through the MXU tile by tile — the idiomatic TPU equivalent of the
+CUDA flash-attention kernel family.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+* grids iterate (batch·head, q-block, k-block) with the k-block dim innermost
+  and sequential; running max/denominator/accumulator live in VMEM scratch
+  that persists across the k-block sweep;
+* running max/denominator are kept as (block_q, 128) lane-replicated tiles
+  (TPU vectors want a 128 lane dim);
+* all matmuls request fp32 accumulation via ``preferred_element_type``.
+
+The backward follows the standard two-kernel flash scheme: the forward saves
+only the per-row logsumexp; dq and dk/dv are computed by separate kernels that
+recompute probabilities blockwise (q-major and k-major grids respectively).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+
+
+def _row_ids(qi, block_q):
+    return qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+
+def _col_ids(ki, block_k):
+    return ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref,  # (block_q, H), (block_k, H), (block_k, H)
+    o_ref,                # (block_q, H)
+    lse_ref,              # (1, block_q) — per-row logsumexp
+    acc_ref, m_ref, l_ref,  # VMEM scratch
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # With causal masking, blocks strictly in the future contribute nothing.
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, block_k)
+        if causal:
+            mask = _row_ids(qi, block_q) >= _col_ids(ki, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                      # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # (block_q, block_k)
+        correction = jnp.exp(m_prev - m_new)       # (block_q, 1)
+        l_new = correction * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (block_q, H)
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        # Fully-masked rows (can't happen causally, but guard) → zero output.
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+    bn, s_q, h = q.shape
+    s_kv = k.shape[1]
+    nq, nk = pl.cdiv(s_q, block_q), pl.cdiv(s_kv, block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q, block_k=block_k
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bn, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s_q, h), q.dtype),
+            jax.ShapeDtypeStruct((bn, s_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, h), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """k-major sweep: for one k/v block, accumulate dk/dv over all q blocks."""
+    ki, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            mask = _row_ids(qi, block_q) >= _col_ids(ki, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)                        # (block_q, block_k)
+
+        # dv += pᵀ · do
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dp = do · vᵀ ; ds = p ∘ (dp − delta) ; dk += dsᵀ · q
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,
+    dq_acc,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """q-major sweep: for one q block, accumulate dq over all k blocks."""
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    run = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            mask = _row_ids(qi, block_q) >= _col_ids(ki, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        # dq += ds · k, then scaled at the end (d(q·scale)/dq = scale).
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finish():
+        dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, interpret, residuals, do):
+    q, k, v, out, lse = residuals
+    bn, s_q, h = q.shape
+    s_kv = k.shape[1]
+    nq, nk = pl.cdiv(s_q, block_q), pl.cdiv(s_kv, block_k)
+
+    # delta_i = Σ_h do_ih · o_ih — tiny elementwise reduction, jnp handles it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    common_specs = [
+        pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, j, 0)),      # q by inner
+        pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),      # k by outer
+        pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),      # v by outer
+        pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, j, 0)),      # do
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),            # lse
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),            # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bn, nk, nq),
+        in_specs=common_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, h), jnp.float32),
+            pltpu.VMEM((block_k, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k,
+        ),
+        grid=(bn, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),      # q by outer
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),      # k by inner
+            pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),      # v by inner
+            pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),      # do
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),            # lse
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),            # delta
+        ],
+        out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, h), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(
+        q, k, v, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, residuals, do):
+    return _bwd(scale, causal, block_q, block_k, interpret, residuals, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise-softmax attention over ``(B, S, N, H)`` inputs.
+
+    Drop-in for :func:`ops.attention.dot_product_attention` (same signature
+    shape-wise) but with O(S·H) memory. Differentiable via the flash backward
+    kernels. ``mask`` is accepted for API compatibility but only the causal
+    structural mask is supported (pass ``causal=True``); arbitrary masks
+    require the dense op.
+
+    Args:
+        block_q / block_k: VMEM tile sizes; 128 aligns with MXU/VPU tiling.
+        interpret: run the Pallas interpreter (CPU testing).
+    """
+    if mask is not None:
+        raise NotImplementedError(
+            "flash_attention supports only the structural causal mask "
+            "(causal=True); use dot_product_attention for arbitrary masks"
+        )
+    b, s_q, n, h = q.shape
+    s_kv = k.shape[1]
+    if s_q % block_q or s_kv % block_k:
+        block_q = min(block_q, s_q)
+        block_k = min(block_k, s_kv)
+        if s_q % block_q or s_kv % block_k:
+            raise ValueError(
+                f"sequence lengths ({s_q}, {s_kv}) must be divisible by "
+                f"block sizes ({block_q}, {block_k})"
+            )
+    scale = h**-0.5 if scale is None else scale
+
+    # (B, S, N, H) → (B·N, S, H): each (batch, head) slice is independent.
+    def to_bn(x):
+        b_, s_, n_, h_ = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(b_ * n_, s_, h_)
+
+    out = _flash(
+        to_bn(q), to_bn(k), to_bn(v), scale, causal, block_q, block_k, interpret
+    )
+    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3)
+
+
+def make_flash_attn_fn(mesh=None, rules=None, **kwargs) -> Any:
+    """An ``attn_fn`` for :class:`models.attention.MultiHeadAttention`:
+    ``attn_fn(q, k, v, *, causal)`` routed to the flash kernel.
+
+    With ``mesh``/``rules``, the kernel runs under ``shard_map`` with batch
+    and heads partitioned per the rules (GSPMD cannot partition a custom
+    kernel by itself). The sequence stays unsharded inside the kernel — flash
+    needs every key/value; sequence-sharded attention is ring attention's job
+    (:mod:`ops.ring_attention`).
+    """
+    in_spec = None
+    if mesh is not None:
+        if rules is None:
+            raise ValueError("rules are required when a mesh is given")
+        from flax.linen import partitioning as nn_partitioning
+        from jax.sharding import PartitionSpec
+
+        from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS
+
+        axes = nn_partitioning.logical_to_mesh_axes(
+            (BATCH, None, HEADS, None), tuple(rules)
+        )
+        in_spec = PartitionSpec(*axes)
+
+    def attn_fn(q, k, v, *, causal: bool = False):
+        fn = functools.partial(flash_attention, causal=causal, **kwargs)
+        if mesh is None:
+            return fn(q, k, v)
+        # check_vma=False: pallas_call's out_shape carries no varying-axes
+        # metadata, which the static replication checker requires.
+        return jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(in_spec, in_spec, in_spec), out_specs=in_spec,
+            check_vma=False,
+        )(q, k, v)
+
+    return attn_fn
