@@ -142,7 +142,31 @@ def bench_transformer_125m():
     return result
 
 
+def _device_ready(timeout_s: float = 600.0) -> bool:
+    """Probe the device with a tiny op under a watchdog.
+
+    The tunneled TPU in this environment can wedge (every device op hangs)
+    after an earlier process died mid-operation; without this guard a wedged
+    tunnel would hang the whole benchmark instead of failing loudly.
+    """
+    import threading
+
+    ok = threading.Event()
+
+    def probe():
+        np.asarray(jnp.ones((8, 8)).sum())
+        ok.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return ok.is_set()
+
+
 def main():
+    if not _device_ready():
+        _log("[bench] FATAL: device did not answer a trivial op (tunnel wedged?)")
+        sys.exit(1)
     dev = jax.devices()[0]
     _log(f"[bench] device: {dev.device_kind} ({dev.platform}), "
          f"peak bf16 {device_peak_flops(dev)}")

@@ -42,8 +42,10 @@ class MultiHeadAttention(nn.Module):
         dtype: computation dtype (bf16 on TPU for MXU throughput; softmax
             still runs fp32 via the op).
         param_dtype: parameter storage dtype.
-        attn_fn: attention backend taking (q, k, v, mask=...) shaped
-            (B, S, N, H); defaults to the dense einsum op.
+        attn_fn: attention backend taking ``(q, k, v, *, causal: bool)`` with
+            (B, S, N, H) operands (see ops.flash_attention.make_flash_attn_fn
+            / ops.ring_attention.make_ring_attn_fn); None (default) uses the
+            dense einsum op, which also supports arbitrary masks.
     """
 
     features: int
@@ -96,9 +98,14 @@ class MultiHeadAttention(nn.Module):
         k = nn.with_logical_constraint(k, (BATCH, SEQ, HEADS, KV))
         v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS, KV))
 
-        mask = causal_mask(s) if self.causal else None
-        attn = self.attn_fn or dot_product_attention
-        out = attn(q, k, v, mask=mask)
+        if self.attn_fn is None:
+            mask = causal_mask(s) if self.causal else None
+            out = dot_product_attention(q, k, v, mask=mask)
+        else:
+            # Custom backends (flash/ring) take the structural flag, not a
+            # dense mask — they cannot honor arbitrary masks and must not
+            # silently reinterpret one.
+            out = self.attn_fn(q, k, v, causal=self.causal)
         out = nn.with_logical_constraint(out, (BATCH, SEQ, HEADS, KV))
         out = out.reshape(b, s, self.inner_dim)
 

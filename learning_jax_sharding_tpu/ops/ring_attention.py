@@ -1,0 +1,152 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference cannot scale sequence length: its attention materializes the
+full (B, N, S, S) score tensor (`/root/reference/case6_attention.py:125-127`)
+and its accidental sequence sharding is immediately undone by the attention
+einsums (SURVEY.md §2.4 "Context parallelism: absent"). Ring attention is the
+TPU-native answer for long context: keep q/k/v sharded along the sequence on a
+mesh axis, and rotate the k/v shards around that axis with ``ppermute`` while
+each device folds the visiting block into a running online softmax
+(blockwise attention, Liu et al.). After ``n`` hops every query has seen every
+key, no device ever held more than S/n keys, and each hop's neighbor transfer
+rides one ICI link while the MXU works on the block just received.
+
+This is deliberately written with JAX collectives inside ``shard_map`` (not a
+Pallas RDMA kernel) so it composes with autodiff — the whole thing is
+reverse-differentiable through ``lax.scan`` + ``ppermute`` — and with any
+per-block attention implementation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    """(B, Sq, N, H) × (B, Sk, N, H) → fp32 scores (B, N, Sq, Sk)."""
+    return jnp.einsum(
+        "bqnh,bknh->bnqk",
+        q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str,
+    causal: bool = False,
+    scale: float | None = None,
+    batch_axis: str | None = None,
+    heads_axis: str | None = None,
+) -> jax.Array:
+    """Attention over ``(B, S, N, H)`` inputs whose S dim is sharded on
+    ``axis``; returns output sharded the same way.
+
+    ``batch_axis`` / ``heads_axis`` name mesh axes the batch / heads dims are
+    already sharded over (attention is independent along both, so they simply
+    partition the work; leaving a sharded dim unnamed here would all-gather it
+    and duplicate the whole computation along that mesh axis).
+
+    Memory per device: O(S/n · H) for k/v plus one (B, N, S/n, S/n) score
+    block — the full S×S matrix never exists anywhere.
+    """
+    h = q.shape[-1]
+    scale = h**-0.5 if scale is None else scale
+    n = mesh.shape[axis]
+
+    def local(q_blk, k_blk, v_blk):
+        # q_blk: (B, Sq, N, H) — this device's query chunk, fixed.
+        # k_blk/v_blk: (B, Sk, N, H) — rotating key/value chunks.
+        idx = lax.axis_index(axis)
+        sq, sk = q_blk.shape[1], k_blk.shape[1]
+        q_pos = idx * sq + jnp.arange(sq)[:, None]            # global q positions
+
+        acc0 = jnp.zeros(
+            (q_blk.shape[0], q_blk.shape[2], sq, h), jnp.float32
+        )  # (B, N, Sq, H)
+        m0 = jnp.full((q_blk.shape[0], q_blk.shape[2], sq, 1), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros_like(m0)
+        # Fresh constants are device-invariant; the scan carry becomes
+        # device-varying after step 1 (over every axis the shards vary on),
+        # so mark them varying up front — VMA types must match across scan
+        # iterations.
+        vary = tuple(a for a in (axis, batch_axis, heads_axis) if a is not None)
+        acc0, m0, l0 = lax.pcast((acc0, m0, l0), vary, to="varying")
+
+        def step(carry, i):
+            acc, m, l, k_cur, v_cur = carry
+            # After i backward rotations, this device holds chunk (idx - i) % n.
+            src = (idx - i) % n
+            s = _block_scores(q_blk, k_cur, scale)            # (B, N, Sq, Sk)
+            if causal:
+                k_pos = src * sk + jnp.arange(sk)[None, :]
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            # Guard rows with no visible keys yet: exp(-1e30 - (-1e30)) = 1
+            # would pollute l; clamp the shift instead.
+            p = jnp.exp(s - jnp.maximum(m_new, _NEG_INF / 2))
+            p = jnp.where(s <= _NEG_INF / 2, 0.0, p)
+            correction = jnp.exp(jnp.minimum(m - m_new, 0.0))
+            l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jnp.einsum(
+                "bnqk,bknh->bnqh", p, v_cur.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            acc_new = acc * correction + pv
+
+            perm = [(j, (j + 1) % n) for j in range(n)]       # send to right neighbor
+            k_nxt = lax.ppermute(k_cur, axis, perm)
+            v_nxt = lax.ppermute(v_cur, axis, perm)
+            return (acc_new, m_new, l_new, k_nxt, v_nxt), ()
+
+        (acc, m, l, _, _), _ = lax.scan(
+            step, (acc0, m0, l0, k_blk, v_blk), jnp.arange(n)
+        )
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        out = (acc / safe_l).astype(q_blk.dtype)              # (B, N, Sq, H)
+        return out.transpose(0, 2, 1, 3)                      # (B, Sq, N, H)
+
+    spec = P(batch_axis, axis, heads_axis, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def make_ring_attn_fn(mesh: Mesh, rules: Any, axis: str | None = None) -> Any:
+    """An ``attn_fn`` for :class:`models.attention.MultiHeadAttention` running
+    ring attention over the mesh axis the rules map ``SEQ`` to.
+
+    Batch/heads placements are derived from the same rules so already-sharded
+    dims partition the ring's work instead of being gathered.
+    """
+    from flax.linen import partitioning as nn_partitioning
+
+    from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS, KV, SEQ
+
+    axes = nn_partitioning.logical_to_mesh_axes((BATCH, SEQ, HEADS, KV), tuple(rules))
+    seq_axis = axis if axis is not None else axes[1]
+    if seq_axis is None:
+        raise ValueError("rules map SEQ to no mesh axis and no axis= was given")
+
+    def attn_fn(q, k, v, *, causal: bool = False):
+        return ring_attention(
+            q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
+            batch_axis=axes[0], heads_axis=axes[2],
+        )
+
+    return attn_fn

@@ -69,6 +69,14 @@ RULES_DP_TP: Rules = (
 #: sequence-over-'model' placement (`/root/reference/case6_attention.py:161`).
 RULES_DP_TP_SP: Rules = RULES_DP_TP + ((SEQ, "model"),)
 
+#: Long-context layout: batch over data, sequence over model, weights
+#: replicated — the activation layout ring attention wants (heads stay whole
+#: per device; the sequence ring runs over the 'model' axis).
+RULES_DP_SP: Rules = (
+    (BATCH, "data"),
+    (SEQ, "model"),
+)
+
 #: Fully-sharded data parallel flavor: parameters sharded over the data axis
 #: too (the case-3 zero-redundancy pattern, `/root/reference/case3_fully_sharded.py`).
 RULES_FSDP: Rules = (
