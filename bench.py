@@ -152,14 +152,25 @@ def _device_ready(timeout_s: float = 600.0) -> bool:
     import threading
 
     ok = threading.Event()
+    err: list[BaseException] = []
 
     def probe():
-        np.asarray(jnp.ones((8, 8)).sum())
+        try:
+            np.asarray(jnp.ones((8, 8)).sum())
+        except BaseException as e:
+            err.append(e)
+            raise
         ok.set()
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    t.join(timeout_s)
+    while t.is_alive() and not err:
+        t.join(1.0)
+        timeout_s -= 1.0
+        if timeout_s <= 0:
+            break
+    if err:  # a real error, not a hang — surface it with its cause
+        raise err[0]
     return ok.is_set()
 
 

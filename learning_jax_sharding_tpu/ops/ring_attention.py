@@ -86,10 +86,9 @@ def ring_attention(
         vary = tuple(a for a in (axis, batch_axis, heads_axis) if a is not None)
         acc0, m0, l0 = lax.pcast((acc0, m0, l0), vary, to="varying")
 
-        def step(carry, i):
-            acc, m, l, k_cur, v_cur = carry
-            # After i backward rotations, this device holds chunk (idx - i) % n.
-            src = (idx - i) % n
+        def fold(acc, m, l, k_cur, v_cur, src):
+            """Fold one visiting k/v block (global chunk ``src``) into the
+            running online softmax."""
             s = _block_scores(q_blk, k_cur, scale)            # (B, N, Sq, Sk)
             if causal:
                 k_pos = src * sk + jnp.arange(sk)[None, :]
@@ -107,16 +106,27 @@ def ring_attention(
                 "bnqk,bknh->bnqh", p, v_cur.astype(jnp.float32),
                 preferred_element_type=jnp.float32,
             )
-            acc_new = acc * correction + pv
+            return acc * correction + pv, m_new, l_new
 
+        def step(carry, i):
+            acc, m, l, k_cur, v_cur = carry
+            # After i backward rotations, this device holds chunk (idx - i) % n.
+            # The permute of k/v and the fold both read k_cur/v_cur with no
+            # dependency between them, so the hop's ICI transfer overlaps the
+            # block's MXU work.
             perm = [(j, (j + 1) % n) for j in range(n)]       # send to right neighbor
             k_nxt = lax.ppermute(k_cur, axis, perm)
             v_nxt = lax.ppermute(v_cur, axis, perm)
-            return (acc_new, m_new, l_new, k_nxt, v_nxt), ()
+            acc, m, l = fold(acc, m, l, k_cur, v_cur, (idx - i) % n)
+            return (acc, m, l, k_nxt, v_nxt), ()
 
-        (acc, m, l, _, _), _ = lax.scan(
-            step, (acc0, m0, l0, k_blk, v_blk), jnp.arange(n)
+        # n-1 hops permute; the last visiting block is folded outside the scan
+        # so no wasted rotation ships k/v that nobody reads (n == 1 → no scan,
+        # single local fold).
+        (acc, m, l, k_last, v_last), _ = lax.scan(
+            step, (acc0, m0, l0, k_blk, v_blk), jnp.arange(n - 1)
         )
+        acc, m, l = fold(acc, m, l, k_last, v_last, (idx - (n - 1)) % n)
         safe_l = jnp.where(l == 0.0, 1.0, l)
         out = (acc / safe_l).astype(q_blk.dtype)              # (B, N, Sq, H)
         return out.transpose(0, 2, 1, 3)                      # (B, Sq, N, H)

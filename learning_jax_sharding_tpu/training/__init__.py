@@ -1,1 +1,20 @@
-"""Sharded-init / train_step / apply pipeline (layer L5)."""
+"""Sharded-init / train_step / apply pipeline (layer L5) + checkpointing."""
+
+from learning_jax_sharding_tpu.training.pipeline import (  # noqa: F401
+    TrainState,
+    make_apply_fn,
+    make_train_step,
+    sharded_train_state,
+)
+
+_CHECKPOINT_EXPORTS = ("CheckpointManager", "as_abstract")
+
+
+def __getattr__(name: str):
+    # checkpoint.py imports orbax at module top; loading it lazily keeps the
+    # training pipeline importable for users without the [checkpoint] extra.
+    if name in _CHECKPOINT_EXPORTS:
+        from learning_jax_sharding_tpu.training import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

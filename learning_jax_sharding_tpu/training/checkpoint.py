@@ -1,0 +1,112 @@
+"""Sharded checkpoint / resume (SURVEY.md §5 "Checkpoint / resume").
+
+The reference keeps its TrainState only in memory
+(`/root/reference/case6_attention.py:171-178`) — a crash means a rerun. This
+module adds the TPU-native persistence layer the survey calls for: Orbax
+checkpoints of the sharded TrainState where
+
+* every host writes only its **addressable shards** (no gather-to-host-0, no
+  replicated materialization — the same born-sharded discipline as
+  ``sharded_train_state``),
+* restore places each shard directly onto its device per the target sharding
+  tree, so a resumed run continues bit-identically under the same mesh, and
+* the on-disk layout is mesh-shape-agnostic: restoring onto a different mesh
+  (e.g. 8 chips → 4) just reshards at load time.
+
+Saves are asynchronous (device→host copy happens synchronously, the filesystem
+write in a background thread) so the train loop overlaps I/O with compute.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def as_abstract(state: Any) -> Any:
+    """The restore target for ``state``: shapes + dtypes + shardings, no data.
+
+    Works on a concrete sharded TrainState (the usual resume flow: rebuild the
+    state with ``sharded_train_state``, then overwrite it from disk) or any
+    pytree of jax Arrays.
+    """
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, jax.Array)
+        else x,
+        state,
+    )
+
+
+class CheckpointManager:
+    """Step-indexed sharded checkpointing with retention and async writes.
+
+    Thin, opinionated wrapper over ``orbax.checkpoint.CheckpointManager``:
+
+    >>> ckpt = CheckpointManager(dir, max_to_keep=3, save_interval_steps=100)
+    >>> ckpt.save(step, state)                      # no-op off the interval
+    >>> state = ckpt.restore_latest(like=state)     # None if nothing on disk
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(os.fspath(directory)),
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        """Persist ``state`` at ``step``. Returns False when skipped by the
+        save interval. Asynchronous: returns once device buffers are copied
+        to host; call :meth:`wait` (or rely on retention) before reading the
+        files back."""
+        return self._mgr.save(
+            int(step), args=ocp.args.StandardSave(state), force=force
+        )
+
+    def restore(self, step: int, *, like: Any) -> Any:
+        """Load the checkpoint at ``step`` into the shardings of ``like``
+        (a concrete state or an :func:`as_abstract` tree)."""
+        return self._mgr.restore(
+            int(step), args=ocp.args.StandardRestore(as_abstract(like))
+        )
+
+    def restore_latest(self, *, like: Any) -> Any | None:
+        """Resume from the newest checkpoint, or None if the directory is
+        empty — callers fall through to their fresh init."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, like=like)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable on disk."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait()
+        self.close()
