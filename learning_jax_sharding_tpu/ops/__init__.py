@@ -1,1 +1,2 @@
-"""Attention and math ops: dense attention, Pallas flash attention, ring attention."""
+"""Attention and math ops: dense attention, Pallas flash attention, ring
+attention, and Ulysses (all-to-all) sequence-parallel attention."""

@@ -1,0 +1,126 @@
+"""Ulysses attention: all-to-all head/sequence-swap sequence parallelism.
+
+The second long-context strategy SURVEY.md §2.4 lists as absent from the
+reference ("Ulysses (all-to-all head/seq swap): ❌ — no all-to-all anywhere"),
+complementing ring attention: instead of rotating k/v shards around the mesh
+axis (n-1 ``ppermute`` hops), Ulysses pays **one all-to-all before and one
+after** the attention itself. Each device trades its sequence shard for a
+head shard — (B, S/n, N, H) → (B, S, N/n, H) — computes *complete* attention
+for its subset of heads (any backend: dense einsum or the Pallas flash
+kernel), and swaps back.
+
+Trade-off vs ring: Ulysses moves q, k, v, and out once each (4 all-to-alls)
+regardless of sequence length and keeps the per-block attention kernel
+whole-sequence (so the flash kernel's tiling sees the full S); ring moves
+k/v n-1 times but never needs the full sequence on any device. Ulysses
+requires ``num_heads % n == 0``; ring has no head constraint. On a TPU torus
+both patterns ride ICI; XLA lowers ``all_to_all`` to its native ICI
+implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str,
+    causal: bool = False,
+    scale: float | None = None,
+    batch_axis: str | None = None,
+    heads_axis: str | None = None,
+    attn_fn: Callable | None = None,
+) -> jax.Array:
+    """Attention over ``(B, S, N, H)`` inputs whose S dim is sharded on
+    ``axis``; returns output sharded the same way.
+
+    Args:
+        mesh: device mesh; ``mesh.shape[axis]`` devices share the sequence.
+        axis: mesh axis carrying the sequence shards.
+        causal: causal masking — exact, because each device sees the full
+            sequence for its heads (no cross-shard position bookkeeping).
+        scale: score scale forwarded to the dense backend (default H^-0.5).
+        batch_axis: mesh axis the batch dim is already sharded over, if any.
+        heads_axis: mesh axis the heads dim is already sharded over (tensor
+            parallelism), if any — attention is independent per head, so it
+            partitions the work; leaving a sharded dim unnamed here would
+            all-gather it and duplicate the whole computation along that
+            axis. Must differ from ``axis`` (the swap re-shards heads over
+            ``axis`` itself).
+        attn_fn: per-device attention backend ``(q, k, v, *, causal)`` on
+            full-sequence (B, S, N/n, H) operands — e.g. the Pallas flash
+            kernel; None uses the dense fp32-softmax einsum op.
+    """
+    n = mesh.shape[axis]
+    if heads_axis == axis:
+        raise ValueError(f"heads_axis must differ from the sequence axis {axis!r}")
+    local_heads = q.shape[2] // (mesh.shape[heads_axis] if heads_axis else 1)
+    if local_heads % n != 0:
+        raise ValueError(
+            f"Ulysses needs per-device head count ({local_heads}) divisible "
+            f"by the '{axis}' axis size ({n}); use ring attention otherwise"
+        )
+
+    def local(q_blk, k_blk, v_blk):
+        # (B, S/n, N, H) → (B, S, N/n, H): scatter heads, gather sequence.
+        def seq_to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        qh, kh, vh = seq_to_heads(q_blk), seq_to_heads(k_blk), seq_to_heads(v_blk)
+        if attn_fn is not None:
+            out = attn_fn(qh, kh, vh, causal=causal)
+        else:
+            mask = causal_mask(qh.shape[1]) if causal else None
+            out = dot_product_attention(qh, kh, vh, scale=scale, mask=mask)
+        # (B, S, N/n, H) → (B, S/n, N, H): back to sequence shards.
+        return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    spec = P(batch_axis, axis, heads_axis, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def make_ulysses_attn_fn(
+    mesh: Mesh, rules: Any, axis: str | None = None, attn_fn: Callable | None = None
+) -> Callable:
+    """An ``attn_fn`` for :class:`models.attention.MultiHeadAttention` running
+    Ulysses over the mesh axis the rules map ``SEQ`` to (mirror of
+    ``ops.ring_attention.make_ring_attn_fn``).
+
+    ``attn_fn`` optionally sets the per-device backend used *inside* the swap
+    (e.g. ``make_flash_attn_fn()``), composing Ulysses' parallelism with the
+    flash kernel's memory behavior.
+    """
+    from flax.linen import partitioning as nn_partitioning
+
+    from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS, KV, SEQ
+
+    axes = nn_partitioning.logical_to_mesh_axes((BATCH, SEQ, HEADS, KV), tuple(rules))
+    seq_axis = axis if axis is not None else axes[1]
+    if seq_axis is None:
+        raise ValueError("rules map SEQ to no mesh axis and no axis= was given")
+    if axes[2] == seq_axis:
+        raise ValueError(
+            f"rules map both SEQ and HEADS to mesh axis {seq_axis!r}; Ulysses "
+            "re-shards heads over that axis itself"
+        )
+
+    def fn(q, k, v, *, causal: bool = False):
+        return ulysses_attention(
+            q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
+            batch_axis=axes[0], heads_axis=axes[2], attn_fn=attn_fn,
+        )
+
+    return fn

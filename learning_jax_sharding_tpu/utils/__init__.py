@@ -1,1 +1,16 @@
-"""Benchmarking, profiling, checkpointing, and debug utilities (layer L6)."""
+"""Observability (layer L6): benchmarking, per-step metrics, profiling,
+debug checks."""
+
+from learning_jax_sharding_tpu.utils.bench import (  # noqa: F401
+    BenchResult,
+    compiled_flops,
+    device_peak_flops,
+    measure,
+    time_fn,
+)
+from learning_jax_sharding_tpu.utils.metrics import MetricsLogger  # noqa: F401
+from learning_jax_sharding_tpu.utils.profiling import (  # noqa: F401
+    annotate,
+    checking,
+    trace,
+)

@@ -1,0 +1,122 @@
+"""Structured per-step training metrics (SURVEY.md §5 "Metrics / logging").
+
+The reference prints shard shapes but never a loss — its ``train_step``
+returns only the new state (`/root/reference/case6_attention.py:208-215`).
+This logger records what the survey says a training run must expose: loss,
+step time, achieved TFLOP/s per chip and MFU, plus token throughput — as
+one JSON object per step (machine-readable, `BENCH_r{N}.json`-style) mirrored
+to a human-readable stderr line.
+
+Timing is steady-state wall clock between ``log()`` calls. Reading the loss
+back to host (``float(loss)``) inside ``log`` is the synchronization point:
+it cannot complete before the step that produced it, so per-step wall time is
+honest even though JAX dispatch is asynchronous (the flaw in the reference's
+timing loop, `case6_attention.py:234-238`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, IO
+
+import jax
+
+from learning_jax_sharding_tpu.utils.bench import device_peak_flops
+
+
+class MetricsLogger:
+    """Per-step metrics: wall time, throughput, MFU, arbitrary scalars.
+
+    >>> metrics = MetricsLogger(flops_per_step=F, tokens_per_step=B*S)
+    >>> for batch in data:
+    ...     state, loss = step(state, batch)
+    ...     metrics.log(int(state.step), loss=loss)
+
+    Args:
+        path: optional JSONL file; parent dirs are created.
+        stream: human-readable mirror (default stderr); None to disable.
+        flops_per_step: whole-program FLOPs per step (e.g. from
+            ``utils.bench.compiled_flops``) — enables TFLOP/s and MFU.
+        tokens_per_step: tokens consumed per step — enables tokens/s.
+        n_devices: chips sharing the work (default: all local devices).
+    """
+
+    def __init__(
+        self,
+        path: str | os.PathLike | None = None,
+        *,
+        stream: IO | None = sys.stderr,
+        flops_per_step: float | None = None,
+        tokens_per_step: int | None = None,
+        n_devices: int | None = None,
+        log_every: int = 1,
+    ):
+        self._file: IO | None = None
+        if path is not None:
+            os.makedirs(os.path.dirname(os.path.abspath(os.fspath(path))), exist_ok=True)
+            self._file = open(path, "a")
+        self._stream = stream
+        self._flops = flops_per_step
+        self._tokens = tokens_per_step
+        self._n_devices = n_devices if n_devices is not None else len(jax.devices())
+        self._peak = device_peak_flops()
+        self._log_every = max(log_every, 1)
+        self._last_t: float | None = None
+        self._last_step: int | None = None
+        self.history: list[dict[str, Any]] = []
+
+    def log(self, step: int, loss: Any = None, **scalars: Any) -> dict[str, Any] | None:
+        """Record one step. Returns the record, or None when skipped by
+        ``log_every``. ``loss`` may be a device array — reading it is the
+        step's sync point, so call this every step even if most are skipped."""
+        rec: dict[str, Any] = {"step": int(step)}
+        if loss is not None:
+            rec["loss"] = float(loss)  # device→host readback: syncs the step
+        now = time.perf_counter()
+        if step % self._log_every:
+            self._last_t, self._last_step = now, int(step)
+            return None
+
+        if self._last_t is not None and step > self._last_step:
+            dt = (now - self._last_t) / (step - self._last_step)
+            rec["seconds_per_step"] = dt
+            if self._tokens is not None:
+                rec["tokens_per_second"] = self._tokens / dt
+            if self._flops is not None:
+                rec["tflops_per_chip"] = self._flops / dt / self._n_devices / 1e12
+                if self._peak is not None:
+                    rec["mfu"] = rec["tflops_per_chip"] * 1e12 / self._peak
+        self._last_t, self._last_step = now, int(step)
+
+        rec.update({k: float(v) for k, v in scalars.items()})
+        self.history.append(rec)
+        if self._file is not None:
+            self._file.write(json.dumps(rec) + "\n")
+            self._file.flush()
+        if self._stream is not None:
+            parts = [f"step {rec['step']}"]
+            if "loss" in rec:
+                parts.append(f"loss {rec['loss']:.4f}")
+            if "seconds_per_step" in rec:
+                parts.append(f"{rec['seconds_per_step'] * 1e3:.1f} ms/step")
+            if "tokens_per_second" in rec:
+                parts.append(f"{rec['tokens_per_second']:,.0f} tok/s")
+            if "mfu" in rec:
+                parts.append(f"MFU {rec['mfu']:.1%}")
+            parts += [f"{k} {rec[k]:.4g}" for k in scalars]
+            print("  ".join(parts), file=self._stream, flush=True)
+        return rec
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

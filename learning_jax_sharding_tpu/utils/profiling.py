@@ -1,0 +1,66 @@
+"""Tracing / profiling + the XLA-world "sanitizers" (SURVEY.md §5).
+
+The reference's entire observability story is ``visualize_array_sharding``
+plus one flawed timing loop (`/root/reference/case6_attention.py:234-238`).
+The TPU-native equivalents:
+
+* :func:`trace` — ``jax.profiler`` capture to an XPlane/Perfetto logdir
+  (open in XProf/TensorBoard to see per-op device time, HBM traffic, and
+  which collectives ride ICI);
+* :func:`annotate` — named trace spans so framework phases (init, step,
+  eval) are findable in the timeline;
+* :func:`checking` — the nearest analogue of a race/memory sanitizer in the
+  SPMD/XLA model, where user-level data races don't exist (SURVEY.md §5
+  "Race detection"): NaN/Inf trapping (``jax_debug_nans``) and internal
+  invariant checks (``jax_enable_checks``), scoped and restored on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str | os.PathLike, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a profiler trace of the enclosed block into ``logdir``.
+
+    The capture includes device (TPU) activity, host Python/runtime activity
+    at ``host_tracer_level``, and all :func:`annotate` spans.
+    """
+    os.makedirs(os.fspath(logdir), exist_ok=True)
+    options = jax.profiler.ProfileOptions()
+    options.host_tracer_level = host_tracer_level
+    with jax.profiler.trace(os.fspath(logdir), profiler_options=options):
+        yield
+
+
+def annotate(name: str) -> jax.profiler.TraceAnnotation:
+    """Named span visible in the profiler timeline::
+
+        with annotate("train_step"):
+            state, loss = step(state, batch)
+    """
+    return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def checking(*, nans: bool = True, checks: bool = True) -> Iterator[None]:
+    """Scoped debug mode: trap NaN/Inf the moment a primitive produces one
+    (``nans``) and enable JAX's internal invariant checks (``checks``).
+
+    Costs recompilation and sync on entry/exit — a debugging tool, not a
+    production setting.
+    """
+    prev_nans = jax.config.jax_debug_nans
+    prev_checks = jax.config.jax_enable_checks
+    try:
+        jax.config.update("jax_debug_nans", nans)
+        jax.config.update("jax_enable_checks", checks)
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev_nans)
+        jax.config.update("jax_enable_checks", prev_checks)

@@ -1,0 +1,70 @@
+"""Metrics logger + profiling/debug contexts (SURVEY.md §5 subsystems)."""
+
+import io
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.utils import MetricsLogger, checking, trace
+from learning_jax_sharding_tpu.utils.profiling import annotate
+
+
+class TestMetricsLogger:
+    def test_records_loss_throughput_and_jsonl(self, tmp_path):
+        path = tmp_path / "m" / "metrics.jsonl"
+        stream = io.StringIO()
+        with MetricsLogger(
+            path, stream=stream, flops_per_step=1e9, tokens_per_step=1024,
+            n_devices=2,
+        ) as m:
+            for step in range(3):
+                rec = m.log(step, loss=jnp.float32(2.5 - step))
+                assert rec is not None
+
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [r["step"] for r in lines] == [0, 1, 2]
+        assert lines[0]["loss"] == 2.5
+        # First step has no predecessor → no rate fields.
+        assert "seconds_per_step" not in lines[0]
+        for r in lines[1:]:
+            assert r["seconds_per_step"] > 0
+            assert r["tokens_per_second"] == pytest.approx(
+                1024 / r["seconds_per_step"]
+            )
+            assert r["tflops_per_chip"] == pytest.approx(
+                1e9 / r["seconds_per_step"] / 2 / 1e12
+            )
+        out = stream.getvalue()
+        assert "loss 2.5000" in out and "ms/step" in out and "tok/s" in out
+
+    def test_log_every_skips_but_still_syncs(self):
+        with MetricsLogger(stream=None, log_every=5) as m:
+            recs = [m.log(s, loss=float(s)) for s in range(11)]
+        assert [r["step"] for r in recs if r is not None] == [0, 5, 10]
+        assert len(m.history) == 3
+
+    def test_extra_scalars(self):
+        with MetricsLogger(stream=None) as m:
+            rec = m.log(0, loss=1.0, grad_norm=jnp.float32(0.25), lr=3e-4)
+        assert rec["grad_norm"] == 0.25 and rec["lr"] == 3e-4
+
+
+class TestProfiling:
+    def test_trace_writes_profile(self, tmp_path):
+        logdir = tmp_path / "profile"
+        with trace(logdir):
+            with annotate("bench_block"):
+                np.asarray(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        # A capture landed: jax.profiler writes plugins/profile/<run>/...
+        dumped = list(logdir.rglob("*.xplane.pb"))
+        assert dumped, f"no xplane capture under {logdir}"
+
+    def test_checking_traps_nan_and_restores(self):
+        prev = jax.config.jax_debug_nans
+        with pytest.raises(FloatingPointError):
+            with checking():
+                jnp.divide(jnp.zeros(()), jnp.zeros(()))  # 0/0 → NaN
+        assert jax.config.jax_debug_nans == prev
