@@ -1,0 +1,164 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+Expert parallelism is absent from the reference (SURVEY.md §2.4 "Expert
+parallelism (EP/MoE): ❌"); this module adds it the TPU way:
+
+* **Static shapes everywhere.** Routing uses the GShard/Switch capacity
+  scheme: every expert processes exactly ``C`` token slots per step, chosen
+  by position-in-expert cumsum; overflow tokens are dropped (their residual
+  path carries them). No gather/scatter with data-dependent shapes — XLA
+  sees three einsums it can tile onto the MXU.
+* **Dispatch/combine as einsums.** ``dispatch (T,E,C)`` one-hot tensors
+  route tokens to expert slots and back; under ``EXPERT→model`` rules GSPMD
+  turns those einsums into the expert all-to-all over ICI.
+* **Expert weights (E, M, H) / (E, H, M)** carry logical axes
+  ``(EXPERT, EMBED, MLP)`` / ``(EXPERT, MLP, EMBED)`` — EP shards the E dim;
+  a 3D mesh can additionally shard MLP for TP-within-expert.
+* **fp32 router.** Gate logits/softmax stay fp32 regardless of compute dtype
+  (the same stability reasoning as the reference's softmax upcast,
+  `/root/reference/case6_attention.py:121-122`).
+
+The load-balancing auxiliary loss (Switch Transformer eq. 4) is sown into the
+``"losses"`` collection; ``training.pipeline.make_train_step(...,
+aux_loss_collection="losses")`` adds it to the task loss.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from learning_jax_sharding_tpu.parallel.logical import (
+    BATCH,
+    EMBED,
+    EXPERT,
+    MLP,
+    SEQ,
+)
+
+
+class MoEFeedForward(nn.Module):
+    """Top-k routed expert FFN, drop-in for the dense ``FeedForward``.
+
+    Attributes:
+        features: residual-stream width M.
+        hidden: per-expert FF hidden width H.
+        num_experts: expert count E.
+        top_k: experts per token (1 = Switch, 2 = GShard-style).
+        capacity_factor: slack over the even-load capacity; each expert gets
+            ``C = ceil(top_k · T · capacity_factor / E)`` slots for the
+            ``T = B·S`` tokens of the step.
+        aux_loss_weight: coefficient on the sown load-balancing loss.
+        router_noise: stddev of multiplicative jitter on router logits during
+            training (0 disables; Switch uses 1e-2).
+    """
+
+    features: int
+    hidden: int
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    router_noise: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(f"top_k={self.top_k} not in [1, {self.num_experts}]")
+        b, s, m = x.shape
+        e = self.num_experts
+        t = b * s
+        capacity = min(t, max(1, math.ceil(self.top_k * t * self.capacity_factor / e)))
+
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+
+        # --- Router (fp32) -------------------------------------------------
+        router = nn.Dense(
+            e,
+            use_bias=False,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, EXPERT)),
+            name="router",
+        )
+        logits = router(x.astype(jnp.float32)).reshape(t, e)
+        if self.router_noise > 0.0 and not deterministic:
+            key = self.make_rng("dropout")
+            logits = logits * jax.random.uniform(
+                key, logits.shape, jnp.float32,
+                1.0 - self.router_noise, 1.0 + self.router_noise,
+            )
+        probs = jax.nn.softmax(logits, axis=-1)                    # (T, E)
+
+        # --- Top-k assignment with capacity --------------------------------
+        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)     # (T, k)
+        masks = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)     # (T, k, E)
+        # Rank-major priority: all rank-0 choices claim slots before any
+        # rank-1 choice, matching GShard's dispatch order. Slot counting in
+        # int32: fp32 cumsum would lose exactness past 2^24 slots per expert.
+        flat = masks.transpose(1, 0, 2).reshape(self.top_k * t, e)  # (k·T, E)
+        pos = jnp.cumsum(flat.astype(jnp.int32), axis=0) - flat.astype(jnp.int32)
+        fits = flat * (pos < capacity)                              # drop overflow
+        pos = pos.reshape(self.top_k, t, e).transpose(1, 0, 2)      # (T, k, E)
+        fits = fits.reshape(self.top_k, t, e).transpose(1, 0, 2)    # (T, k, E)
+
+        if self.top_k > 1:
+            # Normalize the surviving gate weights per token (GShard).
+            kept_vals = gate_vals * jnp.sum(masks * fits, axis=-1)  # (T, k)
+            denom = jnp.maximum(jnp.sum(kept_vals, axis=-1, keepdims=True), 1e-9)
+            gate_vals = kept_vals / denom
+        else:
+            gate_vals = gate_vals * jnp.sum(masks * fits, axis=-1)
+
+        slot = jax.nn.one_hot(
+            jnp.sum(pos * masks.astype(jnp.int32), axis=-1), capacity,
+            dtype=jnp.float32,
+        )                                                           # (T, k, C)
+        # (T,k,E) × (T,k,C) → (T,E,C): one-hot routing tensors.
+        dispatch = jnp.einsum("tke,tkc->tec", fits, slot)
+        combine = jnp.einsum("tke,tkc,tk->tec", fits, slot, gate_vals)
+
+        # --- Load-balancing aux loss (Switch eq. 4, on rank-0 choices) -----
+        load = jnp.mean(masks[:, 0], axis=0)                        # (E,)
+        importance = jnp.mean(probs, axis=0)                        # (E,)
+        self.sow(
+            "losses",
+            "load_balancing",
+            self.aux_loss_weight * e * jnp.sum(load * importance),
+            reduce_fn=lambda a, b: a + b,
+            init_fn=lambda: jnp.zeros((), jnp.float32),
+        )
+
+        # --- Expert computation --------------------------------------------
+        xf = x.reshape(t, m)
+        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), xf.astype(self.dtype))
+        expert_in = nn.with_logical_constraint(expert_in, (EXPERT, None, EMBED))
+
+        w_up = self.param(
+            "up",
+            nn.with_logical_partitioning(self.kernel_init, (EXPERT, EMBED, MLP)),
+            (e, m, self.hidden),
+            self.param_dtype,
+        )
+        w_down = self.param(
+            "down",
+            nn.with_logical_partitioning(self.kernel_init, (EXPERT, MLP, EMBED)),
+            (e, self.hidden, m),
+            self.param_dtype,
+        )
+        h = jnp.einsum("ecm,emh->ech", expert_in, w_up.astype(self.dtype))
+        h = nn.with_logical_constraint(h, (EXPERT, None, MLP))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
+        expert_out = nn.with_logical_constraint(expert_out, (EXPERT, None, EMBED))
+
+        out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        out = out.reshape(b, s, m)
+        return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))

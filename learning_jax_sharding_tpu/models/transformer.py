@@ -98,6 +98,9 @@ class TransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     attn_fn: Optional[Callable] = None
+    num_experts: int = 0          # >0 swaps the dense FF for a routed MoE FF
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -127,13 +130,27 @@ class TransformerBlock(nn.Module):
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
             name="ln_ff",
         )(x)
-        x = x + FeedForward(
-            features=self.features,
-            hidden=self.hidden,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            name="ff",
-        )(h)
+        if self.num_experts > 0:
+            from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+
+            x = x + MoEFeedForward(
+                features=self.features,
+                hidden=self.hidden,
+                num_experts=self.num_experts,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="moe",
+            )(h, deterministic=deterministic)
+        else:
+            x = x + FeedForward(
+                features=self.features,
+                hidden=self.hidden,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="ff",
+            )(h)
         return nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
 
@@ -156,13 +173,20 @@ class TransformerConfig:
     param_dtype: Any = jnp.float32
     remat: bool = False              # rematerialize each block's activations
     attn_fn: Optional[Callable] = None
+    num_experts: int = 0             # >0: MoE FF in every block (EP over mesh)
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @property
     def param_count(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
+        ff_params = 2 * self.features * self.hidden             # ff up + down
+        if self.num_experts > 0:
+            ff_params *= self.num_experts                        # E expert FFs
+            ff_params += self.features * self.num_experts        # router
         per_block = (
             4 * self.features * self.num_heads * self.head_dim  # qkv + out
-            + 2 * self.features * self.hidden                   # ff up + down
+            + ff_params
             + 4 * self.features                                  # 2 LN scale+bias
         )
         embed = self.vocab_size * self.features + self.max_seq_len * self.features
@@ -185,6 +209,10 @@ CONFIG_TINY = TransformerConfig(
     max_seq_len=64,
     dtype=jnp.float32,
 )
+
+#: Tiny MoE variant: 4 experts, top-2 routing (expert-parallel under
+#: RULES_DP_TP_EP).
+CONFIG_TINY_MOE = dataclasses.replace(CONFIG_TINY, num_experts=4)
 
 
 class Transformer(nn.Module):
@@ -242,6 +270,9 @@ class Transformer(nn.Module):
                 dtype=cfg.dtype,
                 param_dtype=cfg.param_dtype,
                 attn_fn=cfg.attn_fn,
+                num_experts=cfg.num_experts,
+                moe_top_k=cfg.moe_top_k,
+                moe_capacity_factor=cfg.moe_capacity_factor,
                 name=f"block_{i}",
             )(x, deterministic=deterministic)
 

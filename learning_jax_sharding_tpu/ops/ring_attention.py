@@ -144,19 +144,14 @@ def make_ring_attn_fn(mesh: Mesh, rules: Any, axis: str | None = None) -> Any:
     Batch/heads placements are derived from the same rules so already-sharded
     dims partition the ring's work instead of being gathered.
     """
-    from flax.linen import partitioning as nn_partitioning
+    from learning_jax_sharding_tpu.parallel.logical import attention_mesh_axes
 
-    from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS, KV, SEQ
-
-    axes = nn_partitioning.logical_to_mesh_axes((BATCH, SEQ, HEADS, KV), tuple(rules))
-    seq_axis = axis if axis is not None else axes[1]
-    if seq_axis is None:
-        raise ValueError("rules map SEQ to no mesh axis and no axis= was given")
+    batch_axis, seq_axis, heads_axis = attention_mesh_axes(rules, axis)
 
     def attn_fn(q, k, v, *, causal: bool = False):
         return ring_attention(
             q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
-            batch_axis=axes[0], heads_axis=axes[2],
+            batch_axis=batch_axis, heads_axis=heads_axis,
         )
 
     return attn_fn

@@ -103,15 +103,10 @@ def make_ulysses_attn_fn(
     (e.g. ``make_flash_attn_fn()``), composing Ulysses' parallelism with the
     flash kernel's memory behavior.
     """
-    from flax.linen import partitioning as nn_partitioning
+    from learning_jax_sharding_tpu.parallel.logical import attention_mesh_axes
 
-    from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS, KV, SEQ
-
-    axes = nn_partitioning.logical_to_mesh_axes((BATCH, SEQ, HEADS, KV), tuple(rules))
-    seq_axis = axis if axis is not None else axes[1]
-    if seq_axis is None:
-        raise ValueError("rules map SEQ to no mesh axis and no axis= was given")
-    if axes[2] == seq_axis:
+    batch_axis, seq_axis, heads_axis = attention_mesh_axes(rules, axis)
+    if heads_axis == seq_axis:
         raise ValueError(
             f"rules map both SEQ and HEADS to mesh axis {seq_axis!r}; Ulysses "
             "re-shards heads over that axis itself"
@@ -120,7 +115,7 @@ def make_ulysses_attn_fn(
     def fn(q, k, v, *, causal: bool = False):
         return ulysses_attention(
             q, k, v, mesh=mesh, axis=seq_axis, causal=causal,
-            batch_axis=axes[0], heads_axis=axes[2], attn_fn=attn_fn,
+            batch_axis=batch_axis, heads_axis=heads_axis, attn_fn=attn_fn,
         )
 
     return fn

@@ -77,6 +77,20 @@ RULES_DP_SP: Rules = (
     (SEQ, "model"),
 )
 
+#: DP×TP plus expert parallelism: expert kernels (EXPERT, EMBED, MLP) shard
+#: their E dim over 'model' — flax resolves duplicate mappings in RULE order
+#: (verified), so EXPERT is listed before MLP to claim the axis; within the
+#: same spec the later MLP→model duplicate is dropped. Dense FF kernels
+#: (EMBED, MLP) still shard MLP — one rule set serves mixed dense/MoE stacks.
+RULES_DP_TP_EP: Rules = (
+    (BATCH, "data"),
+    (HEADS, "model"),
+    (HIDDEN, "model"),
+    (EXPERT, "model"),
+    (MLP, "model"),
+    (VOCAB, "model"),
+)
+
 #: Fully-sharded data parallel flavor: parameters sharded over the data axis
 #: too (the case-3 zero-redundancy pattern, `/root/reference/case3_fully_sharded.py`).
 RULES_FSDP: Rules = (
@@ -123,6 +137,23 @@ def tree_shardings(abstract_tree: Any, mesh: Mesh, rules: Rules) -> Any:
     """
     spec = nn.get_partition_spec(abstract_tree)
     return nn.logical_to_mesh_sharding(spec, mesh, tuple(rules))
+
+
+def attention_mesh_axes(
+    rules: Rules, axis: str | None = None
+) -> tuple[str | None, str, str | None]:
+    """Resolve the (batch, seq, heads) mesh axes of ``(B, S, N, H)`` attention
+    operands under ``rules`` — the shared plumbing of the sequence-parallel
+    attention factories (``make_ring_attn_fn`` / ``make_ulysses_attn_fn``).
+
+    ``axis`` overrides the sequence axis; raises if neither the rules nor the
+    override names one.
+    """
+    axes = nn_partitioning.logical_to_mesh_axes((BATCH, SEQ, HEADS, KV), tuple(rules))
+    seq_axis = axis if axis is not None else axes[1]
+    if seq_axis is None:
+        raise ValueError("rules map SEQ to no mesh axis and no axis= was given")
+    return axes[0], seq_axis, axes[2]
 
 
 def constrain(x: jax.Array, *logical_axes: str | None) -> jax.Array:

@@ -116,6 +116,7 @@ def make_train_step(
     loss_fn: Callable[[jax.Array, Any], jax.Array] = default_loss,
     donate_state: bool = True,
     dropout_rng: jax.Array | None = None,
+    aux_loss_collection: str | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -130,20 +131,33 @@ def make_train_step(
     is then applied with ``deterministic=False`` and a per-step key folded in
     from ``state.step`` (the model must accept a ``deterministic`` kwarg, as
     all framework models do). Left ``None``, dropout stays off.
+
+    ``aux_loss_collection``: name of a Flax variable collection (e.g.
+    ``"losses"``) whose sown scalars — MoE load-balancing terms — are summed
+    into the task loss each step.
     """
 
     def step(state: TrainState, batch: Any):
         def loss_of_params(params):
+            kwargs: dict[str, Any] = {}
             if dropout_rng is not None:
-                y = state.apply_fn(
-                    {"params": params},
-                    _inputs_of(batch),
+                kwargs = dict(
                     deterministic=False,
                     rngs={"dropout": jax.random.fold_in(dropout_rng, state.step)},
                 )
+            aux = 0.0
+            if aux_loss_collection is not None:
+                y, mut = state.apply_fn(
+                    {"params": params},
+                    _inputs_of(batch),
+                    mutable=(aux_loss_collection,),
+                    **kwargs,
+                )
+                for leaf in jax.tree.leaves(mut):
+                    aux = aux + jnp.sum(leaf)
             else:
-                y = state.apply_fn({"params": params}, _inputs_of(batch))
-            return loss_fn(y, batch)
+                y = state.apply_fn({"params": params}, _inputs_of(batch), **kwargs)
+            return loss_fn(y, batch) + aux
 
         loss, grads = jax.value_and_grad(loss_of_params)(state.params)
         return state.apply_gradients(grads=grads), loss

@@ -41,7 +41,9 @@ class MetricsLogger:
         flops_per_step: whole-program FLOPs per step (e.g. from
             ``utils.bench.compiled_flops``) — enables TFLOP/s and MFU.
         tokens_per_step: tokens consumed per step — enables tokens/s.
-        n_devices: chips sharing the work (default: all local devices).
+        n_devices: chips sharing the work (default: all devices in the
+            global ``jax.devices()`` list — the right divisor for
+            whole-program FLOPs on multi-host meshes too).
     """
 
     def __init__(

@@ -1,0 +1,155 @@
+"""Mixture-of-Experts FF + expert parallelism (SURVEY.md §2.4 "EP: absent").
+
+Oracles: at full capacity the routed computation must equal the explicit
+top-k mixture of per-expert FFNs computed directly from the params; under
+RULES_DP_TP_EP the expert dim of the (E, M, H) kernels shards over 'model';
+the MoE transformer trains end-to-end with the sown load-balancing loss.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY_MOE,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import assert_shard_shape, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP_EP, activate
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+B, S, M, H = 2, 16, 8, 32
+
+
+def _x(rng, b=B, s=S, m=M):
+    return jnp.asarray(rng.standard_normal((b, s, m)).astype(np.float32))
+
+
+def _mixture_reference(params, x, top_k):
+    """Explicit top-k mixture from the module's own params (numpy-side)."""
+    wr = np.asarray(params["router"]["kernel"])          # (M, E)
+    up = np.asarray(params["up"])                        # (E, M, H)
+    down = np.asarray(params["down"])                    # (E, H, M)
+    xt = np.asarray(x).reshape(-1, x.shape[-1])          # (T, M)
+    probs = jax.nn.softmax(jnp.asarray(xt @ wr), axis=-1)
+    vals, idx = jax.lax.top_k(probs, top_k)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    if top_k > 1:
+        vals = vals / vals.sum(-1, keepdims=True)
+    out = np.zeros_like(xt)
+    for t in range(xt.shape[0]):
+        for r in range(top_k):
+            e = idx[t, r]
+            h = np.asarray(jax.nn.gelu(jnp.asarray(xt[t] @ up[e])))
+            out[t] += vals[t, r] * (h @ down[e])
+    return out.reshape(x.shape)
+
+
+class TestMoEFeedForward:
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_full_capacity_matches_explicit_mixture(self, rng, top_k):
+        """capacity_factor = E/top_k → capacity = T: nothing drops, so the
+        routed einsum path must equal the explicit per-token mixture."""
+        moe = MoEFeedForward(
+            features=M, hidden=H, num_experts=4, top_k=top_k,
+            capacity_factor=4.0 / top_k,
+        )
+        x = _x(rng)
+        params = moe.init({"params": jax.random.key(0)}, x)["params"]
+        import flax.linen as nn
+
+        params = nn.meta.unbox(params)
+        y, _ = moe.apply({"params": params}, x, mutable=("losses",))
+        expected = _mixture_reference(params, x, top_k)
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-4, atol=2e-5)
+
+    def test_single_expert_is_plain_ff(self, rng):
+        moe = MoEFeedForward(
+            features=M, hidden=H, num_experts=1, top_k=1, capacity_factor=1.0
+        )
+        x = _x(rng)
+        params = moe.init({"params": jax.random.key(0)}, x)["params"]
+        import flax.linen as nn
+
+        params = nn.meta.unbox(params)
+        y, _ = moe.apply({"params": params}, x, mutable=("losses",))
+        up, down = np.asarray(params["up"][0]), np.asarray(params["down"][0])
+        xt = np.asarray(x).reshape(-1, M)
+        expected = (np.asarray(jax.nn.gelu(jnp.asarray(xt @ up))) @ down).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-4, atol=2e-5)
+
+    def test_tiny_capacity_drops_tokens(self, rng):
+        """With ~1 slot per expert most tokens overflow → zero output rows
+        (their residual path carries them in a full block)."""
+        moe = MoEFeedForward(
+            features=M, hidden=H, num_experts=4, top_k=1, capacity_factor=0.05
+        )
+        x = _x(rng)
+        params = moe.init({"params": jax.random.key(0)}, x)["params"]
+        y, _ = moe.apply({"params": params}, x, mutable=("losses",))
+        row_norms = np.linalg.norm(np.asarray(y).reshape(-1, M), axis=-1)
+        assert (row_norms == 0.0).sum() >= row_norms.size // 2
+
+    def test_aux_loss_sown(self, rng):
+        moe = MoEFeedForward(features=M, hidden=H, num_experts=4, top_k=2)
+        x = _x(rng)
+        params = moe.init({"params": jax.random.key(0)}, x)["params"]
+        _, mut = moe.apply({"params": params}, x, mutable=("losses",))
+        (aux,) = jax.tree.leaves(mut["losses"])
+        # Switch aux: weight · E · Σ load·importance ≥ weight (min at balance).
+        assert np.isfinite(float(aux)) and float(aux) > 0.0
+
+    def test_top_k_guard(self, rng):
+        moe = MoEFeedForward(features=M, hidden=H, num_experts=2, top_k=3)
+        with pytest.raises(ValueError, match="top_k"):
+            moe.init({"params": jax.random.key(0)}, _x(rng))
+
+
+class TestMoETransformer:
+    def _setup(self, mesh, cfg=CONFIG_TINY_MOE, b=8, s=32):
+        model = Transformer(cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+        sh = mesh_sharding(mesh, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        state, state_sh = sharded_train_state(
+            model, optax.adamw(3e-4), batch["inputs"], {"params": jax.random.key(0)},
+            mesh, RULES_DP_TP_EP,
+        )
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+            RULES_DP_TP_EP, loss_fn=next_token_loss, aux_loss_collection="losses",
+        )
+        return batch, state, step
+
+    def test_expert_kernels_shard_over_model(self, mesh22):
+        cfg = CONFIG_TINY_MOE
+        batch, state, _ = self._setup(mesh22)
+        up = state.params["block_0"]["moe"]["up"]
+        assert up.shape == (cfg.num_experts, cfg.features, cfg.hidden)
+        # EXPERT→model: 4 experts over 2 model devices → 2 per device.
+        assert_shard_shape(up, (cfg.num_experts // 2, cfg.features, cfg.hidden))
+
+    def test_moe_training_descends_with_aux_loss(self, mesh22):
+        batch, state, step = self._setup(mesh22)
+        losses = []
+        for _ in range(10):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        # Aux term present: initial loss ≳ ln(V) + aux_weight.
+        assert losses[0] > np.log(CONFIG_TINY_MOE.vocab_size)
+
+    def test_param_count_scales_with_experts(self):
+        dense = dataclasses.replace(CONFIG_TINY_MOE, num_experts=0)
+        assert CONFIG_TINY_MOE.param_count > dense.param_count
