@@ -31,3 +31,8 @@ from learning_jax_sharding_tpu.parallel.hlo import (  # noqa: F401
     collective_counts,
     compiled_hlo,
 )
+from learning_jax_sharding_tpu.parallel.pipeline import (  # noqa: F401
+    PIPE_AXIS,
+    spmd_pipeline,
+    stack_stage_params,
+)

@@ -1,0 +1,152 @@
+"""Pipeline parallelism: a GPipe-style SPMD schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: "Pipeline
+parallelism: absent") — every case runs all layers on every device. This
+module adds it the TPU-native way: no per-stage processes, no send/recv
+runtime, just one SPMD program in which a ``pipe`` mesh axis carries the
+stages and ``lax.ppermute`` hands microbatch activations to the next stage
+over a single ICI hop per tick.
+
+Schedule (circular GPipe): with ``P`` stages and ``M`` microbatches the loop
+runs ``M + P - 1`` ticks. At tick ``t`` stage 0 feeds microbatch ``t`` in,
+every stage applies its layers to the activation it currently holds, and the
+result rotates one hop right. Stage ``P-1`` starts emitting at tick ``P-1``;
+the bubble fraction is ``(P-1)/(M+P-1)`` — raise ``num_microbatches`` to
+amortize it.
+
+Composability is the point of building this on ``jax.shard_map`` with
+``axis_names={axis}`` (partial-manual mode): only the pipe axis is manual,
+every other mesh axis stays under GSPMD, so tensor/data/sequence sharding of
+the arrays *inside* a stage keeps working unchanged — dp x tp x pp from one
+jitted function. The whole schedule is ``lax.scan`` + ``ppermute`` +
+dynamic-slice, hence reverse-differentiable: ``jax.grad`` through the
+pipeline yields the backward pipeline automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(layer_params: Any, num_stages: int) -> Any:
+    """Reshape per-layer stacked params ``(L, ...)`` to ``(P, L/P, ...)``.
+
+    Stage ``i`` then owns contiguous layers ``[i*L/P, (i+1)*L/P)`` — the
+    standard contiguous stage assignment. The leading ``P`` dim is the one
+    :func:`spmd_pipeline` shards over the pipe axis.
+    """
+    leaves = jax.tree.leaves(layer_params)
+    if not leaves:
+        return layer_params
+    num_layers = leaves[0].shape[0]
+    if num_layers % num_stages:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by num_stages {num_stages}"
+        )
+    return jax.tree.map(
+        lambda p: p.reshape(num_stages, num_layers // num_stages, *p.shape[1:]),
+        layer_params,
+    )
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = PIPE_AXIS,
+    num_microbatches: int | None = None,
+) -> jax.Array:
+    """Run ``x`` through ``num_stages`` pipelined stages.
+
+    Args:
+        stage_fn: ``(params_for_one_stage, activation) -> activation`` — the
+            per-stage compute (typically a ``lax.scan`` over that stage's
+            layers). Must preserve the activation's shape/dtype (a pipeline
+            hands the same buffer shape around the ring).
+        stage_params: pytree whose leaves have leading dim ``P`` (one slice
+            per stage), placed with the stage dim sharded over ``axis`` (see
+            :func:`stage_param_sharding`).
+        x: global batch ``(B, ...)``; split into ``M`` microbatches of
+            ``B / M`` along dim 0.
+        mesh: mesh containing ``axis``; its other axes remain auto (GSPMD),
+            so dp/tp shardings inside stages are preserved.
+        axis: the pipe mesh axis name.
+        num_microbatches: ``M``; defaults to the number of stages (the
+            minimum that keeps every stage busy in steady state).
+
+    Returns:
+        ``(B, ...)`` output, replicated over ``axis`` (still sharded however
+        GSPMD decides over the other mesh axes).
+    """
+    num_stages = mesh.shape[axis]
+    m = num_stages if num_microbatches is None else num_microbatches
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by num_microbatches {m}")
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+    perm = [(j, (j + 1) % num_stages) for j in range(num_stages)]
+    nticks = m + num_stages - 1
+
+    def local(params, xloc):
+        # params leaves arrive as (1, L/P, ...): this device's stage slice.
+        params = jax.tree.map(lambda p: jnp.squeeze(p, 0), params)
+        stage = lax.axis_index(axis)
+
+        state = jnp.zeros_like(xloc[0])   # activation this stage holds
+        out = jnp.zeros_like(xloc)        # (M, mb, ...) — valid on last stage
+        # Fresh zeros are device-invariant but the carry turns device-varying
+        # after the first rotation; VMA types must match across scan
+        # iterations, so mark them varying up front (same pattern as
+        # ops/ring_attention.py).
+        state, out = lax.pcast((state, out), (axis,), to="varying")
+
+        def tick(carry, t):
+            state, out = carry
+            inp = jnp.where(
+                stage == 0,
+                lax.dynamic_index_in_dim(
+                    xloc, jnp.minimum(t, m - 1), 0, keepdims=False
+                ),
+                state,
+            )
+            y = stage_fn(params, inp)
+            # Stage P-1 finished microbatch t-(P-1) this tick; everyone else
+            # writes back what was already there (masked write keeps the
+            # schedule branch-free under scan).
+            widx = jnp.clip(t - (num_stages - 1), 0, m - 1)
+            prev = lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)
+            write = jnp.logical_and(stage == num_stages - 1, t >= num_stages - 1)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y, prev), widx, 0
+            )
+            # One ICI hop to the right neighbor; stage 0 receives the wrapped
+            # value from stage P-1 and never reads it (its input comes from
+            # the microbatch queue above).
+            state = lax.ppermute(y, axis, perm)
+            return (state, out), None
+
+        (state, out), _ = lax.scan(tick, (state, out), jnp.arange(nticks))
+        # Replicate the last stage's buffer over the pipe axis (masked psum:
+        # every other stage contributes zeros).
+        return lax.psum(jnp.where(stage == num_stages - 1, out, 0.0), axis)
+
+    param_specs = jax.tree.map(
+        lambda p: PartitionSpec(axis, *([None] * (p.ndim - 1))), stage_params
+    )
+    out_mb = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, PartitionSpec()),
+        out_specs=PartitionSpec(),
+        axis_names={axis},
+    )(stage_params, x_mb)
+    return out_mb.reshape(batch, *x.shape[1:])

@@ -1,0 +1,259 @@
+"""Pipeline parallelism: schedule correctness, grads, shardings, composition.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4) — these tests
+cover the framework's addition: the circular GPipe schedule of
+``parallel.pipeline.spmd_pipeline`` and the dp×tp×pp composed
+``models.pipelined.PipelinedTransformer``, on a (pipe=2, data=2, model=2)
+emulated mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learning_jax_sharding_tpu.models.pipelined import PipelinedTransformer
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh, collective_counts
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, activate
+from learning_jax_sharding_tpu.parallel.pipeline import (
+    spmd_pipeline,
+    stack_stage_params,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh_pp():
+    """(pipe=4, data=2) mesh for raw-schedule tests."""
+    return build_mesh((4, 2), ("pipe", "data"))
+
+
+@pytest.fixture(scope="module")
+def mesh_ppdp():
+    """(pipe=2, data=2, model=2) mesh for the composed model."""
+    return build_mesh((2, 2, 2), ("pipe", "data", "model"))
+
+
+def _stage_fn(w, h):
+    return jnp.tanh(h @ w)
+
+
+def _operands(rng, stages=4, batch=16, d=8):
+    w = jnp.asarray(rng.standard_normal((stages, d, d)) * 0.1, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((batch, d)), jnp.float32)
+    return w, x
+
+
+def _sequential(w, x):
+    for i in range(w.shape[0]):
+        x = _stage_fn(w[i], x)
+    return x
+
+
+class TestSpmdPipeline:
+    def test_forward_matches_sequential(self, mesh_pp, rng):
+        w, x = _operands(rng)
+        y = jax.jit(
+            lambda w, x: spmd_pipeline(
+                _stage_fn, w, x, mesh=mesh_pp, num_microbatches=8
+            )
+        )(w, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_sequential(w, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("m", [4, 8, 16])
+    def test_microbatch_counts(self, mesh_pp, rng, m):
+        # Any M with M | batch gives identical results; only the bubble
+        # fraction (P-1)/(M+P-1) changes.
+        w, x = _operands(rng)
+        y = jax.jit(
+            lambda w, x: spmd_pipeline(
+                _stage_fn, w, x, mesh=mesh_pp, num_microbatches=m
+            )
+        )(w, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_sequential(w, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grad_matches_sequential(self, mesh_pp, rng):
+        w, x = _operands(rng)
+
+        def loss_pp(w):
+            return jnp.sum(
+                spmd_pipeline(_stage_fn, w, x,
+                              mesh=mesh_pp, num_microbatches=8) ** 2
+            )
+
+        def loss_seq(w):
+            return jnp.sum(_sequential(w, x) ** 2)
+
+        g_pp = jax.jit(jax.grad(loss_pp))(w)
+        g_seq = jax.jit(jax.grad(loss_seq))(w)
+        np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_composes_with_data_sharding(self, mesh_pp, rng):
+        # The batch stays sharded over 'data' (auto axis) while 'pipe' is
+        # manual — dp×pp in one program.
+        w, x = _operands(rng)
+        ws = jax.device_put(w, NamedSharding(mesh_pp, P("pipe")))
+        xs = jax.device_put(x, NamedSharding(mesh_pp, P("data")))
+        y = jax.jit(
+            lambda w, x: spmd_pipeline(
+                _stage_fn, w, x, mesh=mesh_pp, num_microbatches=8
+            )
+        )(ws, xs)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_sequential(w, x)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_ppermute_in_hlo(self, mesh_pp, rng):
+        # The stage handoff must be a collective-permute ring, not gathers.
+        w, x = _operands(rng)
+        f = jax.jit(
+            lambda w, x: spmd_pipeline(
+                _stage_fn, w, x, mesh=mesh_pp, num_microbatches=8
+            )
+        )
+        counts = collective_counts(f.lower(w, x).compile().as_text())
+        assert counts["collective-permute"] >= 1, counts
+
+    def test_batch_divisibility_error(self, mesh_pp, rng):
+        w, x = _operands(rng, batch=10)
+        with pytest.raises(ValueError, match="not divisible"):
+            spmd_pipeline(_stage_fn, w, x, mesh=mesh_pp,
+                          num_microbatches=4)
+
+    def test_stack_stage_params_divisibility(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            stack_stage_params({"w": jnp.zeros((6, 2))}, 4)
+
+    def test_stack_stage_params_layout(self):
+        stacked = stack_stage_params({"w": jnp.arange(12).reshape(6, 2)}, 3)
+        assert stacked["w"].shape == (3, 2, 2)
+        # Contiguous assignment: stage 0 owns layers 0-1.
+        np.testing.assert_array_equal(
+            np.asarray(stacked["w"][0]), np.arange(4).reshape(2, 2)
+        )
+
+
+def _pp_model(mesh, m=4):
+    return PipelinedTransformer(
+        CONFIG_TINY, mesh, RULES_DP_TP, num_stages=2, num_microbatches=m
+    )
+
+
+def _tokens(cfg, b=8, s=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, size=(b, s)), jnp.int32)
+
+
+class TestPipelinedTransformer:
+    def test_param_shardings(self, mesh_ppdp):
+        model = _pp_model(mesh_ppdp)
+        tokens = _tokens(CONFIG_TINY)
+        params, shardings = model.init_sharded(jax.random.key(0), tokens)
+        # Every block leaf is (stages, layers/stage, ...) with the stage dim
+        # on 'pipe'; TP dims keep their logical mapping (e.g. the FF
+        # up-kernel's MLP dim on 'model').
+        for leaf in jax.tree.leaves(params["blocks"]):
+            assert leaf.shape[0] == 2
+            assert leaf.sharding.spec[0] == "pipe"
+        up = params["blocks"]["ff"]["up"]["kernel"]
+        assert up.sharding.spec == P("pipe", None, None, "model")
+        # Per-device stage slice: 1 stage × 1 layer × full embed × half mlp.
+        assert up.addressable_shards[0].data.shape == (
+            1, 1, CONFIG_TINY.features, CONFIG_TINY.hidden // 2,
+        )
+
+    def test_forward_matches_sequential_blocks(self, mesh_ppdp):
+        cfg = CONFIG_TINY
+        model = _pp_model(mesh_ppdp)
+        tokens = _tokens(cfg)
+        params, _ = model.init_sharded(jax.random.key(0), tokens)
+        with activate(mesh_ppdp, RULES_DP_TP):
+            logits = jax.jit(model.apply)(params, tokens)
+
+        flat = jax.tree.map(
+            lambda p: p.reshape(cfg.num_layers, *p.shape[2:]), params["blocks"]
+        )
+
+        def ref_apply(params, tokens):
+            x = model._embed.apply({"params": params["embed"]}, tokens)
+            for i in range(cfg.num_layers):
+                lp = jax.tree.map(lambda p: p[i], flat)
+                x = model._block.apply({"params": lp}, x)
+            return model._head.apply({"params": params["head"]}, x)
+
+        with activate(mesh_ppdp, RULES_DP_TP):
+            ref = jax.jit(ref_apply)(params, tokens)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_training_descends(self, mesh_ppdp):
+        cfg = CONFIG_TINY
+        model = _pp_model(mesh_ppdp)
+        tokens = _tokens(cfg, s=17)
+        batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+        params, _ = model.init_sharded(jax.random.key(0), batch["inputs"])
+        opt = optax.adamw(1e-3)
+        carry = (params, model.init_optimizer(params, opt))
+        step = model.make_train_step(opt, next_token_loss)
+        losses = []
+        for _ in range(5):
+            carry, loss = step(carry, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        assert abs(losses[0] - np.log(cfg.vocab_size)) < 1.0
+
+    def test_layer_divisibility_error(self, mesh_ppdp):
+        with pytest.raises(ValueError, match="not divisible"):
+            PipelinedTransformer(CONFIG_TINY, mesh_ppdp, RULES_DP_TP,
+                                 num_stages=4)  # 2 layers, 4 stages — but
+        # mesh check fires first only when sizes match; ensure message clear
+
+    def test_mesh_axis_size_error(self, mesh_ppdp):
+        import dataclasses as dc
+
+        cfg = dc.replace(CONFIG_TINY, num_layers=4)
+        with pytest.raises(ValueError, match="mesh axis"):
+            PipelinedTransformer(cfg, mesh_ppdp, RULES_DP_TP, num_stages=4)
+
+    def test_unsupported_config_rejected(self, mesh_ppdp):
+        import dataclasses as dc
+
+        with pytest.raises(ValueError, match="MoE"):
+            PipelinedTransformer(
+                dc.replace(CONFIG_TINY, num_experts=4), mesh_ppdp,
+                RULES_DP_TP, num_stages=2,
+            )
+        with pytest.raises(ValueError, match="dropout"):
+            PipelinedTransformer(
+                dc.replace(CONFIG_TINY, dropout_rate=0.1), mesh_ppdp,
+                RULES_DP_TP, num_stages=2,
+            )
+
+    def test_remat_matches_no_remat(self, mesh_ppdp):
+        import dataclasses as dc
+
+        cfg = CONFIG_TINY
+        tokens = _tokens(cfg, s=17)
+        batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+        losses = []
+        for remat in (False, True):
+            model = PipelinedTransformer(
+                dc.replace(cfg, remat=remat), mesh_ppdp, RULES_DP_TP,
+                num_stages=2, num_microbatches=4,
+            )
+            params, _ = model.init_sharded(jax.random.key(0), batch["inputs"])
+            opt = optax.adamw(1e-3)
+            carry = (params, model.init_optimizer(params, opt))
+            step = model.make_train_step(opt, next_token_loss)
+            _, loss = step(carry, batch)
+            losses.append(float(loss))
+        np.testing.assert_allclose(losses[0], losses[1], rtol=1e-5)
