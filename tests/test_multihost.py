@@ -1,0 +1,93 @@
+"""Multi-host bootstrap + per-host data feeding, on the single-process path.
+
+A real pod can't run in CI; what CAN be verified here is the contract the
+multi-host path shares with single-process runs: rank helpers, the host
+batch-slice arithmetic, and that per-process-local assembly produces arrays
+identical (values AND shardings) to a plain global ``device_put`` when there
+is one process — which is exactly the invariant that makes the same training
+code run unchanged on a pod.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learning_jax_sharding_tpu.parallel import multihost
+
+
+class TestRankHelpers:
+    def test_single_process_ranks(self):
+        assert multihost.process_count() == 1
+        assert multihost.process_index() == 0
+        assert multihost.is_primary()
+
+    def test_initialize_is_idempotent_and_single_process_safe(self):
+        # No cluster metadata here: both calls must no-op without raising,
+        # and the process must still see its devices afterwards.
+        multihost.initialize()
+        multihost.initialize()
+        assert multihost.process_count() == 1
+        assert len(jax.devices()) == 8
+
+    def test_initialize_propagates_real_cluster_errors(self):
+        with pytest.raises((ValueError, RuntimeError)):
+            # A genuinely multi-process request with an unreachable
+            # coordinator must raise, not be silently swallowed.
+            jax.distributed.initialize._ljst_done = False
+            try:
+                multihost.initialize(
+                    coordinator_address="invalid-host:1", num_processes=2,
+                    process_id=0,
+                )
+            finally:
+                jax.distributed.initialize._ljst_done = True
+
+
+class TestLocalBatchSlice:
+    def test_single_process_owns_everything(self, mesh24):
+        assert multihost.local_batch_slice(16) == slice(0, 16)
+
+    def test_four_host_slices(self, mesh24, monkeypatch):
+        # Simulate a 4-host cluster: host i owns contiguous rows
+        # [i*B/4, (i+1)*B/4).
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        for i in range(4):
+            monkeypatch.setattr(jax, "process_index", lambda i=i: i)
+            assert multihost.local_batch_slice(16) == slice(4 * i, 4 * i + 4)
+
+    def test_divisibility_error(self, mesh24, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        with pytest.raises(ValueError, match="not divisible"):
+            multihost.local_batch_slice(17)
+
+
+class TestHostLocalBatch:
+    def test_matches_global_device_put(self, mesh24, rng):
+        batch = {
+            "inputs": rng.integers(0, 100, size=(16, 8)).astype(np.int32),
+            "targets": rng.integers(0, 100, size=(16, 8)).astype(np.int32),
+        }
+        local = {k: v[multihost.local_batch_slice(16)]
+                 for k, v in batch.items()}
+        got = multihost.host_local_batch(local, mesh24, P("x"))
+        want_sh = NamedSharding(mesh24, P("x"))
+        for k in batch:
+            assert got[k].sharding == want_sh
+            np.testing.assert_array_equal(np.asarray(got[k]), batch[k])
+
+    def test_spec_as_sequence(self, mesh24, rng):
+        x = rng.standard_normal((8, 4)).astype(np.float32)
+        got = multihost.host_local_batch(x, mesh24, ("x", "y"))
+        assert got.sharding.spec == P("x", "y")
+        np.testing.assert_allclose(np.asarray(got), x)
+
+    def test_sharded_batches_iterator(self, mesh24, rng):
+        data = [rng.standard_normal((8, 4)).astype(np.float32)
+                for _ in range(3)]
+        out = list(multihost.sharded_batches(iter(data), mesh24, P("x")))
+        assert len(out) == 3
+        for want, got in zip(data, out):
+            assert isinstance(got, jax.Array)
+            np.testing.assert_allclose(np.asarray(got), want)
