@@ -1,0 +1,8 @@
+"""Input pipeline: token datasets and sharded batch loading."""
+
+from learning_jax_sharding_tpu.data.datasets import (  # noqa: F401
+    MemmapTokenDataset,
+    SyntheticLMDataset,
+    write_token_file,
+)
+from learning_jax_sharding_tpu.data.loader import ShardedBatchLoader  # noqa: F401

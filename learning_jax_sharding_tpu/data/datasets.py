@@ -1,0 +1,100 @@
+"""Token datasets for LM training (SURVEY.md §1: "no data-loading layer" in
+the reference — every case trains on `jax.random.normal` tensors made inline,
+e.g. `/root/reference/case6_attention.py:158-161`).
+
+Two sources cover the framework's needs:
+
+* :class:`SyntheticLMDataset` — deterministic random tokens, for tests and
+  benchmarks (the TPU-native analogue of the reference's random inputs, but
+  reproducible across hosts: every host can slice the same virtual stream).
+* :class:`MemmapTokenDataset` — a flat binary file of token ids, memory-mapped
+  so a host touches only the pages behind ITS batch slice. This is the
+  standard "packed tokens" format (GPT-2/nanoGPT style: one long uint16/32
+  array, documents concatenated); :func:`write_token_file` produces it.
+
+Both yield ``{"inputs": (B, S), "targets": (B, S)}`` numpy batches where
+targets are inputs shifted one position left — exactly what
+``models.transformer.next_token_loss`` expects.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticLMDataset:
+    """Deterministic synthetic token stream.
+
+    Batch ``i`` is a pure function of ``(seed, i)`` — hosts can materialize
+    disjoint row slices of the same global batch without coordination, and
+    repeated epochs/benchmark runs see identical data.
+    """
+
+    vocab_size: int
+    seq_len: int
+    seed: int = 0
+
+    def batch(self, index: int, rows: slice | None = None, batch_size: int = 8) -> dict:
+        """Global batch ``index``; ``rows`` selects a host-local row range."""
+        rng = np.random.default_rng((self.seed, index))
+        tokens = rng.integers(
+            0, self.vocab_size, size=(batch_size, self.seq_len + 1), dtype=np.int64
+        ).astype(np.int32)
+        if rows is not None:
+            tokens = tokens[rows]
+        return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+def write_token_file(path: str | Path, tokens: np.ndarray, dtype=np.uint16) -> Path:
+    """Write a packed-token binary file (flat array of ids)."""
+    path = Path(path)
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1:
+        raise ValueError(f"tokens must be flat, got shape {tokens.shape}")
+    if tokens.max(initial=0) >= np.iinfo(dtype).max:
+        raise ValueError(f"token ids exceed {dtype} range")
+    tokens.astype(dtype).tofile(path)
+    return path
+
+
+@dataclasses.dataclass
+class MemmapTokenDataset:
+    """Memory-mapped packed-token file: random-access (B, S+1) windows.
+
+    The file is one flat token array; sample ``j`` of batch ``i`` reads the
+    ``seq_len + 1`` tokens at a position drawn deterministically from
+    ``(seed, i, j)``. Memory cost is only the touched pages — a host feeding
+    its slice of a data-parallel batch never reads other hosts' samples.
+    """
+
+    path: str | Path
+    seq_len: int
+    dtype: type = np.uint16
+    seed: int = 0
+
+    def __post_init__(self):
+        self._data = np.memmap(self.path, dtype=self.dtype, mode="r")
+        if len(self._data) < self.seq_len + 1:
+            raise ValueError(
+                f"token file has {len(self._data)} tokens, need at least "
+                f"seq_len + 1 = {self.seq_len + 1}"
+            )
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def batch(self, index: int, rows: slice | None = None, batch_size: int = 8) -> dict:
+        rng = np.random.default_rng((self.seed, index))
+        starts = rng.integers(
+            0, len(self._data) - self.seq_len, size=batch_size
+        )
+        if rows is not None:
+            starts = starts[rows]
+        windows = np.stack(
+            [np.asarray(self._data[s : s + self.seq_len + 1]) for s in starts]
+        ).astype(np.int32)
+        return {"inputs": windows[:, :-1], "targets": windows[:, 1:]}
