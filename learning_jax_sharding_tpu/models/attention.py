@@ -57,6 +57,8 @@ class MultiHeadAttention(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     attn_fn: Optional[Callable] = None
+    decode: bool = False
+    max_decode_len: int = 0
 
     @property
     def inner_dim(self) -> int:
@@ -98,7 +100,9 @@ class MultiHeadAttention(nn.Module):
         k = nn.with_logical_constraint(k, (BATCH, SEQ, HEADS, KV))
         v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS, KV))
 
-        if self.attn_fn is None:
+        if self.decode:
+            out = self._cached_attention(q, k, v)
+        elif self.attn_fn is None:
             mask = causal_mask(s) if self.causal else None
             out = dot_product_attention(q, k, v, mask=mask)
         else:
@@ -123,3 +127,53 @@ class MultiHeadAttention(nn.Module):
         if self.dropout_rate > 0.0:
             out = nn.Dropout(rate=self.dropout_rate, deterministic=deterministic)(out)
         return out
+
+    def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+        """Autoregressive attention against an in-module KV cache.
+
+        The cache (absent from the reference, which has no inference path —
+        SURVEY.md §5) holds ``(B, max_decode_len, N, H)`` keys/values in
+        Flax's ``"cache"`` collection plus a write index. Each call appends
+        the chunk's k/v at the index and attends q against the full cache
+        with positions past the chunk masked — so one code path serves both
+        prompt prefill (S = prompt length) and single-token decode (S = 1).
+        Shapes stay static (attention always spans the whole cache buffer):
+        XLA compiles exactly two executables for the whole generate loop.
+        """
+        if self.attn_fn is not None:
+            raise ValueError(
+                "decode mode uses the dense cached path; attn_fn backends "
+                "(flash/ring) are for training-length sequences"
+            )
+        if self.max_decode_len <= 0:
+            raise ValueError("decode=True requires max_decode_len > 0")
+        b, s, n, h = q.shape
+        length = self.max_decode_len
+
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (b, length, n, h), self.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (b, length, n, h), self.dtype
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+
+        idx = cache_index.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.dtype), (0, idx, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.dtype), (0, idx, 0, 0)
+        )
+        cache_index.value = idx + s
+
+        k_full = nn.with_logical_constraint(cached_k.value, (BATCH, None, HEADS, KV))
+        v_full = nn.with_logical_constraint(cached_v.value, (BATCH, None, HEADS, KV))
+        # Query i sits at absolute position idx + i: attend to every cache
+        # slot at or before it (this also hides the zero-initialized tail).
+        q_pos = idx + jnp.arange(s)[:, None]
+        k_pos = jnp.arange(length)[None, :]
+        mask = (k_pos <= q_pos)[None, None]            # (1, 1, S, L)
+        return dot_product_attention(q, k_full, v_full, mask=mask)

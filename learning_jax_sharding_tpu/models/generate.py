@@ -1,0 +1,111 @@
+"""Autoregressive generation with a sharded KV cache.
+
+The reference has no inference path at all — its ``apply_fn`` is a full-
+sequence forward used only for timing (`/root/reference/case6_attention.py:
+229-238`). This module adds real decoding on top of the transformer's
+``decode`` mode:
+
+* **prefill**: one apply over the whole prompt fills every block's KV cache
+  (chunked attention against the cache handles intra-prompt causality);
+* **decode loop**: a ``lax.scan`` feeds one token per step — static shapes,
+  so XLA compiles exactly two executables (prefill + step) for any prompt
+  and generation length;
+* **sharded throughout**: runs under mesh + rules like every other entry
+  point; the caches inherit the activation shardings (batch over ``data``,
+  heads over ``model`` under TP rules), so tensor-parallel decoding works
+  unchanged — per-step collectives ride the same GSPMD annotations as
+  training.
+
+Greedy (``temperature=0``) and temperature sampling are supported.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
+from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+
+
+def _sample(logits: jax.Array, temperature: float, rng: jax.Array) -> jax.Array:
+    """(B, V) logits → (B,) token ids; argmax at temperature 0."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+def make_generate_fn(
+    config: TransformerConfig,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+):
+    """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
+
+    ``config`` is the TRAINING config — the decode variant (KV caches sized
+    ``max_seq_len``) is derived here, so train and generate share params
+    verbatim.
+
+    The returned function is jit-compiled as one program: prompt prefill,
+    then a ``lax.scan`` over single-token steps. ``rng`` is ignored for
+    greedy decoding (pass anything); with ``temperature > 0`` it drives
+    per-step categorical sampling.
+    """
+    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
+    model = Transformer(cfg)
+
+    def step_apply(params, cache, tokens):
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        # With no cache passed, the mutable apply CREATES the (zeroed) caches
+        # — that is the prefill call; later calls thread the cache through.
+        logits, mut = model.apply(variables, tokens, mutable=("cache",))
+        return logits[:, -1].astype(jnp.float32), mut["cache"]
+
+    def generate(params, prompt, rng):
+        b, prompt_len = prompt.shape
+        if prompt_len + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({cfg.max_seq_len})"
+            )
+        # Prefill: creates the caches (they are born inside this jitted
+        # program, sized (B, max_seq_len, ...)) and returns the last-position
+        # logits, from which the first new token is sampled.
+        logits, cache = step_apply(params, None, prompt)
+        rng0, rng_loop = jax.random.split(rng)
+        tok = _sample(logits, temperature, rng0)
+
+        def step(carry, _):
+            tok, cache, rng = carry
+            logits, cache = step_apply(params, cache, tok[:, None])
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits, temperature, sub)
+            return (nxt, cache, rng), nxt
+
+        (_, _, _), rest = lax.scan(
+            step, (tok, cache, rng_loop), None, length=max_new_tokens - 1
+        )
+        new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
+        return jnp.concatenate([prompt, new_tokens], axis=1)
+
+    jitted = jax.jit(generate, static_argnames=())
+
+    def run(params, prompt: jax.Array, rng: Optional[jax.Array] = None):
+        rng = jax.random.key(0) if rng is None else rng
+        with activate(mesh, rules):
+            return jitted(params, prompt, rng)
+
+    run.jitted = jitted
+    return run

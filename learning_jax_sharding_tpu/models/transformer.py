@@ -101,6 +101,8 @@ class TransformerBlock(nn.Module):
     num_experts: int = 0          # >0 swaps the dense FF for a routed MoE FF
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    decode: bool = False          # KV-cached autoregressive attention
+    max_decode_len: int = 0
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -121,6 +123,8 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             attn_fn=self.attn_fn,
+            decode=self.decode,
+            max_decode_len=self.max_decode_len,
             name="attn",
         )(h, deterministic=deterministic)
         h = nn.LayerNorm(
@@ -176,6 +180,7 @@ class TransformerConfig:
     num_experts: int = 0             # >0: MoE FF in every block (EP over mesh)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    decode: bool = False             # inference mode: KV cache, chunked input
 
     @property
     def param_count(self) -> int:
@@ -250,11 +255,24 @@ class Transformer(nn.Module):
             (cfg.max_seq_len, cfg.features),
             cfg.param_dtype,
         )
-        x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
+        if cfg.decode:
+            # Chunked autoregressive input: this chunk's absolute positions
+            # continue from the running cache position (the per-module KV
+            # caches keep their own matching indices).
+            pos_var = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = pos_var.value + jnp.arange(s)
+            pos_var.value = pos_var.value + s
+            x = embed(tokens) + jnp.take(pos_embed, positions, axis=0)[None].astype(
+                cfg.dtype
+            )
+        else:
+            x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
         block_cls = TransformerBlock
-        if cfg.remat:
+        if cfg.remat and not cfg.decode:
             # Trade FLOPs for HBM: recompute each block's activations in the
             # backward instead of storing them (SURVEY.md's remat note; key to
             # fitting long sequences).
@@ -273,6 +291,8 @@ class Transformer(nn.Module):
                 num_experts=cfg.num_experts,
                 moe_top_k=cfg.moe_top_k,
                 moe_capacity_factor=cfg.moe_capacity_factor,
+                decode=cfg.decode,
+                max_decode_len=cfg.max_seq_len if cfg.decode else 0,
                 name=f"block_{i}",
             )(x, deterministic=deterministic)
 
