@@ -51,7 +51,10 @@ def _col_ids(ki, block_k):
 def _fwd_kernel(
     q_ref, k_ref, v_ref,  # (block_q, H), (block_k, H), (block_k, H)
     o_ref,                # (block_q, H)
-    lse_ref,              # (1, block_q) — per-row logsumexp
+    lse_ref,              # (block_q, 1) — per-row logsumexp (kept as a
+                          # lane-size-1 3D array: Mosaic block tiling wants
+                          # the sublane dim divisible by 8, which (1, block_q)
+                          # 2D blocks violate on real TPU)
     acc_ref, m_ref, l_ref,  # VMEM scratch
     *, scale: float, causal: bool, block_q: int, block_k: int,
 ):
@@ -99,7 +102,7 @@ def _fwd_kernel(
         # Fully-masked rows (can't happen causally, but guard) → zero output.
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:, 0] + jnp.log(safe_l[:, 0])
+        lse_ref[0] = m_ref[:, :1] + jnp.log(safe_l)
 
 
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
@@ -120,11 +123,11 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bn, s_q, h), q.dtype),
-            jax.ShapeDtypeStruct((bn, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((bn, s_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, h), jnp.float32),
@@ -164,8 +167,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                            # (block_q, 1)
+        delta = delta_ref[0]                        # (block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -216,8 +219,8 @@ def _bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                            # (block_q, 1)
+        delta = delta_ref[0]                        # (block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -247,15 +250,17 @@ def _bwd(scale, causal, block_q, block_k, interpret, residuals, do):
     nq, nk = pl.cdiv(s_q, block_q), pl.cdiv(s_kv, block_k)
 
     # delta_i = Σ_h do_ih · o_ih — tiny elementwise reduction, jnp handles it.
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )
 
     common_specs = [
         pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, j, 0)),      # q by inner
         pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),      # k by outer
         pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, i, 0)),      # v by outer
         pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, j, 0)),      # do
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),            # lse
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),            # delta
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),      # lse
+        pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),      # delta
     ]
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -290,8 +295,8 @@ def _bwd(scale, causal, block_q, block_k, interpret, residuals, do):
             pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),      # k by inner
             pl.BlockSpec((1, block_k, h), lambda b, i, j: (b, j, 0)),      # v by inner
             pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),      # do
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),            # lse
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),            # delta
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),      # lse
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),      # delta
         ],
         out_specs=pl.BlockSpec((1, block_q, h), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),

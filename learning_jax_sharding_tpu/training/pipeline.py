@@ -113,10 +113,12 @@ def make_train_step(
     mesh: Mesh,
     rules: Rules,
     *,
-    loss_fn: Callable[[jax.Array, Any], jax.Array] = default_loss,
+    loss_fn: Callable[..., jax.Array] = default_loss,
     donate_state: bool = True,
     dropout_rng: jax.Array | None = None,
     aux_loss_collection: str | None = None,
+    loss_needs_params: bool = False,
+    apply_kwargs: dict[str, Any] | None = None,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -135,13 +137,20 @@ def make_train_step(
     ``aux_loss_collection``: name of a Flax variable collection (e.g.
     ``"losses"``) whose sown scalars — MoE load-balancing terms — are summed
     into the task loss each step.
+
+    ``loss_needs_params``: call ``loss_fn(y, batch, params)`` — for losses
+    that apply parameters themselves (e.g. the chunked logits head of
+    ``models.transformer.fused_next_token_loss``).
+
+    ``apply_kwargs``: extra kwargs for the model apply (e.g.
+    ``{"return_hidden": True}`` to pair with the fused loss).
     """
 
     def step(state: TrainState, batch: Any):
         def loss_of_params(params):
-            kwargs: dict[str, Any] = {}
+            kwargs: dict[str, Any] = dict(apply_kwargs or {})
             if dropout_rng is not None:
-                kwargs = dict(
+                kwargs.update(
                     deterministic=False,
                     rngs={"dropout": jax.random.fold_in(dropout_rng, state.step)},
                 )
@@ -157,7 +166,8 @@ def make_train_step(
                     aux = aux + jnp.sum(leaf)
             else:
                 y = state.apply_fn({"params": params}, _inputs_of(batch), **kwargs)
-            return loss_fn(y, batch) + aux
+            loss_args = (y, batch, params) if loss_needs_params else (y, batch)
+            return loss_fn(*loss_args) + aux
 
         loss, grads = jax.value_and_grad(loss_of_params)(state.params)
         return state.apply_gradients(grads=grads), loss

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from learning_jax_sharding_tpu.models.transformer import (
     CONFIG_125M,
@@ -148,6 +149,51 @@ class TestTransformer:
         # dropout changes the loss vs deterministic, and masks differ by step
         assert float(loss0) != float(loss_det)
         assert float(loss0) != float(loss1)
+
+    def test_fused_loss_matches_unfused(self, mesh22):
+        """Chunked logits head: identical loss AND grads to the full-logits
+        path (CE is independent across positions), at a fraction of the
+        memory — the large-batch enabler on real HBM."""
+        import functools
+
+        from learning_jax_sharding_tpu.models.transformer import (
+            fused_next_token_loss,
+        )
+
+        cfg = CONFIG_TINY
+        model, batch, state, state_sh, _ = _setup(mesh22)
+        batch_sh = {k: v.sharding for k, v in batch.items()}
+        step_fused = make_train_step(
+            state_sh, batch_sh, mesh22, RULES_DP_TP,
+            loss_fn=functools.partial(fused_next_token_loss, chunk_size=8),
+            loss_needs_params=True, apply_kwargs={"return_hidden": True},
+            donate_state=False,
+        )
+        step_plain = make_train_step(
+            state_sh, batch_sh, mesh22, RULES_DP_TP,
+            loss_fn=next_token_loss, donate_state=False,
+        )
+        state_f, loss_f = step_fused(state, batch)
+        state_p, loss_p = step_plain(state, batch)
+        np.testing.assert_allclose(float(loss_f), float(loss_p), rtol=1e-6)
+        for a, b in zip(
+            jax.tree.leaves(state_f.params), jax.tree.leaves(state_p.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    def test_fused_loss_chunk_divisibility(self, mesh22):
+        from learning_jax_sharding_tpu.models.transformer import (
+            fused_next_token_loss,
+        )
+
+        hidden = jnp.zeros((2, 10, 8))
+        with pytest.raises(ValueError, match="chunk_size"):
+            fused_next_token_loss(
+                hidden, {"targets": jnp.zeros((2, 10), jnp.int32)},
+                {"lm_head": {"kernel": jnp.zeros((8, 16))}}, chunk_size=4,
+            )
 
     def test_seq_len_guard(self, mesh22):
         cfg = CONFIG_TINY
