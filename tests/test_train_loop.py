@@ -1,0 +1,112 @@
+"""End-to-end training loop: fit, metrics, checkpoint/resume determinism."""
+
+import jax
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.data import SyntheticLMDataset
+from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY, Transformer
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+
+
+@pytest.fixture(scope="module")
+def mesh_dm():
+    return build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+
+
+def _dataset():
+    return SyntheticLMDataset(
+        vocab_size=CONFIG_TINY.vocab_size, seq_len=32, seed=7
+    )
+
+
+class _CyclicDataset:
+    """Fully learnable stream: token i+1 always follows token i (mod V) —
+    loss must fall well below ln(V). (Uniform-random synthetic data starts AT
+    its optimum ≈ ln V, so it cannot show descent.)"""
+
+    def __init__(self, vocab_size, seq_len):
+        self.vocab_size, self.seq_len = vocab_size, seq_len
+
+    def batch(self, index, rows=None, batch_size=8):
+        rng = np.random.default_rng((11, index))
+        starts = rng.integers(0, self.vocab_size, size=batch_size)
+        if rows is not None:
+            starts = starts[rows]
+        tokens = (
+            starts[:, None] + np.arange(self.seq_len + 1)[None]
+        ) % self.vocab_size
+        tokens = tokens.astype(np.int32)
+        return {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+class TestFit:
+    def test_trains_and_logs(self, mesh_dm, tmp_path):
+        cfg = TrainLoopConfig(
+            steps=6, global_batch_size=8, learning_rate=3e-3,
+            metrics_path=str(tmp_path / "metrics.jsonl"),
+        )
+        state, history = fit(
+            Transformer(CONFIG_TINY),
+            _CyclicDataset(CONFIG_TINY.vocab_size, 32),
+            mesh_dm, RULES_DP_TP, cfg,
+        )
+        assert int(state.step) == 6
+        assert len(history) == 6
+        losses = [h["loss"] for h in history]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        # JSONL mirror exists and parses
+        lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 6
+
+    def test_resume_is_exact(self, mesh_dm, tmp_path):
+        """Interrupted-then-resumed must equal uninterrupted: same batches
+        (step-indexed loader), same state (checkpoint), same final loss."""
+        model = Transformer(CONFIG_TINY)
+        full_cfg = TrainLoopConfig(steps=6, global_batch_size=8)
+        _, full_hist = fit(model, _dataset(), mesh_dm, RULES_DP_TP, full_cfg)
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        part1 = TrainLoopConfig(
+            steps=3, global_batch_size=8,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        fit(model, _dataset(), mesh_dm, RULES_DP_TP, part1)
+        part2 = TrainLoopConfig(
+            steps=6, global_batch_size=8,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        state, hist2 = fit(model, _dataset(), mesh_dm, RULES_DP_TP, part2)
+        assert int(state.step) == 6
+        # The resumed run executed only steps 4-6.
+        assert [h["step"] for h in hist2] == [4, 5, 6]
+        np.testing.assert_allclose(
+            [h["loss"] for h in hist2],
+            [h["loss"] for h in full_hist[3:]],
+            rtol=1e-6,
+        )
+
+    def test_resume_noop_when_done(self, mesh_dm, tmp_path):
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = TrainLoopConfig(
+            steps=3, global_batch_size=8,
+            checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        model = Transformer(CONFIG_TINY)
+        fit(model, _dataset(), mesh_dm, RULES_DP_TP, cfg)
+        state, hist = fit(model, _dataset(), mesh_dm, RULES_DP_TP, cfg)
+        assert int(state.step) == 3
+        assert hist == []
+
+    def test_warmup_schedule(self, mesh_dm):
+        cfg = TrainLoopConfig(
+            steps=4, global_batch_size=8, warmup_steps=10,
+            learning_rate=1e-2,
+        )
+        state, history = fit(
+            Transformer(CONFIG_TINY), _dataset(), mesh_dm, RULES_DP_TP, cfg
+        )
+        assert all(np.isfinite([h["loss"] for h in history]))
