@@ -1,0 +1,72 @@
+"""Case 9 — KV-cached autoregressive generation on a sharded mesh.
+
+Not in the reference (its only forward is a timing loop over full sequences,
+`/root/reference/case6_attention.py:234-238`). This case trains the tiny
+transformer briefly on a fully predictable token stream, then decodes with
+the framework's KV-cached generate path — prefill + single-token steps as
+two compiled executables — and shows the model reproduces the learned
+pattern. Runs under a (data, model) mesh: the caches and per-step
+collectives follow the same TP/DP shardings as training.
+
+Run: ``python cases/case9_generate.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import numpy as np
+
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY, Transformer
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+
+
+class CyclicDataset:
+    """token(i+1) = token(i) + 1 (mod V): perfectly learnable in a few steps."""
+
+    def __init__(self, vocab_size, seq_len):
+        self.vocab_size, self.seq_len = vocab_size, seq_len
+
+    def batch(self, index, rows=None, batch_size=8):
+        rng = np.random.default_rng((13, index))
+        starts = rng.integers(0, self.vocab_size, size=batch_size)
+        if rows is not None:
+            starts = starts[rows]
+        toks = (starts[:, None] + np.arange(self.seq_len + 1)[None]) % self.vocab_size
+        toks = toks.astype(np.int32)
+        return {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def main():
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    cfg = CONFIG_TINY
+
+    print("training 40 steps on the cyclic stream ...")
+    state, history = fit(
+        Transformer(cfg), CyclicDataset(cfg.vocab_size, 32), mesh, RULES_DP_TP,
+        TrainLoopConfig(steps=40, global_batch_size=16, learning_rate=3e-3,
+                        log_every=10),
+    )
+    print(f"loss: {history[0]['loss']:.3f} → {history[-1]['loss']:.3f}")
+
+    gen = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=8)
+    prompt = np.stack([np.arange(10, 16), np.arange(100, 106)]).astype(np.int32)
+    out = np.asarray(gen(state.params, jax.numpy.asarray(prompt)))
+    print("prompt → continuation:")
+    correct = 0
+    for row in out:
+        print("  ", row[:6], "→", row[6:])
+    want = (out[:, 5:-1] + 1) % cfg.vocab_size
+    correct = (out[:, 6:] == want).mean()
+    print(f"next-token accuracy on continuation: {correct:.0%}")
+    assert correct > 0.7, "trained model should continue the cycle"
+    print("PASS: KV-cached generation continues the learned sequence")
+
+
+if __name__ == "__main__":
+    main()
