@@ -28,6 +28,11 @@ from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_att
 from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
 
 
+def _dense_attention(q, k, v, mask):
+    """Positional-args wrapper so ``jax.checkpoint`` can wrap the dense op."""
+    return dot_product_attention(q, k, v, mask=mask)
+
+
 class MultiHeadAttention(nn.Module):
     """Multi-head self-attention with logical partitioning.
 
@@ -46,6 +51,13 @@ class MultiHeadAttention(nn.Module):
             (B, S, N, H) operands (see ops.flash_attention.make_flash_attn_fn
             / ops.ring_attention.make_ring_attn_fn); None (default) uses the
             dense einsum op, which also supports arbitrary masks.
+        remat_attention: recompute the O(S²) score/softmax tensors in the
+            backward pass instead of saving them (``jax.checkpoint`` around
+            the dense attention op). Costs ~one extra score einsum per layer
+            (a few % of step FLOPs) and removes the (B, N, S, S) arrays from
+            saved activations — the dominant activation-memory term, and what
+            otherwise caps batch size (flash-attention memory behavior
+            without the kernel). Dense backend only.
     """
 
     features: int
@@ -57,6 +69,7 @@ class MultiHeadAttention(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     attn_fn: Optional[Callable] = None
+    remat_attention: bool = False
     decode: bool = False
     max_decode_len: int = 0
 
@@ -104,7 +117,13 @@ class MultiHeadAttention(nn.Module):
             out = self._cached_attention(q, k, v)
         elif self.attn_fn is None:
             mask = causal_mask(s) if self.causal else None
-            out = dot_product_attention(q, k, v, mask=mask)
+            dense = _dense_attention
+            if self.remat_attention:
+                dense = jax.checkpoint(
+                    _dense_attention,
+                    policy=jax.checkpoint_policies.nothing_saveable,
+                )
+            out = dense(q, k, v, mask)
         else:
             # Custom backends (flash/ring) take the structural flag, not a
             # dense mask — they cannot honor arbitrary masks and must not

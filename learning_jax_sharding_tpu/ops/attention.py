@@ -47,12 +47,17 @@ def dot_product_attention(
     head_dim = q.shape[-1]
     scale = head_dim**-0.5 if scale is None else scale
 
-    q = q.astype(softmax_dtype) * jnp.asarray(scale, softmax_dtype)
-    k = k.astype(softmax_dtype)
     # (B,Q,N,H) x (B,K,N,H) -> (B,N,Q,K): the reference's first einsum
     # ("b t n h, b f n h -> b n f t", case6_attention.py:125) up to operand
-    # order / letter naming.
-    scores = jnp.einsum("bqnh,bknh->bnqk", q, k)
+    # order / letter naming. The reference upcasts q/k to fp32 BEFORE the
+    # einsum (case6_attention.py:121-122), which on TPU forces a multi-pass
+    # fp32 MXU matmul; requesting fp32 ACCUMULATION of the native-dtype
+    # matmul (`preferred_element_type`) gives the same stability at full
+    # bf16 MXU speed — products are exact in fp32 either way.
+    scores = jnp.einsum(
+        "bqnh,bknh->bnqk", q, k, preferred_element_type=softmax_dtype
+    )
+    scores = scores * jnp.asarray(scale, softmax_dtype)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(softmax_dtype).min)
     weights = jax.nn.softmax(scores, axis=-1)
