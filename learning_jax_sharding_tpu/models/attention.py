@@ -25,12 +25,30 @@ import jax
 import jax.numpy as jnp
 
 from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
+from learning_jax_sharding_tpu.ops.rope import apply_rope
 from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
 
 
 def _dense_attention(q, k, v, mask):
     """Positional-args wrapper so ``jax.checkpoint`` can wrap the dense op."""
     return dot_product_attention(q, k, v, mask=mask)
+
+
+def repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
+    """Broadcast grouped k/v heads ``(B, S, N_kv, H)`` to ``num_heads``.
+
+    Grouped-query attention shares each k/v head across a group of query
+    heads. Parameters, gradients, and (crucially) the decode KV cache stay at
+    ``N_kv`` heads — the repeat happens only at attention-compute time so the
+    score einsums see matching head counts and every backend (dense, flash,
+    ring) works unchanged.
+    """
+    n_kv = kv.shape[2]
+    if n_kv == num_heads:
+        return kv
+    if num_heads % n_kv:
+        raise ValueError(f"num_heads {num_heads} not a multiple of kv heads {n_kv}")
+    return jnp.repeat(kv, num_heads // n_kv, axis=2)
 
 
 class MultiHeadAttention(nn.Module):
@@ -63,6 +81,9 @@ class MultiHeadAttention(nn.Module):
     features: int
     num_heads: int = 8
     head_dim: int = 64
+    num_kv_heads: Optional[int] = None   # < num_heads → GQA; 1 → MQA
+    rope: bool = False                   # rotary positions on q/k
+    rope_theta: float = 10_000.0
     dropout_rate: float = 0.0
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -77,13 +98,29 @@ class MultiHeadAttention(nn.Module):
     def inner_dim(self) -> int:
         return self.num_heads * self.head_dim
 
-    def _proj(self, name: str) -> nn.Dense:
-        # Kernel (M, N*H) carries logical axes (EMBED, HEADS): under the
+    @property
+    def kv_heads(self) -> int:
+        """K/V head count: ``num_kv_heads`` (GQA/MQA) or all heads (MHA).
+
+        Grouped heads shrink k/v projection params, gradients, and the decode
+        KV cache by ``num_heads / num_kv_heads`` — the cache is usually what
+        caps batch×context at serving time. Query heads are unchanged. Under
+        TP rules (HEADS→model) the mesh axis size must divide this count.
+        """
+        n = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        if self.num_heads % n:
+            raise ValueError(
+                f"num_kv_heads {n} must divide num_heads {self.num_heads}"
+            )
+        return n
+
+    def _proj(self, name: str, heads: int) -> nn.Dense:
+        # Kernel (M, heads*H) carries logical axes (EMBED, HEADS): under the
         # reference rules EMBED→model splits its rows
         # (`/root/reference/case6_attention.py:56-59`); under Megatron-style
         # rules HEADS→model splits its columns.
         return nn.Dense(
-            self.inner_dim,
+            heads * self.head_dim,
             use_bias=False,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
@@ -96,9 +133,9 @@ class MultiHeadAttention(nn.Module):
         b, s, m = x.shape
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
-        q = self._proj("query")(x)
-        k = self._proj("key")(x)
-        v = self._proj("value")(x)
+        q = self._proj("query", self.num_heads)(x)
+        k = self._proj("key", self.kv_heads)(x)
+        v = self._proj("value", self.kv_heads)(x)
         # Projections emerge (B, S, N*H); constrain before the head split
         # (the reference constrains the same three activations,
         # `case6_attention.py:105-116`, but names dim 1 'embed').
@@ -107,11 +144,27 @@ class MultiHeadAttention(nn.Module):
         v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS))
 
         q = q.reshape(b, s, self.num_heads, self.head_dim)
-        k = k.reshape(b, s, self.num_heads, self.head_dim)
-        v = v.reshape(b, s, self.num_heads, self.head_dim)
+        k = k.reshape(b, s, self.kv_heads, self.head_dim)
+        v = v.reshape(b, s, self.kv_heads, self.head_dim)
         q = nn.with_logical_constraint(q, (BATCH, SEQ, HEADS, KV))
         k = nn.with_logical_constraint(k, (BATCH, SEQ, HEADS, KV))
         v = nn.with_logical_constraint(v, (BATCH, SEQ, HEADS, KV))
+
+        if self.rope:
+            # Rotate BEFORE caching so cached keys carry their absolute
+            # positions and chunked decode needs no re-rotation.
+            if self.decode:
+                # Read-only peek: _cached_attention owns (declares and
+                # advances) this variable; during init it doesn't exist yet
+                # and the chunk starts at position 0.
+                idx = self.get_variable(
+                    "cache", "cache_index", jnp.zeros((), jnp.int32)
+                )
+                positions = idx + jnp.arange(s)
+            else:
+                positions = jnp.arange(s)
+            q = apply_rope(q, positions, self.rope_theta)
+            k = apply_rope(k, positions, self.rope_theta)
 
         if self.decode:
             out = self._cached_attention(q, k, v)
@@ -123,12 +176,15 @@ class MultiHeadAttention(nn.Module):
                     _dense_attention,
                     policy=jax.checkpoint_policies.nothing_saveable,
                 )
-            out = dense(q, k, v, mask)
+            out = dense(q, repeat_kv(k, self.num_heads), repeat_kv(v, self.num_heads), mask)
         else:
             # Custom backends (flash/ring) take the structural flag, not a
             # dense mask — they cannot honor arbitrary masks and must not
             # silently reinterpret one.
-            out = self.attn_fn(q, k, v, causal=self.causal)
+            out = self.attn_fn(
+                q, repeat_kv(k, self.num_heads), repeat_kv(v, self.num_heads),
+                causal=self.causal,
+            )
         out = nn.with_logical_constraint(out, (BATCH, SEQ, HEADS, KV))
         out = out.reshape(b, s, self.inner_dim)
 
@@ -167,13 +223,14 @@ class MultiHeadAttention(nn.Module):
         if self.max_decode_len <= 0:
             raise ValueError("decode=True requires max_decode_len > 0")
         b, s, n, h = q.shape
+        n_kv = k.shape[2]  # GQA caches only the k/v heads — the GQA win
         length = self.max_decode_len
 
         cached_k = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, length, n, h), self.dtype
+            "cache", "cached_key", jnp.zeros, (b, length, n_kv, h), self.dtype
         )
         cached_v = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, length, n, h), self.dtype
+            "cache", "cached_value", jnp.zeros, (b, length, n_kv, h), self.dtype
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -188,8 +245,12 @@ class MultiHeadAttention(nn.Module):
         )
         cache_index.value = idx + s
 
-        k_full = nn.with_logical_constraint(cached_k.value, (BATCH, None, HEADS, KV))
-        v_full = nn.with_logical_constraint(cached_v.value, (BATCH, None, HEADS, KV))
+        k_full = repeat_kv(
+            nn.with_logical_constraint(cached_k.value, (BATCH, None, HEADS, KV)), n
+        )
+        v_full = repeat_kv(
+            nn.with_logical_constraint(cached_v.value, (BATCH, None, HEADS, KV)), n
+        )
         # Query i sits at absolute position idx + i: attend to every cache
         # slot at or before it (this also hides the zero-initialized tail).
         q_pos = idx + jnp.arange(s)[:, None]

@@ -16,7 +16,8 @@ sequence forward used only for timing (`/root/reference/case6_attention.py:
   unchanged — per-step collectives ride the same GSPMD annotations as
   training.
 
-Greedy (``temperature=0``) and temperature sampling are supported.
+Greedy (``temperature=0``), temperature, top-k, and nucleus (top-p) sampling
+are supported; the filters compose (k-truncation, then p-truncation).
 """
 
 from __future__ import annotations
@@ -33,13 +34,55 @@ from learning_jax_sharding_tpu.models.transformer import Transformer, Transforme
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 
 
-def _sample(logits: jax.Array, temperature: float, rng: jax.Array) -> jax.Array:
+def top_k_filter(logits: jax.Array, k: int) -> jax.Array:
+    """Mask all but the k largest logits per row to -inf. Static shapes:
+    one ``lax.top_k`` for the threshold, then a compare — no gather/scatter,
+    which is what the TPU wants for a (B, V) vocab-wide op."""
+    if k <= 0:
+        raise ValueError(f"top_k must be positive, got {k}")
+    kth = lax.top_k(logits, k)[0][..., -1:]  # (B, 1) k-th largest value
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest set of tokens with cumulative
+    probability ≥ p, mask the rest to -inf.
+
+    Implemented sort-side (sort probabilities descending, cumulative-sum,
+    map the cutoff back through a second sort of the original positions) so
+    everything is a fixed-shape sort/scan — XLA-friendly, no dynamic shapes.
+    """
+    if not 0.0 < p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {p}")
+    probs = jax.nn.softmax(logits, axis=-1)
+    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]          # descending
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # Number of tokens kept per row: first index where cumsum crosses p,
+    # inclusive (always ≥ 1).
+    keep_sorted = cumulative - sorted_probs < p                  # (B, V) bools
+    # Threshold = smallest kept probability; everything below it is cut.
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(probs < threshold, -jnp.inf, logits)
+
+
+def _sample(
+    logits: jax.Array,
+    temperature: float,
+    rng: jax.Array,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
     """(B, V) logits → (B,) token ids; argmax at temperature 0."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        logits = top_k_filter(logits, top_k)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def make_generate_fn(
@@ -49,6 +92,8 @@ def make_generate_fn(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ):
     """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
 
@@ -59,7 +104,8 @@ def make_generate_fn(
     The returned function is jit-compiled as one program: prompt prefill,
     then a ``lax.scan`` over single-token steps. ``rng`` is ignored for
     greedy decoding (pass anything); with ``temperature > 0`` it drives
-    per-step categorical sampling.
+    per-step categorical sampling, optionally truncated by ``top_k`` and/or
+    nucleus ``top_p``.
     """
     cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
     model = Transformer(cfg)
@@ -85,13 +131,13 @@ def make_generate_fn(
         # logits, from which the first new token is sampled.
         logits, cache = step_apply(params, None, prompt)
         rng0, rng_loop = jax.random.split(rng)
-        tok = _sample(logits, temperature, rng0)
+        tok = _sample(logits, temperature, rng0, top_k, top_p)
 
         def step(carry, _):
             tok, cache, rng = carry
             logits, cache = step_apply(params, cache, tok[:, None])
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits, temperature, sub)
+            nxt = _sample(logits, temperature, sub, top_k, top_p)
             return (nxt, cache, rng), nxt
 
         (_, _, _), rest = lax.scan(

@@ -93,6 +93,9 @@ class TransformerBlock(nn.Module):
     num_heads: int
     head_dim: int
     hidden: int
+    num_kv_heads: Optional[int] = None
+    rope: bool = False
+    rope_theta: float = 10_000.0
     dropout_rate: float = 0.0
     causal: bool = True
     dtype: jnp.dtype = jnp.float32
@@ -119,6 +122,9 @@ class TransformerBlock(nn.Module):
             features=self.features,
             num_heads=self.num_heads,
             head_dim=self.head_dim,
+            num_kv_heads=self.num_kv_heads,
+            rope=self.rope,
+            rope_theta=self.rope_theta,
             dropout_rate=self.dropout_rate,
             causal=self.causal,
             dtype=self.dtype,
@@ -171,6 +177,9 @@ class TransformerConfig:
     features: int = 768
     num_heads: int = 12
     head_dim: int = 64
+    num_kv_heads: Optional[int] = None  # < num_heads → GQA; 1 → MQA
+    rope: bool = False               # rotary positions instead of the learned table
+    rope_theta: float = 10_000.0
     hidden: int = 3072
     max_seq_len: int = 1024
     dropout_rate: float = 0.0
@@ -203,7 +212,7 @@ class TransformerConfig:
             # Per-token ACTIVATED params: top_k routed expert FFs + router.
             ff_params = ff_params * self.moe_top_k + self.features * self.num_experts
         matmul_params_per_layer = (
-            4 * self.features * self.num_heads * self.head_dim + ff_params
+            self._attn_proj_params + ff_params
         )
         matmul_params = (
             self.num_layers * matmul_params_per_layer
@@ -216,6 +225,15 @@ class TransformerConfig:
         return float(per_token) * batch * seq
 
     @property
+    def _attn_proj_params(self) -> int:
+        """q + k + v + out projection params (k/v shrink under GQA)."""
+        kv_heads = self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+        return (
+            2 * self.features * self.num_heads * self.head_dim   # q + out
+            + 2 * self.features * kv_heads * self.head_dim       # k + v
+        )
+
+    @property
     def param_count(self) -> int:
         """Approximate parameter count (embeddings + blocks + head)."""
         ff_params = 2 * self.features * self.hidden             # ff up + down
@@ -223,11 +241,12 @@ class TransformerConfig:
             ff_params *= self.num_experts                        # E expert FFs
             ff_params += self.features * self.num_experts        # router
         per_block = (
-            4 * self.features * self.num_heads * self.head_dim  # qkv + out
+            self._attn_proj_params                               # qkv + out
             + ff_params
             + 4 * self.features                                  # 2 LN scale+bias
         )
-        embed = self.vocab_size * self.features + self.max_seq_len * self.features
+        pos = 0 if self.rope else self.max_seq_len * self.features
+        embed = self.vocab_size * self.features + pos
         head = self.features * self.vocab_size
         return embed + self.num_layers * per_block + 2 * self.features + head
 
@@ -286,28 +305,34 @@ class Transformer(nn.Module):
             ),
             name="tok_embed",
         )
-        pos_embed = self.param(
-            "pos_embed",
-            nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), (SEQ, EMBED)
-            ),
-            (cfg.max_seq_len, cfg.features),
-            cfg.param_dtype,
-        )
-        if cfg.decode:
-            # Chunked autoregressive input: this chunk's absolute positions
-            # continue from the running cache position (the per-module KV
-            # caches keep their own matching indices).
-            pos_var = self.variable(
-                "cache", "position", lambda: jnp.zeros((), jnp.int32)
-            )
-            positions = pos_var.value + jnp.arange(s)
-            pos_var.value = pos_var.value + s
-            x = embed(tokens) + jnp.take(pos_embed, positions, axis=0)[None].astype(
-                cfg.dtype
-            )
+        if cfg.rope:
+            # Positions enter as rotations inside each attention layer
+            # (ops/rope.py) — no learned table, no position counter here (the
+            # per-layer KV caches track their own indices in decode mode).
+            x = embed(tokens)
         else:
-            x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
+            pos_embed = self.param(
+                "pos_embed",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(stddev=0.02), (SEQ, EMBED)
+                ),
+                (cfg.max_seq_len, cfg.features),
+                cfg.param_dtype,
+            )
+            if cfg.decode:
+                # Chunked autoregressive input: this chunk's absolute
+                # positions continue from the running cache position (the
+                # per-module KV caches keep their own matching indices).
+                pos_var = self.variable(
+                    "cache", "position", lambda: jnp.zeros((), jnp.int32)
+                )
+                positions = pos_var.value + jnp.arange(s)
+                pos_var.value = pos_var.value + s
+                x = embed(tokens) + jnp.take(pos_embed, positions, axis=0)[
+                    None
+                ].astype(cfg.dtype)
+            else:
+                x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
         block_cls = TransformerBlock
@@ -321,6 +346,9 @@ class Transformer(nn.Module):
                 features=cfg.features,
                 num_heads=cfg.num_heads,
                 head_dim=cfg.head_dim,
+                num_kv_heads=cfg.num_kv_heads,
+                rope=cfg.rope,
+                rope_theta=cfg.rope_theta,
                 hidden=cfg.hidden,
                 dropout_rate=cfg.dropout_rate,
                 causal=cfg.causal,

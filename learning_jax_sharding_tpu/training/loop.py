@@ -41,6 +41,9 @@ class TrainLoopConfig:
     learning_rate: float = 3e-4
     weight_decay: float = 0.01
     warmup_steps: int = 0
+    lr_schedule: str = "constant"    # "constant" | "cosine" | "linear" decay
+    min_learning_rate: float = 0.0   # decay floor (cosine/linear)
+    grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 100
     max_checkpoints: int = 3
@@ -49,13 +52,42 @@ class TrainLoopConfig:
     seed: int = 0
 
 
+def lr_schedule(cfg: TrainLoopConfig) -> optax.Schedule:
+    """Warmup → decay schedule from the loop config.
+
+    ``warmup_steps`` of linear warmup from 0, then per ``cfg.lr_schedule``:
+    ``"constant"`` holds the peak; ``"cosine"`` / ``"linear"`` decay to
+    ``min_learning_rate`` over the remaining steps. A schedule is a pure
+    step→rate function traced into the jitted step — no host-side LR state.
+    """
+    decay_steps = max(cfg.steps - cfg.warmup_steps, 1)
+    if cfg.lr_schedule == "constant":
+        decay = optax.constant_schedule(cfg.learning_rate)
+    elif cfg.lr_schedule == "cosine":
+        decay = optax.cosine_decay_schedule(
+            cfg.learning_rate, decay_steps,
+            alpha=cfg.min_learning_rate / cfg.learning_rate,
+        )
+    elif cfg.lr_schedule == "linear":
+        decay = optax.linear_schedule(
+            cfg.learning_rate, cfg.min_learning_rate, decay_steps
+        )
+    else:
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+    if cfg.warmup_steps == 0:
+        return decay
+    warmup = optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
+    return optax.join_schedules([warmup, decay], [cfg.warmup_steps])
+
+
 def default_optimizer(cfg: TrainLoopConfig) -> optax.GradientTransformation:
-    """AdamW with optional linear warmup into a constant rate (the reference
-    uses bare Adam(1e-3), `/root/reference/case6_attention.py:181`)."""
-    if cfg.warmup_steps > 0:
-        schedule = optax.linear_schedule(0.0, cfg.learning_rate, cfg.warmup_steps)
-        return optax.adamw(schedule, weight_decay=cfg.weight_decay)
-    return optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay)
+    """AdamW under the config's LR schedule, with optional global-norm
+    gradient clipping (the reference uses bare Adam(1e-3),
+    `/root/reference/case6_attention.py:181`)."""
+    opt = optax.adamw(lr_schedule(cfg), weight_decay=cfg.weight_decay)
+    if cfg.grad_clip_norm is not None:
+        opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
+    return opt
 
 
 def fit(

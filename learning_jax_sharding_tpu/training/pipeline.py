@@ -119,6 +119,7 @@ def make_train_step(
     aux_loss_collection: str | None = None,
     loss_needs_params: bool = False,
     apply_kwargs: dict[str, Any] | None = None,
+    grad_accum_steps: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -144,15 +145,24 @@ def make_train_step(
 
     ``apply_kwargs``: extra kwargs for the model apply (e.g.
     ``{"return_hidden": True}`` to pair with the fused loss).
+
+    ``grad_accum_steps``: split the batch into this many microbatches along
+    the leading axis and accumulate gradients over a ``lax.scan`` before the
+    single optimizer update — a global batch larger than HBM allows, at the
+    cost of one fwd+bwd per microbatch. The per-device batch dim must divide.
     """
 
     def step(state: TrainState, batch: Any):
-        def loss_of_params(params):
+        def loss_of_params(params, batch, micro_idx=0):
             kwargs: dict[str, Any] = dict(apply_kwargs or {})
             if dropout_rng is not None:
+                # Per-step AND per-microbatch key: microbatches must draw
+                # independent dropout masks or the accumulated gradient
+                # correlates the noise across the whole global batch.
+                key = jax.random.fold_in(dropout_rng, state.step)
                 kwargs.update(
                     deterministic=False,
-                    rngs={"dropout": jax.random.fold_in(dropout_rng, state.step)},
+                    rngs={"dropout": jax.random.fold_in(key, micro_idx)},
                 )
             aux = 0.0
             if aux_loss_collection is not None:
@@ -169,7 +179,38 @@ def make_train_step(
             loss_args = (y, batch, params) if loss_needs_params else (y, batch)
             return loss_fn(*loss_args) + aux
 
-        loss, grads = jax.value_and_grad(loss_of_params)(state.params)
+        grad_fn = jax.value_and_grad(loss_of_params)
+        if grad_accum_steps == 1:
+            loss, grads = grad_fn(state.params, batch)
+        else:
+            accum_idx = jnp.arange(grad_accum_steps)
+            def to_micro(x):
+                if x.shape[0] % grad_accum_steps:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"grad_accum_steps {grad_accum_steps}"
+                    )
+                return x.reshape(
+                    grad_accum_steps, x.shape[0] // grad_accum_steps, *x.shape[1:]
+                )
+
+            micro = jax.tree.map(to_micro, batch)
+
+            def body(acc, idx_mb):
+                idx, mb = idx_mb
+                loss_i, grads_i = grad_fn(state.params, mb, idx)
+                return (
+                    acc[0] + loss_i,
+                    jax.tree.map(jnp.add, acc[1], grads_i),
+                ), None
+
+            init = (
+                jnp.zeros((), jnp.float32),
+                jax.tree.map(jnp.zeros_like, state.params),
+            )
+            (loss_sum, grad_sum), _ = jax.lax.scan(body, init, (accum_idx, micro))
+            loss = loss_sum / grad_accum_steps
+            grads = jax.tree.map(lambda g: g / grad_accum_steps, grad_sum)
         return state.apply_gradients(grads=grads), loss
 
     jitted = jax.jit(

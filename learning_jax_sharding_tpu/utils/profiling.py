@@ -60,7 +60,14 @@ def checking(*, nans: bool = True, checks: bool = True) -> Iterator[None]:
     try:
         jax.config.update("jax_debug_nans", nans)
         jax.config.update("jax_enable_checks", checks)
+        # Executables compiled before the toggle can be replayed from the
+        # dispatch cache WITHOUT the nan checks (observed: a warm cache from
+        # unrelated prior compilations let a 0/0 divide through silently), so
+        # force recompilation inside — and again outside, where check-laden
+        # executables must not leak into production dispatch.
+        jax.clear_caches()
         yield
     finally:
         jax.config.update("jax_debug_nans", prev_nans)
         jax.config.update("jax_enable_checks", prev_checks)
+        jax.clear_caches()

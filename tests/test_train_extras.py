@@ -1,0 +1,136 @@
+"""Sampling filters, LR schedules, gradient accumulation.
+
+All complete-framework additions over the reference (whose optimizer is bare
+Adam(1e-3), `/root/reference/case6_attention.py:181`, and which has no
+inference or schedule machinery at all).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_jax_sharding_tpu.models.generate import top_k_filter, top_p_filter
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, lr_schedule
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+
+class TestSamplingFilters:
+    def test_top_k_keeps_k_largest(self):
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+        out = np.asarray(top_k_filter(logits, 2))
+        assert np.isfinite(out[0, [1, 4]]).all()
+        assert np.isneginf(out[0, [0, 2, 3]]).all()
+
+    def test_top_k_full_vocab_is_identity(self):
+        logits = jnp.asarray([[1.0, 5.0, 3.0]])
+        np.testing.assert_array_equal(np.asarray(top_k_filter(logits, 3)), np.asarray(logits))
+
+    def test_top_k_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            top_k_filter(jnp.zeros((1, 4)), 0)
+
+    def test_top_p_nucleus(self):
+        # probs = [0.5, 0.25, 0.125, 0.125]; p=0.6 → keep {0.5, 0.25}.
+        probs = np.array([[0.5, 0.25, 0.125, 0.125]])
+        logits = jnp.asarray(np.log(probs))
+        out = np.asarray(top_p_filter(logits, 0.6))
+        assert np.isfinite(out[0, [0, 1]]).all()
+        assert np.isneginf(out[0, [2, 3]]).all()
+
+    def test_top_p_one_is_identity(self):
+        logits = jnp.asarray([[1.0, 2.0, 3.0]])
+        out = np.asarray(top_p_filter(logits, 1.0))
+        assert np.isfinite(out).all()
+
+    def test_top_p_always_keeps_argmax(self):
+        # Tiny p: the single most likely token must survive.
+        logits = jnp.asarray([[0.0, 10.0, 1.0]])
+        out = np.asarray(top_p_filter(logits, 1e-6))
+        assert np.isfinite(out[0, 1])
+        assert np.isneginf(out[0, [0, 2]]).all()
+
+    def test_top_p_rejects_out_of_range(self):
+        with pytest.raises(ValueError):
+            top_p_filter(jnp.zeros((1, 4)), 0.0)
+        with pytest.raises(ValueError):
+            top_p_filter(jnp.zeros((1, 4)), 1.5)
+
+
+class TestLrSchedule:
+    def _cfg(self, **kw):
+        return TrainLoopConfig(steps=100, global_batch_size=8, learning_rate=1e-3, **kw)
+
+    def test_constant(self):
+        s = lr_schedule(self._cfg())
+        assert float(s(0)) == pytest.approx(1e-3)
+        assert float(s(99)) == pytest.approx(1e-3)
+
+    def test_warmup_then_cosine_decays_to_floor(self):
+        s = lr_schedule(self._cfg(
+            warmup_steps=10, lr_schedule="cosine", min_learning_rate=1e-4
+        ))
+        assert float(s(0)) == pytest.approx(0.0)
+        assert float(s(10)) == pytest.approx(1e-3, rel=1e-2)
+        assert float(s(100)) == pytest.approx(1e-4, rel=1e-2)
+
+    def test_linear_decay(self):
+        s = lr_schedule(self._cfg(lr_schedule="linear", min_learning_rate=0.0))
+        assert float(s(0)) == pytest.approx(1e-3)
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-8)
+
+    def test_unknown_schedule_rejected(self):
+        with pytest.raises(ValueError, match="unknown lr_schedule"):
+            lr_schedule(self._cfg(lr_schedule="exponential"))
+
+
+class TestGradAccumulation:
+    def _setup(self, mesh22, accum):
+        cfg = CONFIG_TINY
+        model = Transformer(cfg)
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+        sh = mesh_sharding(mesh22, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        state, state_sh = sharded_train_state(
+            model, optax.sgd(0.1), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+            RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+            grad_accum_steps=accum,
+        )
+        return state, step, batch
+
+    def test_accum_matches_single_step(self, mesh22):
+        """Accumulated microbatch gradients == one full-batch gradient (mean
+        CE over equal-size microbatches averages exactly)."""
+        s1, step1, batch = self._setup(mesh22, accum=1)
+        s2, step2, _ = self._setup(mesh22, accum=4)
+        new1, loss1 = step1(s1, batch)
+        new2, loss2 = step2(s2, batch)
+        np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(new1.params), jax.tree.leaves(new2.params)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-4, atol=1e-6,
+            )
+
+    def test_indivisible_batch_rejected(self, mesh22):
+        state, step, batch = self._setup(mesh22, accum=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(state, batch)
