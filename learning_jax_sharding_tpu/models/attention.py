@@ -18,6 +18,7 @@ Rebuilds the reference's ``FlaxAttention``
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional
 
 import flax.linen as nn
@@ -29,9 +30,15 @@ from learning_jax_sharding_tpu.ops.rope import apply_rope
 from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
 
 
-def _dense_attention(q, k, v, mask):
-    """Positional-args wrapper so ``jax.checkpoint`` can wrap the dense op."""
-    return dot_product_attention(q, k, v, mask=mask)
+def _dense_attention(q, k, v, mask, *, num_heads):
+    """Positional-array-args wrapper so ``jax.checkpoint`` can wrap the dense
+    op. The GQA head expansion happens INSIDE: a checkpoint always saves its
+    arguments, so expanding before it would store group-factor-times-larger
+    k/v residuals — on exactly the long-context path ``remat_attention``
+    exists to shrink."""
+    return dot_product_attention(
+        q, repeat_kv(k, num_heads), repeat_kv(v, num_heads), mask=mask
+    )
 
 
 def repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
@@ -170,13 +177,12 @@ class MultiHeadAttention(nn.Module):
             out = self._cached_attention(q, k, v)
         elif self.attn_fn is None:
             mask = causal_mask(s) if self.causal else None
-            dense = _dense_attention
+            dense = functools.partial(_dense_attention, num_heads=self.num_heads)
             if self.remat_attention:
                 dense = jax.checkpoint(
-                    _dense_attention,
-                    policy=jax.checkpoint_policies.nothing_saveable,
+                    dense, policy=jax.checkpoint_policies.nothing_saveable
                 )
-            out = dense(q, repeat_kv(k, self.num_heads), repeat_kv(v, self.num_heads), mask)
+            out = dense(q, k, v, mask)
         else:
             # Custom backends (flash/ring) take the structural flag, not a
             # dense mask — they cannot honor arbitrary masks and must not

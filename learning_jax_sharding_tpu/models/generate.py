@@ -55,16 +55,17 @@ def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
     if not 0.0 < p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {p}")
     probs = jax.nn.softmax(logits, axis=-1)
-    sorted_probs = jnp.sort(probs, axis=-1)[..., ::-1]          # descending
+    order = jnp.argsort(probs, axis=-1)[..., ::-1]               # descending
+    sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
     cumulative = jnp.cumsum(sorted_probs, axis=-1)
-    # Number of tokens kept per row: first index where cumsum crosses p,
-    # inclusive (always ≥ 1).
+    # Keep the first tokens whose cumsum-before crosses p (always ≥ 1), then
+    # scatter the kept mask back through the inverse permutation — a
+    # probability THRESHOLD would also keep every token tied with the nucleus
+    # boundary and overshoot p badly under tied logits.
     keep_sorted = cumulative - sorted_probs < p                  # (B, V) bools
-    # Threshold = smallest kept probability; everything below it is cut.
-    threshold = jnp.min(
-        jnp.where(keep_sorted, sorted_probs, jnp.inf), axis=-1, keepdims=True
-    )
-    return jnp.where(probs < threshold, -jnp.inf, logits)
+    inverse = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inverse, axis=-1)
+    return jnp.where(keep, logits, -jnp.inf)
 
 
 def _sample(

@@ -6,6 +6,7 @@ from learning_jax_sharding_tpu.parallel.mesh import (  # noqa: F401
     DEFAULT_AXIS_NAMES,
     MODEL_AXIS,
     MeshSpec,
+    build_hybrid_mesh,
     build_mesh,
     force_emulated_devices,
     single_device_mesh,

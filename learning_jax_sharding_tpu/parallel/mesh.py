@@ -160,6 +160,72 @@ def build_mesh(
     return Mesh(dev_array, tuple(axis_names))
 
 
+def build_hybrid_mesh(
+    ici_shape: Sequence[int],
+    dcn_shape: Sequence[int],
+    axis_names: Sequence[str] = DEFAULT_AXIS_NAMES,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a mesh spanning multiple TPU slices: ICI inside, DCN between.
+
+    A multi-slice pod has two interconnect tiers — ICI within each slice
+    (fast, the torus) and DCN between slices (slower, the datacenter
+    network). Mesh axis ``k`` gets size ``dcn_shape[k] * ici_shape[k]``,
+    slice-major, so an axis that is 1 in ``ici_shape`` varies ONLY across
+    slices: putting data parallelism there and tensor parallelism on an
+    axis that is 1 in ``dcn_shape`` keeps the per-step TP collectives on
+    ICI and sends only the once-per-step gradient all-reduce over DCN —
+    the standard multi-slice layout.
+
+    Example (2 slices of 4 chips, DP across slices, TP within)::
+
+        mesh = build_hybrid_mesh(ici_shape=(1, 4), dcn_shape=(2, 1))
+        # → Mesh('data': 2, 'model': 4)
+
+    On real TPU, ``mesh_utils.create_hybrid_device_mesh`` reads slice ids
+    and ICI coordinates from the devices; under CPU emulation (no slice
+    metadata) the same slice-major layout is reproduced by index, devices
+    ``[0..n/slices)`` forming slice 0, etc.
+    """
+    ici_shape, dcn_shape = tuple(ici_shape), tuple(dcn_shape)
+    axis_names = tuple(axis_names)
+    if len(ici_shape) != len(axis_names) or len(dcn_shape) != len(axis_names):
+        raise ValueError(
+            f"ici_shape {ici_shape} / dcn_shape {dcn_shape} rank must match "
+            f"axis_names {axis_names} rank"
+        )
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = math.prod(ici_shape) * math.prod(dcn_shape)
+    if n != len(devices):
+        raise ValueError(
+            f"hybrid mesh ici{ici_shape}×dcn{dcn_shape} needs exactly {n} "
+            f"devices, have {len(devices)}"
+        )
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices
+        )
+    except (ValueError, AssertionError, NotImplementedError, KeyError) as e:
+        if devices[0].platform != "cpu":
+            warnings.warn(
+                f"create_hybrid_device_mesh failed on {devices[0].platform} "
+                f"({e}); falling back to index order — mesh axes may not "
+                "follow slice topology",
+                stacklevel=2,
+            )
+        # Slice-major by index: reshape to (dcn…, ici…), interleave each
+        # (dcn_k, ici_k) pair, merge — mesh[k] then iterates slices outer,
+        # in-slice devices inner, matching create_hybrid_device_mesh.
+        rank = len(axis_names)
+        arr = np.asarray(devices).reshape(dcn_shape + ici_shape)
+        perm = [x for k in range(rank) for x in (k, rank + k)]
+        dev_array = arr.transpose(perm).reshape(
+            tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+        )
+    return Mesh(dev_array, axis_names)
+
+
 def single_device_mesh(axis_names: Sequence[str] = DEFAULT_AXIS_NAMES) -> Mesh:
     """Degenerate mesh with every axis of size 1 on the default device.
 

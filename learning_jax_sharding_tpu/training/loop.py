@@ -25,6 +25,7 @@ from learning_jax_sharding_tpu.models.transformer import next_token_loss
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 from learning_jax_sharding_tpu.training.checkpoint import CheckpointManager
 from learning_jax_sharding_tpu.training.pipeline import (
+    make_eval_step,
     make_train_step,
     sharded_train_state,
 )
@@ -176,3 +177,41 @@ def fit(
         if ckpt is not None:
             ckpt.close()
     return state, metrics.history
+
+
+def evaluate(
+    state: Any,
+    state_shardings: Any,
+    dataset: Any,
+    mesh: Any,
+    rules: Rules,
+    *,
+    batch_size: int,
+    num_batches: int,
+    loss_fn: Callable[..., jax.Array] = next_token_loss,
+    step_kwargs: dict[str, Any] | None = None,
+) -> dict[str, float]:
+    """Held-out evaluation: mean loss and perplexity over ``num_batches``.
+
+    Walks batches 0..num_batches-1 in deterministic order through a jitted
+    loss-only step on the training mesh (the batch loader is an infinite
+    indexed stream, so the caller bounds the pass). Returns
+    ``{"loss": ..., "perplexity": ..., "batches": ...}``.
+    """
+    loader = ShardedBatchLoader(dataset, mesh, batch_size, spec=("data",))
+    n = num_batches
+    if n <= 0:
+        raise ValueError("evaluate() needs at least one batch")
+    sample = loader.batch_at(0)
+    eval_step = make_eval_step(
+        state_shardings, {k: v.sharding for k, v in sample.items()}, mesh,
+        rules, loss_fn=loss_fn, **(step_kwargs or {}),
+    )
+    total = 0.0
+    for i in range(n):
+        batch = sample if i == 0 else loader.batch_at(i)  # batch 0 already placed
+        total += float(eval_step(state, batch))
+    mean = total / n
+    import math
+
+    return {"loss": mean, "perplexity": math.exp(min(mean, 700.0)), "batches": n}

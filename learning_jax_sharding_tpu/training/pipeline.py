@@ -150,6 +150,11 @@ def make_train_step(
     the leading axis and accumulate gradients over a ``lax.scan`` before the
     single optimizer update — a global batch larger than HBM allows, at the
     cost of one fwd+bwd per microbatch. The per-device batch dim must divide.
+    Loss and gradients are AVERAGED over microbatches, which reproduces the
+    full-batch step exactly for mean-over-batch losses (``next_token_loss``
+    etc.). A sum-style loss (including ``default_loss``) ends up scaled by
+    ``1/grad_accum_steps`` relative to the unaccumulated step — use a mean
+    loss when accumulating.
     """
 
     def step(state: TrainState, batch: Any):
@@ -225,6 +230,45 @@ def make_train_step(
             return jitted(state, batch)
 
     run.jitted = jitted  # expose for lowering/HLO inspection
+    return run
+
+
+def make_eval_step(
+    state_shardings: Any,
+    x_sharding: Any,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    loss_fn: Callable[..., jax.Array] = default_loss,
+    loss_needs_params: bool = False,
+    apply_kwargs: dict[str, Any] | None = None,
+) -> Callable[[TrainState, Any], jax.Array]:
+    """Build the jitted loss-only forward: ``eval_step(state, batch) -> loss``.
+
+    No gradients, no state update — a held-out evaluation pass (absent from
+    the reference, whose train_step even discards the training loss,
+    SURVEY.md §5 "Metrics"). Same sharding regime as the train step, so it
+    runs on the same mesh without resharding the state.
+    """
+
+    def ev(state: TrainState, batch: Any):
+        y = state.apply_fn(
+            {"params": state.params}, _inputs_of(batch), **(apply_kwargs or {})
+        )
+        loss_args = (y, batch, state.params) if loss_needs_params else (y, batch)
+        return loss_fn(*loss_args)
+
+    jitted = jax.jit(
+        ev,
+        in_shardings=(state_shardings, x_sharding),
+        out_shardings=NamedSharding(mesh, jax.sharding.PartitionSpec()),
+    )
+
+    def run(state: TrainState, batch: Any):
+        with activate(mesh, rules):
+            return jitted(state, batch)
+
+    run.jitted = jitted
     return run
 
 

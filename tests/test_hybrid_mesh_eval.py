@@ -1,0 +1,100 @@
+"""Hybrid ICI×DCN meshes and held-out evaluation.
+
+Both absent from the reference (single-slice emulated meshes only; no eval —
+its train_step discards even the training loss, SURVEY.md §5).
+"""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from learning_jax_sharding_tpu.data.datasets import SyntheticLMDataset
+from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY, Transformer
+from learning_jax_sharding_tpu.parallel import build_hybrid_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import evaluate
+from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+
+
+class TestHybridMesh:
+    def test_slice_major_layout(self):
+        """2 slices × 4 chips, DP across slices / TP within: the data axis
+        must vary across slices (device index blocks under the emulated
+        fallback), the model axis within one slice."""
+        mesh = build_hybrid_mesh(ici_shape=(1, 4), dcn_shape=(2, 1))
+        assert dict(mesh.shape) == {"data": 2, "model": 4}
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)
+        # Row r (slice r) holds ids [4r .. 4r+3] — in-slice devices contiguous.
+        np.testing.assert_array_equal(ids, [[0, 1, 2, 3], [4, 5, 6, 7]])
+
+    def test_mixed_axis_interleaving(self):
+        """dcn=(2,1) × ici=(2,2): each mesh axis merges its (dcn, ici) pair
+        slice-major."""
+        mesh = build_hybrid_mesh(ici_shape=(2, 2), dcn_shape=(2, 1))
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+        ids = np.vectorize(lambda d: d.id)(mesh.devices)
+        # Slice 0 = ids 0-3 (rows 0-1), slice 1 = ids 4-7 (rows 2-3).
+        np.testing.assert_array_equal(ids, [[0, 1], [2, 3], [4, 5], [6, 7]])
+
+    def test_device_count_must_match_exactly(self):
+        with pytest.raises(ValueError, match="exactly"):
+            build_hybrid_mesh(ici_shape=(1, 2), dcn_shape=(2, 1))
+
+    def test_rank_mismatch_rejected(self):
+        with pytest.raises(ValueError, match="rank"):
+            build_hybrid_mesh(ici_shape=(1, 2, 1), dcn_shape=(2, 1))
+
+    def test_trains_like_any_mesh(self, rng):
+        """A hybrid mesh is a normal Mesh: the sharded pipeline runs on it."""
+        from learning_jax_sharding_tpu.models.transformer import next_token_loss
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+        from learning_jax_sharding_tpu.training.pipeline import make_train_step
+
+        mesh = build_hybrid_mesh(ici_shape=(1, 4), dcn_shape=(2, 1))
+        cfg = CONFIG_TINY
+        model = Transformer(cfg)
+        tokens = rng.integers(0, cfg.vocab_size, size=(4, 17)).astype(np.int32)
+        sh = mesh_sharding(mesh, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        state, state_sh = sharded_train_state(
+            model, optax.adamw(1e-3), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+        )
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+            RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+        )
+        _, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+
+
+class TestEvaluate:
+    def test_loss_and_perplexity(self, mesh22):
+        cfg = CONFIG_TINY
+        model = Transformer(cfg)
+        data = SyntheticLMDataset(vocab_size=cfg.vocab_size, seq_len=16, seed=0)
+        state, state_sh = sharded_train_state(
+            model, optax.adamw(1e-3),
+            jax.device_put(
+                np.zeros((4, 16), np.int32),
+                jax.sharding.NamedSharding(mesh22, jax.sharding.PartitionSpec("data")),
+            ),
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        out = evaluate(
+            state, state_sh, data, mesh22, RULES_DP_TP,
+            batch_size=4, num_batches=3,
+        )
+        assert out["batches"] == 3
+        assert np.isfinite(out["loss"])
+        # Untrained model ≈ uniform: loss near log(V), perplexity near V.
+        assert out["loss"] == pytest.approx(np.log(cfg.vocab_size), rel=0.15)
+        assert out["perplexity"] == pytest.approx(np.exp(out["loss"]), rel=1e-6)
+
+    def test_zero_batches_rejected(self, mesh22):
+        with pytest.raises(ValueError, match="at least one"):
+            evaluate(
+                None, None, SyntheticLMDataset(vocab_size=16, seq_len=8, seed=0),
+                mesh22, RULES_DP_TP, batch_size=4, num_batches=0,
+            )

@@ -51,6 +51,18 @@ class TestSamplingFilters:
         assert np.isfinite(out[0, [0, 1]]).all()
         assert np.isneginf(out[0, [2, 3]]).all()
 
+    def test_top_p_ties_cut_exactly_at_nucleus(self):
+        # probs [0.53, 0.2, 0.2, 0.07], p=0.6: nucleus = {0.53, first 0.2}.
+        # A probability-threshold implementation would keep BOTH 0.2 tokens
+        # (0.93 mass); the exact nucleus keeps 0.73.
+        probs = np.array([[0.53, 0.2, 0.2, 0.07]])
+        out = np.asarray(top_p_filter(jnp.asarray(np.log(probs)), 0.6))
+        assert np.isfinite(out[0, 0])
+        # Exactly ONE of the tied 0.2 tokens survives (which one is the sort
+        # order's tie-break — immaterial); kept mass is 0.73, not 0.93.
+        assert np.isfinite(out[0, [1, 2]]).sum() == 1
+        assert np.isneginf(out[0, 3])
+
     def test_top_p_one_is_identity(self):
         logits = jnp.asarray([[1.0, 2.0, 3.0]])
         out = np.asarray(top_p_filter(logits, 1.0))
