@@ -155,6 +155,36 @@ def bench_transformer_125m():
     return result
 
 
+def bench_decode_125m():
+    """Serving context: KV-cached greedy decode throughput on the 125M model."""
+    import flax.linen as nn
+
+    from learning_jax_sharding_tpu.models.generate import make_generate_fn
+    from learning_jax_sharding_tpu.utils.bench import time_fn
+
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    cfg = CONFIG_125M
+    b, prompt_len, new = 8, 128, 128
+    model = Transformer(cfg)
+    rng = np.random.default_rng(0)
+    prompt = put(
+        rng.integers(0, cfg.vocab_size, size=(b, prompt_len)).astype(np.int32),
+        mesh_sharding(mesh, "data", None),
+    )
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(jax.random.key(0), prompt)[
+            "params"
+        ]
+    )
+    gen = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=new)
+    secs = time_fn(gen, params, prompt, jax.random.key(1), min_time=2.0)
+    toks = b * new
+    _log(
+        f"[bench] 125M KV-cached decode (b={b}, prompt {prompt_len}, +{new} new): "
+        f"{toks / secs:,.0f} tok/s, {secs / new * 1e3:.2f} ms/token-step"
+    )
+
+
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.
 
@@ -202,6 +232,10 @@ def main():
         bench_transformer_125m()
     except Exception as e:  # context only — never break the headline line
         _log(f"[bench] 125M transformer bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_decode_125m()
+    except Exception as e:
+        _log(f"[bench] 125M decode bench skipped: {type(e).__name__}: {e}")
 
     vs_baseline = (ours / baseline) if (ours and baseline) else None
     print(json.dumps({
