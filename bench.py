@@ -176,12 +176,16 @@ def bench_decode_125m():
             "params"
         ]
     )
-    gen = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=new)
+    gen = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
+        inference_dtype=jnp.bfloat16,
+    )
     secs = time_fn(gen, params, prompt, jax.random.key(1), min_time=2.0)
     toks = b * new
     _log(
-        f"[bench] 125M KV-cached decode (b={b}, prompt {prompt_len}, +{new} new): "
-        f"{toks / secs:,.0f} tok/s, {secs / new * 1e3:.2f} ms/token-step"
+        f"[bench] 125M KV-cached decode, bf16 weights (b={b}, prompt "
+        f"{prompt_len}, +{new} new): {toks / secs:,.0f} tok/s, "
+        f"{secs / new * 1e3:.2f} ms/token-step"
     )
 
 

@@ -23,7 +23,7 @@ are supported; the filters compose (k-truncation, then p-truncation).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -95,6 +95,7 @@ def make_generate_fn(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    inference_dtype: Any | None = None,
 ):
     """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
 
@@ -107,9 +108,28 @@ def make_generate_fn(
     greedy decoding (pass anything); with ``temperature > 0`` it drives
     per-step categorical sampling, optionally truncated by ``top_k`` and/or
     nucleus ``top_p``.
+
+    ``inference_dtype``: cast floating-point params to this dtype (eagerly,
+    once per generate call — NOT inside the jitted program: XLA does not
+    hoist the cast out of the decode scan and re-casting every token step
+    measured 20% slower) and run the whole model at it. bf16 halves weight
+    memory; throughput is neutral on the v5e 125M bench (decode there is
+    bound by KV-cache attention and per-step work, not weight reads).
+    ``None`` keeps training dtypes.
     """
     cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
+    if inference_dtype is not None:
+        cfg = dataclasses.replace(cfg, dtype=inference_dtype, param_dtype=inference_dtype)
     model = Transformer(cfg)
+
+    def maybe_cast(params):
+        if inference_dtype is None:
+            return params
+        return jax.tree.map(
+            lambda x: x.astype(inference_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params,
+        )
 
     def step_apply(params, cache, tokens):
         variables = {"params": params}
@@ -151,6 +171,7 @@ def make_generate_fn(
 
     def run(params, prompt: jax.Array, rng: Optional[jax.Array] = None):
         rng = jax.random.key(0) if rng is None else rng
+        params = maybe_cast(params)  # eager; pre-cast params make this a no-op
         with activate(mesh, rules):
             return jitted(params, prompt, rng)
 

@@ -153,3 +153,24 @@ class TestGenerate:
         gen = make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=10)
         with pytest.raises(ValueError, match="max_seq_len"):
             gen(params, prompt)
+
+    def test_inference_dtype_bf16(self, mesh22, trained):
+        """Params cast eagerly to bf16: valid tokens, same greedy path shape;
+        pre-cast params give identical results (the cast is a no-op then)."""
+        import jax.numpy as jnp
+
+        cfg, params = trained
+        prompt = _tokens(cfg, b=2, s=4, seed=5)
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=4,
+            inference_dtype=jnp.bfloat16,
+        )
+        out = np.asarray(gen(params, prompt))
+        assert out.shape == (2, 8)
+        assert (out >= 0).all() and (out < cfg.vocab_size).all()
+        p16 = jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params,
+        )
+        np.testing.assert_array_equal(out, np.asarray(gen(p16, prompt)))
