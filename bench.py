@@ -35,13 +35,7 @@ from learning_jax_sharding_tpu.models.transformer import (
     Transformer,
 )
 from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
-from learning_jax_sharding_tpu.parallel.logical import (
-    BATCH,
-    EMBED,
-    RULES_DP_TP,
-    SEQ,
-    logical_sharding,
-)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
 from learning_jax_sharding_tpu.training.pipeline import (
     make_train_step,
     sharded_train_state,

@@ -19,7 +19,6 @@ per-block attention implementation.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax

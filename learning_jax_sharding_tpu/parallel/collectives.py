@@ -16,7 +16,6 @@ latency-hiding ring matmul, which GSPMD cannot express).
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -13,7 +13,7 @@ import numpy as np
 from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
 from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
 from learning_jax_sharding_tpu.ops.ring_attention import make_ring_attn_fn
-from learning_jax_sharding_tpu.parallel import mesh_sharding, put, shard_shapes
+from learning_jax_sharding_tpu.parallel import put, shard_shapes
 from learning_jax_sharding_tpu.parallel.logical import (
     BATCH,
     EMBED,

@@ -10,11 +10,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 
 from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
 from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
-from learning_jax_sharding_tpu.parallel import assert_shard_shape, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel import assert_shard_shape, put
 from learning_jax_sharding_tpu.parallel.logical import (
     BATCH,
     EMBED,
@@ -22,7 +21,6 @@ from learning_jax_sharding_tpu.parallel.logical import (
     RULES_DP_TP_SP,
     RULES_REFERENCE,
     SEQ,
-    activate,
     logical_sharding,
 )
 from learning_jax_sharding_tpu.training.pipeline import (

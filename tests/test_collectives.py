@@ -8,7 +8,6 @@ facts about our own explicit implementations too.
 
 from functools import partial
 
-import jax
 import numpy as np
 
 from tests.conftest import matmul_operands

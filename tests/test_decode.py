@@ -18,7 +18,6 @@ from learning_jax_sharding_tpu.models.generate import make_generate_fn
 from learning_jax_sharding_tpu.models.transformer import (
     CONFIG_TINY,
     Transformer,
-    next_token_loss,
 )
 from learning_jax_sharding_tpu.parallel import mesh_sharding, put
 from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, activate

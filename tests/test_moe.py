@@ -21,7 +21,7 @@ from learning_jax_sharding_tpu.models.transformer import (
     next_token_loss,
 )
 from learning_jax_sharding_tpu.parallel import assert_shard_shape, mesh_sharding, put
-from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP_EP, activate
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP_EP
 from learning_jax_sharding_tpu.training.pipeline import (
     make_train_step,
     sharded_train_state,

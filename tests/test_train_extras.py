@@ -5,7 +5,6 @@ Adam(1e-3), `/root/reference/case6_attention.py:181`, and which has no
 inference or schedule machinery at all).
 """
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ from learning_jax_sharding_tpu.parallel import (
     collective_counts,
     mesh_sharding,
     put,
-    shard_shapes,
 )
 from learning_jax_sharding_tpu.parallel.logical import (
     RULES_DP_TP,
