@@ -1,0 +1,61 @@
+"""Byte-level tokenizer: train on raw text with zero external dependencies.
+
+The reference trains on random tensors only (SURVEY.md §5 "Data loading:
+none"); the framework's packed-token pipeline (``datasets.py``) needs token
+ids from somewhere. This is the dependency-free source: UTF-8 bytes as the
+vocabulary (ids 0-255) plus a few special tokens — the GPT-2-byte-fallback
+idea without the merge table. Any text round-trips exactly; no downloaded
+vocab files, which matters in network-isolated TPU environments.
+
+Pairs with :func:`datasets.write_token_file` / :class:`datasets.MemmapTokenDataset`::
+
+    tok = ByteTokenizer()
+    write_token_file("corpus.bin", tok.encode_to_array(text))
+    ds = MemmapTokenDataset("corpus.bin", seq_len=1024)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: Special token ids sit ABOVE the byte range.
+PAD_ID = 256
+BOS_ID = 257
+EOS_ID = 258
+
+
+@dataclasses.dataclass(frozen=True)
+class ByteTokenizer:
+    """UTF-8 byte tokenizer with optional BOS/EOS framing.
+
+    ``vocab_size`` is 259 (256 bytes + pad/bos/eos); round it up to a
+    TPU-friendly multiple in the model config (e.g. 384 or 512 — the lm_head
+    matmul wants lane-aligned vocab dims) — extra ids are simply never
+    produced.
+    """
+
+    add_bos: bool = False
+    add_eos: bool = False
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if self.add_bos:
+            ids.insert(0, BOS_ID)
+        if self.add_eos:
+            ids.append(EOS_ID)
+        return ids
+
+    def encode_to_array(self, text: str, dtype=np.uint16) -> np.ndarray:
+        return np.asarray(self.encode(text), dtype=dtype)
+
+    def decode(self, ids) -> str:
+        """Inverse of :meth:`encode`; special tokens are dropped, invalid
+        UTF-8 (possible mid-sequence truncation) is replaced, not raised."""
+        data = bytes(i for i in np.asarray(ids).reshape(-1).tolist() if i < 256)
+        return data.decode("utf-8", errors="replace")

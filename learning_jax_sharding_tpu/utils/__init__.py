@@ -8,6 +8,11 @@ from learning_jax_sharding_tpu.utils.bench import (  # noqa: F401
     measure,
     time_fn,
 )
+from learning_jax_sharding_tpu.utils.memory import (  # noqa: F401
+    HBM_BYTES,
+    MemoryPlan,
+    memory_plan,
+)
 from learning_jax_sharding_tpu.utils.metrics import MetricsLogger  # noqa: F401
 from learning_jax_sharding_tpu.utils.profiling import (  # noqa: F401
     annotate,

@@ -1,0 +1,120 @@
+"""HBM budget estimation for a transformer training configuration.
+
+Answers "will this config fit a chip?" before paying a compile + OOM cycle
+(measured on the v5e: the 125M model at b=16, s=1024 OOMs from stored dense
+attention probabilities alone — exactly the term this planner surfaces).
+Estimates, not measurements: XLA fusion changes the constants, but the big
+terms (parameters, optimizer moments, per-layer saved activations, S² score
+tensors, (B,S,V) logits) dominate and are shape-arithmetic.
+
+Conventions: fp32 params/optimizer (the framework default), activations in
+``cfg.dtype``. ``saved`` activations are what backward needs — the planner
+models the three attention regimes (dense / remat / flash) and the fused
+vs. unfused loss head explicitly, because those are the order-of-magnitude
+levers (PERF.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+#: Per-chip HBM, bytes. Public system specs, keyed by device_kind.
+HBM_BYTES: dict[str, float] = {
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,   # v5e
+    "TPU v5": 95e9,        # v5p
+    "TPU v5p": 95e9,
+    "TPU v6 lite": 32e9,   # v6e
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryPlan:
+    """Byte estimates for one train step (single chip unless divided)."""
+
+    params: float
+    grads: float
+    optimizer_state: float
+    saved_activations: float
+    loss_head: float
+    total: float
+    detail: dict
+
+    def fits(self, hbm_bytes: float, *, headroom: float = 0.8) -> bool:
+        """Conservative fit check: estimate under ``headroom`` × capacity
+        (XLA scratch, fragmentation, and fusion temporaries take the rest)."""
+        return self.total <= hbm_bytes * headroom
+
+
+def memory_plan(
+    cfg: Any,
+    batch: int,
+    seq: int,
+    *,
+    optimizer_slots: int = 2,       # adamw: m + v
+    donate_state: bool = True,
+    unfused_loss: bool = False,
+    n_model_shards: int = 1,        # TP/FSDP degree dividing params & opt state
+    n_data_shards: int = 1,         # DP degree dividing the batch dim
+) -> MemoryPlan:
+    """Estimate train-step HBM for a :class:`TransformerConfig`.
+
+    Attention regime is read off the config: ``attn_fn`` set → flash-style
+    (no S² saved); else ``remat_attention`` → q/k/v saved, scores recomputed;
+    else dense → fp32 scores + probabilities saved for backward.
+    """
+    act_bytes = jnp.dtype(cfg.dtype).itemsize
+    param_bytes = jnp.dtype(cfg.param_dtype).itemsize
+    b = batch / n_data_shards
+    p = cfg.param_count / n_model_shards
+
+    params = p * param_bytes
+    grads = p * param_bytes
+    opt = p * param_bytes * optimizer_slots
+    if not donate_state:
+        # Undonated input state stays alive next to the output state.
+        params, opt = 2 * params, 2 * opt
+
+    kv_heads = cfg.num_kv_heads if cfg.num_kv_heads is not None else cfg.num_heads
+    nh = cfg.num_heads * cfg.head_dim / n_model_shards
+    nkv = kv_heads * cfg.head_dim / n_model_shards
+    tokens = b * seq
+
+    # Saved-per-layer residuals the backward reads (block input, LN outputs,
+    # q/k/v, attention output, FF up/GELU); coefficients from the block
+    # structure, not measured constants.
+    per_layer = tokens * act_bytes * (
+        4 * cfg.features            # block in, 2×LN out, attn out
+        + nh + 2 * nkv              # q, k, v
+        + 2 * cfg.hidden / n_model_shards  # FF up pre/post-GELU
+    )
+    if cfg.attn_fn is not None:
+        scores = 0.0                # flash: O(S·H) only, counted in q/k/v
+    elif getattr(cfg, "remat_attention", False):
+        scores = 0.0                # recomputed in backward
+    else:
+        heads = cfg.num_heads / n_model_shards
+        # Saved probabilities (softmax backward reads only its OUTPUT, so the
+        # fp32 pre-softmax scores are fusion temporaries, not residuals).
+        scores = b * heads * seq * seq * act_bytes
+    saved = cfg.num_layers * (per_layer + scores)
+
+    if unfused_loss:
+        # bf16 logits + the fp32 softmax upcast both live at peak.
+        head = tokens * cfg.vocab_size / n_model_shards * (act_bytes + 4)
+    else:
+        head = tokens * 128 / seq * cfg.vocab_size / n_model_shards * (act_bytes + 4)
+
+    total = params + grads + opt + saved + head
+    return MemoryPlan(
+        params=params, grads=grads, optimizer_state=opt,
+        saved_activations=saved, loss_head=head, total=total,
+        detail={
+            "per_layer_residuals": per_layer,
+            "per_layer_scores": scores,
+            "batch_per_shard": b,
+        },
+    )

@@ -1,0 +1,130 @@
+"""Byte tokenizer round-trips and memory-planner calibration.
+
+The planner's oracle values are the OBSERVED fit/OOM boundary on the 16 GB
+v5e (this repo's bench experiments, PERF.md): the 125M model at s=1024,
+donate_state=False —
+
+* b=8,  dense attention, unfused loss → ran (102 ms baseline);
+* b=16, dense attention              → ResourceExhausted;
+* b=16, flash + fused loss           → ran;
+* b=32, flash + fused loss           → ResourceExhausted.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.data.tokenizer import (
+    BOS_ID,
+    EOS_ID,
+    ByteTokenizer,
+)
+from learning_jax_sharding_tpu.models.transformer import CONFIG_125M
+from learning_jax_sharding_tpu.utils.memory import HBM_BYTES, memory_plan
+
+V5E = HBM_BYTES["TPU v5 lite"]
+
+
+def _flash_cfg():
+    # Any non-None attn_fn marks the flash regime; the planner never calls it.
+    return dataclasses.replace(CONFIG_125M, attn_fn=lambda *a, **k: None)
+
+
+class TestMemoryPlan:
+    def test_b8_dense_unfused_fits_v5e(self):
+        plan = memory_plan(
+            CONFIG_125M, 8, 1024, donate_state=False, unfused_loss=True
+        )
+        assert plan.fits(V5E)
+
+    def test_b16_dense_ooms_v5e(self):
+        plan = memory_plan(
+            CONFIG_125M, 16, 1024, donate_state=False, unfused_loss=True
+        )
+        assert not plan.fits(V5E)
+
+    def test_b16_flash_fused_fits_v5e(self):
+        plan = memory_plan(_flash_cfg(), 16, 1024, donate_state=False)
+        assert plan.fits(V5E)
+
+    def test_b32_flash_fused_ooms_v5e(self):
+        plan = memory_plan(_flash_cfg(), 32, 1024, donate_state=False)
+        assert not plan.fits(V5E)
+
+    def test_remat_attention_drops_score_term(self):
+        dense = memory_plan(CONFIG_125M, 8, 1024)
+        remat = memory_plan(
+            dataclasses.replace(CONFIG_125M, remat_attention=True), 8, 1024
+        )
+        assert dense.detail["per_layer_scores"] > 0
+        assert remat.detail["per_layer_scores"] == 0
+        assert remat.total < dense.total
+
+    def test_sharding_divides_the_big_terms(self):
+        one = memory_plan(CONFIG_125M, 8, 1024)
+        tp4 = memory_plan(CONFIG_125M, 8, 1024, n_model_shards=4)
+        dp4 = memory_plan(CONFIG_125M, 8, 1024, n_data_shards=4)
+        assert tp4.optimizer_state == pytest.approx(one.optimizer_state / 4)
+        assert dp4.saved_activations == pytest.approx(one.saved_activations / 4)
+
+    def test_donation_halves_state_residency(self):
+        kept = memory_plan(CONFIG_125M, 8, 1024, donate_state=False)
+        donated = memory_plan(CONFIG_125M, 8, 1024, donate_state=True)
+        assert donated.params == pytest.approx(kept.params / 2)
+        assert donated.optimizer_state == pytest.approx(kept.optimizer_state / 2)
+
+
+class TestByteTokenizer:
+    def test_ascii_round_trip(self):
+        tok = ByteTokenizer()
+        text = "hello, TPU world!"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_utf8_round_trip(self):
+        tok = ByteTokenizer()
+        text = "résumé — 日本語 🚀"
+        assert tok.decode(tok.encode(text)) == text
+
+    def test_bos_eos_framing(self):
+        tok = ByteTokenizer(add_bos=True, add_eos=True)
+        ids = tok.encode("ab")
+        assert ids[0] == BOS_ID and ids[-1] == EOS_ID
+        assert tok.decode(ids) == "ab"  # specials dropped on decode
+
+    def test_array_encoding_dtype(self):
+        arr = ByteTokenizer().encode_to_array("abc")
+        assert arr.dtype == np.uint16
+        np.testing.assert_array_equal(arr, [97, 98, 99])
+
+    def test_truncated_utf8_replaces_not_raises(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("🚀")[:2]  # mid-codepoint cut
+        assert "�" in tok.decode(ids)
+
+    def test_vocab_size_covers_specials(self):
+        assert ByteTokenizer().vocab_size == 259 > EOS_ID
+
+
+class TestEndToEnd:
+    def test_text_to_training_batches(self, tmp_path):
+        """Raw text → packed token file → sharded batches, no externals."""
+        from learning_jax_sharding_tpu.data.datasets import (
+            MemmapTokenDataset,
+            write_token_file,
+        )
+
+        tok = ByteTokenizer(add_eos=True)
+        corpus = "the quick brown fox jumps over the lazy dog. " * 40
+        path = write_token_file(tmp_path / "corpus.bin", tok.encode_to_array(corpus))
+        ds = MemmapTokenDataset(path, seq_len=16)
+        batch = ds.batch(0, batch_size=4)
+        assert batch["inputs"].shape == (4, 16)
+        np.testing.assert_array_equal(
+            batch["inputs"][:, 1:], batch["targets"][:, :-1]
+        )
+        # Decoded inputs are substrings of the corpus (plus possible EOS).
+        row = tok.decode(batch["inputs"][0])
+        assert row.strip("�") and all(
+            piece in corpus for piece in row.split("�") if piece
+        )
