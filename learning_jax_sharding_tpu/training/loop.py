@@ -181,7 +181,6 @@ def fit(
 
 def evaluate(
     state: Any,
-    state_shardings: Any,
     dataset: Any,
     mesh: Any,
     rules: Rules,
@@ -195,7 +194,9 @@ def evaluate(
 
     Walks batches 0..num_batches-1 in deterministic order through a jitted
     loss-only step on the training mesh (the batch loader is an infinite
-    indexed stream, so the caller bounds the pass). Returns
+    indexed stream, so the caller bounds the pass). ``state`` is used with
+    whatever shardings it already carries — pass the state ``fit()`` (or
+    ``sharded_train_state``) returned. Returns
     ``{"loss": ..., "perplexity": ..., "batches": ...}``.
     """
     loader = ShardedBatchLoader(dataset, mesh, batch_size, spec=("data",))
@@ -204,8 +205,7 @@ def evaluate(
         raise ValueError("evaluate() needs at least one batch")
     sample = loader.batch_at(0)
     eval_step = make_eval_step(
-        state_shardings, {k: v.sharding for k, v in sample.items()}, mesh,
-        rules, loss_fn=loss_fn, **(step_kwargs or {}),
+        mesh, rules, loss_fn=loss_fn, **(step_kwargs or {}),
     )
     total = 0.0
     for i in range(n):

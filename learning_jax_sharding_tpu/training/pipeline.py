@@ -234,8 +234,6 @@ def make_train_step(
 
 
 def make_eval_step(
-    state_shardings: Any,
-    x_sharding: Any,
     mesh: Mesh,
     rules: Rules,
     *,
@@ -247,8 +245,12 @@ def make_eval_step(
 
     No gradients, no state update — a held-out evaluation pass (absent from
     the reference, whose train_step even discards the training loss,
-    SURVEY.md §5 "Metrics"). Same sharding regime as the train step, so it
-    runs on the same mesh without resharding the state.
+    SURVEY.md §5 "Metrics"). Input shardings are INFERRED from the state and
+    batch actually passed (a trained state arrives correctly sharded from the
+    train pipeline; rebuilding matching sharding trees is impossible anyway —
+    TrainState's pytree metadata embeds the optimizer closures, so two
+    ``sharded_train_state`` calls never compare equal); only the scalar loss
+    is pinned, replicated.
     """
 
     def ev(state: TrainState, batch: Any):
@@ -260,7 +262,6 @@ def make_eval_step(
 
     jitted = jax.jit(
         ev,
-        in_shardings=(state_shardings, x_sharding),
         out_shardings=NamedSharding(mesh, jax.sharding.PartitionSpec()),
     )
 

@@ -64,7 +64,8 @@ def memory_plan(
 
     Attention regime is read off the config: ``attn_fn`` set → flash-style
     (no S² saved); else ``remat_attention`` → q/k/v saved, scores recomputed;
-    else dense → fp32 scores + probabilities saved for backward.
+    else dense → the S² softmax probabilities saved for backward (pre-softmax
+    scores are fusion temporaries, not residuals).
     """
     act_bytes = jnp.dtype(cfg.dtype).itemsize
     param_bytes = jnp.dtype(cfg.param_dtype).itemsize
@@ -106,7 +107,8 @@ def memory_plan(
         # bf16 logits + the fp32 softmax upcast both live at peak.
         head = tokens * cfg.vocab_size / n_model_shards * (act_bytes + 4)
     else:
-        head = tokens * 128 / seq * cfg.vocab_size / n_model_shards * (act_bytes + 4)
+        chunk = min(seq, 128)  # fused_next_token_loss chunk size
+        head = tokens * chunk / seq * cfg.vocab_size / n_model_shards * (act_bytes + 4)
 
     total = params + grads + opt + saved + head
     return MemoryPlan(

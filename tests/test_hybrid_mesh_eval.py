@@ -74,7 +74,7 @@ class TestEvaluate:
         cfg = CONFIG_TINY
         model = Transformer(cfg)
         data = SyntheticLMDataset(vocab_size=cfg.vocab_size, seq_len=16, seed=0)
-        state, state_sh = sharded_train_state(
+        state, _ = sharded_train_state(
             model, optax.adamw(1e-3),
             jax.device_put(
                 np.zeros((4, 16), np.int32),
@@ -83,8 +83,7 @@ class TestEvaluate:
             {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
         )
         out = evaluate(
-            state, state_sh, data, mesh22, RULES_DP_TP,
-            batch_size=4, num_batches=3,
+            state, data, mesh22, RULES_DP_TP, batch_size=4, num_batches=3,
         )
         assert out["batches"] == 3
         assert np.isfinite(out["loss"])
@@ -95,6 +94,6 @@ class TestEvaluate:
     def test_zero_batches_rejected(self, mesh22):
         with pytest.raises(ValueError, match="at least one"):
             evaluate(
-                None, None, SyntheticLMDataset(vocab_size=16, seq_len=8, seed=0),
+                None, SyntheticLMDataset(vocab_size=16, seq_len=8, seed=0),
                 mesh22, RULES_DP_TP, batch_size=4, num_batches=0,
             )
