@@ -52,11 +52,12 @@ def initialize(
     Safe to call when already initialized (no-op) and in single-process runs
     (``num_processes=1`` explicitly, or TPU metadata saying so).
     """
-    if jax.process_count() > 1:
-        return  # already initialized
-    already = getattr(jax.distributed.initialize, "_ljst_done", False)
-    if already:
-        return
+    # IMPORTANT: nothing here may touch the backend (jax.process_count(),
+    # jax.devices(), …) before the distributed client exists —
+    # jax.distributed.initialize refuses to run once any JAX computation has
+    # initialized the runtime (caught by tests/test_distributed_cluster.py).
+    if jax.distributed.is_initialized():
+        return  # a cluster is already up
     kwargs: dict[str, Any] = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -70,11 +71,13 @@ def initialize(
         jax.distributed.initialize(**kwargs)
     except (ValueError, RuntimeError):
         # No cluster metadata to discover (plain single-process run): fine —
-        # the rest of the module works with process_count() == 1. A real
-        # multi-process request must not be swallowed.
+        # the rest of the module works with process_count() == 1, and a later
+        # call with real coordinates simply retries (failures are NOT cached:
+        # caching one would turn that later genuine bootstrap into a silent
+        # no-op and hang the peer ranks in rendezvous). A real multi-process
+        # request must not be swallowed.
         if num_processes not in (None, 1):
             raise
-    jax.distributed.initialize._ljst_done = True  # type: ignore[attr-defined]
 
 
 def process_count() -> int:

@@ -1,0 +1,76 @@
+"""Worker process for the real 2-process cluster test (not a test module).
+
+Launched by ``test_distributed_cluster.py`` as ``python _distributed_worker.py
+<rank> <nproc> <port>``. Each process owns 2 emulated CPU devices; together
+they form one 4-device system over the JAX distributed runtime (Gloo-backed
+cross-process collectives — the CPU stand-in for DCN). The worker runs the
+FRAMEWORK path end to end: ``multihost.initialize`` → ``build_mesh`` over the
+global devices → per-host batch assembly via ``ShardedBatchLoader`` →
+one sharded train step; prints the loss for the launcher to compare across
+ranks.
+"""
+
+import os
+import sys
+
+rank, nproc, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from learning_jax_sharding_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=nproc, process_id=rank
+)
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from learning_jax_sharding_tpu.data import (  # noqa: E402
+    ShardedBatchLoader,
+    SyntheticLMDataset,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh  # noqa: E402
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.training.pipeline import (  # noqa: E402
+    make_train_step,
+    sharded_train_state,
+)
+
+assert multihost.process_count() == nproc, multihost.process_count()
+assert len(jax.devices()) == 2 * nproc, jax.devices()
+assert len(jax.local_devices()) == 2
+
+# data axis spans PROCESSES (the DCN direction), model axis stays host-local.
+mesh = build_mesh((nproc, 2), ("data", "model"))
+
+cfg = CONFIG_TINY
+model = Transformer(cfg)
+loader = ShardedBatchLoader(
+    SyntheticLMDataset(vocab_size=cfg.vocab_size, seq_len=32, seed=0),
+    mesh, batch_size=4, spec=("data",),
+)
+batch = loader.batch_at(0)  # this host materializes only ITS rows
+
+state, state_sh = sharded_train_state(
+    model, optax.adamw(1e-3), batch["inputs"],
+    {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+)
+step = make_train_step(
+    state_sh, {k: v.sharding for k, v in batch.items()}, mesh, RULES_DP_TP,
+    loss_fn=next_token_loss,
+)
+state, loss = step(state, batch)
+loss = float(loss)  # cross-process replicated scalar: readback syncs all
+assert np.isfinite(loss)
+print(f"RANK{rank} LOSS {loss:.6f}", flush=True)

@@ -33,15 +33,12 @@ class TestRankHelpers:
     def test_initialize_propagates_real_cluster_errors(self):
         with pytest.raises((ValueError, RuntimeError)):
             # A genuinely multi-process request with an unreachable
-            # coordinator must raise, not be silently swallowed.
-            jax.distributed.initialize._ljst_done = False
-            try:
-                multihost.initialize(
-                    coordinator_address="invalid-host:1", num_processes=2,
-                    process_id=0,
-                )
-            finally:
-                jax.distributed.initialize._ljst_done = True
+            # coordinator must raise, not be silently swallowed — and a prior
+            # swallowed single-process no-op must not cache it away.
+            multihost.initialize(
+                coordinator_address="invalid-host:1", num_processes=2,
+                process_id=0,
+            )
 
 
 class TestLocalBatchSlice:
