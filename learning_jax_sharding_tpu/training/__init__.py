@@ -3,8 +3,13 @@
 from learning_jax_sharding_tpu.training.pipeline import (  # noqa: F401
     TrainState,
     make_apply_fn,
+    make_eval_step,
     make_train_step,
     sharded_train_state,
+)
+from learning_jax_sharding_tpu.training.precision import (  # noqa: F401
+    MasterWeightsState,
+    master_weights,
 )
 
 _CHECKPOINT_EXPORTS = ("CheckpointManager", "as_abstract")

@@ -1,0 +1,76 @@
+"""Mixed-precision training: low-precision params, fp32 master weights.
+
+The TPU-idiomatic dtype split is bf16 compute with fp32 parameters (what the
+framework defaults to). The next step — bf16 PARAMETERS — halves weight HBM
+traffic and storage, but naive bf16 Adam diverges: with ~8 significand bits,
+small updates round to nothing (`p + lr*u == p` once ``lr*u < p * 2^-9``).
+The standard fix wraps the optimizer with fp32 "master" copies:
+
+* the optimizer state carries an fp32 master of every parameter;
+* gradients are upcast, the inner optimizer runs entirely in fp32 against
+  the master, and the emitted update is exactly the delta that makes the
+  bf16 params equal ``master.astype(bf16)`` — so the model's bf16 weights
+  always track the fp32 trajectory with one final rounding, never an
+  accumulated one.
+
+Works as a plain ``optax.GradientTransformation`` wrapper: compatible with
+``TrainState.apply_gradients``, ``sharded_train_state`` (masters inherit the
+param logical axes via ``tree_shardings``' structural mapping), schedules,
+clipping chains, etc.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MasterWeightsState(NamedTuple):
+    inner: Any        # inner optimizer state, built over the fp32 masters
+    master: Any       # fp32 copy of every floating-point parameter
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def master_weights(
+    inner: optax.GradientTransformation,
+    master_dtype: jnp.dtype = jnp.float32,
+) -> optax.GradientTransformation:
+    """Wrap ``inner`` so it updates fp32 masters and emits low-precision deltas.
+
+    Use with low-precision params (``TransformerConfig(param_dtype=bf16)``)::
+
+        tx = master_weights(optax.adamw(3e-4))
+        state, sh = sharded_train_state(model, tx, ...)
+
+    Non-floating leaves (none in practice) pass through untouched.
+    """
+
+    def init(params):
+        master = jax.tree.map(
+            lambda p: p.astype(master_dtype) if _is_float(p) else p, params
+        )
+        return MasterWeightsState(inner=inner.init(master), master=master)
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("master_weights requires params (pass via TrainState)")
+        g32 = jax.tree.map(
+            lambda g: g.astype(master_dtype) if _is_float(g) else g, grads
+        )
+        updates32, inner_state = inner.update(g32, state.inner, state.master)
+        new_master = optax.apply_updates(state.master, updates32)
+        # Emit the exact delta that lands the low-precision params on
+        # round(new_master): p + u == new_master.astype(p.dtype).
+        deltas = jax.tree.map(
+            lambda m, p: (m.astype(p.dtype) - p) if _is_float(p) else m - p,
+            new_master, params,
+        )
+        return deltas, MasterWeightsState(inner=inner_state, master=new_master)
+
+    return optax.GradientTransformation(init, update)
