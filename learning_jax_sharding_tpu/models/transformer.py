@@ -81,6 +81,28 @@ class FeedForward(nn.Module):
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
 
 
+def make_norm(kind: str, dtype, param_dtype, name: str) -> nn.Module:
+    """``"layernorm"`` (GPT-2 style, scale+bias) or ``"rmsnorm"`` (LLaMA
+    style, scale only — one fewer reduction and parameter vector; the modern
+    default). Scale/bias carry the ``(EMBED,)`` logical axis either way."""
+    if kind == "layernorm":
+        return nn.LayerNorm(
+            dtype=dtype,
+            param_dtype=param_dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
+            name=name,
+        )
+    if kind == "rmsnorm":
+        return nn.RMSNorm(
+            dtype=dtype,
+            param_dtype=param_dtype,
+            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            name=name,
+        )
+    raise ValueError(f"unknown norm {kind!r}: expected 'layernorm' or 'rmsnorm'")
+
+
 class TransformerBlock(nn.Module):
     """Pre-LN block: x + Attn(LN(x)); x + FF(LN(x)).
 
@@ -107,17 +129,12 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     decode: bool = False          # KV-cached autoregressive attention
     max_decode_len: int = 0
+    norm: str = "layernorm"       # "layernorm" | "rmsnorm"
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
-        h = nn.LayerNorm(
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
-            name="ln_attn",
-        )(x)
+        h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_attn")(x)
         x = x + MultiHeadAttention(
             features=self.features,
             num_heads=self.num_heads,
@@ -135,13 +152,7 @@ class TransformerBlock(nn.Module):
             max_decode_len=self.max_decode_len,
             name="attn",
         )(h, deterministic=deterministic)
-        h = nn.LayerNorm(
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
-            name="ln_ff",
-        )(x)
+        h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_ff")(x)
         if self.num_experts > 0:
             from learning_jax_sharding_tpu.models.moe import MoEFeedForward
 
@@ -193,6 +204,7 @@ class TransformerConfig:
     num_experts: int = 0             # >0: MoE FF in every block (EP over mesh)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     decode: bool = False             # inference mode: KV cache, chunked input
 
     def train_step_flops(self, batch: int, seq: int) -> float:
@@ -361,16 +373,11 @@ class Transformer(nn.Module):
                 moe_capacity_factor=cfg.moe_capacity_factor,
                 decode=cfg.decode,
                 max_decode_len=cfg.max_seq_len if cfg.decode else 0,
+                norm=cfg.norm,
                 name=f"block_{i}",
             )(x, deterministic=deterministic)
 
-        x = nn.LayerNorm(
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
-            name="ln_out",
-        )(x)
+        x = make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out")(x)
         if return_hidden:
             # Skip the logits projection: callers pairing this with
             # :func:`fused_next_token_loss` apply the lm_head kernel chunk by

@@ -163,6 +163,25 @@ class TestTransformerVariants:
         assert logits.shape == (2, 16, cfg.vocab_size)
         assert np.isfinite(np.asarray(logits, np.float32)).all()
 
+    def test_rmsnorm_variant(self, rng):
+        """norm='rmsnorm': scale-only norms, model runs; unknown kind raises."""
+        cfg = dataclasses.replace(CONFIG_TINY, norm="rmsnorm")
+        model = Transformer(cfg)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 16)), jnp.int32)
+        import flax.linen as nn
+
+        params = nn.meta.unbox(
+            model.init({"params": jax.random.key(0)}, tokens)["params"]
+        )
+        assert "bias" not in params["block_0"]["ln_attn"]
+        assert "scale" in params["ln_out"]
+        y = model.apply({"params": params}, tokens)
+        assert np.isfinite(np.asarray(y, np.float32)).all()
+
+        bad = Transformer(dataclasses.replace(CONFIG_TINY, norm="batchnorm"))
+        with pytest.raises(ValueError, match="unknown norm"):
+            bad.init({"params": jax.random.key(0)}, tokens)
+
     def test_param_count_tracks_gqa(self):
         dense = CONFIG_TINY
         gqa = dataclasses.replace(CONFIG_TINY, num_kv_heads=1)

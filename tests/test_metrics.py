@@ -68,3 +68,34 @@ class TestProfiling:
             with checking():
                 jnp.divide(jnp.zeros(()), jnp.zeros(()))  # 0/0 → NaN
         assert jax.config.jax_debug_nans == prev
+
+
+class TestBenchUtils:
+    def test_time_fn_measures_per_iteration_cost(self):
+        """The k/2k differencing recovers per-call cost with fixed overhead
+        cancelled: a fn that sleeps 2 ms measures ≈2 ms, not 2 ms + L."""
+        import time
+
+        import numpy as np
+
+        def fn():
+            time.sleep(0.002)
+            return np.zeros(())
+
+        from learning_jax_sharding_tpu.utils.bench import time_fn
+
+        per = time_fn(fn, warmup=1, min_time=0.05, repeats=2)
+        assert 0.0015 < per < 0.004, per
+
+    def test_compiled_flops_counts_matmul(self):
+        import jax
+        import jax.numpy as jnp
+
+        from learning_jax_sharding_tpu.utils.bench import compiled_flops
+
+        a = jnp.ones((64, 128), jnp.float32)
+        b = jnp.ones((128, 32), jnp.float32)
+        flops = compiled_flops(lambda a, b: a @ b, a, b)
+        # 2*M*N*K, allow XLA accounting slack either way.
+        assert flops is not None
+        assert 0.5 * 2 * 64 * 128 * 32 <= flops <= 2 * 2 * 64 * 128 * 32
