@@ -62,3 +62,86 @@ class ShardedBatchLoader:
         while True:
             yield self.batch_at(index)
             index += 1
+
+    def prefetched(self, depth: int = 2, start: int | None = None) -> "PrefetchIterator":
+        """Iterate with a background thread keeping ``depth`` batches ahead.
+
+        ``batch_at`` does host work (dataset slicing, host→device transfer
+        start) on the training thread; with prefetch that work overlaps the
+        previous step's device execution — the standard input-pipeline shape
+        for keeping the TPU fed. Device placement happens on the prefetch
+        thread; the arrays crossing the queue are already-sharded
+        ``jax.Array``s, safe to hand between threads.
+
+        ``start`` overrides ``self.start_index`` for this iterator (pass the
+        resume step explicitly rather than mutating the loader).
+
+        Returns a :class:`PrefetchIterator`; its ``close()`` always stops the
+        producer thread and drops the buffered batches, even if no batch was
+        ever consumed.
+        """
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be ≥ 1, got {depth}")
+        return PrefetchIterator(
+            self, depth, self.start_index if start is None else start
+        )
+
+
+class PrefetchIterator:
+    """Background-thread batch iterator (see ``ShardedBatchLoader.prefetched``).
+
+    ``close()`` is unconditional: it stops the producer and drains the queue
+    whether or not iteration ever started (a generator-`finally` based
+    implementation would leak the thread and its ``depth`` buffered device
+    batches when a resume lands past the last step and ``next`` is never
+    called). Also usable as a context manager.
+    """
+
+    def __init__(self, loader: "ShardedBatchLoader", depth: int, start: int):
+        import queue
+        import threading
+
+        self._q: Any = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+
+        def producer():
+            index = start
+            while not self._stop.is_set():
+                try:
+                    item = loader.batch_at(index)
+                except Exception as e:  # surface on the consumer side
+                    self._q.put(("error", e))
+                    return
+                self._q.put(("ok", item))
+                index += 1
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, item = self._q.get()
+        if kind == "error":
+            self.close()
+            raise item
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock a producer waiting on a full queue.
+        while not self._q.empty():
+            try:
+                self._q.get_nowait()
+            except Exception:
+                break
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; daemon thread dies with process anyway
+        self.close()

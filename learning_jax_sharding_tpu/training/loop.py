@@ -51,6 +51,8 @@ class TrainLoopConfig:
     metrics_path: Optional[str] = None
     log_every: int = 1
     seed: int = 0
+    prefetch: int = 2                # batches prepared ahead on a background
+                                     # thread (0 = synchronous loading)
 
 
 def lr_schedule(cfg: TrainLoopConfig) -> optax.Schedule:
@@ -161,9 +163,12 @@ def fit(
         n_devices=mesh.size,
         log_every=cfg.log_every,
     )
+    batches = None
+    if cfg.prefetch > 0:
+        batches = loader.prefetched(cfg.prefetch, start=start_step)
     try:
         for i in range(start_step, cfg.steps):
-            batch = loader.batch_at(i)
+            batch = next(batches) if batches is not None else loader.batch_at(i)
             state, loss = step_fn(state, batch)
             metrics.log(i + 1, loss=loss)
             if ckpt is not None:
@@ -173,6 +178,8 @@ def fit(
                 ckpt.save(cfg.steps, state, force=True)
             ckpt.wait()
     finally:
+        if batches is not None:
+            batches.close()
         metrics.close()
         if ckpt is not None:
             ckpt.close()

@@ -111,3 +111,33 @@ class TestCheckpoint:
         _, state, _ = _setup(mesh22)
         with CheckpointManager(tmp_path / "empty") as ckpt:
             assert ckpt.restore_latest(like=as_abstract(state)) is None
+
+
+class TestCrossMeshRestore:
+    def test_restore_onto_a_different_mesh(self, mesh22, tmp_path):
+        """Elastic resharding: save under a 2×2 mesh, restore under 4×2 —
+        values identical, every leaf resharded to the NEW mesh's layout
+        (what lets a run resume after the slice size changes)."""
+        from learning_jax_sharding_tpu.parallel import build_mesh
+
+        _, state, _ = _setup(mesh22)
+        mesh42 = build_mesh((4, 2), ("data", "model"))
+        with CheckpointManager(tmp_path) as ckpt:
+            assert ckpt.save(1, state, force=True)
+            ckpt.wait()
+
+            # Rebuild the abstract target under the new mesh, then restore.
+            _, new_state, _ = _setup(mesh42)
+            restored = ckpt.restore(1, like=new_state)
+
+        old_kernel = state.params["block_0"]["attn"]["query"]["kernel"]
+        new_kernel = restored.params["block_0"]["attn"]["query"]["kernel"]
+        np.testing.assert_array_equal(
+            np.asarray(old_kernel, np.float32), np.asarray(new_kernel, np.float32)
+        )
+        assert dict(new_kernel.sharding.mesh.shape) == {"data": 4, "model": 2}
+        # Restored leaf carries exactly the layout the NEW mesh's pipeline
+        # assigned (same spec as a fresh init under that mesh).
+        target_kernel = new_state.params["block_0"]["attn"]["query"]["kernel"]
+        assert new_kernel.sharding.spec == target_kernel.sharding.spec
+        assert shard_shapes(new_kernel) == shard_shapes(target_kernel)

@@ -103,3 +103,57 @@ class TestShardedBatchLoader:
             np.asarray(loader.batch_at(7)["inputs"]),
             ds.batch(7, batch_size=8)["inputs"],
         )
+
+
+class TestPrefetch:
+    def test_prefetched_matches_batch_at(self, mesh24):
+        from learning_jax_sharding_tpu.data import SyntheticLMDataset
+
+        loader = ShardedBatchLoader(
+            SyntheticLMDataset(vocab_size=64, seq_len=8, seed=1), mesh24,
+            batch_size=4, spec=("x",), start_index=3,
+        )
+        it = loader.prefetched(depth=2)
+        try:
+            for i in range(3, 8):
+                got = next(it)
+                want = loader.batch_at(i)
+                np.testing.assert_array_equal(
+                    np.asarray(got["inputs"]), np.asarray(want["inputs"])
+                )
+        finally:
+            it.close()
+
+    def test_prefetched_propagates_dataset_errors(self, mesh24):
+        class Exploding:
+            def batch(self, index, rows=None, batch_size=8):
+                raise RuntimeError("disk on fire")
+
+        loader = ShardedBatchLoader(Exploding(), mesh24, batch_size=4, spec=("x",))
+        it = loader.prefetched()
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            next(it)
+
+    def test_bad_depth_rejected(self, mesh24):
+        from learning_jax_sharding_tpu.data import SyntheticLMDataset
+
+        loader = ShardedBatchLoader(
+            SyntheticLMDataset(vocab_size=64, seq_len=8, seed=1), mesh24,
+            batch_size=4, spec=("x",),
+        )
+        with pytest.raises(ValueError, match="depth"):
+            loader.prefetched(depth=0)
+
+    def test_close_without_consuming_stops_producer(self, mesh24):
+        """Regression: a resume landing past the last step closes the
+        iterator before any next() — the producer thread must still stop."""
+        from learning_jax_sharding_tpu.data import SyntheticLMDataset
+
+        loader = ShardedBatchLoader(
+            SyntheticLMDataset(vocab_size=64, seq_len=8, seed=1), mesh24,
+            batch_size=4, spec=("x",),
+        )
+        it = loader.prefetched(depth=2)
+        it.close()
+        it._thread.join(timeout=10)
+        assert not it._thread.is_alive()

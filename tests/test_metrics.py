@@ -85,7 +85,9 @@ class TestBenchUtils:
         from learning_jax_sharding_tpu.utils.bench import time_fn
 
         per = time_fn(fn, warmup=1, min_time=0.05, repeats=2)
-        assert 0.0015 < per < 0.004, per
+        # Sleep overshoot isn't a fixed latency the k/2k diff can cancel, so
+        # only bound loosely: clearly the sleep, not sleep + a ~100 ms L.
+        assert 0.0015 < per < 0.01, per
 
     def test_compiled_flops_counts_matmul(self):
         import jax
