@@ -103,6 +103,7 @@ class PrefetchIterator:
 
         self._q: Any = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
+        self._closed = False
 
         def producer():
             index = start
@@ -122,6 +123,11 @@ class PrefetchIterator:
         return self
 
     def __next__(self):
+        # After close() (including the error path below) the producer is
+        # gone and nothing will ever put again — a bare get() would block
+        # forever. Fail fast instead.
+        if self._closed:
+            raise RuntimeError("PrefetchIterator is closed")
         kind, item = self._q.get()
         if kind == "error":
             self.close()
@@ -129,6 +135,7 @@ class PrefetchIterator:
         return item
 
     def close(self) -> None:
+        self._closed = True
         self._stop.set()
         # Unblock a producer waiting on a full queue.
         while not self._q.empty():

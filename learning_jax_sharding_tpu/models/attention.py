@@ -25,7 +25,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
+from learning_jax_sharding_tpu.ops.attention import (
+    causal_mask,
+    dot_product_attention,
+    sliding_window_mask,
+)
 from learning_jax_sharding_tpu.ops.rope import apply_rope
 from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
 
@@ -91,6 +95,7 @@ class MultiHeadAttention(nn.Module):
     num_kv_heads: Optional[int] = None   # < num_heads → GQA; 1 → MQA
     rope: bool = False                   # rotary positions on q/k
     rope_theta: float = 10_000.0
+    window: Optional[int] = None         # causal sliding-window size (SWA)
     dropout_rate: float = 0.0
     causal: bool = False
     dtype: jnp.dtype = jnp.float32
@@ -176,7 +181,12 @@ class MultiHeadAttention(nn.Module):
         if self.decode:
             out = self._cached_attention(q, k, v)
         elif self.attn_fn is None:
-            mask = causal_mask(s) if self.causal else None
+            if self.window is not None:
+                if not self.causal:
+                    raise ValueError("window (sliding-window attention) requires causal=True")
+                mask = sliding_window_mask(s, self.window)
+            else:
+                mask = causal_mask(s) if self.causal else None
             dense = functools.partial(_dense_attention, num_heads=self.num_heads)
             if self.remat_attention:
                 dense = jax.checkpoint(
@@ -184,6 +194,11 @@ class MultiHeadAttention(nn.Module):
                 )
             out = dense(q, k, v, mask)
         else:
+            if self.window is not None:
+                raise ValueError(
+                    "window with a custom attn_fn: configure the backend "
+                    "instead (e.g. make_flash_attn_fn(window=...))"
+                )
             # Custom backends (flash/ring) take the structural flag, not a
             # dense mask — they cannot honor arbitrary masks and must not
             # silently reinterpret one.
@@ -261,5 +276,8 @@ class MultiHeadAttention(nn.Module):
         # slot at or before it (this also hides the zero-initialized tail).
         q_pos = idx + jnp.arange(s)[:, None]
         k_pos = jnp.arange(length)[None, :]
-        mask = (k_pos <= q_pos)[None, None]            # (1, 1, S, L)
-        return dot_product_attention(q, k_full, v_full, mask=mask)
+        mask = k_pos <= q_pos                          # (S, L)
+        if self.window is not None:
+            # SWA decode: attend only to the last `window` cache slots.
+            mask = mask & (k_pos > q_pos - self.window)
+        return dot_product_attention(q, k_full, v_full, mask=mask[None, None])

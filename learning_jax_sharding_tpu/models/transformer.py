@@ -118,6 +118,7 @@ class TransformerBlock(nn.Module):
     num_kv_heads: Optional[int] = None
     rope: bool = False
     rope_theta: float = 10_000.0
+    window: Optional[int] = None
     dropout_rate: float = 0.0
     causal: bool = True
     dtype: jnp.dtype = jnp.float32
@@ -142,6 +143,7 @@ class TransformerBlock(nn.Module):
             num_kv_heads=self.num_kv_heads,
             rope=self.rope,
             rope_theta=self.rope_theta,
+            window=self.window,
             dropout_rate=self.dropout_rate,
             causal=self.causal,
             dtype=self.dtype,
@@ -191,6 +193,7 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None  # < num_heads → GQA; 1 → MQA
     rope: bool = False               # rotary positions instead of the learned table
     rope_theta: float = 10_000.0
+    window: Optional[int] = None     # causal sliding-window attention size
     hidden: int = 3072
     max_seq_len: int = 1024
     dropout_rate: float = 0.0
@@ -361,6 +364,7 @@ class Transformer(nn.Module):
                 num_kv_heads=cfg.num_kv_heads,
                 rope=cfg.rope,
                 rope_theta=cfg.rope_theta,
+                window=cfg.window,
                 hidden=cfg.hidden,
                 dropout_rate=cfg.dropout_rate,
                 causal=cfg.causal,

@@ -77,3 +77,18 @@ def causal_mask(q_len: int, k_len: int | None = None) -> jax.Array:
     i = jnp.arange(q_len)[:, None]
     j = jnp.arange(k_len)[None, :]
     return (j <= i)[None, None, :, :]
+
+
+def sliding_window_mask(
+    q_len: int, window: int, k_len: int | None = None
+) -> jax.Array:
+    """Causal sliding-window mask ``(1, 1, Q, K)``: query ``i`` attends to
+    keys in ``(i - window, i]`` — the last ``window`` positions including
+    itself (Mistral-style local attention). The dense counterpart of
+    ``flash_attention(..., causal=True, window=w)``."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    k_len = q_len if k_len is None else k_len
+    i = jnp.arange(q_len)[:, None]
+    j = jnp.arange(k_len)[None, :]
+    return ((j <= i) & (j > i - window))[None, None, :, :]

@@ -157,3 +157,30 @@ class TestPrefetch:
         it.close()
         it._thread.join(timeout=10)
         assert not it._thread.is_alive()
+
+    def test_next_after_close_or_error_fails_fast(self, mesh24):
+        """Regression: a drained queue with a dead producer must raise, not
+        block forever."""
+        from learning_jax_sharding_tpu.data import SyntheticLMDataset
+
+        loader = ShardedBatchLoader(
+            SyntheticLMDataset(vocab_size=64, seq_len=8, seed=1), mesh24,
+            batch_size=4, spec=("x",),
+        )
+        it = loader.prefetched(depth=1)
+        next(it)
+        it.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            next(it)
+
+        class Exploding:
+            def batch(self, index, rows=None, batch_size=8):
+                raise RuntimeError("disk on fire")
+
+        it2 = ShardedBatchLoader(
+            Exploding(), mesh24, batch_size=4, spec=("x",)
+        ).prefetched()
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            next(it2)
+        with pytest.raises(RuntimeError, match="closed"):
+            next(it2)

@@ -1,0 +1,148 @@
+"""Sliding-window (local) attention: dense mask and flash kernel agree.
+
+Not in the reference (full S² attention only). Window semantics: query i
+attends to keys in (i-window, i] — Mistral-style causal SWA. Oracles:
+
+* dense sliding_window_mask == flash(window=w), forward AND gradients
+  (the kernel's block skipping + in-block band mask must match exactly);
+* window == S reproduces plain causal attention;
+* window=1 is pure self-attention: output == v;
+* the transformer trains with a window config.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.ops.attention import (
+    causal_mask,
+    dot_product_attention,
+    sliding_window_mask,
+)
+from learning_jax_sharding_tpu.ops.flash_attention import flash_attention
+
+B, S, N, H = 2, 128, 2, 16
+
+
+def _qkv(rng, s=S):
+    return tuple(
+        jnp.asarray(rng.standard_normal((B, s, N, H)).astype(np.float32))
+        for _ in range(3)
+    )
+
+
+class TestMaskOracle:
+    def test_window_mask_structure(self):
+        m = np.asarray(sliding_window_mask(5, 2))[0, 0]
+        expected = np.array([
+            [1, 0, 0, 0, 0],
+            [1, 1, 0, 0, 0],
+            [0, 1, 1, 0, 0],
+            [0, 0, 1, 1, 0],
+            [0, 0, 0, 1, 1],
+        ], bool)
+        np.testing.assert_array_equal(m, expected)
+
+    def test_window_geq_len_is_causal(self):
+        np.testing.assert_array_equal(
+            np.asarray(sliding_window_mask(6, 6)), np.asarray(causal_mask(6))
+        )
+
+    def test_bad_window_rejected(self):
+        with pytest.raises(ValueError, match="window"):
+            sliding_window_mask(4, 0)
+
+
+class TestFlashWindow:
+    @pytest.mark.parametrize("window", [1, 16, 100, S])
+    def test_forward_matches_dense(self, rng, window):
+        q, k, v = _qkv(rng)
+        dense = dot_product_attention(q, k, v, mask=sliding_window_mask(S, window))
+        flash = flash_attention(
+            q, k, v, causal=True, window=window, interpret=True,
+            block_q=32, block_k=32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(flash), atol=2e-5
+        )
+
+    @pytest.mark.parametrize("window", [16, 100])
+    def test_gradients_match_dense(self, rng, window):
+        q, k, v = _qkv(rng)
+
+        def dense_loss(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, mask=sliding_window_mask(S, window))
+                ** 2
+            )
+
+        def flash_loss(q, k, v):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, window=window, interpret=True,
+                    block_q=32, block_k=32,
+                ) ** 2
+            )
+
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gd, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4)
+
+    def test_window_one_is_self_attention(self, rng):
+        q, k, v = _qkv(rng)
+        out = flash_attention(
+            q, k, v, causal=True, window=1, interpret=True,
+            block_q=32, block_k=32,
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(v), atol=2e-5)
+
+    def test_window_requires_causal(self, rng):
+        q, k, v = _qkv(rng)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8, interpret=True)
+
+
+class TestModelWindow:
+    def test_transformer_trains_with_window(self, rng):
+        cfg = dataclasses.replace(CONFIG_TINY, window=8, rope=True)
+        model = Transformer(cfg)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 32)), jnp.int32)
+        import flax.linen as nn
+
+        params = nn.meta.unbox(
+            model.init({"params": jax.random.key(0)}, tokens)["params"]
+        )
+        logits = model.apply({"params": params}, tokens)
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+    def test_window_matches_dense_windowed_module(self, rng):
+        """Model with window=W == model with full attention at S<=W."""
+        s = 8
+        cfg_w = dataclasses.replace(CONFIG_TINY, window=s)
+        cfg_f = CONFIG_TINY
+        tokens = jnp.asarray(rng.integers(0, cfg_w.vocab_size, size=(2, s)), jnp.int32)
+        mw, mf = Transformer(cfg_w), Transformer(cfg_f)
+        p = mw.init({"params": jax.random.key(0)}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(mw.apply(p, tokens)), np.asarray(mf.apply(p, tokens)),
+            atol=1e-5,
+        )
+
+    def test_custom_backend_with_window_rejected(self, rng):
+        from learning_jax_sharding_tpu.models.attention import MultiHeadAttention
+
+        model = MultiHeadAttention(
+            features=32, num_heads=2, head_dim=16, causal=True, window=4,
+            attn_fn=lambda q, k, v, causal: v,
+        )
+        x = jnp.zeros((1, 8, 32))
+        with pytest.raises(ValueError, match="configure the backend"):
+            model.init({"params": jax.random.key(0)}, x)
