@@ -14,7 +14,11 @@ case shows the three long-context mechanisms the framework adds, on one model:
 3. **ring attention** (``ops/ring_attention.py``) — the sequence axis itself
    sharded over the mesh, k/v blocks rotating by ``lax.ppermute`` (ICI
    neighbor hops on hardware) with an online softmax, so S scales with the
-   number of devices: context parallelism.
+   number of devices: context parallelism;
+4. **sliding-window attention** (``flash_attention(window=w)``) — banded
+   kernel grids cut compute AND HBM traffic to O(S·window): cost grows
+   linearly with context (measured 3.7× over full causal at S=16k on the
+   v5e, PERF.md).
 
 All three compute the same function; the case proves it numerically, then
 takes a sharded train step at a sequence length where the reference's dense
@@ -81,6 +85,24 @@ def backends_agree():
     print(f"PASS: dense == flash == ring at S={S} (causal, 2×4 seq ring)")
 
 
+def windowed_attention():
+    """Sliding-window == dense with the band mask; window ≥ S == causal."""
+    from learning_jax_sharding_tpu.ops.attention import sliding_window_mask
+
+    rng = np.random.default_rng(1)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, S, N, H)).astype(np.float32))
+        for _ in range(3)
+    )
+    w = 96
+    dense = dot_product_attention(q, k, v, mask=sliding_window_mask(S, w))
+    flash = flash_attention(
+        q, k, v, causal=True, window=w, interpret=True, block_q=128, block_k=128
+    )
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(flash), atol=2e-5)
+    print(f"PASS: sliding-window attention (w={w}) matches the dense band mask")
+
+
 def long_context_train_step():
     """Sharded train step at S=1024 on the tiny model with attention remat:
     no (B, N, S, S) tensor is ever stored for backward."""
@@ -111,9 +133,10 @@ def long_context_train_step():
 
 def main():
     backends_agree()
+    windowed_attention()
     long_context_train_step()
-    print("PASS: long-context mechanisms (flash / remat / ring) all serve "
-          "the same model")
+    print("PASS: long-context mechanisms (flash / window / remat / ring) all "
+          "serve the same model")
 
 
 if __name__ == "__main__":
