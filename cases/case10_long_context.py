@@ -3,7 +3,7 @@
 Not in the reference: its attention materializes the full (B, N, S, S) score
 tensor (`/root/reference/case6_attention.py:125-127`), capping sequence length
 at a few thousand tokens (SURVEY.md §2.4 "Context parallelism: absent"). This
-case shows the three long-context mechanisms the framework adds, on one model:
+case shows the four long-context mechanisms the framework adds, on one model:
 
 1. **flash attention** (``ops/flash_attention.py``) — blockwise-softmax Pallas
    kernel, O(S·H) memory instead of O(S²) (interpret mode here on emulated CPU
@@ -20,7 +20,8 @@ case shows the three long-context mechanisms the framework adds, on one model:
    linearly with context (measured 3.7× over full causal at S=16k on the
    v5e, PERF.md).
 
-All three compute the same function; the case proves it numerically, then
+The first three compute the same function (the window variant its own banded
+one, proven against a dense mask); the case proves each numerically, then
 takes a sharded train step at a sequence length where the reference's dense
 scores would need ~4× the activation memory.
 

@@ -64,6 +64,8 @@ def sharded_train_state(
     rngs: dict[str, jax.Array],
     mesh: Mesh,
     rules: Rules,
+    *,
+    zero1_axis: str | None = None,
 ) -> tuple[TrainState, Any]:
     """Create a TrainState whose every leaf is born sharded.
 
@@ -76,6 +78,10 @@ def sharded_train_state(
         rngs: init PRNG keys, e.g. ``{"params": key}``.
         mesh: device mesh.
         rules: logical→mesh rules.
+        zero1_axis: mesh axis name (usually ``"data"``) to additionally shard
+            the OPTIMIZER STATE over — ZeRO stage 1 (``training.zero``).
+            Params keep their rule-derived shardings; moments/masters are
+            born 1/D-sharded and GSPMD derives the reduce-scatter / gather.
 
     Returns:
         ``(state, state_shardings)`` — the sharded TrainState and the matching
@@ -98,6 +104,17 @@ def sharded_train_state(
     with activate(mesh, rules):
         abstract = jax.eval_shape(boxed_init, rngs, x)
         state_shardings = tree_shardings(abstract, mesh, rules)
+        if zero1_axis is not None:
+            from learning_jax_sharding_tpu.training.zero import zero1_shardings
+
+            state_shardings = state_shardings.replace(
+                opt_state=zero1_shardings(
+                    nn.meta.unbox(abstract).opt_state,
+                    state_shardings.opt_state,
+                    mesh,
+                    zero1_axis,
+                )
+            )
         jit_init = jax.jit(
             init_fn,
             in_shardings=(NamedSharding(mesh, jax.sharding.PartitionSpec()), x.sharding),

@@ -1,0 +1,101 @@
+"""ZeRO-1: optimizer state sharded over the data axis.
+
+Under plain data parallelism every device holds a full replica of the
+optimizer state — for Adam that is 2× (moments) or 3× (+fp32 masters,
+``training.precision``) the parameter bytes, the single largest HBM line item
+of a training step. ZeRO stage 1 removes the redundancy: each data-parallel
+device owns a 1/D slice of the moments, updates only its slice, and the
+parameter update is gathered back.
+
+The reference has no optimizer-state strategy at all (its Adam moments are
+replicated wherever the params are, `/root/reference/case6_attention.py:181`),
+but its case 3 demonstrates exactly the underlying placement idea — shard
+every operand so no device stores redundant bytes
+(`/root/reference/case3_fully_sharded.py:23-60`). This module applies that
+pattern to the optimizer state, the GSPMD way: no gather/scatter code, just a
+different ``out_shardings`` tree for the born-sharded init. The SPMD
+partitioner then derives the ZeRO arithmetic itself — gradients
+reduce-scatter into the moment sharding, the Adam update runs 1/D-sized per
+device, and the parameter delta all-gathers back to the params' own sharding.
+
+Composes with ``training.precision.master_weights`` (the fp32 masters live in
+the optimizer state, so they are sharded too — most of ZeRO-1's savings) and
+with any optax chain, because the sharding choice is purely structural: any
+floating leaf of the optimizer state shaped like a tensor gets its first
+evenly divisible unsharded dim split over the data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def _used_axes(spec: PartitionSpec) -> set[str]:
+    used: set[str] = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def _zero1_leaf(
+    abstract: jax.ShapeDtypeStruct, sharding: Any, mesh: Mesh, axis: str
+) -> Any:
+    if not isinstance(sharding, NamedSharding):
+        return sharding
+    shape = abstract.shape
+    if len(shape) == 0 or not jnp.issubdtype(abstract.dtype, jnp.floating):
+        return sharding  # step counters etc. stay replicated
+    spec = tuple(sharding.spec) + (None,) * (len(shape) - len(sharding.spec))
+    if axis in _used_axes(sharding.spec):
+        return sharding  # already sharded over the data axis (e.g. FSDP rules)
+    size = mesh.shape[axis]
+    for d, entry in enumerate(spec):
+        if shape[d] % size:
+            continue
+        if entry is None:
+            new = spec[:d] + (axis,) + spec[d + 1 :]
+        elif shape[d] % (size * _entry_size(entry, mesh)):
+            continue
+        else:
+            # Dim already sharded (e.g. over 'model'): stack the data axis on
+            # top — P(('model','data')) splits the dim over both.
+            joint = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+            new = spec[:d] + (joint + (axis,),) + spec[d + 1 :]
+        return NamedSharding(mesh, PartitionSpec(*new))
+    return sharding  # nothing divides — leave replicated rather than fail
+
+
+def _entry_size(entry: Any, mesh: Mesh) -> int:
+    names = tuple(entry) if isinstance(entry, (tuple, list)) else (entry,)
+    size = 1
+    for n in names:
+        size *= mesh.shape[n]
+    return size
+
+
+def zero1_shardings(
+    abstract_opt_state: Any, opt_shardings: Any, mesh: Mesh, axis: str = "data"
+) -> Any:
+    """Re-shard an optimizer-state sharding tree over the ``axis`` mesh axis.
+
+    For every floating tensor leaf whose sharding does not already use
+    ``axis``, the first dim that divides evenly is split over it (stacking on
+    an existing 'model' split when needed). Scalars and non-float leaves are
+    untouched. Returns the new sharding tree; pass it as the init's
+    ``out_shardings`` so the state is born ZeRO-sharded — ``sharded_train_state``
+    does this when given ``zero1_axis=...``.
+    """
+    return jax.tree.map(
+        lambda a, s: _zero1_leaf(a, s, mesh, axis),
+        abstract_opt_state,
+        opt_shardings,
+    )
