@@ -1,0 +1,217 @@
+"""LoRA: low-rank adapter fine-tuning over a frozen base model.
+
+Parameter-efficient fine-tuning in the framework's own SPMD idiom: every 2D
+``kernel`` leaf W (in, out) of a trained model gets a pair of low-rank
+factors A (in, r), B (r, out); the model runs with the merged weights
+``W + (alpha/r)·A@B`` and only A/B receive gradients. B initializes to zero,
+so step 0 reproduces the base model exactly.
+
+Nothing like this exists in the reference (it has no fine-tuning story at
+all — its TrainState updates every parameter,
+`/root/reference/case6_attention.py:206-215`), but the sharding treatment is
+pure framework: A inherits the kernel's row sharding, B its column sharding
+(`lora_shardings`), so under tensor parallelism the adapter math runs where
+the kernel shards live and ``A@B`` needs no resharding beyond what the base
+matmul already does. The optimizer state — the dominant fine-tuning memory
+cost this technique exists to remove — covers only the adapters: for a 125M
+model at r=8 that is ~0.4% of the full-model Adam state.
+
+Adapters are plain nested dicts mirroring the matched subtree of the param
+tree with ``{"lora_a": A, "lora_b": B}`` leaves — checkpointable with
+``training.checkpoint`` like any pytree, and mergeable into the base for
+zero-overhead serving (``merge_lora``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+from learning_jax_sharding_tpu.training.pipeline import _inputs_of
+
+Path = tuple[str, ...]
+
+
+def default_match(path: Path, leaf: Any) -> bool:
+    """Adapt every 2D ``kernel`` (attention q/k/v/out, FF up/down, lm_head);
+    leave embeddings, norms, and biases frozen-only."""
+    return path[-1] == "kernel" and getattr(leaf, "ndim", 0) == 2
+
+
+def init_lora(
+    rng: jax.Array,
+    params: Any,
+    rank: int,
+    *,
+    match: Callable[[Path, Any], bool] = default_match,
+    dtype: Any = None,
+) -> Any:
+    """Build the adapter tree for ``params``: A ~ N(0, 1/sqrt(in)), B = 0.
+
+    Returns a nested dict containing only the matched paths, each leaf a dict
+    ``{"lora_a": (in, r), "lora_b": (r, out)}``.
+    """
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    adapters: dict = {}
+    for keypath, leaf in flat:
+        path = tuple(getattr(k, "key", str(k)) for k in keypath)
+        if not match(path, leaf):
+            continue
+        rng, key = jax.random.split(rng)
+        d_in, d_out = leaf.shape
+        dt = dtype or leaf.dtype
+        node = adapters
+        for k in path[:-1]:
+            node = node.setdefault(k, {})
+        node[path[-1]] = {
+            "lora_a": (
+                jax.random.normal(key, (d_in, rank), dt) / jnp.sqrt(d_in).astype(dt)
+            ),
+            "lora_b": jnp.zeros((rank, d_out), dt),
+        }
+    if not adapters:
+        raise ValueError("no parameters matched — nothing to adapt")
+    return adapters
+
+
+def _is_adapter(node: Any) -> bool:
+    return isinstance(node, dict) and set(node) == {"lora_a", "lora_b"}
+
+
+def merge_lora(params: Any, adapters: Any, *, alpha: float = 16.0) -> Any:
+    """``W + (alpha/r)·A@B`` at every adapted path; other leaves unchanged.
+
+    Differentiable in ``adapters`` — the fine-tuning loss applies the model
+    with ``merge_lora(base, adapters)`` and takes gradients of the adapters
+    alone. Also the zero-overhead serving export (the merged tree is a plain
+    param tree for ``make_generate_fn`` etc.). Pass a :class:`LoraState` as
+    ``adapters`` to merge with the alpha it was trained with.
+    """
+    if isinstance(adapters, LoraState):
+        alpha = float(adapters.alpha)
+        adapters = adapters.adapters
+
+    def walk(p: Any, a: Any) -> Any:
+        if not isinstance(p, dict):
+            return p
+        out = {}
+        for k, v in p.items():
+            sub = a.get(k) if isinstance(a, dict) else None
+            if sub is not None and _is_adapter(sub):
+                rank = sub["lora_a"].shape[1]
+                delta = (alpha / rank) * (sub["lora_a"] @ sub["lora_b"])
+                out[k] = (v + delta.astype(v.dtype)) if not isinstance(v, dict) else v
+            else:
+                out[k] = walk(v, sub if sub is not None else {})
+        return out
+
+    return walk(params, adapters)
+
+
+def lora_shardings(params: Any, adapters: Any, mesh: Mesh) -> Any:
+    """Shardings for the adapter tree, inherited from the base kernels.
+
+    For kernel spec ``(row, col)``: A gets ``(row, None)``, B ``(None, col)``
+    — A@B then contracts over the replicated rank dim and lands sharded
+    exactly like the kernel, no extra collectives.
+    """
+
+    def walk(p: Any, a: Any) -> Any:
+        if _is_adapter(a):
+            if not isinstance(p.sharding, NamedSharding):
+                # Single-device / restored arrays carry no spec: replicated.
+                spec: tuple = (None, None)
+            else:
+                spec = tuple(p.sharding.spec) + (None,) * (2 - len(p.sharding.spec))
+            return {
+                "lora_a": NamedSharding(mesh, PartitionSpec(spec[0], None)),
+                "lora_b": NamedSharding(mesh, PartitionSpec(None, spec[1])),
+            }
+        return {k: walk(p[k], v) for k, v in a.items()}
+
+    return walk(params, adapters)
+
+
+class LoraState(NamedTuple):
+    adapters: Any
+    opt_state: Any
+    step: jax.Array
+    alpha: jax.Array  # LoRA scale numerator, carried so merges can't drift
+
+
+def make_lora_train_step(
+    model: Any,
+    base_shardings: Any,
+    x_sharding: Any,
+    mesh: Mesh,
+    rules: Rules,
+    optimizer: optax.GradientTransformation,
+    *,
+    loss_fn: Callable[..., jax.Array],
+    loss_needs_params: bool = False,
+    apply_kwargs: dict[str, Any] | None = None,
+) -> Callable[[Any, LoraState, Any], tuple[LoraState, jax.Array]]:
+    """Jitted SPMD fine-tuning step: grads flow to the adapters only.
+
+    The frozen base is an explicit argument (``step(base, lora_state, batch)``)
+    so its buffers are shared across steps, never donated, never copied into
+    the executable. ``base_shardings`` is the params sharding tree from
+    ``sharded_train_state`` (or ``jax.tree.map(lambda p: p.sharding, base)``).
+    The LoRA scale comes from ``ls.alpha`` (set at ``lora_train_state``), the
+    single source of truth merges also read.
+    """
+
+    def step(base: Any, ls: LoraState, batch: Any):
+        def loss_of(adapters):
+            merged = merge_lora(base, adapters, alpha=ls.alpha)
+            kwargs = dict(apply_kwargs or {})
+            y = model.apply({"params": merged}, _inputs_of(batch), **kwargs)
+            args = (y, batch, merged) if loss_needs_params else (y, batch)
+            return loss_fn(*args)
+
+        loss, grads = jax.value_and_grad(loss_of)(ls.adapters)
+        updates, opt_state = optimizer.update(grads, ls.opt_state, ls.adapters)
+        adapters = optax.apply_updates(ls.adapters, updates)
+        return LoraState(adapters, opt_state, ls.step + 1, ls.alpha), loss
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(base_shardings, None, x_sharding),
+        out_shardings=(None, NamedSharding(mesh, PartitionSpec())),
+        donate_argnums=(1,),
+    )
+
+    def run(base: Any, ls: LoraState, batch: Any):
+        with activate(mesh, rules):
+            return jitted(base, ls, batch)
+
+    run.jitted = jitted
+    return run
+
+
+def lora_train_state(
+    rng: jax.Array,
+    params: Any,
+    optimizer: optax.GradientTransformation,
+    rank: int,
+    mesh: Mesh,
+    *,
+    alpha: float = 16.0,
+    match: Callable[[Path, Any], bool] = default_match,
+    dtype: Any = None,
+) -> LoraState:
+    """Adapters + optimizer state, born sharded per ``lora_shardings``."""
+    adapters = init_lora(rng, params, rank, match=match, dtype=dtype)
+    shardings = lora_shardings(params, adapters, mesh)
+    adapters = jax.device_put(adapters, shardings)
+    # optax.init builds zeros_like the adapters → moments inherit shardings.
+    opt_state = optimizer.init(adapters)
+    return LoraState(
+        adapters, opt_state, jnp.zeros((), jnp.int32),
+        jnp.asarray(alpha, jnp.float32),
+    )

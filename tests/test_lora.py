@@ -1,0 +1,165 @@
+"""LoRA fine-tuning: frozen base, low-rank adapters, inherited shardings.
+
+Oracles: B=0 init makes step-0 output EXACTLY the base model; training moves
+the loss while the base stays bitwise frozen; adapter shardings are the
+kernel's row/col specs split between A and B; merging after training equals
+the runtime (base + adapter) forward.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.lora import (
+    LoraState,
+    init_lora,
+    lora_shardings,
+    lora_train_state,
+    make_lora_train_step,
+    merge_lora,
+)
+from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+
+
+def _base(mesh, rng):
+    model = Transformer(CONFIG_TINY)
+    tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        model, optax.adamw(3e-3), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    return model, state, state_sh, batch
+
+
+class TestLoraStructure:
+    def test_matches_2d_kernels_only(self, mesh22, rng):
+        _, state, _, _ = _base(mesh22, rng)
+        adapters = init_lora(jax.random.key(1), state.params, rank=4)
+        flat = {
+            tuple(getattr(k, "key", k) for k in p): v
+            for p, v in jax.tree_util.tree_flatten_with_path(adapters)[0]
+        }
+        paths = {p[:-1] for p in flat}  # strip the lora_a/lora_b leaf key
+        # Kernels adapted; embeddings/norms/biases not.
+        assert ("block_0", "attn", "query", "kernel") in paths
+        assert not any("tok_embed" in p or "ln_attn" in p for p in paths)
+        a = adapters["block_0"]["attn"]["query"]["kernel"]["lora_a"]
+        b = adapters["block_0"]["attn"]["query"]["kernel"]["lora_b"]
+        assert a.shape == (64, 4) and b.shape == (4, 64)
+        assert not np.any(np.asarray(b))  # B = 0: merged == base at init
+
+    def test_merge_at_init_is_identity(self, mesh22, rng):
+        model, state, _, batch = _base(mesh22, rng)
+        adapters = init_lora(jax.random.key(1), state.params, rank=4)
+        merged = merge_lora(state.params, adapters)
+        y0 = model.apply({"params": state.params}, batch["inputs"])
+        y1 = model.apply({"params": merged}, batch["inputs"])
+        np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+
+    def test_shardings_inherit_kernel_specs(self, mesh22, rng):
+        _, state, _, _ = _base(mesh22, rng)
+        adapters = init_lora(jax.random.key(1), state.params, rank=4)
+        sh = lora_shardings(state.params, adapters, mesh22)
+        kernel_spec = tuple(
+            state.params["block_0"]["ff"]["up"]["kernel"].sharding.spec
+        )
+        node = sh["block_0"]["ff"]["up"]["kernel"]
+        pad = kernel_spec + (None,) * (2 - len(kernel_spec))
+        assert tuple(node["lora_a"].spec) == (pad[0], None)
+        assert tuple(node["lora_b"].spec) == (None, pad[1])
+
+
+class TestLoraTraining:
+    def test_learns_with_base_frozen(self, mesh22, rng):
+        model, state, state_sh, batch = _base(mesh22, rng)
+        base = state.params
+        base_before = jax.tree.map(np.asarray, base)
+        ls = lora_train_state(
+            jax.random.key(1), base, optax.adamw(1e-2), rank=8, mesh=mesh22
+        )
+        step = make_lora_train_step(
+            model, state_sh.params,
+            {k: v.sharding for k, v in batch.items()},
+            mesh22, RULES_DP_TP, optax.adamw(1e-2), loss_fn=next_token_loss,
+        )
+        losses = []
+        for _ in range(10):
+            ls, loss = step(base, ls, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        # Frozen base: bitwise unchanged by fine-tuning.
+        jax.tree.map(
+            lambda before, after: np.testing.assert_array_equal(
+                before, np.asarray(after)
+            ),
+            base_before, base,
+        )
+        # Merged export reproduces the runtime forward: the jitted
+        # merge-inside-the-program path (what the train step computes, with
+        # ls.alpha) vs the eager pre-merged export (with the alpha recorded
+        # in the state).
+        merged = merge_lora(base, ls)
+        y_runtime = jax.jit(
+            lambda b, a, al, x: model.apply(
+                {"params": merge_lora(b, a, alpha=al)}, x
+            )
+        )(base, ls.adapters, ls.alpha, batch["inputs"])
+        y_merged = model.apply({"params": merged}, batch["inputs"])
+        np.testing.assert_allclose(
+            np.asarray(y_runtime), np.asarray(y_merged), rtol=2e-5, atol=2e-5
+        )
+        # And differs from the base model (training actually moved something).
+        y_base = model.apply({"params": base}, batch["inputs"])
+        assert np.abs(np.asarray(y_merged) - np.asarray(y_base)).max() > 1e-4
+
+    def test_merge_uses_trained_alpha(self, mesh22, rng):
+        """LoraState carries its alpha: merging via the state applies the
+        trained scale, not the default."""
+        _, state, _, _ = _base(mesh22, rng)
+        ls = lora_train_state(
+            jax.random.key(1), state.params, optax.sgd(1e-2), rank=4,
+            mesh=mesh22, alpha=32.0,
+        )
+        # Give the adapters a nonzero delta so scale actually matters.
+        ls = ls._replace(
+            adapters=jax.tree.map(lambda a: a + 0.01, ls.adapters)
+        )
+        via_state = merge_lora(state.params, ls)
+        explicit = merge_lora(state.params, ls.adapters, alpha=32.0)
+        jax.tree.map(
+            lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+            via_state, explicit,
+        )
+        wrong = merge_lora(state.params, ls.adapters)  # default alpha=16
+        deltas = jax.tree.map(
+            lambda x, y: float(np.abs(np.asarray(x) - np.asarray(y)).max()),
+            via_state, wrong,
+        )
+        assert max(jax.tree.leaves(deltas)) > 1e-6
+
+    def test_adapter_count_is_small(self, mesh22, rng):
+        _, state, _, _ = _base(mesh22, rng)
+        adapters = init_lora(jax.random.key(1), state.params, rank=4)
+        n_base = sum(x.size for x in jax.tree.leaves(state.params))
+        n_lora = sum(x.size for x in jax.tree.leaves(adapters))
+        assert n_lora < 0.25 * n_base
+
+    def test_state_is_donatable_pytree(self, mesh22, rng):
+        ls = LoraState(
+            adapters={"k": jnp.zeros((2, 2))},
+            opt_state=optax.sgd(1e-2).init({"k": jnp.zeros((2, 2))}),
+            step=jnp.zeros((), jnp.int32),
+            alpha=jnp.asarray(16.0),
+        )
+        leaves, treedef = jax.tree.flatten(ls)
+        assert jax.tree.unflatten(treedef, leaves)._fields == ls._fields
