@@ -182,6 +182,25 @@ def bench_decode_125m():
         f"{secs / new * 1e3:.2f} ms/token-step"
     )
 
+    # int8 weight-only variant: same harness, quantized tree + in-jit dequant.
+    from learning_jax_sharding_tpu.models.quantize import (
+        quantize_tree,
+        quantized_bytes,
+    )
+
+    qparams = quantize_tree(params)
+    gen_q = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
+        inference_dtype=jnp.bfloat16, dequantize=True,
+    )
+    secs_q = time_fn(gen_q, qparams, prompt, jax.random.key(1), min_time=2.0)
+    _log(
+        f"[bench] 125M KV-cached decode, int8 weights (same shape): "
+        f"{toks / secs_q:,.0f} tok/s, {secs_q / new * 1e3:.2f} ms/token-step, "
+        f"weight bytes {quantized_bytes(params) / 1e6:.0f}→"
+        f"{quantized_bytes(qparams) / 1e6:.0f} MB"
+    )
+
 
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.

@@ -96,6 +96,7 @@ def make_generate_fn(
     top_k: int | None = None,
     top_p: float | None = None,
     inference_dtype: Any | None = None,
+    dequantize: bool = False,
 ):
     """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
 
@@ -116,22 +117,62 @@ def make_generate_fn(
     memory; throughput is neutral on the v5e 125M bench (decode there is
     bound by KV-cache attention and per-step work, not weight reads).
     ``None`` keeps training dtypes.
+
+    ``dequantize``: the params are an int8 tree from
+    ``models.quantize.quantize_tree``; they are dequantized INSIDE the jitted
+    program (per step, next to the consuming matmuls), so HBM STORES int8 —
+    the guaranteed win is weight memory (half of bf16). Whether the decode
+    loop also streams int8 (a bandwidth win) depends on XLA fusing the
+    upcast into the matmul operands instead of materializing bf16 weights
+    each step; measure at your shape (``bench.py`` prints an int8 decode
+    context line). Combine with ``inference_dtype=bf16`` to set the
+    compute/dequant dtype; non-quantized leaves (embeddings, norms) are
+    still cast to it eagerly.
     """
     cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
     if inference_dtype is not None:
         cfg = dataclasses.replace(cfg, dtype=inference_dtype, param_dtype=inference_dtype)
     model = Transformer(cfg)
+    dequant_dtype = inference_dtype if inference_dtype is not None else cfg.param_dtype
 
     def maybe_cast(params):
         if inference_dtype is None:
             return params
-        return jax.tree.map(
-            lambda x: x.astype(inference_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            params,
-        )
+
+        def cast(x):
+            return (
+                x.astype(inference_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x
+            )
+
+        if not dequantize:
+            return jax.tree.map(cast, params)
+
+        # Quantized nodes keep int8 q + fp32 scale (the in-jit dequant picks
+        # the target dtype); everything else — embeddings, norms, biases,
+        # often the largest remaining fp32 blocks — still casts eagerly.
+        from learning_jax_sharding_tpu.models.quantize import _is_quantized
+
+        def walk(node):
+            if _is_quantized(node):
+                return node
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            return cast(node)
+
+        return walk(params)
 
     def step_apply(params, cache, tokens):
+        if dequantize:
+            from learning_jax_sharding_tpu.models.quantize import dequantize_tree
+
+            # Dequant INSIDE each apply so the decode scan holds only int8
+            # weights in its carry/constants — the storage win. The per-step
+            # upcast is then XLA's to place: fused into the matmul operands
+            # (int8 streamed, the bandwidth win) or materialized (extra
+            # traffic — the analogous in-scan bf16 cast measured 20% slower
+            # here, see ``inference_dtype`` above). bench.py measures it.
+            params = dequantize_tree(params, dequant_dtype)
         variables = {"params": params}
         if cache is not None:
             variables["cache"] = cache

@@ -1,0 +1,137 @@
+"""Weight-only int8 quantization for serving.
+
+Decode is memory-bandwidth-bound at scale: every generated token re-reads the
+full weight set from HBM, so bytes-per-weight is the fit (and often the
+throughput) currency. This module stores every matmul kernel — 2D ``kernel``
+leaves and the 3D MoE expert stacks — as int8 with a per-output-channel fp32
+scale: symmetric, zero-point-free (dequant is one convert + one broadcast
+multiply), halving weight bytes vs bf16 and quartering vs fp32 at ≤0.4%
+per-channel relative error. The STORAGE saving is unconditional; the decode
+bandwidth effect depends on XLA fusing the upcast into the consuming matmul
+rather than materializing bf16 weights per step — measure with ``bench.py``'s
+int8 decode context before claiming a speedup at a new shape.
+
+The reference has no inference path at all (SURVEY.md §5 — its ``apply_fn``
+exists only for timing, `/root/reference/case6_attention.py:229-238`); this
+extends the framework's own generation stack (``models/generate.py``).
+
+Quantization is offline and eager (``quantize_tree``); dequantization happens
+INSIDE the jitted program (``make_generate_fn(..., dequantize=True)`` routes
+through :func:`dequantize_tree`), so HBM holds and streams int8 and the
+upcast happens on-chip. Sharding is preserved: ``q`` inherits the kernel's
+NamedSharding, the scale vector its column spec, so tensor-parallel serving
+is unchanged.
+
+Embeddings, norms, and biases stay in full precision (a few % of weight
+bytes; quantizing the embedding table measurably hurts output quality for
+negligible savings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+Path = tuple[str, ...]
+
+
+def default_match(path: Path, leaf: Any) -> bool:
+    """Quantize every 2D ``kernel`` (q/k/v/out, FF up/down, lm_head) and the
+    3D MoE expert stacks (``moe/up``, ``moe/down`` — the dominant params of
+    an MoE config). The MoE ``router`` kernel is excluded: routing is fp32
+    on purpose (`models/moe.py`), and a quantization-flipped top-k there
+    reroutes whole tokens — a far larger perturbation than the ≤0.4%
+    per-channel error everywhere else."""
+    if len(path) >= 2 and path[-2] == "router":
+        return False
+    if path[-1] == "kernel" and getattr(leaf, "ndim", 0) == 2:
+        return True
+    return path[-1] in ("up", "down") and getattr(leaf, "ndim", 0) == 3
+
+
+def _is_quantized(node: Any) -> bool:
+    return isinstance(node, dict) and set(node) == {"q", "scale"}
+
+
+def quantize_leaf(w: jax.Array) -> dict[str, jax.Array]:
+    """(..., in, out) kernel → {"q": int8 same shape, "scale": fp32 (..., out)}.
+
+    Symmetric per-output-channel: scale = max|W|/127 over the contraction
+    (second-to-last) dim, so dequant error per element is ≤ scale/2 (≈0.4% of
+    the channel's max). Leading dims (the MoE expert dim) keep their own
+    scales per channel.
+    """
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale[..., None, :]), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequantize_leaf(node: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> jax.Array:
+    return (node["q"].astype(jnp.float32) * node["scale"][..., None, :]).astype(dtype)
+
+
+def quantize_tree(
+    params: Any,
+    *,
+    match: Callable[[Path, Any], bool] = default_match,
+) -> Any:
+    """Replace matched kernels with ``{"q", "scale"}`` nodes; rest untouched.
+
+    Eager/offline — run once after training (or checkpoint load). Sharded
+    inputs stay sharded: the reduction and rounding follow the kernel's own
+    placement, and ``q`` lands with the kernel's sharding.
+    """
+
+    def walk(node: Any, prefix: Path) -> Any:
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            path = prefix + (k,)
+            if not isinstance(v, dict) and match(path, v):
+                out[k] = quantize_leaf(v)
+                # Pin the shardings explicitly: q like the kernel, the scale
+                # like the kernel's columns (eager propagation already does
+                # this for NamedSharding inputs; device_put makes it a
+                # guarantee rather than a propagation detail).
+                if isinstance(v.sharding, NamedSharding):
+                    spec = tuple(v.sharding.spec) + (None,) * (v.ndim - len(v.sharding.spec))
+                    # The scale drops the contraction (-2) dim of the kernel.
+                    scale_spec = spec[:-2] + (spec[-1],)
+                    out[k] = {
+                        "q": jax.device_put(out[k]["q"], v.sharding),
+                        "scale": jax.device_put(
+                            out[k]["scale"],
+                            NamedSharding(v.sharding.mesh, PartitionSpec(*scale_spec)),
+                        ),
+                    }
+            else:
+                out[k] = walk(v, path)
+        return out
+
+    return walk(params, ())
+
+
+def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Inverse of :func:`quantize_tree`; traceable — call it inside jit so
+    the int8→dtype upcast happens on-chip, next to the consuming matmul."""
+
+    def walk(node: Any) -> Any:
+        if not isinstance(node, dict):
+            return node
+        if _is_quantized(node):
+            return dequantize_leaf(node, dtype)
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)
+
+
+def quantized_bytes(params: Any) -> int:
+    """Total serving bytes of a (possibly partially) quantized tree."""
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
