@@ -194,11 +194,24 @@ def bench_decode_125m():
         inference_dtype=jnp.bfloat16, dequantize=True,
     )
     secs_q = time_fn(gen_q, qparams, prompt, jax.random.key(1), min_time=2.0)
+    # Apples-to-apples SERVED bytes: the bf16 baseline serves bf16-cast
+    # weights, and the int8 path also casts its non-quantized leaves
+    # (embeddings/norms) to bf16 via maybe_cast — mirror both casts here.
+    from learning_jax_sharding_tpu.models.quantize import map_unquantized
+
+    def to_bf16(x):
+        return (
+            x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+        )
+
+    bf16_bytes = quantized_bytes(jax.tree.map(to_bf16, params))
+    int8_bytes = quantized_bytes(map_unquantized(to_bf16, qparams))
     _log(
         f"[bench] 125M KV-cached decode, int8 weights (same shape): "
         f"{toks / secs_q:,.0f} tok/s, {secs_q / new * 1e3:.2f} ms/token-step, "
-        f"weight bytes {quantized_bytes(params) / 1e6:.0f}→"
-        f"{quantized_bytes(qparams) / 1e6:.0f} MB"
+        f"served weight bytes {bf16_bytes / 1e6:.0f} (bf16)→"
+        f"{int8_bytes / 1e6:.0f} MB"
     )
 
 

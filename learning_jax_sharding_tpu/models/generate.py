@@ -133,7 +133,7 @@ def make_generate_fn(
     if inference_dtype is not None:
         cfg = dataclasses.replace(cfg, dtype=inference_dtype, param_dtype=inference_dtype)
     model = Transformer(cfg)
-    dequant_dtype = inference_dtype if inference_dtype is not None else cfg.param_dtype
+    dequant_dtype = cfg.param_dtype  # == inference_dtype when one was given
 
     def maybe_cast(params):
         if inference_dtype is None:
@@ -151,16 +151,9 @@ def make_generate_fn(
         # Quantized nodes keep int8 q + fp32 scale (the in-jit dequant picks
         # the target dtype); everything else — embeddings, norms, biases,
         # often the largest remaining fp32 blocks — still casts eagerly.
-        from learning_jax_sharding_tpu.models.quantize import _is_quantized
+        from learning_jax_sharding_tpu.models.quantize import map_unquantized
 
-        def walk(node):
-            if _is_quantized(node):
-                return node
-            if isinstance(node, dict):
-                return {k: walk(v) for k, v in node.items()}
-            return cast(node)
-
-        return walk(params)
+        return map_unquantized(cast, params)
 
     def step_apply(params, cache, tokens):
         if dequantize:

@@ -132,6 +132,22 @@ def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
     return walk(params)
 
 
+def map_unquantized(fn: Callable[[Any], Any], tree: Any) -> Any:
+    """Map ``fn`` over every leaf that is NOT part of a quantized node,
+    passing ``{"q","scale"}`` nodes through untouched — the traversal every
+    consumer of a partially quantized tree needs (e.g. casting embeddings/
+    norms while keeping int8 kernels)."""
+
+    def walk(node: Any) -> Any:
+        if _is_quantized(node):
+            return node
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return fn(node)
+
+    return walk(tree)
+
+
 def quantized_bytes(params: Any) -> int:
     """Total serving bytes of a (possibly partially) quantized tree."""
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))

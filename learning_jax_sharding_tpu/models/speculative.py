@@ -1,0 +1,214 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Autoregressive decoding is latency-bound by one serialized target forward per
+token. Speculative decoding breaks the serialization: a cheap draft model
+greedily proposes ``num_draft`` tokens one-by-one, then the target scores the
+whole proposal in ONE chunked forward (the same cache path that serves
+prefill, `models/attention.py::_cached_attention` — chunk attention against
+the KV cache at the current index). The longest prefix of draft tokens that
+matches the target's own greedy choices is accepted, plus one bonus token
+from the target's logits — so each round costs one target forward and yields
+1..num_draft+1 tokens, and the output is EXACTLY what plain greedy decoding
+of the target would produce (the oracle the tests pin).
+
+Nothing like this exists in the reference (no inference path at all,
+SURVEY.md §5); it composes the framework's own pieces:
+
+* chunked verification reuses the cache-at-index attention;
+* acceptance rollback is just rewinding each block's ``cache_index`` —
+  stale K/V entries beyond the index are never attended (the causal mask is
+  ``position < index + i``) and are overwritten by the next chunk write;
+* batch handling takes the MINIMUM acceptance across rows each round: rows
+  that matched further ahead re-derive the same tokens in later rounds (the
+  bonus token equals their next draft match), so exactness is preserved and
+  only the speedup varies with batch agreement;
+* everything runs under mesh + rules — draft and target can use different
+  shardings of the same mesh.
+
+Greedy only (``temperature == 0``): that is where acceptance is a hard token
+equality and the exactness guarantee is unconditional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
+from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+
+
+def _rollback(cache: Any, index: jax.Array) -> Any:
+    """Rewind the decode position counters to ``index``: every attention
+    block's ``cache_index`` AND the transformer's top-level ``position``
+    (which drives positional embeddings). Stale K/V beyond the index are
+    masked out by the causal-at-index attention and later overwritten."""
+
+    def leaf(path, x):
+        if getattr(path[-1], "key", None) in ("cache_index", "position"):
+            return jnp.full_like(x, index)
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+def _greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
+def make_speculative_generate_fn(
+    target_config: TransformerConfig,
+    draft_config: TransformerConfig,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    max_new_tokens: int,
+    num_draft: int = 4,
+    inference_dtype: Any | None = None,
+):
+    """Build ``generate(target_params, draft_params, prompt) -> tokens``.
+
+    ``target_config``/``draft_config`` are TRAINING configs sharing a vocab;
+    decode variants are derived here (as in ``make_generate_fn``). The result
+    is bit-identical to greedy decoding of the target alone; the draft only
+    changes how many serialized target passes it takes to get there.
+    """
+    if target_config.vocab_size != draft_config.vocab_size:
+        raise ValueError(
+            f"target vocab {target_config.vocab_size} != draft vocab "
+            f"{draft_config.vocab_size}"
+        )
+    if num_draft < 1:
+        raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+
+    def decode_cfg(cfg):
+        cfg = dataclasses.replace(cfg, decode=True, dropout_rate=0.0)
+        if inference_dtype is not None:
+            cfg = dataclasses.replace(
+                cfg, dtype=inference_dtype, param_dtype=inference_dtype
+            )
+        return cfg
+
+    t_cfg, d_cfg = decode_cfg(target_config), decode_cfg(draft_config)
+    target, draft = Transformer(t_cfg), Transformer(d_cfg)
+
+    def apply(model, params, cache, tokens):
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        logits, mut = model.apply(variables, tokens, mutable=("cache",))
+        return logits.astype(jnp.float32), mut["cache"]
+
+    def generate(t_params, d_params, prompt):
+        b, prompt_len = prompt.shape
+        # Verification writes up to num_draft+1 positions past the accepted
+        # prefix before rolling back, so leave that much headroom.
+        need = prompt_len + max_new_tokens + num_draft + 1
+        for name, cfg in (("target", t_cfg), ("draft", d_cfg)):
+            if need > cfg.max_seq_len:
+                raise ValueError(
+                    f"prompt+new+draft ({need}) exceeds {name} max_seq_len "
+                    f"({cfg.max_seq_len})"
+                )
+
+        # Prefill both models on the prompt. The first new token comes from
+        # the target's last-position logits — exactly as plain greedy.
+        t_logits, t_cache = apply(target, t_params, None, prompt)
+        _, d_cache = apply(draft, d_params, None, prompt)
+        t_cur = _greedy(t_logits[:, -1])
+
+        buf_len = max_new_tokens + num_draft + 1
+        buffer = jnp.zeros((b, buf_len), jnp.int32)
+        buffer = lax.dynamic_update_slice(buffer, t_cur[:, None], (0, 0))
+
+        def cond(carry):
+            n, *_ = carry
+            return n < max_new_tokens
+
+        def body(carry):
+            n, t_cur, t_cache, d_cache, buffer = carry
+            # Invariant: both caches hold prompt + the n-1 accepted tokens
+            # BEFORE t_cur (t_cur itself is pending, fed by this round).
+            base = prompt_len + n - 1
+
+            # 1. Draft proposes num_draft tokens greedily, one at a time;
+            #    one extra feed pushes the last proposal's K/V into the draft
+            #    cache so a full acceptance leaves the cache complete.
+            def draft_step(carry, _):
+                prev, cache = carry
+                logits, cache = apply(draft, d_params, cache, prev[:, None])
+                nxt = _greedy(logits[:, -1])
+                return (nxt, cache), nxt
+
+            (last_d, d_cache), drafts = lax.scan(
+                draft_step, (t_cur, d_cache), None, length=num_draft
+            )
+            drafts = drafts.T  # (num_draft, B) scan stack → (B, num_draft)
+            _, d_cache = apply(draft, d_params, d_cache, last_d[:, None])
+
+            # 2. Target verifies the whole proposal in one chunked forward:
+            #    [t_cur, d_1..d_num_draft] → greedy choice after each.
+            chunk = jnp.concatenate([t_cur[:, None], drafts], axis=1)
+            t_logits, t_cache = apply(target, t_params, t_cache, chunk)
+            choices = _greedy(t_logits)  # (B, num_draft+1)
+
+            # 3. Accept the longest prefix where draft == target choice;
+            #    batch-min keeps a single scalar cache index.
+            eq = drafts == choices[:, :-1]  # (B, num_draft)
+            m_row = jnp.sum(jnp.cumprod(eq.astype(jnp.int32), axis=1), axis=1)
+            m = jnp.min(m_row)  # scalar: accepted draft count this round
+
+            # 4. Emit d_1..d_m then the bonus/correction token choices[:, m].
+            #    Positions past m hold the bonus too — junk that later rounds
+            #    overwrite (and the final slice drops).
+            idx = jnp.arange(num_draft + 1)
+            bonus = jnp.take_along_axis(choices, jnp.full((b, 1), m), axis=1)[:, 0]
+            padded = jnp.pad(drafts, ((0, 0), (0, 1)))  # (B, num_draft+1)
+            emitted = jnp.where(idx[None, :] < m, padded, bonus[:, None])
+            # buffer[i] is the (i+1)-th generated token; t_cur sits at n-1,
+            # so this round's tokens start at n.
+            buffer = lax.dynamic_update_slice(buffer, emitted, (0, n))
+
+            # 5. Roll both caches back to the accepted length. The target
+            #    consumed base..base+num_draft; valid prefix is base + 1 + m
+            #    (t_cur and the m accepted drafts). Same for the draft.
+            accepted = base + 1 + m
+            t_cache = _rollback(t_cache, accepted)
+            d_cache = _rollback(d_cache, accepted)
+
+            return (n + 1 + m, bonus, t_cache, d_cache, buffer)
+
+        n, _, _, _, buffer = lax.while_loop(
+            cond, body, (jnp.asarray(1, jnp.int32), t_cur, t_cache, d_cache, buffer)
+        )
+        return jnp.concatenate([prompt, buffer[:, :max_new_tokens]], axis=1)
+
+    jitted = jax.jit(generate)
+
+    def maybe_cast(params):
+        # Eager, like make_generate_fn: casting inside the jitted loop would
+        # re-cast every round (measured 20% slower there) and keep the fp32
+        # copies resident.
+        if inference_dtype is None:
+            return params
+        return jax.tree.map(
+            lambda x: x.astype(inference_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params,
+        )
+
+    def run(
+        t_params: Any, d_params: Any, prompt: jax.Array,
+        rng: Optional[jax.Array] = None,
+    ):
+        del rng  # greedy: deterministic, kept for signature symmetry
+        with activate(mesh, rules):
+            return jitted(maybe_cast(t_params), maybe_cast(d_params), prompt)
+
+    run.jitted = jitted
+    return run

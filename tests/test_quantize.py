@@ -160,21 +160,16 @@ class TestQuantizedServing:
         """With dequantize=True + inference_dtype=bf16, embeddings/norms of an
         fp32-trained tree are still cast eagerly: feeding the fp32 tree and a
         pre-cast tree must produce identical programs and outputs."""
-        from learning_jax_sharding_tpu.models.quantize import _is_quantized
+        from learning_jax_sharding_tpu.models.quantize import map_unquantized
 
         params, tokens = _trained_params(mesh22, rng)
         qtree_fp32_rest = quantize_tree(params)  # embeddings stay fp32
 
-        def cast_rest(node):
-            if _is_quantized(node):
-                return node
-            if isinstance(node, dict):
-                return {k: cast_rest(v) for k, v in node.items()}
-            return node.astype(jnp.bfloat16) if jnp.issubdtype(
-                node.dtype, jnp.floating
-            ) else node
-
-        pre_cast = cast_rest(qtree_fp32_rest)
+        pre_cast = map_unquantized(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            qtree_fp32_rest,
+        )
         prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
         gen = make_generate_fn(
             CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=8,

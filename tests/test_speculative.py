@@ -1,0 +1,159 @@
+"""Speculative decoding: exactness against plain greedy, for ANY draft.
+
+The defining property of (greedy) speculative decoding is that the draft
+model changes only the COST of decoding, never the output: acceptance is a
+hard equality against the target's own greedy choices, rejections are
+corrected from the target's logits. So the oracle is brutal and simple —
+output must be bit-identical to ``make_generate_fn``'s greedy decode of the
+target alone, whatever the draft params are (untrained garbage, a smaller
+model, or the target itself for the full-acceptance path).
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.speculative import (
+    make_speculative_generate_fn,
+)
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+DRAFT_CFG = dataclasses.replace(CONFIG_TINY, num_layers=1, hidden=64)
+
+
+def _trained_target(mesh, rng, steps=5):
+    model = Transformer(CONFIG_TINY)
+    tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        model, optax.adamw(3e-3), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+    )
+    for _ in range(steps):
+        state, _ = step(state, batch)
+    return state.params, tokens
+
+
+def _draft_params(cfg=DRAFT_CFG):
+    model = Transformer(cfg)
+    toks = np.zeros((2, 8), np.int32)
+    return nn.meta.unbox(
+        model.init({"params": jax.random.key(7)}, toks)["params"]
+    )
+
+
+class TestSpeculativeExactness:
+    @pytest.mark.parametrize("num_draft", [1, 3, 5])
+    def test_matches_plain_greedy_any_draft(self, mesh22, rng, num_draft):
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()  # UNTRAINED draft: worst case, still exact
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+
+        plain = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=16
+        )
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=16, num_draft=num_draft,
+        )
+        out_plain = np.asarray(plain(t_params, prompt, jax.random.key(0)))
+        out_spec = np.asarray(spec(t_params, d_params, prompt))
+        np.testing.assert_array_equal(out_spec, out_plain)
+
+    def test_full_acceptance_with_self_draft(self, mesh22, rng):
+        """Draft == target: every proposal matches, so every round takes the
+        m == num_draft path (draft-cache completeness edge) — and the output
+        is still exactly plain greedy."""
+        t_params, tokens = _trained_target(mesh22, rng)
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        plain = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=12
+        )
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, CONFIG_TINY, mesh22, RULES_DP_TP,
+            max_new_tokens=12, num_draft=4,
+        )
+        out_plain = np.asarray(plain(t_params, prompt, jax.random.key(0)))
+        out_spec = np.asarray(spec(t_params, t_params, prompt))
+        np.testing.assert_array_equal(out_spec, out_plain)
+
+    def test_inference_dtype_exactness(self, mesh22, rng):
+        """bf16 serving: params cast eagerly (not per loop round) and the
+        output still matches make_generate_fn's bf16 greedy decode."""
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        plain = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=12,
+            inference_dtype=jnp.bfloat16,
+        )
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=12, num_draft=3, inference_dtype=jnp.bfloat16,
+        )
+        out_plain = np.asarray(plain(t_params, prompt, jax.random.key(0)))
+        out_spec = np.asarray(spec(t_params, d_params, prompt))
+        np.testing.assert_array_equal(out_spec, out_plain)
+
+    def test_batch_rows_decode_independently(self, mesh22, rng):
+        """Batch-min acceptance must not leak tokens across rows: decoding a
+        batch equals decoding each half separately."""
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=10, num_draft=3,
+        )
+        sh = mesh_sharding(mesh22, "data", None)
+        full = np.asarray(spec(t_params, d_params, put(tokens[:4, :8], sh)))
+        hi = np.asarray(spec(t_params, d_params, put(tokens[:2, :8], sh)))
+        lo = np.asarray(spec(t_params, d_params, put(tokens[2:4, :8], sh)))
+        np.testing.assert_array_equal(full, np.concatenate([hi, lo], axis=0))
+
+
+class TestSpeculativeValidation:
+    def test_vocab_mismatch_rejected(self, mesh22):
+        bad = dataclasses.replace(DRAFT_CFG, vocab_size=128)
+        with pytest.raises(ValueError, match="vocab"):
+            make_speculative_generate_fn(
+                CONFIG_TINY, bad, mesh22, RULES_DP_TP, max_new_tokens=4
+            )
+
+    def test_bad_num_draft_rejected(self, mesh22):
+        with pytest.raises(ValueError, match="num_draft"):
+            make_speculative_generate_fn(
+                CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+                max_new_tokens=4, num_draft=0,
+            )
+
+    def test_seq_len_overflow_rejected(self, mesh22, rng):
+        t_params, tokens = _trained_target(mesh22, rng, steps=1)
+        d_params = _draft_params()
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=CONFIG_TINY.max_seq_len, num_draft=2,
+        )
+        prompt = put(tokens[:2, :8], mesh_sharding(mesh22, "data", None))
+        with pytest.raises(ValueError, match="max_seq_len"):
+            spec(t_params, d_params, prompt)
