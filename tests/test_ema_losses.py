@@ -1,0 +1,189 @@
+"""EMA weights + configurable loss (label smoothing, z-loss).
+
+Oracles: with_ema leaves training dynamics bitwise unchanged while the EMA
+follows the analytic geometric average; make_next_token_loss defaults equal
+next_token_loss exactly; the smoothing shortcut equals the explicit
+smoothed-one-hot cross-entropy; z-loss shrinks logsumexp over training.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    make_next_token_loss,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.ema import EmaState, ema_params, with_ema
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+
+class TestLossFactory:
+    def _logits_batch(self, rng):
+        logits = jnp.asarray(rng.standard_normal((4, 16, 32)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, 32, size=(4, 16)).astype(np.int32))
+        return logits, {"targets": targets}
+
+    def test_defaults_equal_next_token_loss(self, rng):
+        logits, batch = self._logits_batch(rng)
+        a = float(next_token_loss(logits, batch))
+        b = float(make_next_token_loss()(logits, batch))
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_smoothing_matches_explicit_one_hot(self, rng):
+        logits, batch = self._logits_batch(rng)
+        eps = 0.1
+        ours = float(make_next_token_loss(label_smoothing=eps)(logits, batch))
+        v = logits.shape[-1]
+        one_hot = jax.nn.one_hot(batch["targets"], v)
+        smoothed = (1 - eps) * one_hot + eps / v
+        explicit = float(optax.softmax_cross_entropy(logits, smoothed).mean())
+        np.testing.assert_allclose(ours, explicit, rtol=1e-5)
+
+    def test_z_loss_adds_squared_logsumexp(self, rng):
+        logits, batch = self._logits_batch(rng)
+        base = float(make_next_token_loss()(logits, batch))
+        with_z = float(make_next_token_loss(z_loss=1e-2)(logits, batch))
+        lse2 = float(jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1))))
+        np.testing.assert_allclose(with_z, base + 1e-2 * lse2, rtol=1e-5)
+
+    def test_z_loss_shrinks_partition_function(self, mesh22, rng):
+        """Training with z-loss drives mean logsumexp² down vs without."""
+        tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+        sh = mesh_sharding(mesh22, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        model = Transformer(CONFIG_TINY)
+
+        def lse2_after(loss_fn, steps=12):
+            state, state_sh = sharded_train_state(
+                model, optax.adamw(3e-3), batch["inputs"],
+                {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+            )
+            step = make_train_step(
+                state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+                RULES_DP_TP, loss_fn=loss_fn, donate_state=False,
+            )
+            for _ in range(steps):
+                state, _ = step(state, batch)
+            logits = model.apply({"params": state.params}, batch["inputs"])
+            return float(
+                jnp.mean(jnp.square(jax.nn.logsumexp(
+                    logits.astype(jnp.float32), axis=-1
+                )))
+            )
+
+        assert lse2_after(make_next_token_loss(z_loss=1e-1)) < lse2_after(
+            next_token_loss
+        )
+
+
+class TestEma:
+    def test_training_dynamics_unchanged(self):
+        p = {"w": jnp.ones((4,), jnp.float32)}
+        g = {"w": jnp.full((4,), 0.5, jnp.float32)}
+        plain, wrapped = optax.adam(1e-2), with_ema(optax.adam(1e-2), 0.9)
+        sp, sw = plain.init(p), wrapped.init(p)
+        pp, pw = p, p
+        for _ in range(5):
+            up, sp = plain.update(g, sp, pp)
+            pp = optax.apply_updates(pp, up)
+            uw, sw = wrapped.update(g, sw, pw)
+            pw = optax.apply_updates(pw, uw)
+        np.testing.assert_array_equal(np.asarray(pp["w"]), np.asarray(pw["w"]))
+
+    def test_ema_is_geometric_average(self):
+        decay = 0.8
+        p = {"w": jnp.zeros((), jnp.float32)}
+        tx = with_ema(optax.sgd(1.0), decay)
+        state = tx.init(p)
+        expected_ema = 0.0
+        for _ in range(6):
+            up, state = tx.update({"w": jnp.asarray(-1.0)}, state, p)
+            p = optax.apply_updates(p, up)  # w increases by 1 each step
+            expected_ema = decay * expected_ema + (1 - decay) * float(p["w"])
+        np.testing.assert_allclose(
+            float(ema_params(state)["w"]), expected_ema, rtol=1e-6
+        )
+
+    def test_bf16_params_ema_does_not_freeze(self):
+        """with_ema(master_weights(...)) on bf16 params: the fp32 accumulator
+        keeps moving where a bf16 one would round 0.001·(p-e) to zero."""
+        from learning_jax_sharding_tpu.training.precision import master_weights
+
+        decay = 0.999
+        tx = with_ema(master_weights(optax.sgd(1e-3)), decay)
+        p = {"w": jnp.ones((), jnp.bfloat16)}
+        state = tx.init(p)
+        assert ema_params(state)["w"].dtype == jnp.float32
+        first = None
+        for i in range(20):
+            up, state = tx.update({"w": jnp.ones((), jnp.bfloat16)}, state, p)
+            p = optax.apply_updates(p, up)
+            if first is None:
+                first = float(ema_params(state)["w"])
+        last = float(ema_params(state)["w"])
+        # Tracks the decreasing trajectory (a bf16 accumulator would freeze
+        # at 1.0 forever: every 0.001·(p-e) increment rounds away near 1.0).
+        assert last < 1.0 and last <= first
+
+    def test_requires_params(self):
+        tx = with_ema(optax.sgd(1e-2))
+        state = tx.init({"w": jnp.ones(())})
+        try:
+            tx.update({"w": jnp.ones(())}, state)
+        except ValueError as e:
+            assert "params" in str(e)
+        else:
+            raise AssertionError("expected ValueError")
+
+    def test_lookup_raises_without_ema(self):
+        state = optax.adam(1e-2).init({"w": jnp.ones(())})
+        try:
+            ema_params(state)
+        except LookupError:
+            pass
+        else:
+            raise AssertionError("expected LookupError")
+
+    def test_sharded_integration(self, mesh22, rng):
+        """EMA tree born sharded like the params; serving from the EMA works;
+        ema_params finds the tree through TrainState.opt_state."""
+        tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+        sh = mesh_sharding(mesh22, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        model = Transformer(CONFIG_TINY)
+        state, state_sh = sharded_train_state(
+            model, with_ema(optax.adamw(3e-3), 0.99), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        assert isinstance(state.opt_state, EmaState)
+        kernel = state.params["block_0"]["attn"]["query"]["kernel"]
+        ema_kernel = state.opt_state.ema["block_0"]["attn"]["query"]["kernel"]
+        assert kernel.sharding.spec == ema_kernel.sharding.spec
+
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+            RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+        )
+        losses = []
+        for _ in range(8):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        # EMA lags the iterate but is a usable param tree.
+        ema = ema_params(state.opt_state)
+        y = model.apply({"params": ema}, batch["inputs"])
+        assert np.isfinite(np.asarray(y, np.float32)).all()
+        d = jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            ema, state.params,
+        )
+        assert max(jax.tree.leaves(d)) > 0  # lags, not equal
