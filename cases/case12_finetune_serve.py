@@ -1,0 +1,146 @@
+"""Case 12 — the post-training lifecycle: LoRA fine-tune → quantize → serve.
+
+Nothing in the reference goes past a jitted forward
+(`/root/reference/case6_attention.py:229-238`); this case composes the
+framework's post-training stack on one model, end to end:
+
+1. **pretrain** the tiny transformer on a base pattern (ascending mod-V);
+2. **LoRA fine-tune** (``training/lora.py``) on a SHIFTED pattern with the
+   base frozen — only rank-r adapters train, and merging them back yields a
+   plain param tree;
+3. **int8-quantize** the merged model (``models/quantize.py``) and serve it
+   with in-jit dequantization;
+4. **speculative decoding** (``models/speculative.py``): the PRETRAINED
+   model drafts for the fine-tuned target — exactness holds by construction,
+   and the acceptance rate shows how draft/target agreement pays.
+
+Everything runs under one (data, model) mesh: adapters inherit kernel
+shardings, int8 tensors inherit theirs, both decoders run the same GSPMD
+collectives as training.
+
+Run: ``python cases/case12_finetune_serve.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.quantize import (
+    quantize_tree,
+    quantized_bytes,
+)
+from learning_jax_sharding_tpu.models.speculative import (
+    make_speculative_generate_fn,
+)
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.lora import (
+    lora_train_state,
+    make_lora_train_step,
+    merge_lora,
+)
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+SEQ = 32
+SHIFT = 7  # fine-tune task: next token jumps by SHIFT instead of 1
+
+
+def pattern_batch(mesh, vocab, step, batch_size=8, index=0):
+    rng = np.random.default_rng((29, index))
+    starts = rng.integers(0, vocab, size=batch_size)
+    toks = (starts[:, None] + step * np.arange(SEQ + 1)[None]) % vocab
+    toks = toks.astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    return {"inputs": put(toks[:, :-1], sh), "targets": put(toks[:, 1:], sh)}
+
+
+def main():
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    cfg = CONFIG_TINY
+    model = Transformer(cfg)
+
+    # 1. Pretrain on the +1 pattern.
+    batch = pattern_batch(mesh, cfg.vocab_size, step=1)
+    state, state_sh = sharded_train_state(
+        model, optax.adamw(3e-3), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+    )
+    for i in range(60):
+        state, base_loss = step(state, pattern_batch(mesh, cfg.vocab_size, 1, index=i))
+    base = state.params
+    print(f"pretrain (+1 pattern): final loss {float(base_loss):.3f}")
+
+    # 2. LoRA fine-tune on the +SHIFT pattern, base frozen.
+    ls = lora_train_state(
+        jax.random.key(1), base, optax.adamw(1e-2), rank=8, mesh=mesh
+    )
+    ft_batch = pattern_batch(mesh, cfg.vocab_size, step=SHIFT)
+    lora_step = make_lora_train_step(
+        model, state_sh.params, {k: v.sharding for k, v in ft_batch.items()},
+        mesh, RULES_DP_TP, optax.adamw(1e-2), loss_fn=next_token_loss,
+    )
+    first = last = None
+    for i in range(80):
+        ls, loss = lora_step(base, ls, pattern_batch(mesh, cfg.vocab_size, SHIFT, index=i))
+        first = float(loss) if first is None else first
+        last = float(loss)
+    print(f"LoRA fine-tune (+{SHIFT} pattern): loss {first:.3f} → {last:.3f}")
+    assert last < first
+    n_lora = sum(x.size for x in jax.tree.leaves(ls.adapters))
+    n_base = sum(x.size for x in jax.tree.leaves(base))
+    print(f"trained params: {n_lora:,} adapters vs {n_base:,} base "
+          f"({n_lora / n_base:.1%})")
+
+    merged = merge_lora(base, ls)
+
+    # 3. Quantize the merged model; serve int8 with in-jit dequant.
+    bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), merged)
+    qtree = quantize_tree(bf16)
+    print(f"serving bytes: bf16 {quantized_bytes(bf16):,} → int8 "
+          f"{quantized_bytes(qtree):,}")
+    prompt = np.stack([np.arange(10, 10 + 8), np.arange(40, 40 + 8)]).astype(np.int32)
+    prompt = put(prompt, mesh_sharding(mesh, "data", None))
+    gen_q = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=10,
+        inference_dtype=jnp.bfloat16, dequantize=True,
+    )
+    out_q = np.asarray(gen_q(qtree, prompt, jax.random.key(2)))
+    print("int8 serve, fine-tuned model continues the +7 pattern:")
+    print(" ", out_q[0])
+    # The fine-tuned model must continue with +SHIFT steps, not +1.
+    diffs = np.diff(out_q[0, 7:]) % cfg.vocab_size
+    assert (diffs == SHIFT).mean() > 0.6, diffs
+
+    # 4. Speculative decoding: pretrained model drafts for the merged target.
+    spec = make_speculative_generate_fn(
+        cfg, cfg, mesh, RULES_DP_TP, max_new_tokens=10, num_draft=3,
+    )
+    plain = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=10)
+    out_spec = np.asarray(spec(merged, base, prompt))
+    out_plain = np.asarray(plain(merged, prompt, jax.random.key(0)))
+    assert (out_spec == out_plain).all(), "speculative must equal plain greedy"
+    print("speculative decode (pretrained drafts for fine-tuned): exact ✓")
+    print("case12 PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,9 +7,25 @@ from learning_jax_sharding_tpu.training.pipeline import (  # noqa: F401
     make_train_step,
     sharded_train_state,
 )
+from learning_jax_sharding_tpu.training.ema import (  # noqa: F401
+    EmaState,
+    ema_params,
+    with_ema,
+)
+from learning_jax_sharding_tpu.training.lora import (  # noqa: F401
+    LoraState,
+    init_lora,
+    lora_shardings,
+    lora_train_state,
+    make_lora_train_step,
+    merge_lora,
+)
 from learning_jax_sharding_tpu.training.precision import (  # noqa: F401
     MasterWeightsState,
     master_weights,
+)
+from learning_jax_sharding_tpu.training.zero import (  # noqa: F401
+    zero1_shardings,
 )
 
 _CHECKPOINT_EXPORTS = ("CheckpointManager", "as_abstract")

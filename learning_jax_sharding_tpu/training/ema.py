@@ -42,6 +42,12 @@ def with_ema(
     params. Gradients/updates pass through unchanged — training dynamics
     are identical to bare ``inner``.
 
+    ``with_ema`` must be the OUTERMOST transformation: it reconstructs the
+    post-update params from the updates IT emits, so anything wrapped around
+    it (e.g. ``optax.chain(with_ema(...), clip)``) would make it average a
+    trajectory the real params never follow. Put clipping/schedules inside:
+    ``with_ema(optax.chain(clip, adamw))``.
+
     The EMA accumulates in ``ema_dtype`` (fp32 by default) regardless of the
     params' dtype: with bf16 params and decay=0.999 a bf16 accumulator would
     round the ``0.001·(p - e)`` increment to zero and freeze — the same
@@ -78,8 +84,11 @@ def with_ema(
 def ema_params(opt_state: Any) -> Any:
     """Pull the EMA tree out of a (possibly nested) optimizer state.
 
-    Works on ``TrainState.opt_state`` whether ``with_ema`` is outermost or
-    wrapped inside chains/other wrappers. Raises LookupError if absent.
+    Searches ``TrainState.opt_state`` recursively, so the lookup works even
+    when other wrappers sit around ``with_ema`` — but ``with_ema`` itself
+    must be the OUTERMOST transformation (see its docstring): placed
+    mid-chain it would average a pre-transformed trajectory the params never
+    follow. Raises LookupError if absent.
     """
     if isinstance(opt_state, EmaState):
         return opt_state.ema
