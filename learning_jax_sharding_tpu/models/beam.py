@@ -1,0 +1,188 @@
+"""Beam search decoding over the sharded KV-cache stack.
+
+Sampling (``models/generate.py``) explores one path; beam search keeps the
+``beam_size`` highest-logprob prefixes at every step and returns the best
+complete sequence — the standard serving decoder the greedy path can't
+replace. Nothing like it exists in the reference (no inference path at all,
+SURVEY.md §5).
+
+TPU-shaped implementation:
+
+* beams fold into the batch: all caches and forwards run at ``B·K`` rows, so
+  each decode step is ONE chunked model apply — no per-beam loops;
+* beam reordering is a batched ``jnp.take`` of every cache leaf along its
+  leading dim inside the same jitted scan step (XLA lowers it to a gather
+  that follows the cache's sharding — batch stays on the ``data`` axis);
+* everything is static-shaped: ``lax.scan`` over ``max_new_tokens`` steps,
+  top-k over the flattened ``K·V`` continuation scores per batch row.
+
+Optional ``eos_id``: finished beams are frozen (their only continuation is a
+repeated EOS at zero added logprob) and scores are length-normalized by
+``(length)**length_penalty`` — without an EOS every beam has equal length
+and the penalty cancels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
+from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+
+NEG_INF = -1e9
+
+
+def _gather_beams(tree: Any, parent: jax.Array, batch: int, k: int) -> Any:
+    """Reorder the leading ``B·K`` dim of every array leaf to follow
+    ``parent`` (B, K) beam indices."""
+    flat = (jnp.arange(batch)[:, None] * k + parent).reshape(-1)  # (B·K,)
+
+    def leaf(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch * k:
+            return jnp.take(x, flat, axis=0)
+        return x  # scalars: cache_index / position, shared across beams
+
+    return jax.tree.map(leaf, tree)
+
+
+def make_beam_search_fn(
+    config: TransformerConfig,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    beam_size: int,
+    max_new_tokens: int,
+    eos_id: Optional[int] = None,
+    length_penalty: float = 1.0,
+    inference_dtype: Any | None = None,
+):
+    """Build ``search(params, prompt) -> (tokens, scores)``.
+
+    ``tokens`` is the best beam per row, ``(B, prompt+max_new)``; ``scores``
+    its length-normalized sequence logprob, ``(B,)``. ``config`` is the
+    TRAINING config; the decode variant is derived here (as in
+    ``make_generate_fn``).
+    """
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
+    if inference_dtype is not None:
+        cfg = dataclasses.replace(
+            cfg, dtype=inference_dtype, param_dtype=inference_dtype
+        )
+    model = Transformer(cfg)
+    k = beam_size
+
+    def apply(params, cache, tokens):
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        logits, mut = model.apply(variables, tokens, mutable=("cache",))
+        return logits.astype(jnp.float32), mut["cache"]
+
+    def search(params, prompt):
+        b, prompt_len = prompt.shape
+        if prompt_len + max_new_tokens > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_seq_len ({cfg.max_seq_len})"
+            )
+        # Prefill ONCE at batch B, then tile the caches to the (B·K) serving
+        # shape inside the same jitted program — prefill FLOPs don't scale
+        # with beam_size, and the decode loop still runs at a single static
+        # B·K batch (row-major: a row's beams are adjacent).
+        logits, cache = apply(params, None, prompt)
+        cache = jax.tree.map(
+            lambda x: jnp.repeat(x, k, axis=0)
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] == b else x,
+            cache,
+        )
+        logp0 = jax.nn.log_softmax(logits[:, -1])  # (B, V)
+        vocab = logp0.shape[-1]
+
+        # First expansion: the K beams of a row are identical here, so the
+        # top-K tokens of the single prefill row seed the K beams (a K·V
+        # top-k would K-fold duplicate each candidate).
+        scores, first_tok = lax.top_k(logp0, k)  # (B, K) each
+        tokens_buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+        tokens_buf = tokens_buf.at[:, :, 0].set(first_tok)
+        finished = (
+            first_tok == eos_id if eos_id is not None
+            else jnp.zeros((b, k), bool)
+        )
+        lengths = jnp.ones((b, k), jnp.int32)
+
+        def step(carry, i):
+            scores, tokens_buf, finished, lengths, cache = carry
+            last = lax.dynamic_index_in_dim(
+                tokens_buf, i - 1, axis=2, keepdims=False
+            )  # (B, K)
+            logits, cache = apply(params, cache, last.reshape(b * k, 1))
+            logp = jax.nn.log_softmax(logits[:, -1]).reshape(b, k, vocab)
+            if eos_id is not None:
+                # Frozen beams may only emit EOS again, at no cost — keeps
+                # their score comparable while occupying one candidate slot.
+                frozen = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
+                logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+            total = scores[:, :, None] + logp  # (B, K, V)
+            scores, flat_idx = lax.top_k(total.reshape(b, k * vocab), k)
+            parent = flat_idx // vocab  # (B, K)
+            token = (flat_idx % vocab).astype(jnp.int32)
+
+            tokens_buf = _gather_beams(
+                tokens_buf.reshape(b * k, -1), parent, b, k
+            ).reshape(b, k, -1)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            lengths = jnp.take_along_axis(lengths, parent, axis=1)
+            cache = _gather_beams(cache, parent, b, k)
+
+            tokens_buf = tokens_buf.at[:, :, i].set(token)
+            lengths = lengths + (~finished).astype(jnp.int32)
+            if eos_id is not None:
+                finished = finished | (token == eos_id)
+            return (scores, tokens_buf, finished, lengths, cache), None
+
+        (scores, tokens_buf, finished, lengths, _), _ = lax.scan(
+            step,
+            (scores, tokens_buf, finished, lengths, cache),
+            jnp.arange(1, max_new_tokens),
+        )
+
+        norm = jnp.power(lengths.astype(jnp.float32), length_penalty)
+        final = scores / norm
+        best = jnp.argmax(final, axis=1)  # (B,)
+        best_tokens = jnp.take_along_axis(
+            tokens_buf, best[:, None, None], axis=1
+        )[:, 0]
+        best_score = jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+        return (
+            jnp.concatenate([prompt, best_tokens], axis=1),
+            best_score,
+        )
+
+    jitted = jax.jit(search)
+
+    def maybe_cast(params):
+        # Eager, like make_generate_fn: an in-program cast re-runs every
+        # scan step (measured 20% slower there) and keeps fp32 copies
+        # resident.
+        if inference_dtype is None:
+            return params
+        return jax.tree.map(
+            lambda x: x.astype(inference_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            params,
+        )
+
+    def run(params: Any, prompt: jax.Array):
+        with activate(mesh, rules):
+            return jitted(maybe_cast(params), prompt)
+
+    run.jitted = jitted
+    return run
