@@ -35,10 +35,36 @@ from learning_jax_sharding_tpu.parallel.logical import (
     BATCH,
     EMBED,
     HIDDEN,
+    LAYERS,
     MLP,
     SEQ,
     VOCAB,
 )
+
+
+def resolve_remat_policy(name: Optional[str]):
+    """Named ``jax.checkpoint`` policies for block rematerialization.
+
+    ``None``/``"nothing"`` — save nothing, recompute everything (the
+    ``jax.checkpoint`` default; minimum memory, ~1/3 extra FLOPs);
+    ``"dots"`` — save matmul outputs, recompute only elementwise/softmax work
+    (most of the memory win at a fraction of the recompute);
+    ``"dots_no_batch"`` — save only batch-free matmuls (i.e. none in a
+    transformer block: everything carries the batch dim, so this is the
+    conservative middle ground XLA offload papers use).
+    """
+    if name is None or name == "nothing":
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}: expected None, 'nothing', "
+            f"'dots', or 'dots_no_batch'"
+        )
+    return policies[name]
 
 
 class FeedForward(nn.Module):
@@ -131,9 +157,10 @@ class TransformerBlock(nn.Module):
     decode: bool = False          # KV-cached autoregressive attention
     max_decode_len: int = 0
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
+    scan: bool = False            # under nn.scan: return (x, None) pairs
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+    def __call__(self, x: jax.Array, deterministic: bool = True):
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
         h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_attn")(x)
         x = x + MultiHeadAttention(
@@ -176,7 +203,9 @@ class TransformerBlock(nn.Module):
                 param_dtype=self.param_dtype,
                 name="ff",
             )(h)
-        return nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        # nn.scan's carry protocol wants (carry, per-step output) pairs.
+        return (x, None) if self.scan else x
 
 
 @dataclasses.dataclass(frozen=True)
@@ -201,14 +230,30 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False              # rematerialize each block's activations
+    remat_policy: Optional[str] = None  # what remat SAVES: None/'nothing'
+                                     # (recompute all), 'dots', 'dots_no_batch'
+                                     # (see resolve_remat_policy)
     remat_attention: bool = False    # rematerialize only the O(S²) attention
                                      # internals (cheap; lifts the batch cap)
+    scan_layers: bool = False        # one nn.scan'd stacked block instead of
+                                     # N unrolled blocks: O(1) compile time in
+                                     # depth, params gain a leading (LAYERS,)
+                                     # dim; math is identical (tests prove it)
     attn_fn: Optional[Callable] = None
     num_experts: int = 0             # >0: MoE FF in every block (EP over mesh)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     decode: bool = False             # inference mode: KV cache, chunked input
+
+    def __post_init__(self):
+        if self.remat_policy is not None:
+            resolve_remat_policy(self.remat_policy)  # fail fast on typos
+            if not self.remat:
+                raise ValueError(
+                    "remat_policy is set but remat=False — the policy would "
+                    "be silently ignored; set remat=True (or drop the policy)"
+                )
 
     def train_step_flops(self, batch: int, seq: int) -> float:
         """Analytic model FLOPs of one train step (fwd + bwd ≈ 3× fwd).
@@ -350,36 +395,83 @@ class Transformer(nn.Module):
                 x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
-        block_cls = TransformerBlock
-        if cfg.remat and not cfg.decode:
-            # Trade FLOPs for HBM: recompute each block's activations in the
-            # backward instead of storing them (SURVEY.md's remat note; key to
-            # fitting long sequences).
-            block_cls = nn.remat(TransformerBlock, static_argnums=())
-        for i in range(cfg.num_layers):
-            x = block_cls(
-                features=cfg.features,
-                num_heads=cfg.num_heads,
-                head_dim=cfg.head_dim,
-                num_kv_heads=cfg.num_kv_heads,
-                rope=cfg.rope,
-                rope_theta=cfg.rope_theta,
-                window=cfg.window,
-                hidden=cfg.hidden,
-                dropout_rate=cfg.dropout_rate,
-                causal=cfg.causal,
-                dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype,
-                attn_fn=cfg.attn_fn,
-                remat_attention=cfg.remat_attention,
-                num_experts=cfg.num_experts,
-                moe_top_k=cfg.moe_top_k,
-                moe_capacity_factor=cfg.moe_capacity_factor,
-                decode=cfg.decode,
-                max_decode_len=cfg.max_seq_len if cfg.decode else 0,
-                norm=cfg.norm,
-                name=f"block_{i}",
-            )(x, deterministic=deterministic)
+        block_fields = dict(
+            features=cfg.features,
+            num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim,
+            num_kv_heads=cfg.num_kv_heads,
+            rope=cfg.rope,
+            rope_theta=cfg.rope_theta,
+            window=cfg.window,
+            hidden=cfg.hidden,
+            dropout_rate=cfg.dropout_rate,
+            causal=cfg.causal,
+            dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            attn_fn=cfg.attn_fn,
+            remat_attention=cfg.remat_attention,
+            num_experts=cfg.num_experts,
+            moe_top_k=cfg.moe_top_k,
+            moe_capacity_factor=cfg.moe_capacity_factor,
+            decode=cfg.decode,
+            max_decode_len=cfg.max_seq_len if cfg.decode else 0,
+            norm=cfg.norm,
+        )
+        if cfg.scan_layers:
+            if cfg.decode:
+                raise ValueError(
+                    "scan_layers does not support decode mode yet: use the "
+                    "unrolled stack for KV-cached generation"
+                )
+            # One stacked block scanned over a leading (LAYERS,) param dim:
+            # XLA traces/compiles the block body ONCE regardless of depth
+            # (unrolled 12-layer 125M: ~12x the block HLO), and the weights
+            # stay stationary per scan step. split_rngs gives every layer its
+            # own init (and dropout) stream; metadata_params records the new
+            # leading axis as LAYERS in each param's logical names, so the
+            # rule sets (which leave LAYERS unmapped) shard stacked kernels
+            # exactly like their unrolled counterparts, layer dim whole.
+            block_cls = TransformerBlock
+            if cfg.remat:
+                # prevent_cse is about XLA de-duplicating the rematerialized
+                # ops against the forward; inside lax.scan that cannot happen,
+                # so skip the (optimization-barrier) guards. static_argnums
+                # counts the module method's args with self=0, so
+                # deterministic — which nn.Dropout branches on in Python —
+                # is arg 2 and must stay untraced.
+                block_cls = nn.remat(
+                    TransformerBlock,
+                    prevent_cse=False,
+                    policy=resolve_remat_policy(cfg.remat_policy),
+                    static_argnums=(2,),
+                )
+            stack = nn.scan(
+                block_cls,
+                variable_axes={"params": 0, "losses": 0, "intermediates": 0},
+                split_rngs={"params": True, "dropout": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.num_layers,
+                metadata_params={nn.meta.PARTITION_NAME: LAYERS},
+            )
+            x, _ = stack(scan=True, **block_fields, name="blocks")(
+                x, deterministic
+            )
+        else:
+            block_cls = TransformerBlock
+            if cfg.remat and not cfg.decode:
+                # Trade FLOPs for HBM: recompute each block's activations in
+                # the backward instead of storing them (SURVEY.md's remat
+                # note; key to fitting long sequences). deterministic is arg 2
+                # (self=0) and must stay untraced — nn.Dropout branches on it.
+                block_cls = nn.remat(
+                    TransformerBlock,
+                    static_argnums=(2,),
+                    policy=resolve_remat_policy(cfg.remat_policy),
+                )
+            for i in range(cfg.num_layers):
+                x = block_cls(**block_fields, name=f"block_{i}")(
+                    x, deterministic
+                )
 
         x = make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out")(x)
         if return_hidden:

@@ -41,6 +41,10 @@ MLP = "mlp"        # alias kept distinct for gated-FF variants
 VOCAB = "vocab"    # embedding rows / logits columns
 STAGE = "stage"    # pipeline stage (stretch, not in reference)
 EXPERT = "expert"  # MoE expert (stretch, not in reference)
+LAYERS = "layers"  # stacked-layer dim of nn.scan'd block stacks
+                   # (models.transformer scan_layers; unmapped in every rule
+                   # set → the layer dim stays unsharded, each param leaf
+                   # keeps its per-layer sharding)
 
 Rules = tuple[tuple[str, str | None], ...]
 
