@@ -14,17 +14,21 @@ TPU-shaped implementation:
   leading dim inside the same jitted scan step (XLA lowers it to a gather
   that follows the cache's sharding — batch stays on the ``data`` axis);
 * everything is static-shaped: ``lax.scan`` over ``max_new_tokens`` steps,
-  top-k over the flattened ``K·V`` continuation scores per batch row.
+  top-2K over the flattened ``K·V`` continuation scores per batch row.
 
-Optional ``eos_id``: finished beams are frozen (their only continuation is a
-repeated EOS at zero added logprob) and scores are length-normalized by
-``(length)**length_penalty`` — without an EOS every beam has equal length
-and the penalty cancels.
+With ``eos_id`` set, hypotheses that emit EOS leave the live set for a
+separate **finished pool** of size K (scores length-normalized by
+``length**length_penalty`` at finishing time), and the live slots keep
+exploring — a completed hypothesis can never be evicted by a live prefix
+that later decays below it, the guarantee that makes beam search return the
+best sequence it ever found (same pool discipline as t5x/flax beam search).
+Expanding 2K candidates guarantees K live survivors: at most one candidate
+per parent ends in EOS. Without an EOS every beam has equal length and the
+length penalty cancels.
 """
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Optional
 
 import jax
@@ -32,6 +36,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
+from learning_jax_sharding_tpu.models.decoding import (
+    check_sequence_budget,
+    derive_decode_config,
+    make_cached_apply,
+    make_param_caster,
+)
 from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 
@@ -61,38 +71,68 @@ def make_beam_search_fn(
     eos_id: Optional[int] = None,
     length_penalty: float = 1.0,
     inference_dtype: Any | None = None,
+    dequantize: bool = False,
 ):
     """Build ``search(params, prompt) -> (tokens, scores)``.
 
-    ``tokens`` is the best beam per row, ``(B, prompt+max_new)``; ``scores``
-    its length-normalized sequence logprob, ``(B,)``. ``config`` is the
-    TRAINING config; the decode variant is derived here (as in
-    ``make_generate_fn``).
+    ``tokens`` is the best hypothesis per row, ``(B, prompt+max_new)``, with
+    everything after an EOS padded with EOS; ``scores`` its
+    length-normalized sequence logprob, ``(B,)``. ``config`` is the TRAINING
+    config; the decode variant is derived here. ``inference_dtype`` /
+    ``dequantize`` follow ``make_generate_fn`` (eager cast; int8 trees
+    dequantized in-jit).
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
-    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
-    if inference_dtype is not None:
-        cfg = dataclasses.replace(
-            cfg, dtype=inference_dtype, param_dtype=inference_dtype
+    if config.vocab_size < 2 * beam_size:
+        raise ValueError(
+            f"vocab_size ({config.vocab_size}) must be >= 2*beam_size "
+            f"({2 * beam_size}) for the 2K candidate expansion"
         )
+    cfg = derive_decode_config(config, inference_dtype)
     model = Transformer(cfg)
+    maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
+    apply = make_cached_apply(
+        model, dequantize=dequantize, dequant_dtype=cfg.param_dtype
+    )
     k = beam_size
 
-    def apply(params, cache, tokens):
-        variables = {"params": params}
-        if cache is not None:
-            variables["cache"] = cache
-        logits, mut = model.apply(variables, tokens, mutable=("cache",))
-        return logits.astype(jnp.float32), mut["cache"]
+    def norm(length):
+        return jnp.power(jnp.asarray(length, jnp.float32), length_penalty)
+
+    def expand(scores_2k, tokens_2k, cand_buf, pos, fin_scores, fin_buf):
+        """Split 2K candidates into EOS-finished (→ merge into the K-slot
+        finished pool, normalized at their final length ``pos+1``) and live
+        (→ top-K raw scores). Returns the updated pool and the live pick."""
+        is_eos = (
+            tokens_2k == eos_id if eos_id is not None
+            else jnp.zeros_like(tokens_2k, bool)
+        )
+        # Finished candidates: freeze the suffix to EOS so the returned
+        # sequence is cleanly padded, then keep the best K of pool ∪ new.
+        if eos_id is not None:
+            padded = jnp.where(
+                jnp.arange(cand_buf.shape[-1])[None, None] > pos,
+                eos_id, cand_buf,
+            )
+            cand_fin = jnp.where(is_eos, scores_2k / norm(pos + 1), NEG_INF)
+            all_scores = jnp.concatenate([fin_scores, cand_fin], axis=1)
+            all_buf = jnp.concatenate([fin_buf, padded], axis=1)
+            fin_scores, fin_idx = lax.top_k(all_scores, k)
+            fin_buf = jnp.take_along_axis(all_buf, fin_idx[:, :, None], axis=1)
+        # Live candidates: EOS rows drop out (at most one per parent, so at
+        # least K of 2K remain).
+        live_scores, live_idx = lax.top_k(
+            jnp.where(is_eos, NEG_INF, scores_2k), k
+        )
+        return fin_scores, fin_buf, live_scores, live_idx
 
     def search(params, prompt):
         b, prompt_len = prompt.shape
-        if prompt_len + max_new_tokens > cfg.max_seq_len:
-            raise ValueError(
-                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_seq_len ({cfg.max_seq_len})"
-            )
+        check_sequence_budget(
+            prompt_len + max_new_tokens, cfg.max_seq_len,
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens})",
+        )
         # Prefill ONCE at batch B, then tile the caches to the (B·K) serving
         # shape inside the same jitted program — prefill FLOPs don't scale
         # with beam_size, and the decode loop still runs at a single static
@@ -106,79 +146,72 @@ def make_beam_search_fn(
         logp0 = jax.nn.log_softmax(logits[:, -1])  # (B, V)
         vocab = logp0.shape[-1]
 
+        fin_scores = jnp.full((b, k), NEG_INF)
+        fin_buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+
         # First expansion: the K beams of a row are identical here, so the
-        # top-K tokens of the single prefill row seed the K beams (a K·V
+        # top-2K tokens of the single prefill row seed the pools (a K·V
         # top-k would K-fold duplicate each candidate).
-        scores, first_tok = lax.top_k(logp0, k)  # (B, K) each
-        tokens_buf = jnp.zeros((b, k, max_new_tokens), jnp.int32)
-        tokens_buf = tokens_buf.at[:, :, 0].set(first_tok)
-        finished = (
-            first_tok == eos_id if eos_id is not None
-            else jnp.zeros((b, k), bool)
+        scores_2k, tok_2k = lax.top_k(logp0, 2 * k)  # (B, 2K) each
+        cand_buf = jnp.zeros((b, 2 * k, max_new_tokens), jnp.int32)
+        cand_buf = cand_buf.at[:, :, 0].set(tok_2k)
+        fin_scores, fin_buf, scores, live_idx = expand(
+            scores_2k, tok_2k, cand_buf, 0, fin_scores, fin_buf
         )
-        lengths = jnp.ones((b, k), jnp.int32)
+        tokens_buf = jnp.take_along_axis(cand_buf, live_idx[:, :, None], axis=1)
+        # All K beams share the one prefill cache row — no gather needed.
 
         def step(carry, i):
-            scores, tokens_buf, finished, lengths, cache = carry
+            scores, tokens_buf, fin_scores, fin_buf, cache = carry
             last = lax.dynamic_index_in_dim(
                 tokens_buf, i - 1, axis=2, keepdims=False
             )  # (B, K)
             logits, cache = apply(params, cache, last.reshape(b * k, 1))
             logp = jax.nn.log_softmax(logits[:, -1]).reshape(b, k, vocab)
-            if eos_id is not None:
-                # Frozen beams may only emit EOS again, at no cost — keeps
-                # their score comparable while occupying one candidate slot.
-                frozen = jnp.full((vocab,), NEG_INF).at[eos_id].set(0.0)
-                logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
             total = scores[:, :, None] + logp  # (B, K, V)
-            scores, flat_idx = lax.top_k(total.reshape(b, k * vocab), k)
-            parent = flat_idx // vocab  # (B, K)
-            token = (flat_idx % vocab).astype(jnp.int32)
+            scores_2k, flat_idx = lax.top_k(total.reshape(b, k * vocab), 2 * k)
+            parent_2k = flat_idx // vocab  # (B, 2K)
+            tok_2k = (flat_idx % vocab).astype(jnp.int32)
 
-            tokens_buf = _gather_beams(
-                tokens_buf.reshape(b * k, -1), parent, b, k
-            ).reshape(b, k, -1)
-            finished = jnp.take_along_axis(finished, parent, axis=1)
-            lengths = jnp.take_along_axis(lengths, parent, axis=1)
+            cand_buf = _gather_beams(
+                tokens_buf.reshape(b * k, -1),
+                parent_2k.reshape(b, 2 * k), b, k,
+            ).reshape(b, 2 * k, -1)
+            cand_buf = cand_buf.at[:, :, i].set(tok_2k)
+
+            fin_scores, fin_buf, scores, live_idx = expand(
+                scores_2k, tok_2k, cand_buf, i, fin_scores, fin_buf
+            )
+            tokens_buf = jnp.take_along_axis(
+                cand_buf, live_idx[:, :, None], axis=1
+            )
+            parent = jnp.take_along_axis(parent_2k, live_idx, axis=1)
             cache = _gather_beams(cache, parent, b, k)
+            return (scores, tokens_buf, fin_scores, fin_buf, cache), None
 
-            tokens_buf = tokens_buf.at[:, :, i].set(token)
-            lengths = lengths + (~finished).astype(jnp.int32)
-            if eos_id is not None:
-                finished = finished | (token == eos_id)
-            return (scores, tokens_buf, finished, lengths, cache), None
-
-        (scores, tokens_buf, finished, lengths, _), _ = lax.scan(
+        (scores, tokens_buf, fin_scores, fin_buf, _), _ = lax.scan(
             step,
-            (scores, tokens_buf, finished, lengths, cache),
+            (scores, tokens_buf, fin_scores, fin_buf, cache),
             jnp.arange(1, max_new_tokens),
         )
 
-        norm = jnp.power(lengths.astype(jnp.float32), length_penalty)
-        final = scores / norm
-        best = jnp.argmax(final, axis=1)  # (B,)
+        # Final selection: live hypotheses (all at full length) join the
+        # finished pool on normalized scores; with no EOS the pool is empty
+        # (all NEG_INF) and the best live beam wins as before.
+        live_final = scores / norm(max_new_tokens)
+        all_scores = jnp.concatenate([fin_scores, live_final], axis=1)
+        all_buf = jnp.concatenate([fin_buf, tokens_buf], axis=1)
+        best = jnp.argmax(all_scores, axis=1)  # (B,)
         best_tokens = jnp.take_along_axis(
-            tokens_buf, best[:, None, None], axis=1
+            all_buf, best[:, None, None], axis=1
         )[:, 0]
-        best_score = jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+        best_score = jnp.take_along_axis(all_scores, best[:, None], axis=1)[:, 0]
         return (
             jnp.concatenate([prompt, best_tokens], axis=1),
             best_score,
         )
 
     jitted = jax.jit(search)
-
-    def maybe_cast(params):
-        # Eager, like make_generate_fn: an in-program cast re-runs every
-        # scan step (measured 20% slower there) and keeps fp32 copies
-        # resident.
-        if inference_dtype is None:
-            return params
-        return jax.tree.map(
-            lambda x: x.astype(inference_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            params,
-        )
 
     def run(params: Any, prompt: jax.Array):
         with activate(mesh, rules):

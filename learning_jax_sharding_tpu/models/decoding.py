@@ -1,0 +1,112 @@
+"""Shared serving-path plumbing for the decoding entry points.
+
+``make_generate_fn`` (sampling), ``make_beam_search_fn`` (beam search), and
+``make_speculative_generate_fn`` (draft-verify) all need the same four
+pieces; this module is their single copy, so policies like "how params are
+cast for inference" or "how quantized trees are handled" cannot drift
+between decoders:
+
+* :func:`derive_decode_config` — turn a TRAINING config into its decode
+  variant (KV caches on, dropout off, optional inference dtype swap);
+* :func:`make_param_caster` — the eager params cast for ``inference_dtype``
+  (eager on purpose: an in-program cast re-runs every scan step — measured
+  20% slower on the v5e decode bench — and keeps the fp32 copies resident),
+  quantization-aware: int8 ``{"q","scale"}`` nodes pass through untouched;
+* :func:`make_cached_apply` — the mutable-cache model apply every decoder
+  loops over (prefill creates the caches, later calls thread them), with
+  optional in-jit dequantization of int8 weight trees;
+* :func:`check_sequence_budget` — the prompt+new vs ``max_seq_len`` guard.
+
+(The reference has no inference path at all, SURVEY.md §5 — these helpers
+back the serving stack that replaces its timing-only ``apply_fn``,
+`/root/reference/case6_attention.py:229-238`.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from learning_jax_sharding_tpu.models.transformer import TransformerConfig
+
+
+def derive_decode_config(
+    config: TransformerConfig, inference_dtype: Any | None = None
+) -> TransformerConfig:
+    """Decode variant of a TRAINING config: KV caches on, dropout off, and —
+    when ``inference_dtype`` is given — compute/param dtypes swapped to it,
+    so train and serve share params verbatim."""
+    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
+    if inference_dtype is not None:
+        cfg = dataclasses.replace(
+            cfg, dtype=inference_dtype, param_dtype=inference_dtype
+        )
+    return cfg
+
+
+def make_param_caster(
+    inference_dtype: Any | None, *, dequantize: bool = False
+) -> Callable[[Any], Any]:
+    """Eager ``maybe_cast(params)`` for serving.
+
+    Casts floating leaves to ``inference_dtype`` (identity when ``None``).
+    With ``dequantize`` the tree holds int8 ``{"q","scale"}`` nodes from
+    ``models.quantize.quantize_tree``: those stay untouched (the in-jit
+    dequant picks the target dtype) while everything else — embeddings,
+    norms, biases, often the largest remaining fp32 blocks — still casts.
+    """
+
+    def maybe_cast(params: Any) -> Any:
+        if inference_dtype is None:
+            return params
+
+        def cast(x):
+            return (
+                x.astype(inference_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x
+            )
+
+        if not dequantize:
+            return jax.tree.map(cast, params)
+        from learning_jax_sharding_tpu.models.quantize import map_unquantized
+
+        return map_unquantized(cast, params)
+
+    return maybe_cast
+
+
+def make_cached_apply(
+    model: Any, *, dequantize: bool = False, dequant_dtype: Any | None = None
+) -> Callable[[Any, Any, jax.Array], tuple[jax.Array, Any]]:
+    """The decode-loop workhorse: ``apply(params, cache, tokens) ->
+    (fp32 logits, new cache)``.
+
+    With ``cache=None`` the mutable apply CREATES the (zeroed) caches — that
+    is the prefill call; later calls thread the cache through. With
+    ``dequantize`` the int8 tree is dequantized INSIDE each apply so the
+    decode scan holds only int8 weights in its carry/constants (the storage
+    win); whether XLA streams int8 into the matmuls or materializes the
+    upcast is its call — ``bench.py`` measures it.
+    """
+
+    def apply(params: Any, cache: Any, tokens: jax.Array):
+        if dequantize:
+            from learning_jax_sharding_tpu.models.quantize import dequantize_tree
+
+            params = dequantize_tree(params, dequant_dtype)
+        variables = {"params": params}
+        if cache is not None:
+            variables["cache"] = cache
+        logits, mut = model.apply(variables, tokens, mutable=("cache",))
+        return logits.astype(jnp.float32), mut["cache"]
+
+    return apply
+
+
+def check_sequence_budget(needed: int, max_seq_len: int, what: str) -> None:
+    """Raise if a decode plan would write past the KV caches."""
+    if needed > max_seq_len:
+        raise ValueError(f"{what} ({needed}) exceeds max_seq_len ({max_seq_len})")

@@ -22,7 +22,6 @@ are supported; the filters compose (k-truncation, then p-truncation).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Optional
 
 import jax
@@ -30,6 +29,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
+from learning_jax_sharding_tpu.models.decoding import (
+    check_sequence_budget,
+    derive_decode_config,
+    make_cached_apply,
+    make_param_caster,
+)
 from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 
@@ -129,58 +134,24 @@ def make_generate_fn(
     compute/dequant dtype; non-quantized leaves (embeddings, norms) are
     still cast to it eagerly.
     """
-    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
-    if inference_dtype is not None:
-        cfg = dataclasses.replace(cfg, dtype=inference_dtype, param_dtype=inference_dtype)
+    cfg = derive_decode_config(config, inference_dtype)
     model = Transformer(cfg)
-    dequant_dtype = cfg.param_dtype  # == inference_dtype when one was given
-
-    def maybe_cast(params):
-        if inference_dtype is None:
-            return params
-
-        def cast(x):
-            return (
-                x.astype(inference_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x
-            )
-
-        if not dequantize:
-            return jax.tree.map(cast, params)
-
-        # Quantized nodes keep int8 q + fp32 scale (the in-jit dequant picks
-        # the target dtype); everything else — embeddings, norms, biases,
-        # often the largest remaining fp32 blocks — still casts eagerly.
-        from learning_jax_sharding_tpu.models.quantize import map_unquantized
-
-        return map_unquantized(cast, params)
+    maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
+    # dequant dtype == inference_dtype when one was given (models.decoding)
+    apply = make_cached_apply(
+        model, dequantize=dequantize, dequant_dtype=cfg.param_dtype
+    )
 
     def step_apply(params, cache, tokens):
-        if dequantize:
-            from learning_jax_sharding_tpu.models.quantize import dequantize_tree
-
-            # Dequant INSIDE each apply so the decode scan holds only int8
-            # weights in its carry/constants — the storage win. The per-step
-            # upcast is then XLA's to place: fused into the matmul operands
-            # (int8 streamed, the bandwidth win) or materialized (extra
-            # traffic — the analogous in-scan bf16 cast measured 20% slower
-            # here, see ``inference_dtype`` above). bench.py measures it.
-            params = dequantize_tree(params, dequant_dtype)
-        variables = {"params": params}
-        if cache is not None:
-            variables["cache"] = cache
-        # With no cache passed, the mutable apply CREATES the (zeroed) caches
-        # — that is the prefill call; later calls thread the cache through.
-        logits, mut = model.apply(variables, tokens, mutable=("cache",))
-        return logits[:, -1].astype(jnp.float32), mut["cache"]
+        logits, cache = apply(params, cache, tokens)
+        return logits[:, -1], cache
 
     def generate(params, prompt, rng):
         b, prompt_len = prompt.shape
-        if prompt_len + max_new_tokens > cfg.max_seq_len:
-            raise ValueError(
-                f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds max_seq_len ({cfg.max_seq_len})"
-            )
+        check_sequence_budget(
+            prompt_len + max_new_tokens, cfg.max_seq_len,
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens})",
+        )
         # Prefill: creates the caches (they are born inside this jitted
         # program, sized (B, max_seq_len, ...)) and returns the last-position
         # logits, from which the first new token is sampled.

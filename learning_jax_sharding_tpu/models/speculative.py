@@ -31,7 +31,6 @@ equality and the exactness guarantee is unconditional.
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, Optional
 
 import jax
@@ -39,6 +38,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
+from learning_jax_sharding_tpu.models.decoding import (
+    check_sequence_budget,
+    derive_decode_config,
+    make_cached_apply,
+    make_param_caster,
+)
 from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 
@@ -86,23 +91,11 @@ def make_speculative_generate_fn(
     if num_draft < 1:
         raise ValueError(f"num_draft must be >= 1, got {num_draft}")
 
-    def decode_cfg(cfg):
-        cfg = dataclasses.replace(cfg, decode=True, dropout_rate=0.0)
-        if inference_dtype is not None:
-            cfg = dataclasses.replace(
-                cfg, dtype=inference_dtype, param_dtype=inference_dtype
-            )
-        return cfg
-
-    t_cfg, d_cfg = decode_cfg(target_config), decode_cfg(draft_config)
+    t_cfg = derive_decode_config(target_config, inference_dtype)
+    d_cfg = derive_decode_config(draft_config, inference_dtype)
     target, draft = Transformer(t_cfg), Transformer(d_cfg)
-
-    def apply(model, params, cache, tokens):
-        variables = {"params": params}
-        if cache is not None:
-            variables["cache"] = cache
-        logits, mut = model.apply(variables, tokens, mutable=("cache",))
-        return logits.astype(jnp.float32), mut["cache"]
+    t_apply, d_apply = make_cached_apply(target), make_cached_apply(draft)
+    maybe_cast = make_param_caster(inference_dtype)
 
     def generate(t_params, d_params, prompt):
         b, prompt_len = prompt.shape
@@ -110,16 +103,14 @@ def make_speculative_generate_fn(
         # prefix before rolling back, so leave that much headroom.
         need = prompt_len + max_new_tokens + num_draft + 1
         for name, cfg in (("target", t_cfg), ("draft", d_cfg)):
-            if need > cfg.max_seq_len:
-                raise ValueError(
-                    f"prompt+new+draft ({need}) exceeds {name} max_seq_len "
-                    f"({cfg.max_seq_len})"
-                )
+            check_sequence_budget(
+                need, cfg.max_seq_len, f"prompt+new+draft for {name}"
+            )
 
         # Prefill both models on the prompt. The first new token comes from
         # the target's last-position logits — exactly as plain greedy.
-        t_logits, t_cache = apply(target, t_params, None, prompt)
-        _, d_cache = apply(draft, d_params, None, prompt)
+        t_logits, t_cache = t_apply(t_params, None, prompt)
+        _, d_cache = d_apply(d_params, None, prompt)
         t_cur = _greedy(t_logits[:, -1])
 
         buf_len = max_new_tokens + num_draft + 1
@@ -141,7 +132,7 @@ def make_speculative_generate_fn(
             #    cache so a full acceptance leaves the cache complete.
             def draft_step(carry, _):
                 prev, cache = carry
-                logits, cache = apply(draft, d_params, cache, prev[:, None])
+                logits, cache = d_apply(d_params, cache, prev[:, None])
                 nxt = _greedy(logits[:, -1])
                 return (nxt, cache), nxt
 
@@ -149,12 +140,12 @@ def make_speculative_generate_fn(
                 draft_step, (t_cur, d_cache), None, length=num_draft
             )
             drafts = drafts.T  # (num_draft, B) scan stack → (B, num_draft)
-            _, d_cache = apply(draft, d_params, d_cache, last_d[:, None])
+            _, d_cache = d_apply(d_params, d_cache, last_d[:, None])
 
             # 2. Target verifies the whole proposal in one chunked forward:
             #    [t_cur, d_1..d_num_draft] → greedy choice after each.
             chunk = jnp.concatenate([t_cur[:, None], drafts], axis=1)
-            t_logits, t_cache = apply(target, t_params, t_cache, chunk)
+            t_logits, t_cache = t_apply(t_params, t_cache, chunk)
             choices = _greedy(t_logits)  # (B, num_draft+1)
 
             # 3. Accept the longest prefix where draft == target choice;
@@ -189,18 +180,6 @@ def make_speculative_generate_fn(
         return jnp.concatenate([prompt, buffer[:, :max_new_tokens]], axis=1)
 
     jitted = jax.jit(generate)
-
-    def maybe_cast(params):
-        # Eager, like make_generate_fn: casting inside the jitted loop would
-        # re-cast every round (measured 20% slower there) and keep the fp32
-        # copies resident.
-        if inference_dtype is None:
-            return params
-        return jax.tree.map(
-            lambda x: x.astype(inference_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            params,
-        )
 
     def run(
         t_params: Any, d_params: Any, prompt: jax.Array,

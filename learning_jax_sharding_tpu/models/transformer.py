@@ -247,13 +247,13 @@ class TransformerConfig:
     decode: bool = False             # inference mode: KV cache, chunked input
 
     def __post_init__(self):
-        if self.remat_policy is not None:
-            resolve_remat_policy(self.remat_policy)  # fail fast on typos
-            if not self.remat:
-                raise ValueError(
-                    "remat_policy is set but remat=False — the policy would "
-                    "be silently ignored; set remat=True (or drop the policy)"
-                )
+        # Fail fast on typos; 'nothing' IS the default, so only a policy that
+        # changes behavior demands remat=True.
+        if resolve_remat_policy(self.remat_policy) is not None and not self.remat:
+            raise ValueError(
+                "remat_policy is set but remat=False — the policy would "
+                "be silently ignored; set remat=True (or drop the policy)"
+            )
 
     def train_step_flops(self, batch: int, seq: int) -> float:
         """Analytic model FLOPs of one train step (fwd + bwd ≈ 3× fwd).

@@ -151,3 +151,59 @@ class TestBeamSearch:
             make_beam_search_fn(
                 CONFIG_TINY, mesh22, RULES_DP_TP, beam_size=0, max_new_tokens=4
             )
+
+    def test_returned_score_is_normalized_seq_logprob_with_eos(self, mesh22, rng):
+        """Self-consistency of the finished pool: whatever hypothesis wins,
+        its returned score must equal the model's own logprob of that
+        sequence up to (and including) the first EOS, normalized by that
+        length — scores brought forward from the pool can't be stale."""
+        model, params, tokens = _trained(mesh22, rng)
+        prompt_np = tokens[:4, :8]
+        prompt = put(prompt_np, mesh_sharding(mesh22, "data", None))
+        # Pick EOS = the greedy continuation token of row 0 at step 2 so at
+        # least one row finishes mid-search on a real hypothesis.
+        greedy = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=10
+        )
+        out_g = np.asarray(greedy(params, prompt, jax.random.key(0)))
+        eos = int(out_g[0, 8 + 2])
+        beam = make_beam_search_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, beam_size=3,
+            max_new_tokens=10, eos_id=eos, length_penalty=1.0,
+        )
+        out, scores = beam(params, prompt)
+        out, scores = np.asarray(out), np.asarray(scores)
+        logits = model.apply({"params": params}, jnp.asarray(out[:, :-1]))
+        logp = np.asarray(
+            jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        )
+        for r in range(out.shape[0]):
+            gen = out[r, 8:]
+            end = np.argmax(gen == eos) + 1 if (gen == eos).any() else len(gen)
+            # everything after the first EOS must be EOS padding
+            assert (gen[end:] == eos).all() or end == len(gen)
+            total = sum(
+                logp[r, 8 - 1 + t, gen[t]] for t in range(end)
+            )
+            assert scores[r] == pytest.approx(total / end, rel=1e-3, abs=1e-3)
+
+    def test_beam1_dequantized_equals_int8_greedy(self, mesh22, rng):
+        """int8 trees are beam-searchable: beam_size=1 with dequantize must
+        reproduce the int8 greedy decode token for token (the same oracle
+        that ties beam-1 to greedy in fp32)."""
+        from learning_jax_sharding_tpu.models.quantize import quantize_tree
+
+        _, params, tokens = _trained(mesh22, rng)
+        qparams = quantize_tree(params)
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        greedy = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=10,
+            inference_dtype=jnp.bfloat16, dequantize=True,
+        )
+        beam = make_beam_search_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, beam_size=1, max_new_tokens=10,
+            inference_dtype=jnp.bfloat16, dequantize=True,
+        )
+        out_g = np.asarray(greedy(qparams, prompt, jax.random.key(0)))
+        out_b, _ = beam(qparams, prompt)
+        np.testing.assert_array_equal(np.asarray(out_b), out_g)
