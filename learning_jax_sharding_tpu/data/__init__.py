@@ -6,4 +6,7 @@ from learning_jax_sharding_tpu.data.datasets import (  # noqa: F401
     write_token_file,
 )
 from learning_jax_sharding_tpu.data.loader import ShardedBatchLoader  # noqa: F401
-from learning_jax_sharding_tpu.data.tokenizer import ByteTokenizer  # noqa: F401
+from learning_jax_sharding_tpu.data.tokenizer import (  # noqa: F401
+    BPETokenizer,
+    ByteTokenizer,
+)

@@ -17,6 +17,7 @@ Pairs with :func:`datasets.write_token_file` / :class:`datasets.MemmapTokenDatas
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -59,3 +60,181 @@ class ByteTokenizer:
         UTF-8 (possible mid-sequence truncation) is replaced, not raised."""
         data = bytes(i for i in np.asarray(ids).reshape(-1).tolist() if i < 256)
         return data.decode("utf-8", errors="replace")
+
+
+# --------------------------------------------------------------------------
+# Byte-level BPE: learned merges on top of the byte vocabulary.
+# --------------------------------------------------------------------------
+
+# GPT-2-style pre-tokenization, stdlib-only: split into word-ish chunks with
+# AT MOST one leading space glued to the word (longer whitespace runs keep
+# their tail space attached to the word via the lookahead, GPT-2's trick), so
+# " the" learns ONE merge chain whether it follows a space, a newline, or an
+# indent — and merges never cross word boundaries, which would otherwise
+# learn corpus-specific cross-word bigrams and make encode O(merges · text)
+# instead of per-word.
+import re  # noqa: E402
+
+_PRETOKEN = re.compile(r" ?\S+|\s+(?!\S)|\s+")
+
+
+def _merge_word(word: tuple[int, ...], ranks: dict) -> tuple[int, ...]:
+    """Apply merges to one word: repeatedly fuse the lowest-rank adjacent
+    pair present (the standard BPE encode order — training order replayed)."""
+    word = list(word)
+    while len(word) > 1:
+        best, best_rank = -1, None
+        for i in range(len(word) - 1):
+            r = ranks.get((word[i], word[i + 1]))
+            if r is not None and (best_rank is None or r < best_rank):
+                best, best_rank = i, r
+        if best_rank is None:
+            break
+        new_id = 256 + best_rank
+        word[best : best + 2] = [new_id]
+    return tuple(word)
+
+
+@dataclasses.dataclass(frozen=True)
+class BPETokenizer:
+    """Byte-level BPE: 256 byte ids + learned merges + pad/bos/eos on top.
+
+    Train with :meth:`train` (pure Python, no downloaded vocab files — same
+    isolation constraint as :class:`ByteTokenizer`); every text round-trips
+    exactly because unmerged bytes are always valid tokens (the GPT-2
+    byte-fallback property). Ids: ``0-255`` bytes, ``256..256+M-1`` merges in
+    rank order, then PAD/BOS/EOS.
+    """
+
+    merges: tuple[tuple[int, int], ...] = ()
+    add_bos: bool = False
+    add_eos: bool = False
+
+    @classmethod
+    def train(
+        cls, text: str, vocab_size: int, *, add_bos=False, add_eos=False
+    ) -> "BPETokenizer":
+        """Learn merges greedily: fuse the most frequent adjacent pair until
+        ``vocab_size`` (bytes + merges + 3 specials) is reached or no pair
+        repeats. Counting is per unique word weighted by frequency."""
+        num_merges = vocab_size - 256 - 3
+        if num_merges < 0:
+            raise ValueError(f"vocab_size must be >= 259, got {vocab_size}")
+        words: dict[tuple[int, ...], int] = {}
+        for m in _PRETOKEN.finditer(text):
+            w = tuple(m.group().encode("utf-8"))
+            words[w] = words.get(w, 0) + 1
+        merges: list[tuple[int, int]] = []
+        for rank in range(num_merges):
+            pairs: dict[tuple[int, int], int] = {}
+            for w, n in words.items():
+                for pair in zip(w, w[1:]):
+                    pairs[pair] = pairs.get(pair, 0) + n
+            if not pairs:
+                break
+            # Deterministic argmax: count desc, then pair id asc.
+            pair, count = min(pairs.items(), key=lambda kv: (-kv[1], kv[0]))
+            if count < 2:
+                break  # no repeated pair left — further merges are noise
+            merges.append(pair)
+            new_id = 256 + rank
+            def fuse(w):
+                out, i = [], 0
+                while i < len(w):
+                    if i + 1 < len(w) and (w[i], w[i + 1]) == pair:
+                        out.append(new_id)
+                        i += 2
+                    else:
+                        out.append(w[i])
+                        i += 1
+                return tuple(out)
+            fused: dict[tuple[int, ...], int] = {}
+            for w, n in words.items():
+                fw = fuse(w)
+                fused[fw] = fused.get(fw, 0) + n
+            words = fused
+        return cls(merges=tuple(merges), add_bos=add_bos, add_eos=add_eos)
+
+    # -- id layout ----------------------------------------------------------
+
+    @property
+    def pad_id(self) -> int:
+        return 256 + len(self.merges)
+
+    @property
+    def bos_id(self) -> int:
+        return 257 + len(self.merges)
+
+    @property
+    def eos_id(self) -> int:
+        return 258 + len(self.merges)
+
+    @property
+    def vocab_size(self) -> int:
+        return 259 + len(self.merges)
+
+    # -- encode / decode ----------------------------------------------------
+    # cached_property writes straight to __dict__, which a frozen dataclass
+    # permits — ranks/table are derived from the immutable merges once, not
+    # rebuilt per call in per-document pipeline loops.
+
+    @functools.cached_property
+    def _ranks(self) -> dict[tuple[int, int], int]:
+        return {pair: r for r, pair in enumerate(self.merges)}
+
+    @functools.cached_property
+    def _table(self) -> list[bytes]:
+        table = [bytes([i]) for i in range(256)]
+        for a, b in self.merges:
+            table.append(table[a] + table[b])
+        return table
+
+    def encode(self, text: str) -> list[int]:
+        ids: list[int] = []
+        if self.add_bos:
+            ids.append(self.bos_id)
+        for m in _PRETOKEN.finditer(text):
+            ids.extend(_merge_word(tuple(m.group().encode("utf-8")), self._ranks))
+        if self.add_eos:
+            ids.append(self.eos_id)
+        return ids
+
+    def encode_to_array(self, text: str, dtype=np.uint16) -> np.ndarray:
+        return np.asarray(self.encode(text), dtype=dtype)
+
+    def decode(self, ids) -> str:
+        """Specials dropped; invalid UTF-8 replaced (as ByteTokenizer)."""
+        table = self._table
+        data = b"".join(
+            table[i]
+            for i in np.asarray(ids).reshape(-1).tolist()
+            if i < 256 + len(self.merges)
+        )
+        return data.decode("utf-8", errors="replace")
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, path) -> None:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "merges": [list(p) for p in self.merges],
+                    "add_bos": self.add_bos,
+                    "add_eos": self.add_eos,
+                },
+                f,
+            )
+
+    @classmethod
+    def load(cls, path) -> "BPETokenizer":
+        import json
+
+        with open(path) as f:
+            d = json.load(f)
+        return cls(
+            merges=tuple(tuple(p) for p in d["merges"]),
+            add_bos=d["add_bos"],
+            add_eos=d["add_eos"],
+        )

@@ -106,6 +106,79 @@ class TestByteTokenizer:
         assert ByteTokenizer().vocab_size == 259 > EOS_ID
 
 
+class TestBPETokenizer:
+    CORPUS = (
+        "the quick brown fox jumps over the lazy dog. " * 30
+        + "sharding shards the shared shardings across the mesh. " * 30
+        + "naïve café — résumé ünïcôde ✓ " * 10
+    )
+
+    def _tok(self, **kw):
+        from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+
+        return BPETokenizer.train(self.CORPUS, vocab_size=512, **kw)
+
+    def test_round_trips_training_and_novel_text(self):
+        tok = self._tok()
+        assert tok.decode(tok.encode(self.CORPUS)) == self.CORPUS
+        # Byte fallback: text with unseen words/codepoints still round-trips.
+        novel = "wholly unseen zebra-quartz glyphs ☂ §§ 🚀 across the mesh"
+        assert tok.decode(tok.encode(novel)) == novel
+
+    def test_compresses_vs_bytes(self):
+        tok = self._tok()
+        n_bytes = len(self.CORPUS.encode("utf-8"))
+        n_bpe = len(tok.encode(self.CORPUS))
+        assert len(tok.merges) > 0
+        assert n_bpe < n_bytes / 2  # repeated words must merge substantially
+
+    def test_id_layout_and_specials(self):
+        tok = self._tok(add_bos=True, add_eos=True)
+        m = len(tok.merges)
+        assert (tok.pad_id, tok.bos_id, tok.eos_id) == (256 + m, 257 + m, 258 + m)
+        assert tok.vocab_size == 259 + m
+        ids = tok.encode("hi")
+        assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+        assert tok.decode(ids) == "hi"  # specials dropped
+
+    def test_training_is_deterministic(self):
+        assert self._tok().merges == self._tok().merges
+
+    def test_save_load_round_trip(self, tmp_path):
+        from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+
+        tok = self._tok(add_eos=True)
+        path = tmp_path / "bpe.json"
+        tok.save(path)
+        tok2 = BPETokenizer.load(path)
+        assert tok2 == tok
+        text = "the shared mesh"
+        assert tok2.encode(text) == tok.encode(text)
+
+    def test_one_merge_chain_per_word_across_whitespace_contexts(self):
+        # GPT-2-style gluing: at most ONE leading space joins the word, so
+        # " the" uses the same learned tokens after a space, a newline, or an
+        # indent — deeper whitespace must not fork a second merge chain.
+        tok = self._tok()
+        word = tok.encode(" the")
+        for ctx in ["\n the", "\n\n    the", "  the"]:
+            assert tok.encode(ctx)[-len(word):] == word
+
+    def test_merges_never_cross_words(self):
+        tok = self._tok()
+        # "the" is frequent; encoding " the the" must reuse the same word
+        # token(s) for both occurrences, not a cross-word merge.
+        a = tok.encode(" the")
+        b = tok.encode(" the the")
+        assert b[: len(a)] == a
+
+    def test_vocab_floor_rejected(self):
+        from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+
+        with pytest.raises(ValueError, match="vocab_size"):
+            BPETokenizer.train("abc", vocab_size=100)
+
+
 class TestEndToEnd:
     def test_text_to_training_batches(self, tmp_path):
         """Raw text → packed token file → sharded batches, no externals."""
