@@ -203,12 +203,15 @@ class BPETokenizer:
         return np.asarray(self.encode(text), dtype=dtype)
 
     def decode(self, ids) -> str:
-        """Specials dropped; invalid UTF-8 replaced (as ByteTokenizer)."""
+        """Specials dropped; invalid UTF-8 replaced (as ByteTokenizer).
+        Negative ids raise (matching ByteTokenizer's ``bytes()`` behavior)
+        rather than silently indexing the merge table from the end."""
         table = self._table
+        flat = np.asarray(ids).reshape(-1).tolist()
+        if flat and min(flat) < 0:
+            raise ValueError(f"token ids must be non-negative, got {min(flat)}")
         data = b"".join(
-            table[i]
-            for i in np.asarray(ids).reshape(-1).tolist()
-            if i < 256 + len(self.merges)
+            table[i] for i in flat if i < 256 + len(self.merges)
         )
         return data.decode("utf-8", errors="replace")
 

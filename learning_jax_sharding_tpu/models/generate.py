@@ -73,12 +73,40 @@ def top_p_filter(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(keep, logits, -jnp.inf)
 
 
+def min_p_filter(logits: jax.Array, p: float) -> jax.Array:
+    """Min-p filter: keep tokens whose probability is at least ``p`` times
+    the most likely token's, mask the rest to -inf. Scales the kept set with
+    the model's confidence (sharp distribution → few survivors, flat → many)
+    where top-p keeps a fixed probability mass. One max + compare — cheaper
+    than the top-p sort."""
+    if not 0.0 < p <= 1.0:
+        raise ValueError(f"min_p must be in (0, 1], got {p}")
+    # prob >= p·max_prob ⇔ logit >= max_logit + log(p): the softmax
+    # normalizer cancels, so no logsumexp in the decode hot loop.
+    cutoff = jnp.max(logits, axis=-1, keepdims=True) + jnp.log(p)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
+def repetition_penalty_filter(
+    logits: jax.Array, seen: jax.Array, penalty: float
+) -> jax.Array:
+    """CTRL-style repetition penalty: for tokens already in the sequence
+    (``seen``: (B, V) bool), positive logits are divided by ``penalty`` and
+    negative ones multiplied — both push repeated tokens down regardless of
+    sign. ``penalty`` > 1 discourages repeats; 1 is a no-op."""
+    if penalty <= 0:
+        raise ValueError(f"repetition_penalty must be positive, got {penalty}")
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
 def _sample(
     logits: jax.Array,
     temperature: float,
     rng: jax.Array,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
 ) -> jax.Array:
     """(B, V) logits → (B,) token ids; argmax at temperature 0."""
     if temperature == 0.0:
@@ -88,6 +116,8 @@ def _sample(
         logits = top_k_filter(logits, top_k)
     if top_p is not None:
         logits = top_p_filter(logits, top_p)
+    if min_p is not None:
+        logits = min_p_filter(logits, min_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -100,6 +130,8 @@ def make_generate_fn(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
+    repetition_penalty: float | None = None,
     inference_dtype: Any | None = None,
     dequantize: bool = False,
 ):
@@ -112,8 +144,11 @@ def make_generate_fn(
     The returned function is jit-compiled as one program: prompt prefill,
     then a ``lax.scan`` over single-token steps. ``rng`` is ignored for
     greedy decoding (pass anything); with ``temperature > 0`` it drives
-    per-step categorical sampling, optionally truncated by ``top_k`` and/or
-    nucleus ``top_p``.
+    per-step categorical sampling, optionally truncated by ``top_k``,
+    nucleus ``top_p``, and/or confidence-scaled ``min_p`` (filters compose
+    in that order). ``repetition_penalty`` (> 1) down-weights every token
+    already in the row — prompt included — before sampling OR greedy argmax;
+    the seen-set is a (B, V) presence mask carried through the decode scan.
 
     ``inference_dtype``: cast floating-point params to this dtype (eagerly,
     once per generate call — NOT inside the jitted program: XLA does not
@@ -157,17 +192,38 @@ def make_generate_fn(
         # logits, from which the first new token is sampled.
         logits, cache = step_apply(params, None, prompt)
         rng0, rng_loop = jax.random.split(rng)
-        tok = _sample(logits, temperature, rng0, top_k, top_p)
+        rows = jnp.arange(b)
+
+        def pick(logits, seen, rng):
+            # One place for the penalty→sample→seen-update sequence so the
+            # prefill token and the scan tokens cannot diverge.
+            if repetition_penalty is not None:
+                logits = repetition_penalty_filter(
+                    logits, seen, repetition_penalty
+                )
+            tok = _sample(logits, temperature, rng, top_k, top_p, min_p)
+            if repetition_penalty is not None:
+                seen = seen.at[rows, tok].set(True)
+            return tok, seen
+
+        if repetition_penalty is not None:
+            # (B, V) presence mask of every token in the row so far; a
+            # scatter per step keeps it current inside the scan carry.
+            seen = jnp.zeros((b, logits.shape[-1]), bool)
+            seen = seen.at[rows[:, None], prompt].set(True)
+        else:
+            seen = None
+        tok, seen = pick(logits, seen, rng0)
 
         def step(carry, _):
-            tok, cache, rng = carry
+            tok, cache, rng, seen = carry
             logits, cache = step_apply(params, cache, tok[:, None])
             rng, sub = jax.random.split(rng)
-            nxt = _sample(logits, temperature, sub, top_k, top_p)
-            return (nxt, cache, rng), nxt
+            nxt, seen = pick(logits, seen, sub)
+            return (nxt, cache, rng, seen), nxt
 
-        (_, _, _), rest = lax.scan(
-            step, (tok, cache, rng_loop), None, length=max_new_tokens - 1
+        (_, _, _, _), rest = lax.scan(
+            step, (tok, cache, rng_loop, seen), None, length=max_new_tokens - 1
         )
         new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
         return jnp.concatenate([prompt, new_tokens], axis=1)

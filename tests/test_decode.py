@@ -146,6 +146,42 @@ class TestGenerate:
         b = gen(params, prompt, jax.random.key(2))
         assert (np.asarray(a) != np.asarray(b)).any()
 
+    def test_repetition_penalty_greedy_never_repeats(self, mesh22, trained):
+        """With an overwhelming penalty, greedy decode must avoid every
+        token already in the row — prompt included — so all tokens of each
+        output row are distinct (vocab 256 >> 4+10 tokens)."""
+        cfg, params = trained
+        prompt = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=10,
+            repetition_penalty=1e9,
+        )
+        out = np.asarray(gen(params, prompt))
+        for row in out:
+            assert len(set(row.tolist())) == len(row), row
+
+    def test_repetition_penalty_one_is_noop(self, mesh22, trained):
+        cfg, params = trained
+        prompt = _tokens(cfg, b=2, s=4, seed=5)
+        plain = make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=6)
+        pen1 = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=6, repetition_penalty=1.0
+        )
+        np.testing.assert_array_equal(
+            np.asarray(plain(params, prompt)), np.asarray(pen1(params, prompt))
+        )
+
+    def test_min_p_sampling_runs(self, mesh22, trained):
+        cfg, params = trained
+        prompt = _tokens(cfg, b=2, s=4, seed=5)
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=6,
+            temperature=1.0, min_p=0.2,
+        )
+        out = np.asarray(gen(params, prompt, jax.random.key(3)))
+        assert out.shape == (2, 10)
+        assert ((0 <= out) & (out < cfg.vocab_size)).all()
+
     def test_length_guard(self, mesh22, trained):
         cfg, params = trained
         prompt = _tokens(cfg, b=2, s=60)

@@ -80,6 +80,32 @@ class TestSamplingFilters:
         with pytest.raises(ValueError):
             top_p_filter(jnp.zeros((1, 4)), 1.5)
 
+    def test_min_p_confidence_scaled_cutoff(self):
+        from learning_jax_sharding_tpu.models.generate import min_p_filter
+
+        # probs [0.5, 0.3, 0.15, 0.05]; min_p=0.5 → cutoff 0.25 → keep {0,1}.
+        probs = np.array([[0.5, 0.3, 0.15, 0.05]])
+        out = np.asarray(min_p_filter(jnp.asarray(np.log(probs)), 0.5))
+        assert np.isfinite(out[0, [0, 1]]).all()
+        assert np.isneginf(out[0, [2, 3]]).all()
+        # Flat distribution at the same min_p keeps everything.
+        flat = np.asarray(min_p_filter(jnp.zeros((1, 4)), 0.5))
+        assert np.isfinite(flat).all()
+        with pytest.raises(ValueError):
+            min_p_filter(jnp.zeros((1, 4)), 0.0)
+
+    def test_repetition_penalty_pushes_both_signs_down(self):
+        from learning_jax_sharding_tpu.models.generate import (
+            repetition_penalty_filter,
+        )
+
+        logits = jnp.asarray([[2.0, -2.0, 2.0, -2.0]])
+        seen = jnp.asarray([[True, True, False, False]])
+        out = np.asarray(repetition_penalty_filter(logits, seen, 2.0))
+        np.testing.assert_allclose(out[0], [1.0, -4.0, 2.0, -2.0])
+        with pytest.raises(ValueError):
+            repetition_penalty_filter(logits, seen, 0.0)
+
 
 class TestLrSchedule:
     def _cfg(self, **kw):
