@@ -214,6 +214,18 @@ def bench_decode_125m():
         f"{int8_bytes / 1e6:.0f} MB"
     )
 
+    # int4 variant: nibble-packed, group-wise scales — the footprint point
+    # of the quantization ladder (quarter of bf16); decode pays the per-step
+    # unpack (PERF.md records the measured cost).
+    q4params = quantize_tree(params, bits=4)
+    secs_q4 = time_fn(gen_q, q4params, prompt, jax.random.key(1), min_time=2.0)
+    int4_bytes = quantized_bytes(map_unquantized(to_bf16, q4params))
+    _log(
+        f"[bench] 125M KV-cached decode, int4 weights (same shape): "
+        f"{toks / secs_q4:,.0f} tok/s, {secs_q4 / new * 1e3:.2f} ms/token-step, "
+        f"served weight bytes {int4_bytes / 1e6:.0f} MB"
+    )
+
 
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.

@@ -11,10 +11,11 @@ between decoders:
 * :func:`make_param_caster` — the eager params cast for ``inference_dtype``
   (eager on purpose: an in-program cast re-runs every scan step — measured
   20% slower on the v5e decode bench — and keeps the fp32 copies resident),
-  quantization-aware: int8 ``{"q","scale"}`` nodes pass through untouched;
+  quantization-aware: quantized ``{"q","scale"}`` / ``{"q4","scale"}``
+  nodes pass through untouched;
 * :func:`make_cached_apply` — the mutable-cache model apply every decoder
   loops over (prefill creates the caches, later calls thread them), with
-  optional in-jit dequantization of int8 weight trees;
+  optional in-jit dequantization of int8/int4 weight trees;
 * :func:`check_sequence_budget` — the prompt+new vs ``max_seq_len`` guard.
 
 (The reference has no inference path at all, SURVEY.md §5 — these helpers
@@ -53,7 +54,7 @@ def make_param_caster(
     """Eager ``maybe_cast(params)`` for serving.
 
     Casts floating leaves to ``inference_dtype`` (identity when ``None``).
-    With ``dequantize`` the tree holds int8 ``{"q","scale"}`` nodes from
+    With ``dequantize`` the tree holds int8/int4 quantized nodes from
     ``models.quantize.quantize_tree``: those stay untouched (the in-jit
     dequant picks the target dtype) while everything else — embeddings,
     norms, biases, often the largest remaining fp32 blocks — still casts.
@@ -86,10 +87,10 @@ def make_cached_apply(
 
     With ``cache=None`` the mutable apply CREATES the (zeroed) caches — that
     is the prefill call; later calls thread the cache through. With
-    ``dequantize`` the int8 tree is dequantized INSIDE each apply so the
-    decode scan holds only int8 weights in its carry/constants (the storage
-    win); whether XLA streams int8 into the matmuls or materializes the
-    upcast is its call — ``bench.py`` measures it.
+    ``dequantize`` the int8/int4 tree is dequantized INSIDE each apply so
+    the decode scan holds only quantized weights in its carry/constants (the
+    storage win); whether XLA streams them into the matmuls or materializes
+    the upcast is its call — ``bench.py`` measures it.
     """
 
     def apply(params: Any, cache: Any, tokens: jax.Array):

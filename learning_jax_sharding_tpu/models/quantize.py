@@ -1,15 +1,22 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8 / int4 quantization for serving.
 
 Decode is memory-bandwidth-bound at scale: every generated token re-reads the
 full weight set from HBM, so bytes-per-weight is the fit (and often the
 throughput) currency. This module stores every matmul kernel — 2D ``kernel``
-leaves and the 3D MoE expert stacks — as int8 with a per-output-channel fp32
-scale: symmetric, zero-point-free (dequant is one convert + one broadcast
-multiply), halving weight bytes vs bf16 and quartering vs fp32 at ≤0.4%
-per-channel relative error. The STORAGE saving is unconditional; the decode
-bandwidth effect depends on XLA fusing the upcast into the consuming matmul
-rather than materializing bf16 weights per step — measure with ``bench.py``'s
-int8 decode context before claiming a speedup at a new shape.
+leaves and the 3D MoE expert stacks — quantized symmetric and
+zero-point-free:
+
+* **int8** (default): per-output-channel fp32 scale; half of bf16, ≤0.4%
+  per-channel error, dequant is one convert + one broadcast multiply.
+* **int4** (``bits=4``): two weights packed per byte (offset-binary nibbles)
+  with GROUP-WISE scales every ``group_size`` contraction rows (the
+  GPTQ/AWQ convention — pure per-channel scales lose too much at 4 bits);
+  a quarter of bf16.
+
+The STORAGE saving is unconditional; the decode bandwidth effect depends on
+XLA fusing the upcast into the consuming matmul rather than materializing
+bf16 weights per step — measure with ``bench.py``'s int8 decode context
+before claiming a speedup at a new shape.
 
 The reference has no inference path at all (SURVEY.md §5 — its ``apply_fn``
 exists only for timing, `/root/reference/case6_attention.py:229-238`); this
@@ -53,7 +60,7 @@ def default_match(path: Path, leaf: Any) -> bool:
 
 
 def _is_quantized(node: Any) -> bool:
-    return isinstance(node, dict) and set(node) == {"q", "scale"}
+    return isinstance(node, dict) and set(node) in ({"q", "scale"}, {"q4", "scale"})
 
 
 def quantize_leaf(w: jax.Array) -> dict[str, jax.Array]:
@@ -76,17 +83,77 @@ def dequantize_leaf(node: dict[str, jax.Array], dtype: Any = jnp.bfloat16) -> ja
     return (node["q"].astype(jnp.float32) * node["scale"][..., None, :]).astype(dtype)
 
 
+def quantize_leaf_int4(w: jax.Array, group_size: int = 128) -> dict[str, jax.Array]:
+    """(..., in, out) kernel → {"q4": uint8 (..., in/2, out), "scale": fp32
+    (..., in/g, out)}.
+
+    Symmetric 4-bit with GROUP-WISE scales: per-channel absmax over groups of
+    ``group_size`` contraction rows (the GPTQ/AWQ convention — per-channel
+    scales alone lose too much at 4 bits), values in [-7, 7], two rows packed
+    per byte as offset-binary nibbles in split-half order (see below).
+    Quarter the bytes of bf16; error ≤ group_scale/2 per element.
+    """
+    if group_size < 1:
+        raise ValueError(f"group_size must be >= 1, got {group_size}")
+    rows = w.shape[-2]
+    g = min(group_size, rows)
+    if rows % 2:
+        raise ValueError(f"int4 packing needs an even contraction dim, got {rows}")
+    if rows % g:
+        raise ValueError(
+            f"contraction dim {rows} not divisible by group_size {g}"
+        )
+    wf = w.astype(jnp.float32)
+    grouped = wf.reshape(*w.shape[:-2], rows // g, g, w.shape[-1])
+    absmax = jnp.max(jnp.abs(grouped), axis=-2)            # (..., in/g, out)
+    scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(grouped / scale[..., :, None, :]), -7, 7)
+    q = q.reshape(*w.shape[:-2], rows, w.shape[-1]).astype(jnp.int32)
+    # Split-half packing: low nibbles hold rows [0, in/2), high nibbles rows
+    # [in/2, in) — dequant then rebuilds the kernel with ONE concatenate
+    # instead of an even/odd interleave (which cost 3x decode throughput
+    # when measured as a per-step reshuffle on the v5e).
+    low = q[..., : rows // 2, :] + 8                        # [1, 15]
+    high = q[..., rows // 2 :, :] + 8
+    packed = (low | (high << 4)).astype(jnp.uint8)
+    return {"q4": packed, "scale": scale}
+
+
+def dequantize_leaf_int4(
+    node: dict[str, jax.Array], dtype: Any = jnp.bfloat16
+) -> jax.Array:
+    """Unpack nibbles, interleave rows back, apply group scales. Traceable —
+    runs inside jit so HBM streams the packed bytes."""
+    p, scale = node["q4"], node["scale"]
+    # Same-width nibble math (uint8→int8 is a free bitcast-level convert),
+    # then one concatenate rebuilds the row order of split-half packing.
+    low = (p & 0xF).astype(jnp.int8) - 8
+    high = (p >> 4).astype(jnp.int8) - 8
+    rows = p.shape[-2] * 2
+    q = jnp.concatenate([low, high], axis=-2)               # (..., in, out)
+    groups = scale.shape[-2]
+    qg = q.reshape(*p.shape[:-2], groups, rows // groups, p.shape[-1])
+    w = qg.astype(jnp.float32) * scale[..., :, None, :]
+    return w.reshape(*p.shape[:-2], rows, p.shape[-1]).astype(dtype)
+
+
 def quantize_tree(
     params: Any,
     *,
     match: Callable[[Path, Any], bool] = default_match,
+    bits: int = 8,
+    group_size: int = 128,
 ) -> Any:
-    """Replace matched kernels with ``{"q", "scale"}`` nodes; rest untouched.
+    """Replace matched kernels with ``{"q", "scale"}`` (int8) or
+    ``{"q4", "scale"}`` (int4, ``bits=4``) nodes; rest untouched.
 
     Eager/offline — run once after training (or checkpoint load). Sharded
     inputs stay sharded: the reduction and rounding follow the kernel's own
-    placement, and ``q`` lands with the kernel's sharding.
+    placement, and the packed weights land with the kernel's sharding.
+    ``group_size`` applies to int4 only (contraction rows per scale group).
     """
+    if bits not in (8, 4):
+        raise ValueError(f"bits must be 8 or 4, got {bits}")
 
     def walk(node: Any, prefix: Path) -> Any:
         if not isinstance(node, dict):
@@ -95,17 +162,26 @@ def quantize_tree(
         for k, v in node.items():
             path = prefix + (k,)
             if not isinstance(v, dict) and match(path, v):
-                out[k] = quantize_leaf(v)
-                # Pin the shardings explicitly: q like the kernel, the scale
-                # like the kernel's columns (eager propagation already does
-                # this for NamedSharding inputs; device_put makes it a
-                # guarantee rather than a propagation detail).
+                if bits == 8:
+                    out[k] = quantize_leaf(v)
+                else:
+                    out[k] = quantize_leaf_int4(v, group_size)
+                # Pin the shardings explicitly: packed weights like the
+                # kernel (specs name dims, not sizes, so the halved int4 row
+                # dim keeps the same spec), the scale like the kernel's
+                # columns with the group dim unsharded (eager propagation
+                # already does this for NamedSharding inputs; device_put
+                # makes it a guarantee rather than a propagation detail).
                 if isinstance(v.sharding, NamedSharding):
                     spec = tuple(v.sharding.spec) + (None,) * (v.ndim - len(v.sharding.spec))
-                    # The scale drops the contraction (-2) dim of the kernel.
-                    scale_spec = spec[:-2] + (spec[-1],)
+                    if bits == 8:
+                        # The scale drops the contraction (-2) dim.
+                        scale_spec = spec[:-2] + (spec[-1],)
+                    else:
+                        scale_spec = spec[:-2] + (None, spec[-1])
+                    (qk,) = set(out[k]) - {"scale"}
                     out[k] = {
-                        "q": jax.device_put(out[k]["q"], v.sharding),
+                        qk: jax.device_put(out[k][qk], v.sharding),
                         "scale": jax.device_put(
                             out[k]["scale"],
                             NamedSharding(v.sharding.mesh, PartitionSpec(*scale_spec)),
@@ -126,6 +202,8 @@ def dequantize_tree(params: Any, dtype: Any = jnp.bfloat16) -> Any:
         if not isinstance(node, dict):
             return node
         if _is_quantized(node):
+            if "q4" in node:
+                return dequantize_leaf_int4(node, dtype)
             return dequantize_leaf(node, dtype)
         return {k: walk(v) for k, v in node.items()}
 

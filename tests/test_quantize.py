@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 
 from learning_jax_sharding_tpu.models.generate import make_generate_fn
 from learning_jax_sharding_tpu.models.quantize import (
@@ -198,3 +199,78 @@ class TestQuantizedServing:
         # Prompt echoed identically; the first new token matches on most rows.
         np.testing.assert_array_equal(out_q[:, :8], out_f[:, :8])
         assert (out_q[:, 8] == out_f[:, 8]).mean() >= 0.75
+
+
+class TestInt4:
+    def test_error_bounded_by_half_group_scale(self, rng):
+        from learning_jax_sharding_tpu.models.quantize import (
+            dequantize_leaf_int4,
+            quantize_leaf_int4,
+        )
+
+        w = jnp.asarray(rng.standard_normal((128, 32)).astype(np.float32))
+        node = quantize_leaf_int4(w, group_size=32)
+        assert node["q4"].dtype == jnp.uint8
+        assert node["q4"].shape == (64, 32)       # two rows per byte
+        assert node["scale"].shape == (4, 32)     # 128/32 groups
+        deq = np.asarray(dequantize_leaf_int4(node, jnp.float32))
+        err = np.abs(np.asarray(w) - deq)
+        bound = np.repeat(np.asarray(node["scale"]), 32, axis=0) / 2 + 1e-6
+        assert (err <= bound).all()
+
+    def test_round_trip_exact_for_representable(self, rng):
+        # Weights already on the int4 grid must survive pack/unpack exactly
+        # (pins nibble order and the offset-binary encoding).
+        from learning_jax_sharding_tpu.models.quantize import (
+            dequantize_leaf_int4,
+            quantize_leaf_int4,
+        )
+
+        grid = rng.integers(-7, 8, size=(16, 8)).astype(np.float32)
+        node = quantize_leaf_int4(jnp.asarray(grid), group_size=16)
+        deq = np.asarray(dequantize_leaf_int4(node, jnp.float32))
+        np.testing.assert_allclose(deq, grid, atol=1e-5)
+
+    def test_odd_rows_and_bad_group_rejected(self):
+        from learning_jax_sharding_tpu.models.quantize import quantize_leaf_int4
+
+        with pytest.raises(ValueError, match="even"):
+            quantize_leaf_int4(jnp.zeros((7, 4)))
+        with pytest.raises(ValueError, match="group_size"):
+            quantize_leaf_int4(jnp.zeros((64, 4)), group_size=48)
+
+    def test_tree_bytes_quarter_vs_bf16(self, mesh22, rng):
+        params, _ = _trained_params(mesh22, rng)
+        q4 = quantize_tree(params, bits=4, group_size=32)
+        # Every default-matched kernel became a packed node.
+        assert "q4" in q4["block_0"]["attn"]["query"]["kernel"]
+        k = params["block_0"]["attn"]["query"]["kernel"]
+        packed = q4["block_0"]["attn"]["query"]["kernel"]["q4"]
+        assert packed.size == k.size // 2 and packed.dtype == jnp.uint8
+        # Sharding inherited from the kernel (specs name dims, not sizes).
+        assert packed.sharding.spec == k.sharding.spec
+
+    def test_int4_serving_runs_and_tracks_full_precision(self, mesh22, rng):
+        params, tokens = _trained_params(mesh22, rng, steps=6)
+        bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        gen_q = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=4,
+            inference_dtype=jnp.bfloat16, dequantize=True,
+        )
+        gen = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=4,
+            inference_dtype=jnp.bfloat16,
+        )
+        q4 = quantize_tree(bf16, bits=4, group_size=32)
+        out_q = np.asarray(gen_q(q4, prompt, jax.random.key(1)))
+        out_f = np.asarray(gen(bf16, prompt, jax.random.key(1)))
+        np.testing.assert_array_equal(out_q[:, :8], out_f[:, :8])
+        # int4 is coarser than int8 — ask only for majority agreement on the
+        # first new token.
+        assert (out_q[:, 8] == out_f[:, 8]).mean() >= 0.5
+
+    def test_bad_bits_rejected(self, mesh22, rng):
+        params, _ = _trained_params(mesh22, rng)
+        with pytest.raises(ValueError, match="bits"):
+            quantize_tree(params, bits=2)
