@@ -100,6 +100,28 @@ def repetition_penalty_filter(
     return jnp.where(seen, penalized, logits)
 
 
+def filtered_logits(
+    logits: jax.Array,
+    temperature: float,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    min_p: float | None = None,
+) -> jax.Array:
+    """The sampling distribution in logit space: temperature → top-k →
+    top-p → min-p, fp32. THE single definition of filter order — plain
+    sampling and speculative verification (``models/speculative.py``) both
+    call it, which is what makes speculative sampling exact for the same
+    distribution plain sampling draws from. Requires ``temperature > 0``."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        logits = top_k_filter(logits, top_k)
+    if top_p is not None:
+        logits = top_p_filter(logits, top_p)
+    if min_p is not None:
+        logits = min_p_filter(logits, min_p)
+    return logits
+
+
 def _sample(
     logits: jax.Array,
     temperature: float,
@@ -111,14 +133,9 @@ def _sample(
     """(B, V) logits → (B,) token ids; argmax at temperature 0."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k is not None:
-        logits = top_k_filter(logits, top_k)
-    if top_p is not None:
-        logits = top_p_filter(logits, top_p)
-    if min_p is not None:
-        logits = min_p_filter(logits, min_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filtered_logits(logits, temperature, top_k, top_p, min_p), axis=-1
+    ).astype(jnp.int32)
 
 
 def make_generate_fn(

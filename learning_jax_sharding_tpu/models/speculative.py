@@ -25,8 +25,12 @@ SURVEY.md §5); it composes the framework's own pieces:
 * everything runs under mesh + rules — draft and target can use different
   shardings of the same mesh.
 
-Greedy only (``temperature == 0``): that is where acceptance is a hard token
-equality and the exactness guarantee is unconditional.
+Two verification modes: greedy (``temperature == 0``, acceptance is a hard
+token equality, output bit-identical to plain greedy) and **rejection
+sampling** (``temperature > 0``, Leviathan-style: accept x with probability
+``min(1, p(x)/q(x))``, correct rejections from ``norm(max(p − q, 0))``) —
+the sampled output is distributed exactly as sampling the target alone,
+with position-keyed randomness keeping the batch-min rollback exact.
 """
 
 from __future__ import annotations
@@ -66,6 +70,17 @@ def _greedy(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
 
 
+def _pos_key(rng: jax.Array, pos: jax.Array, tag: int) -> jax.Array:
+    """Randomness keyed by ABSOLUTE generated position (+ a role tag:
+    0 = draft proposal, 1 = acceptance uniform, 2 = residual/bonus sample).
+
+    Position-keyed keys are what make batch-min rollback exact under
+    sampling: a row that accepted further than the batch minimum re-derives
+    the SAME draft proposals and acceptance draws for the rolled-back
+    positions next round, so its tokens cannot drift."""
+    return jax.random.fold_in(jax.random.fold_in(rng, pos), tag)
+
+
 def make_speculative_generate_fn(
     target_config: TransformerConfig,
     draft_config: TransformerConfig,
@@ -74,14 +89,30 @@ def make_speculative_generate_fn(
     *,
     max_new_tokens: int,
     num_draft: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     inference_dtype: Any | None = None,
 ):
-    """Build ``generate(target_params, draft_params, prompt) -> tokens``.
+    """Build ``generate(target_params, draft_params, prompt[, rng]) -> tokens``.
 
     ``target_config``/``draft_config`` are TRAINING configs sharing a vocab;
-    decode variants are derived here (as in ``make_generate_fn``). The result
-    is bit-identical to greedy decoding of the target alone; the draft only
+    decode variants are derived here (as in ``make_generate_fn``).
+
+    ``temperature == 0`` (default): greedy verification — the output is
+    bit-identical to greedy decoding of the target alone; the draft only
     changes how many serialized target passes it takes to get there.
+
+    ``temperature > 0``: **speculative sampling** (Leviathan-style rejection):
+    the draft SAMPLES proposals from its own filtered distribution q, the
+    target computes its filtered distribution p in one chunked pass, each
+    proposal x is accepted with probability ``min(1, p(x)/q(x))``, and the
+    first rejection is replaced by a sample from ``norm(max(p - q, 0))``
+    (full acceptance earns a bonus sample from p). The emitted tokens are
+    distributed EXACTLY as sampling the target alone — the property
+    ``tests/test_speculative.py`` pins distributionally. ``top_k``/``top_p``
+    shape both p and q the same way, so exactness holds for the filtered
+    distribution (what plain ``make_generate_fn`` samples too).
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError(
@@ -179,15 +210,143 @@ def make_speculative_generate_fn(
         )
         return jnp.concatenate([prompt, buffer[:, :max_new_tokens]], axis=1)
 
-    jitted = jax.jit(generate)
+    def to_probs(logits):
+        """The filtered sampling distribution — ``generate.filtered_logits``
+        is THE definition of the filter order, shared with plain sampling so
+        the two distributions cannot drift apart."""
+        from learning_jax_sharding_tpu.models.generate import filtered_logits
+
+        return jax.nn.softmax(
+            filtered_logits(logits, temperature, top_k, top_p), axis=-1
+        )
+
+    def generate_sampled(t_params, d_params, prompt, rng):
+        b, prompt_len = prompt.shape
+        need = prompt_len + max_new_tokens + num_draft + 1
+        for name, cfg in (("target", t_cfg), ("draft", d_cfg)):
+            check_sequence_budget(
+                need, cfg.max_seq_len, f"prompt+new+draft for {name}"
+            )
+
+        t_logits, t_cache = t_apply(t_params, None, prompt)
+        _, d_cache = d_apply(d_params, None, prompt)
+        # Generated position 0 comes straight from the target's prefill
+        # distribution (tag 2 = "the final sample of its position").
+        t_cur = jax.random.categorical(
+            _pos_key(rng, jnp.asarray(0), 2), jnp.log(to_probs(t_logits[:, -1]))
+        ).astype(jnp.int32)
+
+        buf_len = max_new_tokens + num_draft + 1
+        buffer = jnp.zeros((b, buf_len), jnp.int32)
+        buffer = lax.dynamic_update_slice(buffer, t_cur[:, None], (0, 0))
+
+        def cond(carry):
+            n, *_ = carry
+            return n < max_new_tokens
+
+        def body(carry):
+            n, t_cur, t_cache, d_cache, buffer = carry
+            base = prompt_len + n - 1  # same cache invariant as greedy
+
+            # 1. Draft SAMPLES num_draft proposals, keeping its full filtered
+            #    distribution per position (the residual needs p - q).
+            def draft_step(carry, pos):
+                prev, cache = carry
+                logits, cache = d_apply(d_params, cache, prev[:, None])
+                q = to_probs(logits[:, -1])
+                tok = jax.random.categorical(
+                    _pos_key(rng, pos, 0), jnp.log(q)
+                ).astype(jnp.int32)
+                return (tok, cache), (tok, q)
+
+            (last_d, d_cache), (drafts, q_all) = lax.scan(
+                draft_step, (t_cur, d_cache), n + jnp.arange(num_draft)
+            )
+            drafts = drafts.T                      # (B, num_draft)
+            q_all = jnp.moveaxis(q_all, 0, 1)      # (B, num_draft, V)
+            _, d_cache = d_apply(d_params, d_cache, last_d[:, None])
+
+            # 2. Target distribution at every proposal position + bonus slot.
+            chunk = jnp.concatenate([t_cur[:, None], drafts], axis=1)
+            t_logits, t_cache = t_apply(t_params, t_cache, chunk)
+            p_all = to_probs(t_logits)             # (B, num_draft+1, V)
+
+            # 3. Accept x_j with prob min(1, p(x_j)/q(x_j)); keep the longest
+            #    accepted prefix, batch-min for a single scalar cache index.
+            p_at = jnp.take_along_axis(
+                p_all[:, :num_draft], drafts[..., None], axis=-1
+            )[..., 0]
+            q_at = jnp.take_along_axis(q_all, drafts[..., None], axis=-1)[..., 0]
+            u = jax.vmap(
+                lambda pos: jax.random.uniform(_pos_key(rng, pos, 1), (b,)),
+                out_axes=1,
+            )(n + jnp.arange(num_draft))           # (B, num_draft)
+            # Strict <: with u ∈ [0,1), p==q still always accepts (u·q < q),
+            # while p==0 (draft token outside the target's filtered support)
+            # never does — <= would leak such tokens on exact u==0.0 draws.
+            accept = u * q_at < p_at               # u < p/q without the div
+            a_row = jnp.sum(
+                jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+            )
+            m = jnp.min(a_row)                     # scalar accepted count
+
+            # 4. The token at slot m: rows that accepted past m emit their
+            #    draft token; rows that rejected AT m sample the residual
+            #    norm(max(p - q, 0)). Padding q with zeros makes the
+            #    full-acceptance bonus (sample from p, no q to subtract) the
+            #    same code path.
+            q_pad = jnp.concatenate(
+                [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
+            )
+            def take_m(x):  # x[:, m] with a traced m
+                return jnp.take_along_axis(x, jnp.full((b, 1, 1), m), axis=1)[:, 0]
+
+            p_m = take_m(p_all)                    # (B, V)
+            q_m = take_m(q_pad)
+            residual = jnp.maximum(p_m - q_m, 0.0)
+            mass = jnp.sum(residual, axis=-1, keepdims=True)
+            residual = jnp.where(mass > 0, residual / mass, p_m)
+            res_tok = jax.random.categorical(
+                _pos_key(rng, n + m, 2), jnp.log(residual)
+            ).astype(jnp.int32)
+            drafts_pad = jnp.pad(drafts, ((0, 0), (0, 1)))
+            draft_m = jnp.take_along_axis(
+                drafts_pad, jnp.full((b, 1), m), axis=1
+            )[:, 0]
+            token_m = jnp.where(a_row > m, draft_m, res_tok)
+
+            # 5. Emit accepted drafts then token_m; junk past it is
+            #    overwritten by later rounds (and the final slice drops it).
+            idx = jnp.arange(num_draft + 1)
+            emitted = jnp.where(
+                idx[None, :] < m, drafts_pad, token_m[:, None]
+            )
+            buffer = lax.dynamic_update_slice(buffer, emitted, (0, n))
+
+            accepted = base + 1 + m
+            t_cache = _rollback(t_cache, accepted)
+            d_cache = _rollback(d_cache, accepted)
+            return (n + 1 + m, token_m, t_cache, d_cache, buffer)
+
+        n, _, _, _, buffer = lax.while_loop(
+            cond, body, (jnp.asarray(1, jnp.int32), t_cur, t_cache, d_cache, buffer)
+        )
+        return jnp.concatenate([prompt, buffer[:, :max_new_tokens]], axis=1)
+
+    jitted = jax.jit(generate if temperature == 0.0 else generate_sampled)
 
     def run(
         t_params: Any, d_params: Any, prompt: jax.Array,
         rng: Optional[jax.Array] = None,
     ):
-        del rng  # greedy: deterministic, kept for signature symmetry
         with activate(mesh, rules):
-            return jitted(maybe_cast(t_params), maybe_cast(d_params), prompt)
+            if temperature == 0.0:
+                del rng  # greedy: deterministic, kept for signature symmetry
+                return jitted(maybe_cast(t_params), maybe_cast(d_params), prompt)
+            rng = jax.random.key(0) if rng is None else rng
+            return jitted(
+                maybe_cast(t_params), maybe_cast(d_params), prompt, rng
+            )
 
     run.jitted = jitted
     return run

@@ -157,3 +157,85 @@ class TestSpeculativeValidation:
         prompt = put(tokens[:2, :8], mesh_sharding(mesh22, "data", None))
         with pytest.raises(ValueError, match="max_seq_len"):
             spec(t_params, d_params, prompt)
+
+
+class TestSpeculativeSampling:
+    """temperature > 0: Leviathan rejection sampling. The oracle is
+    DISTRIBUTIONAL — emitted tokens must follow exactly the target's own
+    (filtered) sampling distribution, whatever the draft proposes."""
+
+    def test_two_token_joint_matches_target_distribution(self, mesh22, rng):
+        """4096 identical prompt rows → 4096 iid 2-token samples; their
+        empirical joint must match the exact target joint (computed from the
+        full-sequence model with the same top-k filter) in total variation.
+        An untrained 1-layer draft makes acceptance genuinely partial, so
+        the accept, residual, AND bonus paths all contribute."""
+        from learning_jax_sharding_tpu.models.generate import top_k_filter
+
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        b = 4096
+        prompt_row = tokens[:1, :8]
+        prompt = jnp.asarray(np.repeat(prompt_row, b, axis=0))
+        gen = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=2, num_draft=2, temperature=1.0, top_k=4,
+        )
+        out = np.asarray(gen(t_params, d_params, prompt, jax.random.key(11)))
+        pairs = out[:, 8:10]
+
+        model = Transformer(CONFIG_TINY)
+        v = CONFIG_TINY.vocab_size
+
+        def filtered_probs(toks):
+            logits = model.apply({"params": t_params}, jnp.asarray(toks))
+            return np.asarray(
+                jax.nn.softmax(
+                    top_k_filter(logits[:, -1].astype(jnp.float32), 4), axis=-1
+                )
+            )
+
+        p0 = filtered_probs(prompt_row)[0]
+        exact = np.zeros((v, v))
+        (support0,) = np.nonzero(p0)
+        for t0 in support0:
+            row = np.concatenate(
+                [prompt_row, [[t0]]], axis=1
+            ).astype(np.int32)
+            exact[t0] = p0[t0] * filtered_probs(row)[0]
+        emp = np.zeros((v, v))
+        for t0, t1 in pairs:
+            emp[t0, t1] += 1.0 / b
+        # Samples may only land in the exact joint's support.
+        assert (emp[exact == 0] == 0).all()
+        tv = 0.5 * np.abs(emp - exact).sum()
+        # 4096 samples over <=16(+ties) cells: expected TV ~0.03.
+        assert tv < 0.1, f"total variation {tv:.3f}"
+
+    def test_same_rng_deterministic_different_rng_varies(self, mesh22, rng):
+        t_params, tokens = _trained_target(mesh22, rng, steps=2)
+        d_params = _draft_params()
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        gen = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=10, num_draft=3, temperature=1.0,
+        )
+        a = np.asarray(gen(t_params, d_params, prompt, jax.random.key(1)))
+        b_ = np.asarray(gen(t_params, d_params, prompt, jax.random.key(1)))
+        c = np.asarray(gen(t_params, d_params, prompt, jax.random.key(2)))
+        np.testing.assert_array_equal(a, b_)
+        assert (a != c).any()
+
+    def test_self_draft_full_acceptance_sampling(self, mesh22, rng):
+        """Draft == target ⇒ p == q ⇒ every proposal accepted (u <= 1);
+        output must be valid and deterministic per rng — the all-accept
+        path of the sampling verifier."""
+        t_params, tokens = _trained_target(mesh22, rng, steps=2)
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        gen = make_speculative_generate_fn(
+            CONFIG_TINY, CONFIG_TINY, mesh22, RULES_DP_TP,
+            max_new_tokens=8, num_draft=4, temperature=1.0, top_k=16,
+        )
+        out = np.asarray(gen(t_params, t_params, prompt, jax.random.key(3)))
+        assert out.shape == (4, 16)
+        assert ((0 <= out) & (out < CONFIG_TINY.vocab_size)).all()
