@@ -210,15 +210,18 @@ def make_speculative_generate_fn(
         )
         return jnp.concatenate([prompt, buffer[:, :max_new_tokens]], axis=1)
 
-    def to_probs(logits):
-        """The filtered sampling distribution — ``generate.filtered_logits``
-        is THE definition of the filter order, shared with plain sampling so
-        the two distributions cannot drift apart."""
+    def to_flogits(logits):
+        """The filtered sampling distribution in logit space —
+        ``generate.filtered_logits`` is THE definition of the filter order,
+        shared with plain sampling so the two distributions cannot drift
+        apart. Sampling draws straight from these (as plain ``_sample``
+        does); acceptance ratios softmax them into probabilities."""
         from learning_jax_sharding_tpu.models.generate import filtered_logits
 
-        return jax.nn.softmax(
-            filtered_logits(logits, temperature, top_k, top_p), axis=-1
-        )
+        return filtered_logits(logits, temperature, top_k, top_p)
+
+    def to_probs(logits):
+        return jax.nn.softmax(to_flogits(logits), axis=-1)
 
     def generate_sampled(t_params, d_params, prompt, rng):
         b, prompt_len = prompt.shape
@@ -233,7 +236,7 @@ def make_speculative_generate_fn(
         # Generated position 0 comes straight from the target's prefill
         # distribution (tag 2 = "the final sample of its position").
         t_cur = jax.random.categorical(
-            _pos_key(rng, jnp.asarray(0), 2), jnp.log(to_probs(t_logits[:, -1]))
+            _pos_key(rng, jnp.asarray(0), 2), to_flogits(t_logits[:, -1])
         ).astype(jnp.int32)
 
         buf_len = max_new_tokens + num_draft + 1
@@ -253,11 +256,11 @@ def make_speculative_generate_fn(
             def draft_step(carry, pos):
                 prev, cache = carry
                 logits, cache = d_apply(d_params, cache, prev[:, None])
-                q = to_probs(logits[:, -1])
+                fl = to_flogits(logits[:, -1])
                 tok = jax.random.categorical(
-                    _pos_key(rng, pos, 0), jnp.log(q)
+                    _pos_key(rng, pos, 0), fl
                 ).astype(jnp.int32)
-                return (tok, cache), (tok, q)
+                return (tok, cache), (tok, jax.nn.softmax(fl, axis=-1))
 
             (last_d, d_cache), (drafts, q_all) = lax.scan(
                 draft_step, (t_cur, d_cache), n + jnp.arange(num_draft)

@@ -182,6 +182,51 @@ class TestGenerate:
         assert out.shape == (2, 10)
         assert ((0 <= out) & (out < cfg.vocab_size)).all()
 
+    def test_eos_path_matches_scan_path_when_eos_never_fires(
+        self, mesh22, trained
+    ):
+        """The while_loop (eos) and scan (no eos) decoders must produce the
+        same greedy tokens when the EOS token never appears."""
+        cfg, params = trained
+        prompt = _tokens(cfg, b=2, s=4, seed=5)
+        plain = make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=8)
+        out_plain = np.asarray(plain(params, prompt))
+        unused = [
+            t for t in range(cfg.vocab_size)
+            if t not in set(out_plain[:, 4:].reshape(-1).tolist())
+        ][0]
+        with_eos = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=8, eos_id=unused
+        )
+        np.testing.assert_array_equal(
+            np.asarray(with_eos(params, prompt)), out_plain
+        )
+
+    def test_eos_freezes_rows_and_pads(self, mesh22, trained):
+        """Set EOS = the first greedy token of row 0: that row must be all
+        EOS after the prompt while other rows keep decoding normally until
+        their own (possibly absent) EOS."""
+        cfg, params = trained
+        prompt = _tokens(cfg, b=4, s=4, seed=7)
+        plain = make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=8)
+        out_plain = np.asarray(plain(params, prompt))
+        eos = int(out_plain[0, 4])  # row 0 finishes immediately
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=8, eos_id=eos
+        )
+        out = np.asarray(gen(params, prompt))
+        np.testing.assert_array_equal(out[0, 4:], np.full(8, eos))
+        for r in range(4):
+            gen_r = out[r, 4:]
+            hits = np.nonzero(gen_r == eos)[0]
+            if hits.size:  # everything after the first EOS is EOS padding
+                np.testing.assert_array_equal(
+                    gen_r[hits[0]:], np.full(8 - hits[0], eos)
+                )
+            # before the first EOS, tokens match the plain decoder
+            end = hits[0] if hits.size else 8
+            np.testing.assert_array_equal(gen_r[:end], out_plain[r, 4:4 + end])
+
     def test_length_guard(self, mesh22, trained):
         cfg, params = trained
         prompt = _tokens(cfg, b=2, s=60)
