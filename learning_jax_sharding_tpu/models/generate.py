@@ -243,13 +243,19 @@ def make_generate_fn(
             seen = None
         tok, seen = pick(logits, seen, rng0)
 
+        def advance(tok, cache, rng, seen):
+            # The per-token sequence shared by BOTH loop flavors — the eos
+            # while_loop must equal the scan truncated at EOS, so there is
+            # exactly one copy of it.
+            logits, cache = step_apply(params, cache, tok[:, None])
+            rng, sub = jax.random.split(rng)
+            nxt, seen = pick(logits, seen, sub)
+            return nxt, cache, rng, seen
+
         if eos_id is None:
             # Fixed trip count: a lax.scan over single-token steps.
             def step(carry, _):
-                tok, cache, rng, seen = carry
-                logits, cache = step_apply(params, cache, tok[:, None])
-                rng, sub = jax.random.split(rng)
-                nxt, seen = pick(logits, seen, sub)
+                nxt, cache, rng, seen = advance(*carry)
                 return (nxt, cache, rng, seen), nxt
 
             (_, _, _, _), rest = lax.scan(
@@ -275,9 +281,7 @@ def make_generate_fn(
 
         def body(carry):
             i, tok, cache, rng, seen, finished, buffer = carry
-            logits, cache = step_apply(params, cache, tok[:, None])
-            rng, sub = jax.random.split(rng)
-            nxt, seen = pick(logits, seen, sub)
+            nxt, cache, rng, seen = advance(tok, cache, rng, seen)
             nxt = jnp.where(finished, eos_id, nxt)
             buffer = buffer.at[:, i].set(nxt)
             finished = finished | (nxt == eos_id)
