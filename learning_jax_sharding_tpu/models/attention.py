@@ -105,6 +105,13 @@ class MultiHeadAttention(nn.Module):
     remat_attention: bool = False
     decode: bool = False
     max_decode_len: int = 0
+    kv_cache_dtype: Optional[jnp.dtype] = None
+    # Decode-cache storage format. None stores at compute dtype (default).
+    # jnp.int8 quantizes K/V on write with a per-(token, head) fp32 scale —
+    # the cache is usually what caps batch x context at serving time, and
+    # int8 roughly halves it vs bf16 (fp32 scales add 4/head_dim of the int8
+    # bytes: 6% at head_dim=64). Any other dtype (e.g. bf16 under fp32
+    # compute) is a plain storage cast.
 
     @property
     def inner_dim(self) -> int:
@@ -246,32 +253,60 @@ class MultiHeadAttention(nn.Module):
         b, s, n, h = q.shape
         n_kv = k.shape[2]  # GQA caches only the k/v heads — the GQA win
         length = self.max_decode_len
+        store = self.kv_cache_dtype if self.kv_cache_dtype is not None else self.dtype
+        quantized = store == jnp.int8
 
         cached_k = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, length, n_kv, h), self.dtype
+            "cache", "cached_key", jnp.zeros, (b, length, n_kv, h), store
         )
         cached_v = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, length, n_kv, h), self.dtype
+            "cache", "cached_value", jnp.zeros, (b, length, n_kv, h), store
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if quantized:
+            # Symmetric per-(token, kv-head) scales, written with the chunk.
+            k_scale = self.variable(
+                "cache", "key_scale", jnp.zeros, (b, length, n_kv), jnp.float32
+            )
+            v_scale = self.variable(
+                "cache", "value_scale", jnp.zeros, (b, length, n_kv), jnp.float32
+            )
+
+        def write(var, chunk, scale_var=None):
+            if quantized:
+                absmax = jnp.max(jnp.abs(chunk.astype(jnp.float32)), axis=-1)
+                scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+                chunk = jnp.clip(
+                    jnp.round(chunk.astype(jnp.float32) / scale[..., None]),
+                    -127, 127,
+                )
+                scale_var.value = jax.lax.dynamic_update_slice(
+                    scale_var.value, scale, (0, idx, 0)
+                )
+            var.value = jax.lax.dynamic_update_slice(
+                var.value, chunk.astype(store), (0, idx, 0, 0)
+            )
+
+        def read(var, scale_var=None):
+            full = var.value
+            if quantized:
+                full = full.astype(jnp.float32) * scale_var.value[..., None]
+            return repeat_kv(
+                nn.with_logical_constraint(
+                    full.astype(self.dtype), (BATCH, None, HEADS, KV)
+                ),
+                n,
+            )
 
         idx = cache_index.value
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k.astype(self.dtype), (0, idx, 0, 0)
-        )
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v.astype(self.dtype), (0, idx, 0, 0)
-        )
+        write(cached_k, k, k_scale if quantized else None)
+        write(cached_v, v, v_scale if quantized else None)
         cache_index.value = idx + s
 
-        k_full = repeat_kv(
-            nn.with_logical_constraint(cached_k.value, (BATCH, None, HEADS, KV)), n
-        )
-        v_full = repeat_kv(
-            nn.with_logical_constraint(cached_v.value, (BATCH, None, HEADS, KV)), n
-        )
+        k_full = read(cached_k, k_scale if quantized else None)
+        v_full = read(cached_v, v_scale if quantized else None)
         # Query i sits at absolute position idx + i: attend to every cache
         # slot at or before it (this also hides the zero-initialized tail).
         q_pos = idx + jnp.arange(s)[:, None]

@@ -156,6 +156,8 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     decode: bool = False          # KV-cached autoregressive attention
     max_decode_len: int = 0
+    kv_cache_dtype: Optional[Any] = None  # decode-cache storage: None =
+                                  # compute dtype; jnp.int8 = quantized cache
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
     scan: bool = False            # under nn.scan: return (x, None) pairs
 
@@ -179,6 +181,7 @@ class TransformerBlock(nn.Module):
             remat_attention=self.remat_attention,
             decode=self.decode,
             max_decode_len=self.max_decode_len,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(h, deterministic=deterministic)
         h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_ff")(x)
@@ -245,6 +248,10 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     decode: bool = False             # inference mode: KV cache, chunked input
+    kv_cache_dtype: Optional[Any] = None  # decode KV-cache storage dtype:
+                                     # None = compute dtype; jnp.int8 =
+                                     # quantized cache with per-(token, head)
+                                     # scales (~half the cache bytes of bf16)
 
     def __post_init__(self):
         # Fail fast on typos; 'nothing' IS the default, so only a policy that
@@ -415,6 +422,7 @@ class Transformer(nn.Module):
             moe_capacity_factor=cfg.moe_capacity_factor,
             decode=cfg.decode,
             max_decode_len=cfg.max_seq_len if cfg.decode else 0,
+            kv_cache_dtype=cfg.kv_cache_dtype,
             norm=cfg.norm,
         )
         if cfg.scan_layers:

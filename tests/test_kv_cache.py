@@ -1,0 +1,149 @@
+"""Quantized KV cache: int8 storage with per-(token, head) scales.
+
+At serving time the KV cache — not the weights — is what caps
+batch × context (`models/attention.py::MultiHeadAttention.kv_heads`); int8
+storage roughly halves it vs bf16. Oracles: the quantized cache must not
+change WHAT the model decodes (greedy tokens track the full-precision cache
+closely; logits stay near), the cache tree must actually shrink, and the
+serving decoders (generate, beam with its cache gather) must run unchanged.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from learning_jax_sharding_tpu.models.beam import make_beam_search_fn
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, activate
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+CFG_INT8 = dataclasses.replace(CONFIG_TINY, kv_cache_dtype=jnp.int8)
+
+
+@pytest.fixture(scope="module")
+def trained(mesh22):
+    model = Transformer(CONFIG_TINY)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+    sh = mesh_sharding(mesh22, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        model, optax.adamw(3e-3), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+        RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+    )
+    for _ in range(6):
+        state, _ = step(state, batch)
+    return state.params, tokens
+
+
+class TestInt8KVCache:
+    def test_cache_tree_is_int8_with_scales_and_halves_bytes(self, mesh22, trained):
+        params, tokens = trained
+        prompt = jnp.asarray(tokens[:2, :8])
+
+        def cache_of(cfg):
+            model = Transformer(dataclasses.replace(cfg, decode=True))
+            with activate(mesh22, RULES_DP_TP):
+                _, variables = model.apply(
+                    {"params": params}, prompt, mutable=("cache",)
+                )
+            return variables["cache"]
+
+        cache_q = cache_of(CFG_INT8)
+        leaf = cache_q["block_0"]["attn"]
+        assert leaf["cached_key"].dtype == jnp.int8
+        assert leaf["key_scale"].shape == leaf["cached_key"].shape[:-1]
+        cache_bf = cache_of(
+            dataclasses.replace(CONFIG_TINY, kv_cache_dtype=jnp.bfloat16)
+        )
+        nbytes = lambda tree: sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(tree)
+        )
+        # int8 + fp32/head_dim scales vs bf16: close to half.
+        assert nbytes(cache_q) < 0.7 * nbytes(cache_bf)
+
+    def test_greedy_decode_tracks_full_precision(self, mesh22, trained):
+        params, tokens = trained
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        out_fp = np.asarray(
+            make_generate_fn(CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=8)(
+                params, prompt
+            )
+        )
+        out_q = np.asarray(
+            make_generate_fn(CFG_INT8, mesh22, RULES_DP_TP, max_new_tokens=8)(
+                params, prompt
+            )
+        )
+        np.testing.assert_array_equal(out_q[:, :8], out_fp[:, :8])
+        # ≤0.4% per-element cache error: the first tokens should agree on
+        # (at least) most rows; full-sequence divergence is allowed.
+        assert (out_q[:, 8] == out_fp[:, 8]).mean() >= 0.75
+
+    def test_decode_logits_stay_close(self, mesh22, trained):
+        """Teacher-forcing through the int8 cache: logits near the fp-cache
+        logits at every position (the cache is the only difference)."""
+        params, tokens = trained
+        seq = jnp.asarray(tokens[:2, :16])
+
+        def forced_logits(cfg):
+            model = Transformer(dataclasses.replace(cfg, decode=True))
+            with activate(mesh22, RULES_DP_TP):
+                logits, variables = model.apply(
+                    {"params": params}, seq[:, :1], mutable=("cache",)
+                )
+                outs = [logits]
+                for i in range(1, seq.shape[1]):
+                    logits, variables = model.apply(
+                        {"params": params, **variables}, seq[:, i : i + 1],
+                        mutable=("cache",),
+                    )
+                    outs.append(logits)
+            return np.concatenate([np.asarray(o, np.float32) for o in outs], axis=1)
+
+        lp = forced_logits(CONFIG_TINY)
+        lq = forced_logits(CFG_INT8)
+        # Same argmax nearly everywhere, small absolute drift.
+        agree = (lp.argmax(-1) == lq.argmax(-1)).mean()
+        assert agree >= 0.9, agree
+        assert np.abs(lp - lq).mean() < 0.05 * np.abs(lp).mean() + 0.05
+
+    def test_beam_search_gathers_quantized_cache(self, mesh22, trained):
+        params, tokens = trained
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        beam = make_beam_search_fn(
+            CFG_INT8, mesh22, RULES_DP_TP, beam_size=3, max_new_tokens=6,
+        )
+        out, scores = beam(params, prompt)
+        assert np.asarray(out).shape == (4, 14)
+        assert np.isfinite(np.asarray(scores)).all()
+
+    def test_plain_storage_cast_path(self, mesh22, trained):
+        """kv_cache_dtype=bf16 under fp32 compute: a plain storage cast."""
+        params, tokens = trained
+        prompt = put(tokens[:4, :8], mesh_sharding(mesh22, "data", None))
+        cfg = dataclasses.replace(CONFIG_TINY, kv_cache_dtype=jnp.bfloat16)
+        out = np.asarray(
+            make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=6)(
+                params, prompt
+            )
+        )
+        assert out.shape == (4, 14)
+        assert ((0 <= out) & (out < CONFIG_TINY.vocab_size)).all()
