@@ -98,6 +98,7 @@ class MultiHeadAttention(nn.Module):
     window: Optional[int] = None         # causal sliding-window size (SWA)
     dropout_rate: float = 0.0
     causal: bool = False
+    use_bias: bool = False               # biases on q/k/v/out (GPT-2 style)
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
@@ -140,10 +141,13 @@ class MultiHeadAttention(nn.Module):
         # rules HEADS→model splits its columns.
         return nn.Dense(
             heads * self.head_dim,
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, HEADS)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (HEADS,)
+            ),
             name=name,
         )
 
@@ -220,10 +224,13 @@ class MultiHeadAttention(nn.Module):
         # (`case6_attention.py:83-90`).
         out = nn.Dense(
             self.features,
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=nn.with_logical_partitioning(self.kernel_init, (HEADS, EMBED)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (EMBED,)
+            ),
             name="out",
         )(out)
         out = nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))

@@ -1,0 +1,128 @@
+"""HuggingFace GPT-2 interop: load transformer weights into this framework.
+
+"A user of the reference should be able to switch and find everything they
+need" — including their existing checkpoints. GPT-2's architecture is a
+pre-LN transformer with learned positions, biased projections, and tanh
+GELU: exactly :class:`models.transformer.Transformer` at
+``use_bias=True, norm_eps=1e-5`` (the reference has no model zoo or
+checkpoint interop at all, SURVEY.md §5). This module maps a
+``transformers`` GPT-2 state dict onto this framework's param tree, after
+which the ENTIRE stack applies unchanged: sharded apply under any rule set,
+KV-cached generation, beam search, int8/int4 serving, LoRA fine-tuning.
+
+Parity is exact, not approximate: ``tests/test_convert.py`` checks logits
+against the torch model to float tolerance. Works offline — the tests build
+randomly initialized ``GPT2LMHeadModel``s (no downloads); real checkpoints
+convert the same way.
+
+Layout notes (verified against ``transformers`` GPT-2):
+
+* HF ``Conv1D`` stores weights ``(in, out)`` — the same orientation as our
+  Dense kernels, so no transposes except the tied LM head;
+* ``c_attn`` packs q/k/v as one ``(E, 3E)`` kernel → split into three;
+  the per-head layout after reshaping ``E → (heads, head_dim)`` matches our
+  ``(B, S, N, H)`` reshape, so no head permutation is needed;
+* the LM head is tied to the token embedding: ``lm_head.kernel = wteᵀ``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from learning_jax_sharding_tpu.models.transformer import TransformerConfig
+
+
+def config_from_hf_gpt2(hf_config: Any, **overrides) -> TransformerConfig:
+    """TransformerConfig matching a ``transformers.GPT2Config``.
+
+    ``overrides`` pass through to the dataclass (e.g. ``dtype=jnp.bfloat16``
+    for TPU serving of a converted checkpoint).
+    """
+    if hf_config.activation_function not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported activation {hf_config.activation_function!r}: the "
+            "FeedForward uses tanh GELU (gelu_new)"
+        )
+    # GPT-2 attention variants this attention stack does not implement —
+    # converting them would produce silently wrong logits, breaking the
+    # module's exact-parity contract.
+    for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(f"unsupported GPT-2 attention variant: {flag}=True")
+    import jax.numpy as jnp
+
+    defaults = dict(
+        vocab_size=hf_config.vocab_size,
+        num_layers=hf_config.n_layer,
+        features=hf_config.n_embd,
+        num_heads=hf_config.n_head,
+        head_dim=hf_config.n_embd // hf_config.n_head,
+        # n_inner=None means the GPT-2 default of 4*n_embd.
+        hidden=hf_config.n_inner or 4 * hf_config.n_embd,
+        max_seq_len=hf_config.n_positions,
+        use_bias=True,
+        norm_eps=hf_config.layer_norm_epsilon,
+        norm="layernorm",
+        rope=False,
+        causal=True,
+        dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    defaults.update(overrides)
+    return TransformerConfig(**defaults)
+
+
+def params_from_hf_gpt2(hf_model: Any) -> dict:
+    """Map a ``transformers.GPT2LMHeadModel`` state dict onto this
+    framework's ``Transformer`` param tree (plain numpy leaves — shard with
+    ``jax.device_put`` / the sharded-init pipeline as usual)."""
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    n_layer = hf_model.config.n_layer
+    e = hf_model.config.n_embd
+
+    def t(name):
+        return sd[f"transformer.{name}"].astype(np.float32)
+
+    # GPT-2 usually ties the LM head to wte; reading "lm_head.weight" is
+    # correct for tied AND untied checkpoints (tied state dicts alias it).
+    head = sd.get("lm_head.weight", sd["transformer.wte.weight"])
+    params: dict = {
+        "tok_embed": {"embedding": t("wte.weight")},
+        "pos_embed": t("wpe.weight"),
+        "ln_out": {"scale": t("ln_f.weight"), "bias": t("ln_f.bias")},
+        "lm_head": {"kernel": head.astype(np.float32).T},
+    }
+    for i in range(n_layer):
+        p = f"h.{i}"
+        qkv_w = t(f"{p}.attn.c_attn.weight")  # (E, 3E), Conv1D = (in, out)
+        qkv_b = t(f"{p}.attn.c_attn.bias")
+        params[f"block_{i}"] = {
+            "ln_attn": {
+                "scale": t(f"{p}.ln_1.weight"), "bias": t(f"{p}.ln_1.bias")
+            },
+            "attn": {
+                "query": {"kernel": qkv_w[:, :e], "bias": qkv_b[:e]},
+                "key": {"kernel": qkv_w[:, e : 2 * e], "bias": qkv_b[e : 2 * e]},
+                "value": {"kernel": qkv_w[:, 2 * e :], "bias": qkv_b[2 * e :]},
+                "out": {
+                    "kernel": t(f"{p}.attn.c_proj.weight"),
+                    "bias": t(f"{p}.attn.c_proj.bias"),
+                },
+            },
+            "ln_ff": {
+                "scale": t(f"{p}.ln_2.weight"), "bias": t(f"{p}.ln_2.bias")
+            },
+            "ff": {
+                "up": {
+                    "kernel": t(f"{p}.mlp.c_fc.weight"),
+                    "bias": t(f"{p}.mlp.c_fc.bias"),
+                },
+                "down": {
+                    "kernel": t(f"{p}.mlp.c_proj.weight"),
+                    "bias": t(f"{p}.mlp.c_proj.bias"),
+                },
+            },
+        }
+    return params

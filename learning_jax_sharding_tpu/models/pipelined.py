@@ -89,12 +89,10 @@ class _Head(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
-        x = nn.LayerNorm(
-            dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype,
-            scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros_init(), (EMBED,)),
-            name="ln_out",
+        from learning_jax_sharding_tpu.models.transformer import make_norm
+
+        x = make_norm(
+            cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps
         )(x)
         logits = nn.Dense(
             cfg.vocab_size,
@@ -161,9 +159,16 @@ class PipelinedTransformer:
             features=cfg.features,
             num_heads=cfg.num_heads,
             head_dim=cfg.head_dim,
+            num_kv_heads=cfg.num_kv_heads,
+            rope=cfg.rope,
+            rope_theta=cfg.rope_theta,
+            window=cfg.window,
             hidden=cfg.hidden,
             dropout_rate=0.0,
             causal=cfg.causal,
+            use_bias=cfg.use_bias,
+            norm_eps=cfg.norm_eps,
+            norm=cfg.norm,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             attn_fn=cfg.attn_fn,

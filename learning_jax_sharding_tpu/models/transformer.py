@@ -79,6 +79,7 @@ class FeedForward(nn.Module):
 
     features: int
     hidden: int
+    use_bias: bool = False
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
@@ -88,31 +89,38 @@ class FeedForward(nn.Module):
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
         h = nn.Dense(
             self.hidden,
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, MLP)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (MLP,)
+            ),
             name="up",
         )(x)
         h = nn.with_logical_constraint(h, (BATCH, SEQ, HIDDEN))
         h = nn.gelu(h)
         out = nn.Dense(
             self.features,
-            use_bias=False,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=nn.with_logical_partitioning(self.kernel_init, (MLP, EMBED)),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), (EMBED,)
+            ),
             name="down",
         )(h)
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
 
 
-def make_norm(kind: str, dtype, param_dtype, name: str) -> nn.Module:
+def make_norm(kind: str, dtype, param_dtype, name: str, eps: float = 1e-6) -> nn.Module:
     """``"layernorm"`` (GPT-2 style, scale+bias) or ``"rmsnorm"`` (LLaMA
     style, scale only — one fewer reduction and parameter vector; the modern
     default). Scale/bias carry the ``(EMBED,)`` logical axis either way."""
     if kind == "layernorm":
         return nn.LayerNorm(
+            epsilon=eps,
             dtype=dtype,
             param_dtype=param_dtype,
             scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
@@ -121,6 +129,7 @@ def make_norm(kind: str, dtype, param_dtype, name: str) -> nn.Module:
         )
     if kind == "rmsnorm":
         return nn.RMSNorm(
+            epsilon=eps,
             dtype=dtype,
             param_dtype=param_dtype,
             scale_init=nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
@@ -147,6 +156,8 @@ class TransformerBlock(nn.Module):
     window: Optional[int] = None
     dropout_rate: float = 0.0
     causal: bool = True
+    use_bias: bool = False
+    norm_eps: float = 1e-6
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     attn_fn: Optional[Callable] = None
@@ -164,7 +175,9 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, deterministic: bool = True):
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
-        h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_attn")(x)
+        h = make_norm(
+            self.norm, self.dtype, self.param_dtype, "ln_attn", self.norm_eps
+        )(x)
         x = x + MultiHeadAttention(
             features=self.features,
             num_heads=self.num_heads,
@@ -175,6 +188,7 @@ class TransformerBlock(nn.Module):
             window=self.window,
             dropout_rate=self.dropout_rate,
             causal=self.causal,
+            use_bias=self.use_bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             attn_fn=self.attn_fn,
@@ -184,7 +198,9 @@ class TransformerBlock(nn.Module):
             kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(h, deterministic=deterministic)
-        h = make_norm(self.norm, self.dtype, self.param_dtype, "ln_ff")(x)
+        h = make_norm(
+            self.norm, self.dtype, self.param_dtype, "ln_ff", self.norm_eps
+        )(x)
         if self.num_experts > 0:
             from learning_jax_sharding_tpu.models.moe import MoEFeedForward
 
@@ -202,6 +218,7 @@ class TransformerBlock(nn.Module):
             x = x + FeedForward(
                 features=self.features,
                 hidden=self.hidden,
+                use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="ff",
@@ -230,6 +247,8 @@ class TransformerConfig:
     max_seq_len: int = 1024
     dropout_rate: float = 0.0
     causal: bool = True
+    use_bias: bool = False           # biases on all projections (GPT-2 style)
+    norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = False              # rematerialize each block's activations
@@ -413,6 +432,8 @@ class Transformer(nn.Module):
             hidden=cfg.hidden,
             dropout_rate=cfg.dropout_rate,
             causal=cfg.causal,
+            use_bias=cfg.use_bias,
+            norm_eps=cfg.norm_eps,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
             attn_fn=cfg.attn_fn,
@@ -481,7 +502,9 @@ class Transformer(nn.Module):
                     x, deterministic
                 )
 
-        x = make_norm(cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out")(x)
+        x = make_norm(
+            cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps
+        )(x)
         if return_hidden:
             # Skip the logits projection: callers pairing this with
             # :func:`fused_next_token_loss` apply the lm_head kernel chunk by
