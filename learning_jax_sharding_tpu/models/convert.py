@@ -51,6 +51,11 @@ def config_from_hf_gpt2(hf_config: Any, **overrides) -> TransformerConfig:
     for flag in ("scale_attn_by_inverse_layer_idx", "reorder_and_upcast_attn"):
         if getattr(hf_config, flag, False):
             raise ValueError(f"unsupported GPT-2 attention variant: {flag}=True")
+    if not getattr(hf_config, "scale_attn_weights", True):
+        # This attention stack always scales scores by head_dim**-0.5.
+        raise ValueError(
+            "unsupported GPT-2 attention variant: scale_attn_weights=False"
+        )
     import jax.numpy as jnp
 
     defaults = dict(
@@ -126,3 +131,63 @@ def params_from_hf_gpt2(hf_model: Any) -> dict:
             },
         }
     return params
+
+
+def state_dict_from_params(params: dict, *, tie_head: bool = True) -> dict:
+    """Inverse of :func:`params_from_hf_gpt2`: framework params → a
+    ``transformers`` GPT-2 state dict (torch tensors), so models trained or
+    fine-tuned here (e.g. LoRA-merged) export back to the HF ecosystem.
+
+    ``tie_head`` drops the separate ``lm_head.weight`` entry and lets HF tie
+    it to ``wte`` (set False for params whose head was trained untied).
+    Load with ``hf_model.load_state_dict(sd, strict=False)`` (HF carries
+    non-weight buffers like attention bias masks that this does not emit).
+    """
+    import torch
+
+    def tt(x):
+        return torch.tensor(np.asarray(x, np.float32))
+
+    sd = {
+        "transformer.wte.weight": tt(params["tok_embed"]["embedding"]),
+        "transformer.wpe.weight": tt(params["pos_embed"]),
+        "transformer.ln_f.weight": tt(params["ln_out"]["scale"]),
+        "transformer.ln_f.bias": tt(params["ln_out"]["bias"]),
+    }
+    if not tie_head:
+        sd["lm_head.weight"] = tt(np.asarray(params["lm_head"]["kernel"]).T)
+    if "blocks" in params:
+        raise ValueError(
+            "params use the scan_layers stacked layout ('blocks'); unstack "
+            "to per-layer block_i subtrees before export (split each leaf "
+            "along its leading LAYERS dim)"
+        )
+    n_layer = sum(1 for k in params if k.startswith("block_"))
+    if n_layer == 0:
+        raise ValueError("no block_i subtrees found — not a Transformer param tree")
+    for i in range(n_layer):
+        blk = params[f"block_{i}"]
+        p = f"transformer.h.{i}"
+        attn = blk["attn"]
+        qkv_w = np.concatenate(
+            [np.asarray(attn[k]["kernel"], np.float32) for k in ("query", "key", "value")],
+            axis=1,
+        )
+        qkv_b = np.concatenate(
+            [np.asarray(attn[k]["bias"], np.float32) for k in ("query", "key", "value")]
+        )
+        sd.update({
+            f"{p}.ln_1.weight": tt(blk["ln_attn"]["scale"]),
+            f"{p}.ln_1.bias": tt(blk["ln_attn"]["bias"]),
+            f"{p}.attn.c_attn.weight": tt(qkv_w),
+            f"{p}.attn.c_attn.bias": tt(qkv_b),
+            f"{p}.attn.c_proj.weight": tt(attn["out"]["kernel"]),
+            f"{p}.attn.c_proj.bias": tt(attn["out"]["bias"]),
+            f"{p}.ln_2.weight": tt(blk["ln_ff"]["scale"]),
+            f"{p}.ln_2.bias": tt(blk["ln_ff"]["bias"]),
+            f"{p}.mlp.c_fc.weight": tt(blk["ff"]["up"]["kernel"]),
+            f"{p}.mlp.c_fc.bias": tt(blk["ff"]["up"]["bias"]),
+            f"{p}.mlp.c_proj.weight": tt(blk["ff"]["down"]["kernel"]),
+            f"{p}.mlp.c_proj.bias": tt(blk["ff"]["down"]["bias"]),
+        })
+    return sd

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from learning_jax_sharding_tpu.models.transformer import (
     TransformerBlock,
     TransformerConfig,
+    make_norm,
 )
 from learning_jax_sharding_tpu.parallel.logical import (
     BATCH,
@@ -89,8 +90,6 @@ class _Head(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.config
-        from learning_jax_sharding_tpu.models.transformer import make_norm
-
         x = make_norm(
             cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps
         )(x)

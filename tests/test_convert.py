@@ -110,6 +110,24 @@ class TestGPT2Conversion:
         np.testing.assert_array_equal(out[:, :8], prompt_np)
         assert ((0 <= out) & (out < cfg.vocab_size)).all()
 
+    def test_export_round_trip(self, hf_pair):
+        """params → state dict → fresh HF model: logits identical to the
+        original torch model (tied head re-tied by HF on load)."""
+        from learning_jax_sharding_tpu.models.convert import (
+            state_dict_from_params,
+        )
+
+        hf, cfg, params = hf_pair
+        sd = state_dict_from_params(params)
+        hf2 = transformers.GPT2LMHeadModel(hf.config).eval()
+        hf2.load_state_dict(sd, strict=False)
+        hf2.tie_weights()
+        tok = _tokens(seed=9)
+        with torch.no_grad():
+            want = hf(torch.tensor(tok)).logits.numpy()
+            got = hf2(torch.tensor(tok)).logits.numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
     def test_decode_cache_matches_full_forward(self, hf_pair):
         """Chunked decode through the converted model equals its own full
         forward — biases and norm eps flow through the cache path too."""
