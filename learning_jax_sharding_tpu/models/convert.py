@@ -154,8 +154,18 @@ def state_dict_from_params(params: dict, *, tie_head: bool = True) -> dict:
         "transformer.ln_f.weight": tt(params["ln_out"]["scale"]),
         "transformer.ln_f.bias": tt(params["ln_out"]["bias"]),
     }
-    if not tie_head:
-        sd["lm_head.weight"] = tt(np.asarray(params["lm_head"]["kernel"]).T)
+    head_t = np.asarray(params["lm_head"]["kernel"], np.float32).T
+    if tie_head:
+        # Guard the default: exporting a DIVERGED head as "tied" would
+        # silently drop trained weights (HF re-ties lm_head to wte on load).
+        wte = np.asarray(params["tok_embed"]["embedding"], np.float32)
+        if not np.allclose(head_t, wte, atol=1e-6):
+            raise ValueError(
+                "lm_head is not tied to tok_embed (they differ); export with "
+                "tie_head=False to keep the trained head"
+            )
+    else:
+        sd["lm_head.weight"] = tt(head_t)
     if "blocks" in params:
         raise ValueError(
             "params use the scan_layers stacked layout ('blocks'); unstack "

@@ -113,6 +113,103 @@ def dp_tp_matmul(a: jax.Array, b: jax.Array, *, mesh: Mesh, dp_axis: str, tp_axi
     )(a, b)
 
 
+def quantized_all_reduce(
+    contribs: jax.Array, *, mesh: Mesh, axis: str
+) -> jax.Array:
+    """Int8-payload all-reduce (EQuARX-style, arXiv 2506.17615): ring
+    reduce-scatter + ring all-gather whose wire payloads are int8 chunks with
+    per-chunk fp32 scales — ~4x less ICI traffic than an fp32 AllReduce, at
+    the cost of a requantization at every reduce hop.
+
+    ``contribs``: ``(D, ...)`` with the leading dim holding each device's
+    contribution, sharded over ``axis``. Returns their (replicated) SUM.
+
+    Error model: each of the ``D-1`` reduce hops requantizes a partial sum
+    (≤ scale/2 per element per hop, scale = chunk absmax/127), so relative
+    error grows with ring size — measured ~1.6% L2 for D=8 gaussian data
+    (``tests/test_collectives.py`` pins < 3%). Gradients tolerate this (the
+    quantized all-reduce literature's whole premise); exact reductions
+    should keep the fp32 ``psum`` path.
+    """
+    n = mesh.shape[axis]
+    if contribs.shape[0] != n:
+        raise ValueError(
+            f"contribs leading dim {contribs.shape[0]} != mesh axis size {n}"
+        )
+
+    def quant(v):
+        absmax = jnp.max(jnp.abs(v))
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        return jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8), scale
+
+    def send(payload, scale):
+        # Ring hop to the RIGHT neighbor: source j → dest j+1 (the chunk
+        # index arithmetic below assumes this direction).
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        return (
+            lax.ppermute(payload, axis, perm),
+            lax.ppermute(scale, axis, perm),
+        )
+
+    def local(xd):
+        v = xd[0].astype(jnp.float32)
+        flat = v.reshape(-1)
+        pad = (-flat.size) % n
+        flat = jnp.pad(flat, (0, pad))
+        own = flat.reshape(n, -1)            # (n, chunk) fp32 partials
+        idx = lax.axis_index(axis)
+
+        # Phase 1 — ring reduce-scatter: at step t device d ships its
+        # (re)quantized partial of chunk (d - t) and folds the neighbor's
+        # into chunk (d - t - 1). After n-1 hops, chunk (d + 1) is complete.
+        def rs_step(t, own):
+            send_idx = (idx - t) % n
+            recv_idx = (idx - t - 1) % n
+            q, s = quant(lax.dynamic_index_in_dim(own, send_idx, keepdims=False))
+            q, s = send(q, s)
+            updated = (
+                lax.dynamic_index_in_dim(own, recv_idx, keepdims=False)
+                + q.astype(jnp.float32) * s
+            )
+            return lax.dynamic_update_index_in_dim(own, updated, recv_idx, 0)
+
+        own = lax.fori_loop(0, n - 1, rs_step, own)
+
+        # Replica consistency: the owner keeps its finished chunk at fp32
+        # while everyone else will hold its int8-dequantized copy — pass the
+        # owner's copy through the same quantizer so ALL devices end up with
+        # bitwise-identical values (the replicated out_specs below must be
+        # true on multi-host meshes, not just approximately true).
+        fin_idx = (idx + 1) % n
+        fq, fs = quant(lax.dynamic_index_in_dim(own, fin_idx, keepdims=False))
+        own = lax.dynamic_update_index_in_dim(
+            own, fq.astype(jnp.float32) * fs, fin_idx, 0
+        )
+
+        # Phase 2 — ring all-gather of the finished chunks (re-quantizing an
+        # already-quantized chunk is exact: its absmax maps back to 127, so
+        # forwarded copies stay bitwise equal to the owner's).
+        def ag_step(t, own):
+            send_idx = (idx + 1 - t) % n
+            recv_idx = (idx - t) % n
+            q, s = quant(lax.dynamic_index_in_dim(own, send_idx, keepdims=False))
+            q, s = send(q, s)
+            return lax.dynamic_update_index_in_dim(
+                own, q.astype(jnp.float32) * s, recv_idx, 0
+            )
+
+        own = lax.fori_loop(0, n - 1, ag_step, own)
+        out = own.reshape(-1)
+        if pad:
+            out = out[:-pad]
+        return out.reshape(v.shape).astype(contribs.dtype)
+
+    spec = P(*((axis,) + (None,) * (contribs.ndim - 1)))
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=(spec,), out_specs=P(), check_vma=False
+    )(contribs)
+
+
 def ring_allgather_matmul(
     a: jax.Array, b: jax.Array, *, mesh: Mesh, axis: str
 ) -> jax.Array:

@@ -8,12 +8,15 @@ facts about our own explicit implementations too.
 
 from functools import partial
 
+import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tests.conftest import matmul_operands
 
 from learning_jax_sharding_tpu.parallel import (
     assert_collectives,
+    build_mesh,
     assert_shard_shape,
     collective_counts,
 )
@@ -21,6 +24,7 @@ from learning_jax_sharding_tpu.parallel.collectives import (
     allgather_matmul,
     dp_tp_matmul,
     psum_matmul,
+    quantized_all_reduce,
     reduce_scatter_matmul,
     ring_allgather_matmul,
 )
@@ -93,3 +97,45 @@ class TestRingAllGatherMatmul:
         a, b = matmul_operands(rng, m=8, k=16, n=8)
         fn = partial(ring_allgather_matmul, mesh=mesh24, axis="y")
         assert_collectives(fn, a, b, require=("collective-permute",))
+
+
+class TestQuantizedAllReduce:
+    def _contribs(self, rng, n=8, size=4097):
+        # Deliberately NOT a multiple of n: exercises the pad/unpad path.
+        return jnp.asarray(rng.standard_normal((n, size)).astype(np.float32))
+
+    def test_close_to_exact_sum(self, rng):
+        import jax
+
+        mesh = build_mesh((8,), ("d",))
+        contribs = self._contribs(rng)
+        got = np.asarray(quantized_all_reduce(contribs, mesh=mesh, axis="d"))
+        want = np.asarray(contribs).sum(0)
+        rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+        # D-1 requantization hops at D=8: measured ~1.6% on gaussian data.
+        assert rel < 0.03, rel
+
+    def test_multidim_and_2d_mesh_axis(self, mesh24, rng):
+        contribs = jnp.asarray(
+            rng.standard_normal((4, 3, 65)).astype(np.float32)
+        )
+        got = np.asarray(quantized_all_reduce(contribs, mesh=mesh24, axis="y"))
+        want = np.asarray(contribs).sum(0)
+        assert got.shape == want.shape
+        rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+        assert rel < 0.03, rel
+
+    def test_wire_is_permutes_not_allreduce(self, rng):
+        mesh = build_mesh((8,), ("d",))
+        contribs = self._contribs(rng, size=512)
+        fn = partial(quantized_all_reduce, mesh=mesh, axis="d")
+        assert_collectives(fn, contribs, require=("collective-permute",))
+        counts = collective_counts(fn, contribs)
+        assert counts["all-reduce"] == 0
+
+    def test_size_mismatch_rejected(self, rng):
+        mesh = build_mesh((8,), ("d",))
+        with pytest.raises(ValueError, match="mesh axis"):
+            quantized_all_reduce(
+                jnp.zeros((4, 16)), mesh=mesh, axis="d"
+            )

@@ -91,6 +91,21 @@ class TestGPT2Conversion:
             np.float32,
         )
         np.testing.assert_allclose(got, want, atol=5e-3)
+        # Exporting an untied head as tied would drop trained weights:
+        # the default must refuse, and tie_head=False must round-trip.
+        from learning_jax_sharding_tpu.models.convert import (
+            state_dict_from_params,
+        )
+
+        with pytest.raises(ValueError, match="tie_head=False"):
+            state_dict_from_params(params)
+        hf2 = transformers.GPT2LMHeadModel(hf_cfg).eval()
+        hf2.load_state_dict(
+            state_dict_from_params(params, tie_head=False), strict=False
+        )
+        with torch.no_grad():
+            back = hf2(torch.tensor(tok)).logits.numpy()
+        np.testing.assert_allclose(back, want, atol=1e-5)
 
     def test_converted_model_serves_through_the_stack(self, mesh22, hf_pair):
         """The point of interop: a converted checkpoint runs the framework's
