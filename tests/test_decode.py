@@ -227,6 +227,22 @@ class TestGenerate:
             end = hits[0] if hits.size else 8
             np.testing.assert_array_equal(gen_r[:end], out_plain[r, 4:4 + end])
 
+    @pytest.mark.parametrize("chunk", [3, 5, 10, 64])
+    def test_chunked_prefill_matches_whole_prompt(self, mesh22, trained, chunk):
+        """Chunked prefill is bit-identical to one-apply prefill: dividing,
+        non-dividing, and larger-than-prompt chunk sizes all hit the same
+        cache contents (greedy rollout is the observable)."""
+        cfg, params = trained
+        prompt = _tokens(cfg, b=2, s=10, seed=6)
+        whole = make_generate_fn(cfg, mesh22, RULES_DP_TP, max_new_tokens=6)
+        chunked = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=6,
+            prefill_chunk_size=chunk,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(chunked(params, prompt)), np.asarray(whole(params, prompt))
+        )
+
     def test_length_guard(self, mesh22, trained):
         cfg, params = trained
         prompt = _tokens(cfg, b=2, s=60)
