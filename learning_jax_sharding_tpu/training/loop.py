@@ -45,6 +45,7 @@ class TrainLoopConfig:
     lr_schedule: str = "constant"    # "constant" | "cosine" | "linear" decay
     min_learning_rate: float = 0.0   # decay floor (cosine/linear)
     grad_clip_norm: Optional[float] = None  # global-norm gradient clipping
+    optimizer: str = "adamw"         # "adamw" | "lion" | "adafactor"
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 100
     max_checkpoints: int = 3
@@ -84,10 +85,34 @@ def lr_schedule(cfg: TrainLoopConfig) -> optax.Schedule:
 
 
 def default_optimizer(cfg: TrainLoopConfig) -> optax.GradientTransformation:
-    """AdamW under the config's LR schedule, with optional global-norm
-    gradient clipping (the reference uses bare Adam(1e-3),
-    `/root/reference/case6_attention.py:181`)."""
-    opt = optax.adamw(lr_schedule(cfg), weight_decay=cfg.weight_decay)
+    """``cfg.optimizer`` under the config's LR schedule, with optional
+    global-norm gradient clipping (the reference uses bare Adam(1e-3),
+    `/root/reference/case6_attention.py:181`).
+
+    * ``"adamw"`` — the default; two fp32 moments per param.
+    * ``"lion"`` — sign-based, ONE bf16-friendly momentum: ~half the
+      optimizer-state HBM of AdamW (the big single-chip cost PERF.md
+      measures); typical LRs are ~3-10x smaller than AdamW's.
+    * ``"adafactor"`` — factored second moment: optimizer state shrinks from
+      O(params) to ~O(rows+cols) per matrix, the classic memory-tight
+      choice. ``cfg.weight_decay`` is deliberately NOT forwarded: optax's
+      ``weight_decay_rate`` is a per-step multiplicative decay applied
+      OUTSIDE the learning-rate scaling, so AdamW's 0.01 would shrink
+      weights ~1%/step (≈1000x AdamW's effective decay) — pass a custom
+      optimizer if adafactor-style decay is wanted.
+    """
+    sched = lr_schedule(cfg)
+    if cfg.optimizer == "adamw":
+        opt = optax.adamw(sched, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "lion":
+        opt = optax.lion(sched, weight_decay=cfg.weight_decay)
+    elif cfg.optimizer == "adafactor":
+        opt = optax.adafactor(sched)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r}: "
+            "expected 'adamw', 'lion', or 'adafactor'"
+        )
     if cfg.grad_clip_norm is not None:
         opt = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
     return opt

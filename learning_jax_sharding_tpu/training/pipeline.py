@@ -104,6 +104,22 @@ def sharded_train_state(
     with activate(mesh, rules):
         abstract = jax.eval_shape(boxed_init, rngs, x)
         state_shardings = tree_shardings(abstract, mesh, rules)
+        # Optimizers with FACTORED state (e.g. adafactor's rank-1 v_row /
+        # v_col, reduced from rank-2 kernels) inherit the param's logical
+        # names but not its rank; a spec longer than the leaf's rank is
+        # invalid, so such leaves fall back to replicated (they are the
+        # tiny factored vectors — replication is the right call anyway).
+        def _rank_safe(sh, leaf):
+            if (
+                isinstance(sh, NamedSharding)
+                and len(sh.spec) > getattr(leaf, "ndim", 0)
+            ):
+                return NamedSharding(mesh, jax.sharding.PartitionSpec())
+            return sh
+
+        state_shardings = jax.tree.map(
+            _rank_safe, state_shardings, nn.meta.unbox(abstract)
+        )
         if zero1_axis is not None:
             from learning_jax_sharding_tpu.training.zero import zero1_shardings
 

@@ -171,3 +171,107 @@ class TestGradAccumulation:
         state, step, batch = self._setup(mesh22, accum=3)
         with pytest.raises(ValueError, match="not divisible"):
             step(state, batch)
+
+
+class TestOptimizerPresets:
+    def _cfg(self, **kw):
+        kw.setdefault("learning_rate", 1e-3)
+        return TrainLoopConfig(steps=20, global_batch_size=8, **kw)
+
+    @pytest.mark.parametrize("name", ["adamw", "lion", "adafactor"])
+    def test_presets_descend_loss(self, mesh22, name):
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+            next_token_loss,
+        )
+        from learning_jax_sharding_tpu.training.loop import default_optimizer
+        from learning_jax_sharding_tpu.training.pipeline import (
+            make_train_step,
+            sharded_train_state,
+        )
+
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, CONFIG_TINY.vocab_size, size=(8, 33)).astype(np.int32)
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+
+        sh = mesh_sharding(mesh22, "data", None)
+        batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+        lr = {"adamw": 3e-3, "lion": 3e-4, "adafactor": 3e-2}[name]
+        opt = default_optimizer(self._cfg(optimizer=name, learning_rate=lr))
+        state, state_sh = sharded_train_state(
+            Transformer(CONFIG_TINY), opt, batch["inputs"],
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+            RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+        )
+        first = None
+        for _ in range(8):
+            state, loss = step(state, batch)
+            first = first if first is not None else float(loss)
+        assert float(loss) < first, (name, first, float(loss))
+
+    def test_lion_state_is_single_moment(self, mesh22):
+        # Lion's memory pitch: one momentum tensor per param (AdamW has two).
+        import optax
+        from learning_jax_sharding_tpu.training.loop import default_optimizer
+
+        params = {"w": jnp.zeros((4, 4))}
+        lion_state = default_optimizer(self._cfg(optimizer="lion")).init(params)
+        adamw_state = default_optimizer(self._cfg()).init(params)
+        count = lambda s: sum(
+            x.size for x in jax.tree.leaves(s) if getattr(x, "size", 0) > 1
+        )
+        assert count(lion_state) == count(adamw_state) // 2
+
+    def test_unknown_preset_rejected(self):
+        from learning_jax_sharding_tpu.training.loop import default_optimizer
+
+        with pytest.raises(ValueError, match="optimizer"):
+            default_optimizer(self._cfg(optimizer="sgd9000"))
+
+    def test_adafactor_factored_state_borns_sharded(self, mesh22):
+        """Exercise the FACTORED path (optax factors only dims >= 128): the
+        rank-1 v_row/v_col vectors inherit the kernel's rank-2 spec from the
+        logical metadata and must fall back to replicated instead of
+        crashing the born-sharded init; params keep their TP shardings."""
+        import dataclasses
+
+        from jax.sharding import PartitionSpec as P
+
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+        )
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+        from learning_jax_sharding_tpu.training.loop import default_optimizer
+        from learning_jax_sharding_tpu.training.pipeline import (
+            sharded_train_state,
+        )
+
+        cfg = dataclasses.replace(
+            CONFIG_TINY, features=128, hidden=256, head_dim=32
+        )
+        rng = np.random.default_rng(0)
+        x = put(
+            rng.integers(0, cfg.vocab_size, size=(8, 16)).astype(np.int32),
+            mesh_sharding(mesh22, "data", None),
+        )
+        state, _ = sharded_train_state(
+            Transformer(cfg),
+            default_optimizer(self._cfg(optimizer="adafactor")),
+            x, {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        flat = jax.tree_util.tree_flatten_with_path(state.opt_state)[0]
+        v_rows = [x for p, x in flat if any(
+            getattr(k, "name", getattr(k, "key", "")) == "v_row" for k in p
+        )]
+        factored = [v for v in v_rows if v.ndim >= 1 and v.size > 1]
+        assert factored, "no factored leaves — config too small to exercise the path"
+        for v in factored:
+            assert v.sharding.spec == P()  # rank-safe fallback: replicated
+        # Params keep their rule-derived shardings.
+        up = state.params["block_0"]["ff"]["up"]["kernel"]
+        assert up.sharding.spec == P(None, "model")
