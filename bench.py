@@ -176,10 +176,37 @@ def bench_decode_125m():
     )
     secs = time_fn(gen, params, prompt, jax.random.key(1), min_time=2.0)
     toks = b * new
+
+    def decode_mbu(weight_bytes: float, secs_per_tok: float) -> str:
+        """Per-token-step HBM roofline: served weights + the VALID KV cache
+        (mean over the run: prompt + new/2 slots — the blocked decode kernel
+        reads only valid blocks, which is the whole point; the dense path
+        would read all max_seq_len slots). Reported as MBU because decode is
+        bandwidth-bound — its matmuls are too thin for MFU to mean anything."""
+        from learning_jax_sharding_tpu.utils.bench import mbu
+
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        avg_valid = prompt_len + new / 2
+        cache_bytes = (
+            cfg.num_layers * b * n_kv * avg_valid * cfg.head_dim * 2 * 2
+        )  # K+V, bf16
+        frac = mbu(weight_bytes + cache_bytes, secs_per_tok)
+        return "" if frac is None else f", MBU={frac:.1%}"
+
+    def to_bf16(x):
+        return (
+            x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+        )
+
+    from learning_jax_sharding_tpu.models.quantize import quantized_bytes
+
+    bf16_bytes = quantized_bytes(jax.tree.map(to_bf16, params))
     _log(
         f"[bench] 125M KV-cached decode, bf16 weights (b={b}, prompt "
         f"{prompt_len}, +{new} new): {toks / secs:,.0f} tok/s, "
         f"{secs / new * 1e3:.2f} ms/token-step"
+        + decode_mbu(bf16_bytes, secs / new)
     )
 
     # int8 weight-only variant: same harness, quantized tree + in-jit dequant.
@@ -199,19 +226,13 @@ def bench_decode_125m():
     # (embeddings/norms) to bf16 via maybe_cast — mirror both casts here.
     from learning_jax_sharding_tpu.models.quantize import map_unquantized
 
-    def to_bf16(x):
-        return (
-            x.astype(jnp.bfloat16)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x
-        )
-
-    bf16_bytes = quantized_bytes(jax.tree.map(to_bf16, params))
     int8_bytes = quantized_bytes(map_unquantized(to_bf16, qparams))
     _log(
         f"[bench] 125M KV-cached decode, int8 weights (same shape): "
         f"{toks / secs_q:,.0f} tok/s, {secs_q / new * 1e3:.2f} ms/token-step, "
         f"served weight bytes {bf16_bytes / 1e6:.0f} (bf16)→"
         f"{int8_bytes / 1e6:.0f} MB"
+        + decode_mbu(int8_bytes, secs_q / new)
     )
 
     # int4 variant: nibble-packed, group-wise scales — the footprint point
@@ -224,6 +245,7 @@ def bench_decode_125m():
         f"[bench] 125M KV-cached decode, int4 weights (same shape): "
         f"{toks / secs_q4:,.0f} tok/s, {secs_q4 / new * 1e3:.2f} ms/token-step, "
         f"served weight bytes {int4_bytes / 1e6:.0f} MB"
+        + decode_mbu(int4_bytes, secs_q4 / new)
     )
 
 

@@ -204,12 +204,19 @@ class BPETokenizer:
 
     def decode(self, ids) -> str:
         """Specials dropped; invalid UTF-8 replaced (as ByteTokenizer).
-        Negative ids raise (matching ByteTokenizer's ``bytes()`` behavior)
-        rather than silently indexing the merge table from the end."""
+        Negative ids AND ids ≥ ``vocab_size`` raise — out-of-vocab ids are
+        corruption (e.g. a model whose ``vocab_size`` was padded past the
+        tokenizer's emitting into the pad region), not specials, and
+        dropping them silently would hide it."""
         table = self._table
         flat = np.asarray(ids).reshape(-1).tolist()
         if flat and min(flat) < 0:
             raise ValueError(f"token ids must be non-negative, got {min(flat)}")
+        if flat and max(flat) >= self.vocab_size:
+            raise ValueError(
+                f"token id {max(flat)} out of range for vocab_size "
+                f"{self.vocab_size} (only pad/bos/eos specials are dropped)"
+            )
         data = b"".join(
             table[i] for i in flat if i < 256 + len(self.merges)
         )

@@ -34,6 +34,20 @@ from learning_jax_sharding_tpu.ops.rope import apply_rope
 from learning_jax_sharding_tpu.parallel.logical import BATCH, EMBED, HEADS, KV, SEQ
 
 
+def resolve_decode_backend(mode: str) -> str:
+    """``"auto"`` → the blocked Pallas cache kernel on TPU, the dense cached
+    path elsewhere (the kernel runs off-TPU only under the slow interpreter).
+    Explicit ``"dense"`` / ``"blocked"`` force a backend."""
+    if mode == "auto":
+        return "blocked" if jax.default_backend() == "tpu" else "dense"
+    if mode not in ("dense", "blocked"):
+        raise ValueError(
+            f"unknown decode_attention {mode!r}: expected 'auto', 'dense', "
+            f"or 'blocked'"
+        )
+    return mode
+
+
 def _dense_attention(q, k, v, mask, *, num_heads):
     """Positional-array-args wrapper so ``jax.checkpoint`` can wrap the dense
     op. The GQA head expansion happens INSIDE: a checkpoint always saves its
@@ -43,6 +57,20 @@ def _dense_attention(q, k, v, mask, *, num_heads):
     return dot_product_attention(
         q, repeat_kv(k, num_heads), repeat_kv(v, num_heads), mask=mask
     )
+
+
+def quantize_kv_chunk(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization of a K/V chunk along its last (head-dim)
+    axis: per-(token, head) fp32 scales + clipped integer values. THE single
+    definition of the cache quantization step — both cached-attention
+    backends (dense and blocked) write with it, so the stored values cannot
+    drift between layouts."""
+    absmax = jnp.max(jnp.abs(chunk.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(chunk.astype(jnp.float32) / scale[..., None]), -127, 127
+    )
+    return scale, q
 
 
 def repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
@@ -113,6 +141,21 @@ class MultiHeadAttention(nn.Module):
     # int8 roughly halves it vs bf16 (fp32 scales add 4/head_dim of the int8
     # bytes: 6% at head_dim=64). Any other dtype (e.g. bf16 under fp32
     # compute) is a plain storage cast.
+    decode_attention: str = "auto"
+    # Decode-attention backend: "dense" attends the WHOLE max_decode_len
+    # buffer every step (reference-style, O(max_len) HBM traffic per token);
+    # "blocked" uses the length-aware Pallas cache kernel
+    # (ops/decode_attention.py) whose traffic scales with the VALID cache
+    # length and which reads GQA caches at N_kv heads with no repeat_kv
+    # expansion. "auto" (default) picks blocked on TPU, dense elsewhere.
+    # The backends differ in cache layout: dense stores (B, L, N_kv, H),
+    # blocked stores (B, N_kv, L, H) (sequence-major per head, so each cache
+    # block is one contiguous DMA).
+    decode_block_k: Optional[int] = None   # blocked-backend cache block size
+    decode_attn_fn: Optional[Callable] = None
+    # Mesh-aware override for the blocked backend (shard_map-wrapped kernel
+    # from ops.decode_attention.make_decode_attn_fn); None calls the kernel
+    # directly (single-device, or GSPMD-replicated).
 
     @property
     def inner_dim(self) -> int:
@@ -252,11 +295,14 @@ class MultiHeadAttention(nn.Module):
         """
         if self.attn_fn is not None:
             raise ValueError(
-                "decode mode uses the dense cached path; attn_fn backends "
-                "(flash/ring) are for training-length sequences"
+                "decode mode uses the cached paths (dense or blocked); "
+                "attn_fn backends (flash/ring) are for training-length "
+                "sequences"
             )
         if self.max_decode_len <= 0:
             raise ValueError("decode=True requires max_decode_len > 0")
+        if resolve_decode_backend(self.decode_attention) == "blocked":
+            return self._blocked_cached_attention(q, k, v)
         b, s, n, h = q.shape
         n_kv = k.shape[2]  # GQA caches only the k/v heads — the GQA win
         length = self.max_decode_len
@@ -275,20 +321,15 @@ class MultiHeadAttention(nn.Module):
         if quantized:
             # Symmetric per-(token, kv-head) scales, written with the chunk.
             k_scale = self.variable(
-                "cache", "key_scale", jnp.zeros, (b, length, n_kv), jnp.float32
+                "cache", "key_scale", jnp.ones, (b, length, n_kv), jnp.float32
             )
             v_scale = self.variable(
-                "cache", "value_scale", jnp.zeros, (b, length, n_kv), jnp.float32
+                "cache", "value_scale", jnp.ones, (b, length, n_kv), jnp.float32
             )
 
         def write(var, chunk, scale_var=None):
             if quantized:
-                absmax = jnp.max(jnp.abs(chunk.astype(jnp.float32)), axis=-1)
-                scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-                chunk = jnp.clip(
-                    jnp.round(chunk.astype(jnp.float32) / scale[..., None]),
-                    -127, 127,
-                )
+                scale, chunk = quantize_kv_chunk(chunk)
                 scale_var.value = jax.lax.dynamic_update_slice(
                     scale_var.value, scale, (0, idx, 0)
                 )
@@ -323,3 +364,85 @@ class MultiHeadAttention(nn.Module):
             # SWA decode: attend only to the last `window` cache slots.
             mask = mask & (k_pos > q_pos - self.window)
         return dot_product_attention(q, k_full, v_full, mask=mask[None, None])
+
+    def _blocked_cached_attention(
+        self, q: jax.Array, k: jax.Array, v: jax.Array
+    ) -> jax.Array:
+        """Length-aware cached attention via the Pallas decode kernel.
+
+        Same cache protocol as the dense path (append chunk at the index,
+        attend against the valid prefix) but the cache lives sequence-major
+        per head — ``(B, N_kv, L, H)`` — and attention runs through
+        :func:`ops.decode_attention.decode_attention`: HBM traffic per step
+        scales with the valid cache length instead of ``max_decode_len``,
+        GQA caches are read at N_kv heads (no ``repeat_kv`` expansion), and
+        int8 caches are dequantized only for the blocks actually read —
+        the three decode costs the dense path pays in full every token.
+        """
+        from learning_jax_sharding_tpu.ops.decode_attention import decode_attention
+
+        b, s, n, h = q.shape
+        n_kv = k.shape[2]
+        length = self.max_decode_len
+        store = self.kv_cache_dtype if self.kv_cache_dtype is not None else self.dtype
+        quantized = store == jnp.int8
+
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros, (b, n_kv, length, h), store
+        )
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros, (b, n_kv, length, h), store
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if quantized:
+            k_scale = self.variable(
+                "cache", "key_scale", jnp.ones, (b, n_kv, length), jnp.float32
+            )
+            v_scale = self.variable(
+                "cache", "value_scale", jnp.ones, (b, n_kv, length), jnp.float32
+            )
+
+        idx = cache_index.value
+
+        def write(var, chunk, scale_var=None):
+            # chunk (B, S, N_kv, H) → sequence-major (B, N_kv, S, H).
+            if quantized:
+                scale, chunk = quantize_kv_chunk(chunk)
+                scale_var.value = jax.lax.dynamic_update_slice(
+                    scale_var.value, scale.transpose(0, 2, 1), (0, 0, idx)
+                )
+            var.value = jax.lax.dynamic_update_slice(
+                var.value, chunk.astype(store).transpose(0, 2, 1, 3),
+                (0, 0, idx, 0),
+            )
+
+        write(cached_k, k, k_scale if quantized else None)
+        write(cached_v, v, v_scale if quantized else None)
+        cache_index.value = idx + s
+
+        kc = nn.with_logical_constraint(
+            cached_k.value, (BATCH, HEADS, None, KV)
+        )
+        vc = nn.with_logical_constraint(
+            cached_v.value, (BATCH, HEADS, None, KV)
+        )
+        scales = {}
+        if quantized:
+            scales = dict(
+                k_scale=nn.with_logical_constraint(
+                    k_scale.value, (BATCH, HEADS, None)
+                ),
+                v_scale=nn.with_logical_constraint(
+                    v_scale.value, (BATCH, HEADS, None)
+                ),
+            )
+        fn = self.decode_attn_fn if self.decode_attn_fn is not None else decode_attention
+        # window/block_k pass at CALL time either way: the module is the
+        # single source of truth, so a mesh-aware wrapper built without them
+        # cannot silently drop the sliding window.
+        return fn(
+            q, kc, vc, idx,
+            window=self.window, block_k=self.decode_block_k, **scales,
+        )

@@ -89,7 +89,7 @@ def make_beam_search_fn(
             f"vocab_size ({config.vocab_size}) must be >= 2*beam_size "
             f"({2 * beam_size}) for the 2K candidate expansion"
         )
-    cfg = derive_decode_config(config, inference_dtype)
+    cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
     apply = make_cached_apply(

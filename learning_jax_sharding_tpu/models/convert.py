@@ -56,6 +56,13 @@ def config_from_hf_gpt2(hf_config: Any, **overrides) -> TransformerConfig:
         raise ValueError(
             "unsupported GPT-2 attention variant: scale_attn_weights=False"
         )
+    if hf_config.n_embd % hf_config.n_head:
+        # HF only catches this at model init; fail at config conversion with
+        # the same loudness as the unsupported-variant guards above.
+        raise ValueError(
+            f"n_embd {hf_config.n_embd} not divisible by n_head "
+            f"{hf_config.n_head}: head_dim would be fractional"
+        )
     import jax.numpy as jnp
 
     defaults = dict(

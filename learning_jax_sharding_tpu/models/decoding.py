@@ -35,16 +35,39 @@ from learning_jax_sharding_tpu.models.transformer import TransformerConfig
 
 
 def derive_decode_config(
-    config: TransformerConfig, inference_dtype: Any | None = None
+    config: TransformerConfig,
+    inference_dtype: Any | None = None,
+    *,
+    mesh: Any | None = None,
+    rules: Any | None = None,
 ) -> TransformerConfig:
     """Decode variant of a TRAINING config: KV caches on, dropout off, and —
     when ``inference_dtype`` is given — compute/param dtypes swapped to it,
-    so train and serve share params verbatim."""
+    so train and serve share params verbatim.
+
+    With ``mesh``/``rules`` and more than one device, the blocked decode
+    backend gets its shard_map wrapper injected
+    (``ops.decode_attention.make_decode_attn_fn``) — GSPMD cannot partition
+    the Pallas cache kernel by itself, so multi-device serving needs the
+    explicitly sharded call."""
     cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
     if inference_dtype is not None:
         cfg = dataclasses.replace(
             cfg, dtype=inference_dtype, param_dtype=inference_dtype
         )
+    if mesh is not None and rules is not None and cfg.decode_attn_fn is None:
+        from learning_jax_sharding_tpu.models.attention import resolve_decode_backend
+
+        if mesh.size > 1 and resolve_decode_backend(cfg.decode_attention) == "blocked":
+            from learning_jax_sharding_tpu.ops.decode_attention import (
+                make_decode_attn_fn,
+            )
+
+            # window/block_k are NOT baked: the attention module passes its
+            # own on every call (single source of truth).
+            cfg = dataclasses.replace(
+                cfg, decode_attn_fn=make_decode_attn_fn(mesh, rules)
+            )
     return cfg
 
 

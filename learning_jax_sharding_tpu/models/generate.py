@@ -210,7 +210,7 @@ def make_generate_fn(
     compute/dequant dtype; non-quantized leaves (embeddings, norms) are
     still cast to it eagerly.
     """
-    cfg = derive_decode_config(config, inference_dtype)
+    cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
     # dequant dtype == inference_dtype when one was given (models.decoding)

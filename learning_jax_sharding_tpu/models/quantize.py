@@ -177,11 +177,23 @@ def quantize_tree(
                     if bits == 8:
                         # The scale drops the contraction (-2) dim.
                         scale_spec = spec[:-2] + (spec[-1],)
+                        q_sharding = v.sharding
                     else:
                         scale_spec = spec[:-2] + (None, spec[-1])
+                        # Split-half packing folds row i with row i + n/2 into
+                        # one int8 byte: a q4 row no longer IS a kernel row,
+                        # so sharding the halved contraction dim would both
+                        # risk a divisibility failure (rows/2 % axis) and put
+                        # mismatched halves on each device — forcing a
+                        # reshard at every in-jit dequant. Keep that dim
+                        # unsharded (like the scale's group dim).
+                        q_sharding = NamedSharding(
+                            v.sharding.mesh,
+                            PartitionSpec(*spec[:-2], None, spec[-1]),
+                        )
                     (qk,) = set(out[k]) - {"scale"}
                     out[k] = {
-                        qk: jax.device_put(out[k][qk], v.sharding),
+                        qk: jax.device_put(out[k][qk], q_sharding),
                         "scale": jax.device_put(
                             out[k]["scale"],
                             NamedSharding(v.sharding.mesh, PartitionSpec(*scale_spec)),

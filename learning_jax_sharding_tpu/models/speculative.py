@@ -92,6 +92,7 @@ def make_speculative_generate_fn(
     temperature: float = 0.0,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
     inference_dtype: Any | None = None,
 ):
     """Build ``generate(target_params, draft_params, prompt[, rng]) -> tokens``.
@@ -110,9 +111,12 @@ def make_speculative_generate_fn(
     first rejection is replaced by a sample from ``norm(max(p - q, 0))``
     (full acceptance earns a bonus sample from p). The emitted tokens are
     distributed EXACTLY as sampling the target alone — the property
-    ``tests/test_speculative.py`` pins distributionally. ``top_k``/``top_p``
-    shape both p and q the same way, so exactness holds for the filtered
-    distribution (what plain ``make_generate_fn`` samples too).
+    ``tests/test_speculative.py`` pins distributionally. ``top_k``/``top_p``/
+    ``min_p`` shape both p and q the same way, so exactness holds for the
+    filtered distribution (what plain ``make_generate_fn`` samples too).
+    ``repetition_penalty`` is NOT supported here: it conditions the
+    distribution on the growing output, which would invalidate the draft's
+    q at every accepted token — use plain ``make_generate_fn`` for it.
     """
     if target_config.vocab_size != draft_config.vocab_size:
         raise ValueError(
@@ -122,8 +126,8 @@ def make_speculative_generate_fn(
     if num_draft < 1:
         raise ValueError(f"num_draft must be >= 1, got {num_draft}")
 
-    t_cfg = derive_decode_config(target_config, inference_dtype)
-    d_cfg = derive_decode_config(draft_config, inference_dtype)
+    t_cfg = derive_decode_config(target_config, inference_dtype, mesh=mesh, rules=rules)
+    d_cfg = derive_decode_config(draft_config, inference_dtype, mesh=mesh, rules=rules)
     target, draft = Transformer(t_cfg), Transformer(d_cfg)
     t_apply, d_apply = make_cached_apply(target), make_cached_apply(draft)
     maybe_cast = make_param_caster(inference_dtype)
@@ -218,7 +222,7 @@ def make_speculative_generate_fn(
         does); acceptance ratios softmax them into probabilities."""
         from learning_jax_sharding_tpu.models.generate import filtered_logits
 
-        return filtered_logits(logits, temperature, top_k, top_p)
+        return filtered_logits(logits, temperature, top_k, top_p, min_p)
 
     def to_probs(logits):
         return jax.nn.softmax(to_flogits(logits), axis=-1)

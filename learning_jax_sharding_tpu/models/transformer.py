@@ -169,6 +169,10 @@ class TransformerBlock(nn.Module):
     max_decode_len: int = 0
     kv_cache_dtype: Optional[Any] = None  # decode-cache storage: None =
                                   # compute dtype; jnp.int8 = quantized cache
+    decode_attention: str = "auto"  # "dense" | "blocked" | "auto" (see
+                                  # models.attention.MultiHeadAttention)
+    decode_block_k: Optional[int] = None
+    decode_attn_fn: Optional[Callable] = None
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
     scan: bool = False            # under nn.scan: return (x, None) pairs
 
@@ -196,6 +200,9 @@ class TransformerBlock(nn.Module):
             decode=self.decode,
             max_decode_len=self.max_decode_len,
             kv_cache_dtype=self.kv_cache_dtype,
+            decode_attention=self.decode_attention,
+            decode_block_k=self.decode_block_k,
+            decode_attn_fn=self.decode_attn_fn,
             name="attn",
         )(h, deterministic=deterministic)
         h = make_norm(
@@ -271,6 +278,15 @@ class TransformerConfig:
                                      # None = compute dtype; jnp.int8 =
                                      # quantized cache with per-(token, head)
                                      # scales (~half the cache bytes of bf16)
+    decode_attention: str = "auto"   # decode-attention backend: "dense"
+                                     # (attend the whole cache buffer),
+                                     # "blocked" (length-aware Pallas kernel,
+                                     # ops/decode_attention.py), or "auto"
+                                     # (blocked on TPU, dense elsewhere)
+    decode_block_k: Optional[int] = None  # blocked-backend cache block size
+    decode_attn_fn: Optional[Callable] = None  # mesh-aware blocked-kernel
+                                     # override (make_decode_attn_fn);
+                                     # injected by the serving entry points
 
     def __post_init__(self):
         # Fail fast on typos; 'nothing' IS the default, so only a policy that
@@ -444,6 +460,9 @@ class Transformer(nn.Module):
             decode=cfg.decode,
             max_decode_len=cfg.max_seq_len if cfg.decode else 0,
             kv_cache_dtype=cfg.kv_cache_dtype,
+            decode_attention=cfg.decode_attention,
+            decode_block_k=cfg.decode_block_k,
+            decode_attn_fn=cfg.decode_attn_fn,
             norm=cfg.norm,
         )
         if cfg.scan_layers:

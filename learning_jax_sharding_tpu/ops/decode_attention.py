@@ -1,0 +1,333 @@
+"""Length-aware KV-cache decode attention as a Pallas (Mosaic) TPU kernel.
+
+Serving-time attention reads the KV cache every generated token, and the
+cache buffer is statically sized at ``max_seq_len`` — so a naive decode step
+(the dense path in ``models/attention.py::_cached_attention``) reads and
+multiplies the WHOLE buffer even when only ``index + S`` slots hold real
+tokens. Measured on the v5e 125M decode bench (1024-slot caches, ≤256 valid),
+that is ~4.6× off the HBM bandwidth roofline: decode is cache-bandwidth-bound,
+and most of the bandwidth went to zero padding.
+
+This kernel makes decode traffic proportional to the VALID cache length:
+
+* the k/v grid dimension covers the full buffer (grids must be static), but
+  block index maps CLAMP out-of-range steps to the last needed block — Pallas
+  only issues a DMA when a block index changes between consecutive grid
+  steps, so clamped (repeated) steps move no HBM bytes, and ``pl.when`` skips
+  their compute. Cost scales with ``index + S``, not ``max_seq_len``.
+* ALL kv heads ride one grid step (batched dot_generals over the head dim).
+  At serving shapes the per-step work is tiny — a (B·N_kv, nk) grid was
+  measured grid-step-bound on the v5e, and folding heads cut the 125M decode
+  grid from 384 steps to 32.
+* the cache layout is ``(B, N_kv, L, H)`` — sequence-major per head — so each
+  ``(block_k, H)`` tile is one contiguous DMA (the model's ``(B, L, N, H)``
+  training layout would make every cache row a strided 128-byte read).
+* GQA-native: q arrives at full ``N = N_kv × group`` heads and is folded to
+  ``(group·S, H)`` rows per kv head — the cache is never expanded by
+  ``repeat_kv``, so K/V HBM traffic stays at ``N_kv`` heads (the whole point
+  of GQA at serving time).
+* int8 cache blocks are dequantized INSIDE the kernel, and only for blocks
+  actually read. Per-(token, head) scales multiply the score columns
+  (``q·(k_int·s) = (q·k_int)·s``) and the probability columns for v, so the
+  int8 bytes are what crosses HBM — the upcast never materializes.
+* a sliding window additionally advances the FIRST block read
+  (``kstart = (index - window + 1) // block_k``), so SWA decode touches only
+  the window band.
+* chunk queries (prefill / speculative verification) are tiled over a third
+  grid dimension in ``block_q``-row tiles, each stopping at its own causal
+  frontier — long prompts stay inside VMEM and skip strictly-future blocks'
+  traffic and compute both.
+
+The reference has no decode path at all (its attention forward is a timing
+harness, `/root/reference/case6_attention.py:229-238`); this is the serving
+kernel that replaces it, designed for the TPU memory system rather than
+translated from anything.
+
+Inference-only: no VJP (decode is never differentiated).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
+_BLOCK_Q = 128    # q rows per grid tile; bounds VMEM for long prefill chunks
+
+
+def auto_block_k(length: int, cap: int = 256) -> int:
+    """Largest power of two ≤ ``cap`` dividing ``length`` (the k-block size);
+    falls back to one full-length block when ``length`` has no power-of-two
+    factor ≥ 8 (TPU sublane tiling wants multiples of 8)."""
+    blk = 1
+    while blk < cap and length % (blk * 2) == 0:
+        blk *= 2
+    return blk if blk >= 8 else length
+
+
+def _last_block(qi, sref, *, qb: int, s: int, block_k: int):
+    """Last cache block q-tile ``qi`` may touch: its causal frontier
+    (the tile's final query sits at ``index + min((qi+1)·qb, s) - 1``),
+    which never exceeds the valid prefix ``sref[1] - 1``."""
+    last_q = jnp.minimum((qi + 1) * qb, s) - 1
+    return jnp.minimum(sref[1] - 1, (sref[2] + last_q) // block_k)
+
+
+def _kernel(
+    s_ref,                # SMEM (3,): [kstart_block, valid_blocks, index]
+    q_ref, k_ref, v_ref,  # (1, N_kv, GQ, H), (1, N_kv, block_k, H) ×2
+    *rest,
+    scale: float, block_k: int, group: int, qb: int, s: int,
+    window, quantized: bool,
+):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
+    qi, j = pl.program_id(1), pl.program_id(2)
+    blk = s_ref[0] + j
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(blk <= _last_block(qi, s_ref, qb=qb, s=s, block_k=block_k))
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale           # (N_kv, GQ, H)
+        k = k_ref[0].astype(jnp.float32)                   # (N_kv, bk, H)
+        sc = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )                                                  # (N_kv, GQ, bk)
+        if quantized:
+            # Per-(token, head) k scales are constant over H, so they commute
+            # with the contraction: scale the score COLUMNS instead of
+            # dequantizing the k block.
+            sc = sc * ks_ref[0][:, None, :]
+
+        gq = q.shape[1]
+        # Tile row r is query (qi·qb + r // group) at absolute position
+        # index + that; column c is cache slot blk·block_k + c. Rows past the
+        # chunk (non-dividing last tile) mask nothing extra — their stores
+        # are dropped by the blocked write.
+        rows = jax.lax.broadcasted_iota(jnp.int32, (1, gq, 1), 1)
+        qpos = s_ref[2] + qi * qb + (rows // group if group > 1 else rows)
+        cols = blk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, block_k), 2
+        )
+        mask = cols <= qpos                     # causal + hides the unwritten
+        if window is not None:                  # tail of the cache buffer
+            mask = jnp.logical_and(mask, cols > qpos - window)
+        sc = jnp.where(mask, sc, _NEG_INF)
+
+        m_prev = m_ref[:, :, :1]                           # (N_kv, GQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=2, keepdims=True))
+        p = jnp.exp(sc - m_new)                            # (N_kv, GQ, bk)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = corr * l_ref[:, :, :1] + jnp.sum(p, axis=2, keepdims=True)
+        if quantized:
+            # v scales are per cache row = per probability column.
+            p = p * vs_ref[0][:, None, :]
+        v = v_ref[0].astype(jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    # Output block index is constant over j, so it flushes once per q tile;
+    # write at the STATIC last step (skipped steps don't touch acc).
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    index: jax.Array,
+    *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+    window: int | None = None,
+    scale: float | None = None,
+    block_k: int | None = None,
+    block_q: int = _BLOCK_Q,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attend chunk queries against the valid prefix of a KV cache.
+
+    Args:
+        q: ``(B, S, N, H)`` chunk queries (S = 1 for token steps, the prompt
+            length for prefill). N may exceed the cache's head count (GQA).
+        k_cache / v_cache: ``(B, N_kv, L, H)`` cache buffers — float, or int8
+            with ``k_scale``/``v_scale``.
+        index: int32 scalar — absolute position of the chunk's first query;
+            the chunk's own k/v must already be written at
+            ``[index, index + S)``. Slots ≥ ``index + S`` are never read.
+        k_scale / v_scale: ``(B, N_kv, L)`` fp32 per-(token, head) scales for
+            int8 caches (both or neither).
+        window: causal sliding window — query at position p attends
+            ``(p - window, p]``; blocks before every query's window are not
+            even fetched.
+        block_k: cache block size; None auto-selects (≤256 dividing L).
+        block_q: q rows per grid tile (VMEM bound for long chunks).
+        interpret: run the Pallas interpreter; None = auto (True off-TPU).
+
+    Returns:
+        ``(B, S, N, H)`` attention output in ``q.dtype``.
+    """
+    b, s, n, h = q.shape
+    bk, n_kv, length, hk = k_cache.shape
+    if (bk, hk) != (b, h) or v_cache.shape != k_cache.shape:
+        raise ValueError(
+            f"cache shapes {k_cache.shape}/{v_cache.shape} do not match "
+            f"queries {q.shape} (want (B, N_kv, L, H) = ({b}, *, *, {h}))"
+        )
+    if n % n_kv:
+        raise ValueError(f"num_heads {n} not a multiple of kv heads {n_kv}")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
+    quantized = k_scale is not None
+    group = n // n_kv
+    scale = h**-0.5 if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_k = auto_block_k(length) if block_k is None else block_k
+    if length % block_k:
+        raise ValueError(f"cache length {length} not divisible by block_k {block_k}")
+    nk = length // block_k
+    # q rows tile in whole queries (qb of them → gq = qb·group rows) so a
+    # tile's causal frontier is well-defined; single-token decode is one tile.
+    qb = min(s, max(1, block_q // group))
+    gq = qb * group
+    nq = pl.cdiv(s, qb)
+
+    idx = jnp.asarray(index, jnp.int32)
+    valid_blocks = (idx + s + block_k - 1) // block_k
+    if window is not None:
+        kstart = jnp.maximum(0, (idx - (window - 1)) // block_k)
+    else:
+        kstart = jnp.zeros((), jnp.int32)
+    sargs = jnp.stack([kstart, valid_blocks, idx]).astype(jnp.int32)
+
+    # (B, S, N, H) → (B, N_kv, S·group, H): row r = query (r // group) for
+    # in-group head (r % group); q head n belongs to kv head n // group
+    # (matching models.attention.repeat_kv's jnp.repeat expansion).
+    qr = (
+        q.reshape(b, s, n_kv, group, h)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, n_kv, s * group, h)
+    )
+
+    last_block = functools.partial(_last_block, qb=qb, s=s, block_k=block_k)
+
+    def clamped(bi, qi, j, sref):
+        return (bi, 0, jnp.minimum(sref[0] + j, last_block(qi, sref)), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0)),
+        pl.BlockSpec((1, n_kv, block_k, h), clamped),
+        pl.BlockSpec((1, n_kv, block_k, h), clamped),
+    ]
+    operands = [qr, k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(
+                (1, n_kv, block_k),
+                lambda bi, qi, j, sref: (
+                    bi, 0, jnp.minimum(sref[0] + j, last_block(qi, sref))
+                ),
+            )
+        ] * 2
+        operands += [k_scale, v_scale]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, block_k=block_k, group=group, qb=qb, s=s,
+            window=window, quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, nq, nk),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((n_kv, gq, h), jnp.float32),
+                pltpu.VMEM((n_kv, gq, LANES), jnp.float32),
+                pltpu.VMEM((n_kv, gq, LANES), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, s * group, h), q.dtype),
+        interpret=interpret,
+    )(sargs, *operands)
+
+    return (
+        out.reshape(b, n_kv, s, group, h)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, s, n, h)
+    )
+
+
+def make_decode_attn_fn(mesh, rules, **kwargs):
+    """A mesh-aware wrapper of :func:`decode_attention` for multi-device
+    serving: runs the kernel under ``shard_map`` with batch and heads
+    partitioned per the logical ``rules`` (GSPMD cannot partition a custom
+    kernel by itself). Mirrors ``ops.flash_attention.make_flash_attn_fn``.
+
+    The returned callable accepts :func:`decode_attention` keywords at CALL
+    time (``window``, ``block_k``, ...), which override any baked here — the
+    attention module passes its own ``window``/``decode_block_k`` on every
+    call, so a wrapper built without them cannot silently drop the model's
+    sliding window.
+    """
+    from flax.linen import partitioning as nn_partitioning
+    from jax.sharding import PartitionSpec
+
+    from learning_jax_sharding_tpu.parallel.logical import BATCH, HEADS
+
+    def to_spec(logical):
+        return PartitionSpec(
+            *nn_partitioning.logical_to_mesh_axes(logical, tuple(rules))
+        )
+
+    q_spec = to_spec((BATCH, None, HEADS, None))
+    kv_spec = to_spec((BATCH, HEADS, None, None))
+    sc_spec = to_spec((BATCH, HEADS, None))
+    idx_spec = PartitionSpec()
+
+    def attn_fn(
+        q, k_cache, v_cache, index, *, k_scale=None, v_scale=None, **call_kwargs
+    ):
+        fn = functools.partial(decode_attention, **{**kwargs, **call_kwargs})
+        if k_scale is None:
+            body = lambda q_, k_, v_, i_: fn(q_, k_, v_, i_)
+            in_specs = (q_spec, kv_spec, kv_spec, idx_spec)
+            args = (q, k_cache, v_cache, index)
+        else:
+            body = lambda q_, k_, v_, i_, ks_, vs_: fn(
+                q_, k_, v_, i_, k_scale=ks_, v_scale=vs_
+            )
+            in_specs = (q_spec, kv_spec, kv_spec, idx_spec, sc_spec, sc_spec)
+            args = (q, k_cache, v_cache, index, k_scale, v_scale)
+        # check_vma=False: pallas_call's out_shape carries no varying-axes
+        # metadata, which the static replication checker requires.
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=q_spec,
+            check_vma=False,
+        )(*args)
+
+    return attn_fn

@@ -34,6 +34,44 @@ def device_peak_flops(device: jax.Device | None = None) -> float | None:
     return PEAK_BF16_FLOPS.get(device.device_kind)
 
 
+# Peak HBM bandwidth per chip, bytes/s. Sources: public Google Cloud TPU
+# system specs. The roofline for bandwidth-bound programs (decode!) the way
+# PEAK_BF16_FLOPS is for matmul-bound ones.
+PEAK_HBM_BYTES: dict[str, float] = {
+    "TPU v4": 1.2e12,
+    "TPU v5 lite": 819e9,    # v5e
+    "TPU v5": 2.765e12,      # v5p
+    "TPU v5p": 2.765e12,
+    "TPU v6 lite": 1.64e12,  # v6e / Trillium
+}
+
+
+def device_peak_hbm_bw(device: jax.Device | None = None) -> float | None:
+    """Peak HBM bytes/s for ``device``, or None if unknown."""
+    device = device or jax.devices()[0]
+    return PEAK_HBM_BYTES.get(device.device_kind)
+
+
+def mbu(
+    bytes_per_iter: float,
+    seconds_per_iter: float,
+    device: jax.Device | None = None,
+) -> float | None:
+    """Memory-bandwidth utilization in [0, 1]: achieved bytes/s over the
+    chip's peak HBM bandwidth.
+
+    The roofline metric for DECODE — each generated token must stream the
+    served weights plus the valid KV cache through HBM, so
+    ``bytes_per_iter`` is (weight bytes + mean valid cache bytes) per token
+    step and an MBU near 1 means the step is running at the memory-system
+    limit (MFU is near-meaningless there: decode matmuls are thin). None on
+    unknown devices (e.g. emulated CPU)."""
+    peak = device_peak_hbm_bw(device)
+    if peak is None or seconds_per_iter <= 0:
+        return None
+    return bytes_per_iter / seconds_per_iter / peak
+
+
 def compiled_flops(fn: Callable, *args, **kwargs) -> float | None:
     """Total FLOPs of one execution, from the compiled program's own cost
     analysis — no hand-derived formulas to drift out of sync with the model."""
