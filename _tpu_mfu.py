@@ -1,0 +1,62 @@
+"""Train-MFU ablations: donation-amortized scan timing, optimizer variants."""
+import dataclasses, functools
+import numpy as np, jax, jax.numpy as jnp, optax
+from jax import lax
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M, Transformer, fused_next_token_loss)
+from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, activate
+from learning_jax_sharding_tpu.training.pipeline import make_train_step, sharded_train_state
+from learning_jax_sharding_tpu.utils.bench import time_fn
+
+mesh = build_mesh((1, 1), ("data", "model"))
+b, s = 8, 1024
+cfg = dataclasses.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
+model = Transformer(cfg)
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+sh = mesh_sharding(mesh, "data", None)
+batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+FLOPS = cfg.train_step_flops(b, s)
+
+def report(tag, secs):
+    print(f"{tag}: {secs*1e3:.2f} ms/step, {FLOPS/secs/1e12:.1f} TFLOP/s, MFU={FLOPS/secs/197e12:.1%}", flush=True)
+
+def loss_of(params, bt):
+    hidden = model.apply({"params": params}, bt["inputs"], return_hidden=True)
+    return fused_next_token_loss(hidden, bt, params)
+
+def scan_step_time(opt, tag, k=4, compiler_options=None):
+    state, _ = sharded_train_state(
+        model, opt, batch["inputs"], {"params": jax.random.key(0)}, mesh, RULES_DP_TP)
+    def body(st, _):
+        grads = jax.grad(lambda p: loss_of(p, batch))(st.params)
+        return st.apply_gradients(grads=grads), None
+    def many(st):
+        st, _ = lax.scan(body, st, None, length=k)
+        return st
+    with activate(mesh, RULES_DP_TP):
+        j = jax.jit(many, compiler_options=compiler_options)
+        secs = time_fn(j, state, min_time=2.0) / k
+    report(tag, secs)
+    del state
+    return secs
+
+# 1. current bench config: single step, no donation
+state, state_sh = sharded_train_state(
+    model, optax.adamw(3e-4), batch["inputs"], {"params": jax.random.key(0)}, mesh, RULES_DP_TP)
+step = make_train_step(
+    state_sh, {k: v.sharding for k, v in batch.items()}, mesh, RULES_DP_TP,
+    loss_fn=fused_next_token_loss, loss_needs_params=True,
+    apply_kwargs={"return_hidden": True}, donate_state=False)
+report("single-step no-donate (r1 bench)", time_fn(step, state, batch, min_time=2.0))
+del state
+
+# 2. scanned steps (in-place state, the real training regime)
+scan_step_time(optax.adamw(3e-4), "scan x4 adamw fp32")
+# 3. bf16 first moment
+scan_step_time(optax.adamw(3e-4, mu_dtype=jnp.bfloat16), "scan x4 adamw mu=bf16")
+# 4. bigger scoped vmem for fusions
+scan_step_time(optax.adamw(3e-4), "scan x4 adamw + vmem64M",
+               compiler_options={"xla_tpu_scoped_vmem_limit_kib": 65536})

@@ -1,0 +1,48 @@
+"""MFU ablation 3: mixed-precision param/master/moment stacks, scan x8."""
+import dataclasses
+import numpy as np, jax, jax.numpy as jnp, optax
+from jax import lax
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M, Transformer, fused_next_token_loss)
+from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, activate
+from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+from learning_jax_sharding_tpu.training.precision import master_weights
+from learning_jax_sharding_tpu.utils.bench import time_fn
+
+mesh = build_mesh((1, 1), ("data", "model"))
+b, s = 8, 1024
+rng = np.random.default_rng(0)
+tokens = rng.integers(0, 50304, size=(b, s + 1)).astype(np.int32)
+sh = mesh_sharding(mesh, "data", None)
+batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+
+def bench_cfg(cfg, opt, tag, k=8):
+    model = Transformer(cfg)
+    FLOPS = cfg.train_step_flops(b, s)
+    def loss_of(params, bt):
+        hidden = model.apply({"params": params}, bt["inputs"], return_hidden=True)
+        return fused_next_token_loss(hidden, bt, params)
+    state, _ = sharded_train_state(
+        model, opt, batch["inputs"], {"params": jax.random.key(0)}, mesh, RULES_DP_TP)
+    def body(st, _):
+        grads = jax.grad(lambda p: loss_of(p, batch))(st.params)
+        return st.apply_gradients(grads=grads), None
+    def many(st):
+        st, _ = lax.scan(body, st, None, length=k)
+        return st
+    with activate(mesh, RULES_DP_TP):
+        secs = time_fn(jax.jit(many), state, min_time=2.0) / k
+    print(f"{tag}: {secs*1e3:.2f} ms/step, {FLOPS/secs/1e12:.1f} TFLOP/s, MFU={FLOPS/secs/197e12:.1%}", flush=True)
+    del state
+
+CFG = dataclasses.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
+CFG_BF16P = dataclasses.replace(CFG, param_dtype=jnp.bfloat16)
+
+bench_cfg(CFG_BF16P, master_weights(optax.adamw(3e-4)),
+          "bf16 params + fp32 master, fp32 moments")
+bench_cfg(CFG_BF16P, master_weights(optax.adamw(3e-4, mu_dtype=jnp.bfloat16)),
+          "bf16 params + fp32 master, mu=bf16")
+bench_cfg(CFG, optax.adamw(3e-4, mu_dtype=jnp.bfloat16),
+          "fp32 params, mu=bf16 (yesterday's best, rerun)")

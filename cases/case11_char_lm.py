@@ -106,6 +106,10 @@ def main():
         gen = make_generate_fn(
             CFG, mesh, RULES_DP_TP, max_new_tokens=48,
             temperature=0.7, top_k=40,
+            # The model vocab (384) is lane-padded past the learned BPE
+            # vocab; the limit keeps undecodable pad ids out of the sample
+            # (BPETokenizer.decode raises on them).
+            vocab_limit=tok.vocab_size,
         )
         prompt_text = "the quick brown"  # no trailing space: BPE continuations are space-glued
         prompt = np.asarray([tok.encode(prompt_text)], np.int32)

@@ -69,6 +69,7 @@ def make_beam_search_fn(
     beam_size: int,
     max_new_tokens: int,
     eos_id: Optional[int] = None,
+    vocab_limit: Optional[int] = None,
     length_penalty: float = 1.0,
     inference_dtype: Any | None = None,
     dequantize: bool = False,
@@ -137,13 +138,19 @@ def make_beam_search_fn(
         # shape inside the same jitted program — prefill FLOPs don't scale
         # with beam_size, and the decode loop still runs at a single static
         # B·K batch (row-major: a row's beams are adjacent).
+        if vocab_limit is not None:
+            from learning_jax_sharding_tpu.models.generate import vocab_limit_filter
+
+            limit = lambda lg: vocab_limit_filter(lg, vocab_limit)
+        else:
+            limit = lambda lg: lg
         logits, cache = apply(params, None, prompt)
         cache = jax.tree.map(
             lambda x: jnp.repeat(x, k, axis=0)
             if getattr(x, "ndim", 0) >= 1 and x.shape[0] == b else x,
             cache,
         )
-        logp0 = jax.nn.log_softmax(logits[:, -1])  # (B, V)
+        logp0 = jax.nn.log_softmax(limit(logits[:, -1]))  # (B, V)
         vocab = logp0.shape[-1]
 
         fin_scores = jnp.full((b, k), NEG_INF)
@@ -167,7 +174,7 @@ def make_beam_search_fn(
                 tokens_buf, i - 1, axis=2, keepdims=False
             )  # (B, K)
             logits, cache = apply(params, cache, last.reshape(b * k, 1))
-            logp = jax.nn.log_softmax(logits[:, -1]).reshape(b, k, vocab)
+            logp = jax.nn.log_softmax(limit(logits[:, -1])).reshape(b, k, vocab)
             total = scores[:, :, None] + logp  # (B, K, V)
             scores_2k, flat_idx = lax.top_k(total.reshape(b, k * vocab), 2 * k)
             parent_2k = flat_idx // vocab  # (B, 2K)

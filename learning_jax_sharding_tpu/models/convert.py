@@ -149,8 +149,11 @@ def state_dict_from_params(params: dict, *, tie_head: bool = True) -> dict:
     it to ``wte`` (set False for params whose head was trained untied).
     Load with ``hf_model.load_state_dict(sd, strict=False)`` (HF carries
     non-weight buffers like attention bias masks that this does not emit).
+    Trees trained with ``scan_layers`` are unstacked automatically.
     """
     import torch
+
+    params = unstack_scan_params(params)
 
     def tt(x):
         return torch.tensor(np.asarray(x, np.float32))
@@ -173,12 +176,6 @@ def state_dict_from_params(params: dict, *, tie_head: bool = True) -> dict:
             )
     else:
         sd["lm_head.weight"] = tt(head_t)
-    if "blocks" in params:
-        raise ValueError(
-            "params use the scan_layers stacked layout ('blocks'); unstack "
-            "to per-layer block_i subtrees before export (split each leaf "
-            "along its leading LAYERS dim)"
-        )
     n_layer = sum(1 for k in params if k.startswith("block_"))
     if n_layer == 0:
         raise ValueError("no block_i subtrees found — not a Transformer param tree")
@@ -208,3 +205,54 @@ def state_dict_from_params(params: dict, *, tie_head: bool = True) -> dict:
             f"{p}.mlp.c_proj.bias": tt(blk["ff"]["down"]["bias"]),
         })
     return sd
+
+
+def unstack_scan_params(params: dict) -> dict:
+    """``scan_layers`` stacked params → the unrolled per-layer layout.
+
+    A model trained with ``scan_layers=True`` (O(1) compile time in depth)
+    keeps its block params as one ``"blocks"`` subtree whose leaves carry a
+    leading ``(LAYERS,)`` dim. Serving and export run the unrolled stack
+    (``block_0..block_{L-1}``) — this splits each stacked leaf along that
+    dim so the SAME trained weights drive decode / HF export. Inverse of
+    :func:`stack_scan_params`; a tree already in the unrolled layout passes
+    through unchanged. Math is identical either way (test-pinned logit
+    parity, ``tests/test_scan_layers.py``).
+    """
+    if "blocks" not in params:
+        return params
+    if "embed" in params or "head" in params:
+        # PipelinedTransformer trees also keep a "blocks" subtree, but its
+        # leading dims are (stages, ...) — splitting those as layers would
+        # silently produce wrong-rank per-layer tensors. Fail loudly instead.
+        raise ValueError(
+            "params look like a PipelinedTransformer stage-stacked tree "
+            "(embed/blocks/head); unstack_scan_params handles only "
+            "Transformer scan_layers trees"
+        )
+    import jax
+
+    blocks = params["blocks"]
+    num_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i in range(num_layers):
+        out[f"block_{i}"] = jax.tree.map(lambda x, i=i: x[i], blocks)
+    return out
+
+
+def stack_scan_params(params: dict) -> dict:
+    """Unrolled ``block_i`` params → the ``scan_layers`` stacked layout
+    (leaves gain a leading layer dim). Inverse of
+    :func:`unstack_scan_params`; a tree already stacked passes through."""
+    import jax
+    import jax.numpy as jnp
+
+    n_layer = sum(1 for k in params if k.startswith("block_"))
+    if n_layer == 0:
+        return params
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs),
+        *[params[f"block_{i}"] for i in range(n_layer)],
+    )
+    rest = {k: v for k, v in params.items() if not k.startswith("block_")}
+    return {**rest, "blocks": stacked}

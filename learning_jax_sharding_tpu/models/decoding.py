@@ -50,7 +50,12 @@ def derive_decode_config(
     (``ops.decode_attention.make_decode_attn_fn``) — GSPMD cannot partition
     the Pallas cache kernel by itself, so multi-device serving needs the
     explicitly sharded call."""
-    cfg = dataclasses.replace(config, decode=True, dropout_rate=0.0)
+    # Decode always runs the UNROLLED stack: scan_layers is a compile-time
+    # lever for training depth; its stacked params are unstacked at serve
+    # time (make_param_caster), so train-with-scan → generate just works.
+    cfg = dataclasses.replace(
+        config, decode=True, dropout_rate=0.0, scan_layers=False
+    )
     if inference_dtype is not None:
         cfg = dataclasses.replace(
             cfg, dtype=inference_dtype, param_dtype=inference_dtype
@@ -84,6 +89,15 @@ def make_param_caster(
     """
 
     def maybe_cast(params: Any) -> Any:
+        # Trees trained with scan_layers arrive in the stacked "blocks"
+        # layout; decode always runs the unrolled stack (derive_decode_config
+        # flips scan_layers off), so unstack here — eagerly, once per call,
+        # like the dtype cast (slicing per decode step inside jit would
+        # re-materialize every layer's weights each token).
+        if isinstance(params, dict) and "blocks" in params:
+            from learning_jax_sharding_tpu.models.convert import unstack_scan_params
+
+            params = unstack_scan_params(params)
         if inference_dtype is None:
             return params
 

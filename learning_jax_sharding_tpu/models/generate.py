@@ -101,19 +101,36 @@ def repetition_penalty_filter(
     return jnp.where(seen, penalized, logits)
 
 
+def vocab_limit_filter(logits: jax.Array, limit: int) -> jax.Array:
+    """Mask logits at ids ≥ ``limit`` to -inf.
+
+    Model vocabularies are padded to lane-friendly multiples (the default
+    config rounds GPT-2's 50257 up to 50304), so an un-trained or lightly
+    trained model assigns real probability to ids NO tokenizer can decode.
+    Masking at the source means the pad region can never be emitted — the
+    loud ``BPETokenizer.decode`` range check then only fires on genuine
+    corruption."""
+    if limit < 1:
+        raise ValueError(f"vocab_limit must be >= 1, got {limit}")
+    return jnp.where(jnp.arange(logits.shape[-1]) < limit, logits, -jnp.inf)
+
+
 def filtered_logits(
     logits: jax.Array,
     temperature: float,
     top_k: int | None = None,
     top_p: float | None = None,
     min_p: float | None = None,
+    vocab_limit: int | None = None,
 ) -> jax.Array:
-    """The sampling distribution in logit space: temperature → top-k →
-    top-p → min-p, fp32. THE single definition of filter order — plain
-    sampling and speculative verification (``models/speculative.py``) both
-    call it, which is what makes speculative sampling exact for the same
+    """The sampling distribution in logit space: vocab-limit → temperature →
+    top-k → top-p → min-p, fp32. THE single definition of filter order —
+    plain sampling and speculative verification (``models/speculative.py``)
+    both call it, which is what makes speculative sampling exact for the same
     distribution plain sampling draws from. Requires ``temperature > 0``."""
     logits = logits.astype(jnp.float32) / temperature
+    if vocab_limit is not None:
+        logits = vocab_limit_filter(logits, vocab_limit)
     if top_k is not None:
         logits = top_k_filter(logits, top_k)
     if top_p is not None:
@@ -130,12 +147,17 @@ def _sample(
     top_k: int | None = None,
     top_p: float | None = None,
     min_p: float | None = None,
+    vocab_limit: int | None = None,
 ) -> jax.Array:
     """(B, V) logits → (B,) token ids; argmax at temperature 0."""
     if temperature == 0.0:
+        if vocab_limit is not None:
+            logits = vocab_limit_filter(logits, vocab_limit)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(
-        rng, filtered_logits(logits, temperature, top_k, top_p, min_p), axis=-1
+        rng,
+        filtered_logits(logits, temperature, top_k, top_p, min_p, vocab_limit),
+        axis=-1,
     ).astype(jnp.int32)
 
 
@@ -149,6 +171,7 @@ def make_generate_fn(
     top_k: int | None = None,
     top_p: float | None = None,
     min_p: float | None = None,
+    vocab_limit: int | None = None,
     repetition_penalty: float | None = None,
     eos_id: int | None = None,
     prefill_chunk_size: int | None = None,
@@ -187,7 +210,10 @@ def make_generate_fn(
     greedy decoding (pass anything); with ``temperature > 0`` it drives
     per-step categorical sampling, optionally truncated by ``top_k``,
     nucleus ``top_p``, and/or confidence-scaled ``min_p`` (filters compose
-    in that order). ``repetition_penalty`` (> 1) down-weights every token
+    in that order). ``vocab_limit`` masks ids ≥ it for sampling AND greedy
+    argmax — set it to the TOKENIZER's vocab size when the model vocab is
+    padded to a lane multiple, so undecodable pad ids can never be emitted.
+    ``repetition_penalty`` (> 1) down-weights every token
     already in the row — prompt included — before sampling OR greedy argmax;
     the seen-set is a (B, V) presence mask carried through the decode scan.
 
@@ -271,7 +297,9 @@ def make_generate_fn(
                 logits = repetition_penalty_filter(
                     logits, seen, repetition_penalty
                 )
-            tok = _sample(logits, temperature, rng, top_k, top_p, min_p)
+            tok = _sample(
+                logits, temperature, rng, top_k, top_p, min_p, vocab_limit
+            )
             if repetition_penalty is not None:
                 seen = seen.at[rows, tok].set(True)
             return tok, seen

@@ -126,14 +126,19 @@ class PipelinedTransformer:
     rules: Rules
     num_stages: int
     num_microbatches: Optional[int] = None
+    interleave: int = 1
+    # >1 = interleaved circular schedule: each device owns `interleave`
+    # round-robin layer chunks and microbatches circulate the ring that many
+    # times — the GPipe bubble shrinks ~interleave-fold
+    # (parallel/pipeline.py module docstring has the measured tick counts).
     pipe_axis: str = PIPE_AXIS
 
     def __post_init__(self):
         cfg = self.config
-        if cfg.num_layers % self.num_stages:
+        if cfg.num_layers % (self.num_stages * self.interleave):
             raise ValueError(
                 f"num_layers {cfg.num_layers} not divisible by "
-                f"num_stages {self.num_stages}"
+                f"num_stages {self.num_stages} × interleave {self.interleave}"
             )
         if self.mesh.shape[self.pipe_axis] != self.num_stages:
             raise ValueError(
@@ -217,10 +222,12 @@ class PipelinedTransformer:
             abstract_boxed["head"],
             is_leaf=lambda b: isinstance(b, nn.LogicallyPartitioned),
         )
-        # Block leaves are (P, L/P, *weight_dims): stage dim over pipe, layer
-        # dim replicated, weight dims per their logical names (TP rides here).
+        # Block leaves are (P, L/P, *weight_dims) — or (P, V, c, *weight_dims)
+        # when interleaved: stage dim over pipe, chunk/layer dims replicated,
+        # weight dims per their logical names (TP rides here).
+        lead = (self.pipe_axis, None) + (None,) * (self.interleave > 1)
         blocks_sh = jax.tree.map(
-            lambda b: leaf_sharding(b, (self.pipe_axis, None)),
+            lambda b: leaf_sharding(b, lead),
             abstract_boxed["blocks"],
             is_leaf=lambda b: isinstance(b, nn.LogicallyPartitioned),
         )
@@ -237,7 +244,9 @@ class PipelinedTransformer:
         def init_fn(rng, tokens):
             boxed = self._init_boxed(rng, tokens)
             params = nn.meta.unbox(boxed)
-            params["blocks"] = stack_stage_params(params["blocks"], self.num_stages)
+            params["blocks"] = stack_stage_params(
+                params["blocks"], self.num_stages, self.interleave
+            )
             return params
 
         def restack(box: Any) -> Any:
@@ -245,10 +254,14 @@ class PipelinedTransformer:
             # LogicallyPartitioned boxes (whose names cover only the weight
             # dims): rewrite (L, ...) shapes to (P, L/P, ...) in place.
             value = box.value if isinstance(box, nn.LogicallyPartitioned) else box
-            value = jax.ShapeDtypeStruct(
+            chunks = self.num_stages * self.interleave
+            lead = (
                 (self.num_stages, value.shape[0] // self.num_stages)
-                + tuple(value.shape[1:]),
-                value.dtype,
+                if self.interleave == 1
+                else (self.num_stages, self.interleave, value.shape[0] // chunks)
+            )
+            value = jax.ShapeDtypeStruct(
+                lead + tuple(value.shape[1:]), value.dtype
             )
             if isinstance(box, nn.LogicallyPartitioned):
                 return box.replace_boxed(value)
@@ -295,6 +308,7 @@ class PipelinedTransformer:
             mesh=self.mesh,
             axis=self.pipe_axis,
             num_microbatches=self.num_microbatches,
+            interleave=self.interleave,
         )
         return self._head.apply({"params": params["head"]}, x)
 

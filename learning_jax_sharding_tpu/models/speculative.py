@@ -66,7 +66,11 @@ def _rollback(cache: Any, index: jax.Array) -> Any:
     return jax.tree_util.tree_map_with_path(leaf, cache)
 
 
-def _greedy(logits: jax.Array) -> jax.Array:
+def _greedy(logits: jax.Array, vocab_limit: int | None = None) -> jax.Array:
+    if vocab_limit is not None:
+        from learning_jax_sharding_tpu.models.generate import vocab_limit_filter
+
+        logits = vocab_limit_filter(logits.astype(jnp.float32), vocab_limit)
     return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
 
 
@@ -93,6 +97,7 @@ def make_speculative_generate_fn(
     top_k: int | None = None,
     top_p: float | None = None,
     min_p: float | None = None,
+    vocab_limit: int | None = None,
     inference_dtype: Any | None = None,
 ):
     """Build ``generate(target_params, draft_params, prompt[, rng]) -> tokens``.
@@ -146,7 +151,7 @@ def make_speculative_generate_fn(
         # the target's last-position logits — exactly as plain greedy.
         t_logits, t_cache = t_apply(t_params, None, prompt)
         _, d_cache = d_apply(d_params, None, prompt)
-        t_cur = _greedy(t_logits[:, -1])
+        t_cur = _greedy(t_logits[:, -1], vocab_limit)
 
         buf_len = max_new_tokens + num_draft + 1
         buffer = jnp.zeros((b, buf_len), jnp.int32)
@@ -168,7 +173,7 @@ def make_speculative_generate_fn(
             def draft_step(carry, _):
                 prev, cache = carry
                 logits, cache = d_apply(d_params, cache, prev[:, None])
-                nxt = _greedy(logits[:, -1])
+                nxt = _greedy(logits[:, -1], vocab_limit)
                 return (nxt, cache), nxt
 
             (last_d, d_cache), drafts = lax.scan(
@@ -181,7 +186,7 @@ def make_speculative_generate_fn(
             #    [t_cur, d_1..d_num_draft] → greedy choice after each.
             chunk = jnp.concatenate([t_cur[:, None], drafts], axis=1)
             t_logits, t_cache = t_apply(t_params, t_cache, chunk)
-            choices = _greedy(t_logits)  # (B, num_draft+1)
+            choices = _greedy(t_logits, vocab_limit)  # (B, num_draft+1)
 
             # 3. Accept the longest prefix where draft == target choice;
             #    batch-min keeps a single scalar cache index.
@@ -222,7 +227,9 @@ def make_speculative_generate_fn(
         does); acceptance ratios softmax them into probabilities."""
         from learning_jax_sharding_tpu.models.generate import filtered_logits
 
-        return filtered_logits(logits, temperature, top_k, top_p, min_p)
+        return filtered_logits(
+            logits, temperature, top_k, top_p, min_p, vocab_limit
+        )
 
     def to_probs(logits):
         return jax.nn.softmax(to_flogits(logits), axis=-1)

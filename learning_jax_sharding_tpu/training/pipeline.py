@@ -153,6 +153,7 @@ def make_train_step(
     loss_needs_params: bool = False,
     apply_kwargs: dict[str, Any] | None = None,
     grad_accum_steps: int = 1,
+    steps_per_call: int = 1,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -188,6 +189,17 @@ def make_train_step(
     etc.). A sum-style loss (including ``default_loss``) ends up scaled by
     ``1/grad_accum_steps`` relative to the unaccumulated step — use a mean
     loss when accumulating.
+
+    ``steps_per_call``: run this many FULL optimizer steps per jitted call
+    (a ``lax.scan``); the batch then carries a leading ``(steps_per_call,)``
+    dim of per-step batches and the returned loss is the per-step
+    ``(steps_per_call,)`` vector. Each scan iteration is exactly the
+    single-step program, with the state carried in place — this amortizes
+    per-call host dispatch (decisive on remote/tunneled hosts: ~100 ms
+    latency per call in this environment) and keeps the optimizer update
+    buffer-donating even when the CALLER cannot donate (the v5e 125M bench:
+    single-call no-donate timing reads 66.5 ms/step, the scanned in-place
+    regime 63.0 — the honest sustained-training number).
     """
 
     def step(state: TrainState, batch: Any):
@@ -251,12 +263,29 @@ def make_train_step(
             grads = jax.tree.map(lambda g: g / grad_accum_steps, grad_sum)
         return state.apply_gradients(grads=grads), loss
 
-    jitted = jax.jit(
-        step,
-        in_shardings=(state_shardings, x_sharding),
-        out_shardings=(state_shardings, NamedSharding(mesh, jax.sharding.PartitionSpec())),
-        donate_argnums=(0,) if donate_state else (),
-    )
+    scalar_sh = NamedSharding(mesh, jax.sharding.PartitionSpec())
+    if steps_per_call == 1:
+        jitted = jax.jit(
+            step,
+            in_shardings=(state_shardings, x_sharding),
+            out_shardings=(state_shardings, scalar_sh),
+            donate_argnums=(0,) if donate_state else (),
+        )
+    else:
+        def multi(state: TrainState, batches: Any):
+            return jax.lax.scan(step, state, batches)
+
+        def stack_sh(sh):
+            return NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, *sh.spec)
+            )
+
+        jitted = jax.jit(
+            multi,
+            in_shardings=(state_shardings, jax.tree.map(stack_sh, x_sharding)),
+            out_shardings=(state_shardings, scalar_sh),
+            donate_argnums=(0,) if donate_state else (),
+        )
 
     def run(state: TrainState, batch: Any):
         with activate(mesh, rules):

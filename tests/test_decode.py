@@ -270,3 +270,56 @@ class TestGenerate:
             params,
         )
         np.testing.assert_array_equal(out, np.asarray(gen(p16, prompt)))
+
+
+class TestVocabLimit:
+    """vocab_limit masks the padded tail of the model vocab so undecodable
+    ids can never be emitted (model vocabs are lane-padded past tokenizer
+    vocabs; BPETokenizer.decode raises on out-of-range ids)."""
+
+    def test_filter(self):
+        from learning_jax_sharding_tpu.models.generate import vocab_limit_filter
+
+        logits = jnp.zeros((2, 8)).at[:, 6].set(9.0)
+        out = vocab_limit_filter(logits, 5)
+        assert np.all(np.isneginf(np.asarray(out)[:, 5:]))
+        np.testing.assert_array_equal(np.asarray(out)[:, :5], 0.0)
+        with pytest.raises(ValueError, match="vocab_limit"):
+            vocab_limit_filter(logits, 0)
+
+    @pytest.mark.parametrize("temperature", [0.0, 0.9])
+    def test_generate_never_emits_past_limit(self, mesh22, trained, temperature):
+        cfg, params = trained
+        limit = 7  # tiny: unconstrained argmax/sampling would exceed it
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=12,
+            temperature=temperature, vocab_limit=limit,
+        )
+        out = np.asarray(gen(params, _tokens(cfg, b=2, s=8), jax.random.key(5)))
+        assert out[:, 8:].max() < limit
+
+    def test_beam_respects_limit(self, mesh22, trained):
+        from learning_jax_sharding_tpu.models.beam import make_beam_search_fn
+
+        cfg, params = trained
+        limit = 7
+        beam = make_beam_search_fn(
+            cfg, mesh22, RULES_DP_TP, beam_size=2, max_new_tokens=8,
+            vocab_limit=limit,
+        )
+        tokens, _ = beam(params, _tokens(cfg, b=2, s=6))
+        assert np.asarray(tokens)[:, 6:].max() < limit
+
+    def test_speculative_respects_limit(self, mesh22, trained):
+        from learning_jax_sharding_tpu.models.speculative import (
+            make_speculative_generate_fn,
+        )
+
+        cfg, params = trained
+        limit = 7
+        gen = make_speculative_generate_fn(
+            cfg, cfg, mesh22, RULES_DP_TP, max_new_tokens=8, num_draft=2,
+            temperature=0.8, vocab_limit=limit,
+        )
+        out = np.asarray(gen(params, params, _tokens(cfg, b=2, s=6), jax.random.key(2)))
+        assert out[:, 6:].max() < limit

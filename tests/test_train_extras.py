@@ -173,6 +173,66 @@ class TestGradAccumulation:
             step(state, batch)
 
 
+class TestStepsPerCall:
+    def test_scanned_steps_match_sequential(self, mesh22):
+        """K steps in one jitted lax.scan call == K sequential single-step
+        calls over the same batches: same final params, same per-step
+        losses. (steps_per_call amortizes host dispatch and keeps the state
+        update in place — the bench's sustained-training timing mode.)"""
+        cfg = CONFIG_TINY
+        model = Transformer(cfg)
+        rng = np.random.default_rng(0)
+        K = 3
+        sh = mesh_sharding(mesh22, "data", None)
+        toks = [
+            rng.integers(0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+            for _ in range(K)
+        ]
+        batches = [
+            {"inputs": put(t[:, :-1], sh), "targets": put(t[:, 1:], sh)}
+            for t in toks
+        ]
+        x_sh = {k: v.sharding for k, v in batches[0].items()}
+
+        def fresh_state():
+            return sharded_train_state(
+                model, optax.sgd(0.1), batches[0]["inputs"],
+                {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+            )
+
+        state1, state_sh = fresh_state()
+        single = make_train_step(
+            state_sh, x_sh, mesh22, RULES_DP_TP, loss_fn=next_token_loss,
+            donate_state=False,
+        )
+        losses = []
+        for bt in batches:
+            state1, loss = single(state1, bt)
+            losses.append(float(loss))
+
+        state2, state_sh = fresh_state()
+        multi = make_train_step(
+            state_sh, x_sh, mesh22, RULES_DP_TP, loss_fn=next_token_loss,
+            donate_state=False, steps_per_call=K,
+        )
+        stacked = {
+            k: put(
+                np.stack([np.asarray(b[k]) for b in batches]),
+                mesh_sharding(mesh22, None, "data", None),
+            )
+            for k in ("inputs", "targets")
+        }
+        state2, loss_vec = multi(state2, stacked)
+        np.testing.assert_allclose(np.asarray(loss_vec), losses, rtol=1e-5)
+        for a, b in zip(
+            jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                rtol=2e-4, atol=1e-6,
+            )
+
+
 class TestOptimizerPresets:
     def _cfg(self, **kw):
         kw.setdefault("learning_rate", 1e-3)
