@@ -1,0 +1,120 @@
+"""Case 14 — sequence-parallel attention: ring vs Ulysses, side by side.
+
+Not in the reference: it has no context parallelism of any kind (SURVEY.md
+§2.4 — no ``ppermute``, no ``all_to_all``). The framework ships BOTH
+standard strategies for sequences too long for one device, and this case
+runs them against each other on the same model:
+
+* **ring attention** (``ops/ring_attention.py``): the sequence stays
+  sharded; k/v shards rotate around the mesh axis with ``lax.ppermute``
+  (n−1 single-hop ICI transfers) under an online softmax. No head-count
+  constraint; k/v traffic grows with the axis size.
+* **Ulysses** (``ops/ulysses.py``): one ``all_to_all`` each way swaps the
+  sequence shard for a head shard — every device computes COMPLETE
+  attention for its subset of heads (so the flash kernel's tiling sees the
+  full sequence). Four all-to-alls total, independent of sequence length;
+  requires ``heads % axis == 0``.
+
+Both are exact (parity against the single-device dense op, asserted below),
+and both drive the SAME transformer through a sharded train step — the
+attention backend is one constructor argument (``attn_fn``), which is the
+point: sequence parallelism composes with the rest of the stack instead of
+being a special mode.
+
+Run: ``python cases/case14_sequence_parallel.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.ops.attention import causal_mask, dot_product_attention
+from learning_jax_sharding_tpu.ops.ring_attention import make_ring_attn_fn
+from learning_jax_sharding_tpu.ops.ulysses import make_ulysses_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.hlo import collective_counts
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_SP
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+
+
+def main():
+    # data=2 × model=4: the 'model' axis carries the sequence shards
+    # (RULES_DP_SP maps SEQ→model and leaves heads unmapped, which Ulysses
+    # needs — it re-shards heads over that axis itself).
+    mesh = build_mesh((2, 4), ("data", "model"))
+    rng = np.random.default_rng(0)
+
+    # --- 1. op-level parity: both strategies == single-device dense --------
+    B, S, N, H = 4, 64, 4, 16
+    q = jnp.asarray(rng.normal(size=(B, S, N, H)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, N, H)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, N, H)), jnp.float32)
+    want = dot_product_attention(q, k, v, mask=causal_mask(S))
+
+    ring = make_ring_attn_fn(mesh, RULES_DP_SP)
+    uly = make_ulysses_attn_fn(mesh, RULES_DP_SP)
+    with jax.default_matmul_precision("float32"):
+        got_ring = jax.jit(lambda a, b, c: ring(a, b, c, causal=True))(q, k, v)
+        got_uly = jax.jit(lambda a, b, c: uly(a, b, c, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got_ring), np.asarray(want), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_uly), np.asarray(want), atol=2e-5)
+    print(f"parity vs dense (B{B} S{S} N{N} H{H}): ring OK, ulysses OK")
+
+    # --- 2. the collectives are what the designs say they are --------------
+    counts_ring = collective_counts(
+        jax.jit(lambda a, b, c: ring(a, b, c, causal=True)), q, k, v
+    )
+    counts_uly = collective_counts(
+        jax.jit(lambda a, b, c: uly(a, b, c, causal=True)), q, k, v
+    )
+    print(f"ring HLO:    {counts_ring}")
+    print(f"ulysses HLO: {counts_uly}")
+    assert counts_ring["collective-permute"] >= 1, "ring must ppermute k/v"
+    assert counts_uly["all-to-all"] >= 2, "Ulysses must all_to_all both ways"
+    assert counts_uly["collective-permute"] == 0
+
+    # --- 3. both drive a full sharded train step ---------------------------
+    for tag, fn in (("ring", ring), ("ulysses", uly)):
+        cfg = dataclasses.replace(CONFIG_TINY, attn_fn=fn)
+        tokens = rng.integers(0, cfg.vocab_size, size=(8, 33)).astype(np.int32)
+        sh = mesh_sharding(mesh, "data", None)
+        batch = {
+            "inputs": put(tokens[:, :-1], sh),
+            "targets": put(tokens[:, 1:], sh),
+        }
+        model = Transformer(cfg)
+        state, state_sh = sharded_train_state(
+            model, optax.adamw(3e-4), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh, RULES_DP_SP,
+        )
+        step = make_train_step(
+            state_sh, {k_: v_.sharding for k_, v_ in batch.items()},
+            mesh, RULES_DP_SP, loss_fn=next_token_loss,
+        )
+        state, loss = step(state, batch)
+        assert np.isfinite(float(loss))
+        print(f"{tag} train step on dp×sp mesh {dict(mesh.shape)}: "
+              f"loss {float(loss):.4f}")
+
+    print("PASS: ring and Ulysses sequence parallelism, op parity through "
+          "train step")
+
+
+if __name__ == "__main__":
+    main()

@@ -152,6 +152,11 @@ class MultiHeadAttention(nn.Module):
     # blocked stores (B, N_kv, L, H) (sequence-major per head, so each cache
     # block is one contiguous DMA).
     decode_block_k: Optional[int] = None   # blocked-backend cache block size
+    quantization: Optional[str] = None
+    # "int4": projections consume quantize_tree(bits=4) params VERBATIM via
+    # the fused dequant-matmul kernel (ops/int4_matmul.py) — packed nibbles
+    # stream into the dot, no dequantized weights in HBM. None = nn.Dense.
+    quantization_group: int = 128
     decode_attn_fn: Optional[Callable] = None
     # Mesh-aware override for the blocked backend (shard_map-wrapped kernel
     # from ops.decode_attention.make_decode_attn_fn); None calls the kernel
@@ -177,22 +182,29 @@ class MultiHeadAttention(nn.Module):
             )
         return n
 
-    def _proj(self, name: str, heads: int) -> nn.Dense:
+    def _dense(self, features: int, kernel_axes, name: str):
+        """nn.Dense, or the fused-int4 drop-in under quantization="int4"
+        (one shared dispatch, models/quantize.py::projection_dense)."""
+        from learning_jax_sharding_tpu.models.quantize import projection_dense
+
+        return projection_dense(
+            quantization=self.quantization,
+            features=features,
+            kernel_axes=kernel_axes,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+            group_size=self.quantization_group,
+            name=name,
+        )
+
+    def _proj(self, name: str, heads: int) -> nn.Module:
         # Kernel (M, heads*H) carries logical axes (EMBED, HEADS): under the
         # reference rules EMBED→model splits its rows
         # (`/root/reference/case6_attention.py:56-59`); under Megatron-style
         # rules HEADS→model splits its columns.
-        return nn.Dense(
-            heads * self.head_dim,
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, HEADS)),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (HEADS,)
-            ),
-            name=name,
-        )
+        return self._dense(heads * self.head_dim, (EMBED, HEADS), name)
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -255,27 +267,23 @@ class MultiHeadAttention(nn.Module):
                 )
             # Custom backends (flash/ring) take the structural flag, not a
             # dense mask — they cannot honor arbitrary masks and must not
-            # silently reinterpret one.
-            out = self.attn_fn(
-                q, repeat_kv(k, self.num_heads), repeat_kv(v, self.num_heads),
-                causal=self.causal,
-            )
+            # silently reinterpret one. GQA-native backends (the flash
+            # kernel) read k/v at N_kv heads directly — no repeat_kv
+            # expansion materializes, which is GQA's bandwidth win.
+            if getattr(self.attn_fn, "supports_gqa", False):
+                out = self.attn_fn(q, k, v, causal=self.causal)
+            else:
+                out = self.attn_fn(
+                    q, repeat_kv(k, self.num_heads),
+                    repeat_kv(v, self.num_heads),
+                    causal=self.causal,
+                )
         out = nn.with_logical_constraint(out, (BATCH, SEQ, HEADS, KV))
         out = out.reshape(b, s, self.inner_dim)
 
         # Output projection (N*H, M) with logical (HEADS, EMBED)
         # (`case6_attention.py:83-90`).
-        out = nn.Dense(
-            self.features,
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(self.kernel_init, (HEADS, EMBED)),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (EMBED,)
-            ),
-            name="out",
-        )(out)
+        out = self._dense(self.features, (HEADS, EMBED), "out")(out)
         out = nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
         if self.dropout_rate > 0.0:
             out = nn.Dropout(rate=self.dropout_rate, deterministic=deterministic)(out)

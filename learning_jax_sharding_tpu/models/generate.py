@@ -176,7 +176,7 @@ def make_generate_fn(
     eos_id: int | None = None,
     prefill_chunk_size: int | None = None,
     inference_dtype: Any | None = None,
-    dequantize: bool = False,
+    dequantize: bool | str = False,
 ):
     """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
 
@@ -225,7 +225,12 @@ def make_generate_fn(
     bound by KV-cache attention and per-step work, not weight reads).
     ``None`` keeps training dtypes.
 
-    ``dequantize``: the params are an int8 tree from
+    ``dequantize``: ``"fused"`` — the params are an int4 tree from
+    ``models.quantize.quantize_tree(bits=4)`` and every projection streams
+    the packed nibbles straight into its matmul via the fused Pallas kernel
+    (``ops/int4_matmul.py``): no dequantized weight array ever lands in HBM,
+    which removes the unpack-then-matmul traffic that made int4 slower than
+    int8 in round 1. ``True`` — the params are an int8 tree from
     ``models.quantize.quantize_tree``; they are dequantized INSIDE the jitted
     program (per step, next to the consuming matmuls), so HBM STORES int8 —
     the guaranteed win is weight memory (half of bf16). Whether the decode
@@ -236,12 +241,25 @@ def make_generate_fn(
     compute/dequant dtype; non-quantized leaves (embeddings, norms) are
     still cast to it eagerly.
     """
+    import dataclasses as _dc
+
+    if isinstance(dequantize, str) and dequantize != "fused":
+        raise ValueError(
+            f"dequantize must be False, True, or 'fused'; got {dequantize!r}"
+        )
+    fused = dequantize == "fused"
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
+    if fused:
+        # int4 trees apply VERBATIM through the fused dequant-matmul kernel
+        # (models/quantize.py::Int4Dense) — no in-jit dequantize_tree, no
+        # dequantized weights in HBM.
+        cfg = _dc.replace(cfg, quantization="int4")
     model = Transformer(cfg)
-    maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
+    maybe_cast = make_param_caster(inference_dtype, dequantize=bool(dequantize))
     # dequant dtype == inference_dtype when one was given (models.decoding)
     apply = make_cached_apply(
-        model, dequantize=dequantize, dequant_dtype=cfg.param_dtype
+        model, dequantize=bool(dequantize) and not fused,
+        dequant_dtype=cfg.param_dtype,
     )
 
     def step_apply(params, cache, tokens):

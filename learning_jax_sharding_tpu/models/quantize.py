@@ -38,6 +38,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
@@ -241,3 +242,116 @@ def map_unquantized(fn: Callable[[Any], Any], tree: Any) -> Any:
 def quantized_bytes(params: Any) -> int:
     """Total serving bytes of a (possibly partially) quantized tree."""
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+class Int4Dense(nn.Module):
+    """Drop-in for ``nn.Dense`` over an int4-quantized kernel, computed by
+    the FUSED dequant-matmul Pallas kernel (``ops/int4_matmul.py``) — the
+    packed nibbles stream straight into the dot, with no dequantized weight
+    array ever touching HBM.
+
+    Parameter layout matches :func:`quantize_tree` ``bits=4`` output
+    exactly: a child scope named ``"kernel"`` holding ``q4`` (uint8,
+    ``(K/2, N)``, split-half packed) and ``scale`` (fp32, ``(K/group, N)``)
+    — so a quantized tree applies VERBATIM, no key surgery. Constructed by
+    the transformer when ``TransformerConfig(quantization="int4")``; init
+    creates zero placeholders (real weights always come from
+    ``quantize_tree``).
+
+    Layouts the kernel cannot tile (odd group count — split-half packing
+    needs ``group | K/2``) fall back to ``dequantize_leaf_int4`` + XLA
+    matmul, trading the fusion win for generality.
+
+    SINGLE-DEVICE (or replicated) serving path: the pallas_call runs under
+    plain GSPMD, which cannot partition a custom call — on a tensor-parallel
+    mesh the packed weights would be gathered at the kernel boundary. For
+    multi-device int4 serving use ``dequantize=True`` (the XLA dequant path
+    shards fine); a shard_map-wrapped kernel is the follow-up.
+    """
+
+    features: int
+    use_bias: bool = False
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    group_size: int = 128
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul
+
+        k = x.shape[-1]
+        if k % 2:
+            raise ValueError(f"int4 packing needs an even contraction dim, got {k}")
+        g = min(self.group_size, k)
+
+        class _Kernel(nn.Module):
+            @nn.compact
+            def __call__(self):
+                q4 = self.param(
+                    "q4", nn.initializers.zeros_init(),
+                    (k // 2, features), jnp.uint8,
+                )
+                scale = self.param(
+                    "scale", nn.initializers.ones_init(),
+                    (k // g, features), jnp.float32,
+                )
+                return q4, scale
+
+        features = self.features
+        q4, scale = _Kernel(name="kernel")()
+        x = x.astype(self.dtype)
+        if scale.shape[0] == 1 or (k // 2) % g == 0:
+            y = int4_matmul(x, q4, scale, group=g)
+        else:
+            w = dequantize_leaf_int4({"q4": q4, "scale": scale}, self.dtype)
+            y = x @ w
+        if self.use_bias:
+            bias = self.param(
+                "bias", nn.initializers.zeros_init(), (features,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(y.dtype)
+        return y
+
+
+
+def projection_dense(
+    *,
+    quantization,
+    features: int,
+    kernel_axes: tuple,
+    use_bias: bool,
+    dtype: Any,
+    param_dtype: Any,
+    kernel_init: Callable,
+    name: str,
+    group_size: int = 128,
+    head_init_stddev: float | None = None,
+):
+    """THE dense/Int4Dense dispatch — every projection site (attention
+    q/k/v/out, FF up/down, lm_head) builds through here so the quantized
+    serving path cannot drift between modules."""
+    if quantization == "int4":
+        return Int4Dense(
+            features=features,
+            use_bias=use_bias,
+            dtype=dtype,
+            param_dtype=param_dtype,
+            group_size=group_size,
+            name=name,
+        )
+    if quantization is not None:
+        raise ValueError(
+            f"unknown quantization {quantization!r}: expected None or 'int4'"
+        )
+    return nn.Dense(
+        features,
+        use_bias=use_bias,
+        dtype=dtype,
+        param_dtype=param_dtype,
+        kernel_init=nn.with_logical_partitioning(kernel_init, kernel_axes),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (kernel_axes[-1],)
+        ),
+        name=name,
+    )

@@ -83,34 +83,31 @@ class FeedForward(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
+    quantization: Optional[str] = None       # "int4" → fused-kernel serving
+    quantization_group: int = 128
+
+    def _dense(self, features: int, kernel_axes, name: str):
+        from learning_jax_sharding_tpu.models.quantize import projection_dense
+
+        return projection_dense(
+            quantization=self.quantization,
+            features=features,
+            kernel_axes=kernel_axes,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+            group_size=self.quantization_group,
+            name=name,
+        )
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
-        h = nn.Dense(
-            self.hidden,
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(self.kernel_init, (EMBED, MLP)),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (MLP,)
-            ),
-            name="up",
-        )(x)
+        h = self._dense(self.hidden, (EMBED, MLP), "up")(x)
         h = nn.with_logical_constraint(h, (BATCH, SEQ, HIDDEN))
         h = nn.gelu(h)
-        out = nn.Dense(
-            self.features,
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=nn.with_logical_partitioning(self.kernel_init, (MLP, EMBED)),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), (EMBED,)
-            ),
-            name="down",
-        )(h)
+        out = self._dense(self.features, (MLP, EMBED), "down")(h)
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
 
 
@@ -173,6 +170,8 @@ class TransformerBlock(nn.Module):
                                   # models.attention.MultiHeadAttention)
     decode_block_k: Optional[int] = None
     decode_attn_fn: Optional[Callable] = None
+    quantization: Optional[str] = None   # "int4" → fused-kernel projections
+    quantization_group: int = 128
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
     scan: bool = False            # under nn.scan: return (x, None) pairs
 
@@ -203,6 +202,8 @@ class TransformerBlock(nn.Module):
             decode_attention=self.decode_attention,
             decode_block_k=self.decode_block_k,
             decode_attn_fn=self.decode_attn_fn,
+            quantization=self.quantization,
+            quantization_group=self.quantization_group,
             name="attn",
         )(h, deterministic=deterministic)
         h = make_norm(
@@ -228,6 +229,8 @@ class TransformerBlock(nn.Module):
                 use_bias=self.use_bias,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                quantization=self.quantization,
+                quantization_group=self.quantization_group,
                 name="ff",
             )(h)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
@@ -287,6 +290,11 @@ class TransformerConfig:
     decode_attn_fn: Optional[Callable] = None  # mesh-aware blocked-kernel
                                      # override (make_decode_attn_fn);
                                      # injected by the serving entry points
+    quantization: Optional[str] = None  # "int4": every projection consumes a
+                                     # quantize_tree(bits=4) tree verbatim
+                                     # through the fused dequant-matmul
+                                     # kernel (serving path; ops/int4_matmul)
+    quantization_group: int = 128    # must match quantize_tree group_size
 
     def __post_init__(self):
         # Fail fast on typos; 'nothing' IS the default, so only a policy that
@@ -463,6 +471,8 @@ class Transformer(nn.Module):
             decode_attention=cfg.decode_attention,
             decode_block_k=cfg.decode_block_k,
             decode_attn_fn=cfg.decode_attn_fn,
+            quantization=cfg.quantization,
+            quantization_group=cfg.quantization_group,
             norm=cfg.norm,
         )
         if cfg.scan_layers:
@@ -530,14 +540,17 @@ class Transformer(nn.Module):
             # chunk so the full (B, S, V) logits never materialize. (Init
             # runs with the default False, so lm_head params always exist.)
             return x
-        logits = nn.Dense(
-            cfg.vocab_size,
+        from learning_jax_sharding_tpu.models.quantize import projection_dense
+
+        logits = projection_dense(
+            quantization=cfg.quantization,
+            features=cfg.vocab_size,
+            kernel_axes=(EMBED, VOCAB),
             use_bias=False,
             dtype=cfg.dtype,
             param_dtype=cfg.param_dtype,
-            kernel_init=nn.with_logical_partitioning(
-                nn.initializers.normal(stddev=0.02), (EMBED, VOCAB)
-            ),
+            kernel_init=nn.initializers.normal(stddev=0.02),
+            group_size=cfg.quantization_group,
             name="lm_head",
         )(x)
         # Keep the vocab dim sharded (VOCAB→model under TP rules): replicating

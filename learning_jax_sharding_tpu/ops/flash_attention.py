@@ -35,28 +35,49 @@ LANES = 128
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps exp/max NaN-free
 
 
-def _row_ids(qi, block_q):
-    return qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+def _row_ids(qi, block_q, group=1):
+    """Query POSITION of each row in q-block ``qi``. Under GQA the q rows of
+    one kv head interleave ``group`` query heads per position (row r ↔
+    position r // group), so masks compare positions, not rows."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+    return rows // group if group > 1 else rows
 
 
 def _col_ids(ki, block_k):
     return ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
 
 
-def _run_condition(qi, ki, block_q, block_k, causal, window):
+def _run_condition(qi, ki, block_q, block_k, causal, window, group=1):
     """Does (q-block qi, k-block ki) contain any unmasked position?
 
     Causal skips strictly-future blocks; a sliding window additionally skips
-    blocks entirely BEFORE every query's window (col ≤ row - window)."""
-    run = (qi + 1) * block_q > ki * block_k if causal else True
+    blocks entirely BEFORE every query's window (col ≤ pos - window). Block
+    bounds are in row units; positions are rows // group (GQA row folding).
+    """
+    pos_max = ((qi + 1) * block_q - 1) // group
+    pos_min = (qi * block_q) // group
+    run = pos_max >= ki * block_k if causal else True
     if window is not None:
-        run = jnp.logical_and(run, (ki + 1) * block_k > qi * block_q - window + 1)
+        run = jnp.logical_and(run, (ki + 1) * block_k > pos_min - window + 1)
     return run
 
 
-def _block_mask(qi, ki, block_q, block_k, causal, window):
+def _interior(qi, ki, block_q, block_k, causal, window, group=1):
+    """Is block (qi, ki) fully unmasked (no causal-diagonal or window-edge
+    crossing)? Such blocks skip the mask's where pass entirely."""
+    pos_min = (qi * block_q) // group
+    pos_max = ((qi + 1) * block_q - 1) // group
+    col_max = (ki + 1) * block_k - 1
+    interior = pos_min >= col_max if causal else jnp.bool_(True)
+    if window is not None:
+        # every column inside every row's window: col_min > pos_max - window
+        interior = jnp.logical_and(interior, ki * block_k > pos_max - window)
+    return interior
+
+
+def _block_mask(qi, ki, block_q, block_k, causal, window, group=1):
     """In-block mask (True = keep), or None when nothing masks here."""
-    rows, cols = _row_ids(qi, block_q), _col_ids(ki, block_k)
+    rows, cols = _row_ids(qi, block_q, group), _col_ids(ki, block_k)
     mask = None
     if causal:
         mask = rows >= cols
@@ -78,17 +99,20 @@ def _block_mask(qi, ki, block_q, block_k, causal, window):
 # out-of-range steps.
 
 
-def _band_kstart(qi, block_q, block_k, window):
+def _band_kstart(qi, block_q, block_k, window, group=1):
     """First k-block intersecting q-block ``qi``'s window band."""
-    return jnp.maximum(0, (qi * block_q - (window - 1)) // block_k)
+    pos_min = (qi * block_q) // group
+    return jnp.maximum(0, (pos_min - (window - 1)) // block_k)
 
 
-def _band_qstart(ki, block_q, block_k):
-    """First q-block attending into k-block ``ki`` (causal: row ≥ col)."""
-    return (ki * block_k) // block_q
+def _band_qstart(ki, block_q, block_k, group=1):
+    """First q-block attending into k-block ``ki`` (causal: pos ≥ col)."""
+    return (ki * block_k * group) // block_q
 
 
-def _fwd_band_width(nq: int, nk: int, block_q: int, block_k: int, window: int) -> int:
+def _fwd_band_width(
+    nq: int, nk: int, block_q: int, block_k: int, window: int, group: int = 1
+) -> int:
     """Exact max k-blocks any q-block's (causal) window band touches.
 
     Computed by enumerating the (static) q blocks rather than a worst-case
@@ -98,33 +122,48 @@ def _fwd_band_width(nq: int, nk: int, block_q: int, block_k: int, window: int) -
     """
     width = 1
     for i in range(nq):
-        s = max(0, (i * block_q - (window - 1)) // block_k)
-        e = min(nk - 1, ((i + 1) * block_q - 1) // block_k)  # causal end
+        pos_min = (i * block_q) // group
+        pos_max = ((i + 1) * block_q - 1) // group
+        s = max(0, (pos_min - (window - 1)) // block_k)
+        e = min(nk - 1, pos_max // block_k)  # causal end
         width = max(width, e - s + 1)
     return width
 
 
-def _dkv_band_width(nq: int, nk: int, block_q: int, block_k: int, window: int) -> int:
+def _dkv_band_width(
+    nq: int, nk: int, block_q: int, block_k: int, window: int, group: int = 1
+) -> int:
     """Exact max q-blocks attending into any k-block (causal window)."""
     width = 1
     for i in range(nk):
-        s = (i * block_k) // block_q
-        e = min(nq - 1, (i * block_k + block_k - 1 + window - 1) // block_q)
+        s = (i * block_k * group) // block_q
+        last_pos = i * block_k + block_k - 1 + window - 1
+        e = min(nq - 1, (last_pos * group + group - 1) // block_q)
         width = max(width, e - s + 1)
     return width
 
 
-def _band_k_map(block_q: int, block_k: int, window: int, nk: int):
+def _band_k_map(block_q: int, block_k: int, window: int, nk: int, group: int = 1):
     """Clamped index map: grid step j → k-block within q-block i's band."""
     def k_map(b, i, j):
-        return (b, jnp.minimum(_band_kstart(i, block_q, block_k, window) + j, nk - 1), 0)
+        return (
+            b,
+            jnp.minimum(
+                _band_kstart(i, block_q, block_k, window, group) + j, nk - 1
+            ),
+            0,
+        )
     return k_map
 
 
-def _band_q_map(block_q: int, block_k: int, nq: int):
+def _band_q_map(block_q: int, block_k: int, nq: int, group: int = 1):
     """Clamped index map: grid step j → q-block attending into k-block i."""
     def q_map(b, i, j):
-        return (b, jnp.minimum(_band_qstart(i, block_q, block_k) + j, nq - 1), 0)
+        return (
+            b,
+            jnp.minimum(_band_qstart(i, block_q, block_k, group) + j, nq - 1),
+            0,
+        )
     return q_map
 
 
@@ -142,7 +181,7 @@ def _fwd_kernel(
                           # 2D blocks violate on real TPU)
     acc_ref, m_ref, l_ref,  # VMEM scratch
     *, scale: float, causal: bool, window, block_q: int, block_k: int,
-    nk: int, banded: bool,
+    nk: int, banded: bool, group: int,
 ):
     qi, kj = pl.program_id(1), pl.program_id(2)
     last_j = pl.num_programs(2) - 1
@@ -154,26 +193,16 @@ def _fwd_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
 
     if banded:
-        ki = _band_kstart(qi, block_q, block_k, window) + kj
+        ki = _band_kstart(qi, block_q, block_k, window, group) + kj
         run = jnp.logical_and(
-            ki < nk, _run_condition(qi, ki, block_q, block_k, causal, window)
+            ki < nk, _run_condition(qi, ki, block_q, block_k, causal, window, group)
         )
     else:
         ki = kj
         # With causal masking, blocks strictly in the future contribute nothing.
-        run = _run_condition(qi, ki, block_q, block_k, causal, window)
+        run = _run_condition(qi, ki, block_q, block_k, causal, window, group)
 
-    @pl.when(run)
-    def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (block_q, block_k)
-        mask = _block_mask(qi, ki, block_q, block_k, causal, window)
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
-
+    def _accumulate(s):
         m_prev = m_ref[:, :1]                      # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -181,13 +210,43 @@ def _fwd_kernel(
         correction = jnp.exp(m_prev - m_new)       # (block_q, 1)
         l_new = correction * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
 
-        v = v_ref[0].astype(jnp.float32)
+        v = v_ref[0]
         pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )  # (block_q, H)
         acc_ref[:] = acc_ref[:] * correction + pv
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    def _scores():
+        # Matmuls run at the INPUT dtype with fp32 accumulation: on bf16
+        # operands the MXU runs at full rate; products accumulate in fp32
+        # either way, and the scale folds in after the dot, exactly.
+        return jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+
+    mask = _block_mask(qi, ki, block_q, block_k, causal, window, group)
+    if mask is None:
+        @pl.when(run)
+        def _step():
+            _accumulate(_scores())
+    else:
+        # Only blocks crossing a mask edge (the causal diagonal / the window
+        # boundary) pay the where pass — interior blocks of the band are
+        # fully unmasked, and the extra VPU pass over the (block_q, block_k)
+        # scores is measurable at long S where the kernel is VPU-bound.
+        interior = _interior(qi, ki, block_q, block_k, causal, window, group)
+
+        @pl.when(jnp.logical_and(run, interior))
+        def _step_interior():
+            _accumulate(_scores())
+
+        @pl.when(jnp.logical_and(run, jnp.logical_not(interior)))
+        def _step_edge():
+            _accumulate(jnp.where(mask, _scores(), _NEG_INF))
 
     @pl.when(kj == last_j)
     def _finish():
@@ -198,7 +257,7 @@ def _fwd_kernel(
         lse_ref[0] = m_ref[:, :1] + jnp.log(safe_l)
 
 
-def _fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
+def _fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret, group=1):
     bn, s_q, h = q.shape
     s_kv = k.shape[1]
     nq, nk = pl.cdiv(s_q, block_q), pl.cdiv(s_kv, block_k)
@@ -206,11 +265,11 @@ def _fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
     banded = (
         window is not None
         and causal
-        and _fwd_band_width(nq, nk, block_q, block_k, window) < nk
+        and _fwd_band_width(nq, nk, block_q, block_k, window, group) < nk
     )
     if banded:
-        nkb = _fwd_band_width(nq, nk, block_q, block_k, window)
-        k_map = _band_k_map(block_q, block_k, window, nk)
+        nkb = _fwd_band_width(nq, nk, block_q, block_k, window, group)
+        k_map = _band_k_map(block_q, block_k, window, nk, group)
     else:
         nkb = nk
 
@@ -219,7 +278,7 @@ def _fwd(q, k, v, *, scale, causal, window, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, nk=nk, banded=banded,
+        block_q=block_q, block_k=block_k, nk=nk, banded=banded, group=group,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -257,7 +316,7 @@ def _bwd_dkv_kernel(
     dk_ref, dv_ref,
     dk_acc, dv_acc,
     *, scale: float, causal: bool, window, block_q: int, block_k: int,
-    nq: int, banded: bool,
+    nq: int, banded: bool, group: int,
 ):
     """k-major sweep: for one k/v block, accumulate dk/dv over the q blocks
     that attend into it (all of them, or the window band)."""
@@ -270,34 +329,36 @@ def _bwd_dkv_kernel(
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     if banded:
-        qi = _band_qstart(ki, block_q, block_k) + qj
+        qi = _band_qstart(ki, block_q, block_k, group) + qj
         run = jnp.logical_and(
-            qi < nq, _run_condition(qi, ki, block_q, block_k, causal, window)
+            qi < nq, _run_condition(qi, ki, block_q, block_k, causal, window, group)
         )
     else:
         qi = qj
-        run = _run_condition(qi, ki, block_q, block_k, causal, window)
+        run = _run_condition(qi, ki, block_q, block_k, causal, window, group)
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype matmul operands, fp32 accumulation (see _fwd_kernel).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                            # (block_q, 1)
         delta = delta_ref[0]                        # (block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        mask = _block_mask(qi, ki, block_q, block_k, causal, window)
+        ) * scale
+        mask = _block_mask(qi, ki, block_q, block_k, causal, window, group)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)                        # (block_q, block_k)
 
         # dv += pᵀ · do
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         # dp = do · vᵀ ; ds = p ∘ (dp − delta) ; dk += dsᵀ · q
         dp = jax.lax.dot_general(
@@ -305,12 +366,14 @@ def _bwd_dkv_kernel(
         )
         ds = p * (dp - delta)
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(qj == last_j)
     def _finish():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        # ds·q accumulated UNSCALED (native-dtype q); ds/dk = scale·q.
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
@@ -319,7 +382,7 @@ def _bwd_dq_kernel(
     dq_ref,
     dq_acc,
     *, scale: float, causal: bool, window, block_q: int, block_k: int,
-    nk: int, banded: bool,
+    nk: int, banded: bool, group: int,
 ):
     """q-major sweep: for one q block, accumulate dq over its k blocks
     (all of them, or the window band)."""
@@ -331,27 +394,28 @@ def _bwd_dq_kernel(
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     if banded:
-        ki = _band_kstart(qi, block_q, block_k, window) + kj
+        ki = _band_kstart(qi, block_q, block_k, window, group) + kj
         run = jnp.logical_and(
-            ki < nk, _run_condition(qi, ki, block_q, block_k, causal, window)
+            ki < nk, _run_condition(qi, ki, block_q, block_k, causal, window, group)
         )
     else:
         ki = kj
-        run = _run_condition(qi, ki, block_q, block_k, causal, window)
+        run = _run_condition(qi, ki, block_q, block_k, causal, window, group)
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # Native-dtype matmul operands, fp32 accumulation (see _fwd_kernel).
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                            # (block_q, 1)
         delta = delta_ref[0]                        # (block_q, 1)
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        mask = _block_mask(qi, ki, block_q, block_k, causal, window)
+        ) * scale
+        mask = _block_mask(qi, ki, block_q, block_k, causal, window, group)
         if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
         p = jnp.exp(s - lse)
@@ -361,7 +425,8 @@ def _bwd_dq_kernel(
         ds = p * (dp - delta)
         # dq += ds · k, then scaled at the end (d(q·scale)/dq = scale).
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(kj == last_j)
@@ -369,7 +434,7 @@ def _bwd_dq_kernel(
         dq_ref[0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
+def _bwd(scale, causal, window, block_q, block_k, interpret, group, residuals, do):
     q, k, v, out, lse = residuals
     bn, s_q, h = q.shape
     s_kv = k.shape[1]
@@ -386,11 +451,11 @@ def _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
     dkv_banded = (
         window is not None
         and causal
-        and _dkv_band_width(nq, nk, block_q, block_k, window) < nq
+        and _dkv_band_width(nq, nk, block_q, block_k, window, group) < nq
     )
     if dkv_banded:
-        nqb = _dkv_band_width(nq, nk, block_q, block_k, window)
-        q_map = _band_q_map(block_q, block_k, nq)
+        nqb = _dkv_band_width(nq, nk, block_q, block_k, window, group)
+        q_map = _band_q_map(block_q, block_k, nq, group)
     else:
         nqb = nq
 
@@ -409,6 +474,7 @@ def _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
         functools.partial(
             _bwd_dkv_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, nq=nq, banded=dkv_banded,
+            group=group,
         ),
         grid=(bn, nk, nqb),
         in_specs=common_specs,
@@ -430,11 +496,11 @@ def _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
     dq_banded = (
         window is not None
         and causal
-        and _fwd_band_width(nq, nk, block_q, block_k, window) < nk
+        and _fwd_band_width(nq, nk, block_q, block_k, window, group) < nk
     )
     if dq_banded:
-        nkb = _fwd_band_width(nq, nk, block_q, block_k, window)
-        k_map = _band_k_map(block_q, block_k, window, nk)
+        nkb = _fwd_band_width(nq, nk, block_q, block_k, window, group)
+        k_map = _band_k_map(block_q, block_k, window, nk, group)
     else:
         nkb = nk
 
@@ -445,6 +511,7 @@ def _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
         functools.partial(
             _bwd_dq_kernel, scale=scale, causal=causal, window=window,
             block_q=block_q, block_k=block_k, nk=nk, banded=dq_banded,
+            group=group,
         ),
         grid=(bn, nq, nkb),
         in_specs=[
@@ -491,26 +558,30 @@ def _auto_block(s: int, cap: int = 1024) -> int:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
 )
-def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret):
+def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret, group):
     out, _ = _fwd(
         q, k, v, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, group=group,
     )
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret, group):
     out, lse = _fwd(
         q, k, v, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, group=group,
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, window, block_q, block_k, interpret, residuals, do):
-    return _bwd(scale, causal, window, block_q, block_k, interpret, residuals, do)
+def _flash_bwd(
+    scale, causal, window, block_q, block_k, interpret, group, residuals, do
+):
+    return _bwd(
+        scale, causal, window, block_q, block_k, interpret, group, residuals, do
+    )
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -564,31 +635,51 @@ def flash_attention(
         if window < 1:
             raise ValueError(f"window must be >= 1, got {window}")
     b, s_q, n, h = q.shape
-    s_kv = k.shape[1]
+    s_kv, n_kv = k.shape[1], k.shape[2]
+    if n % n_kv:
+        raise ValueError(f"num_heads {n} not a multiple of kv heads {n_kv}")
+    group = n // n_kv
+    if group > 1 and s_q != s_kv:
+        raise ValueError("GQA flash requires matching q/kv sequence lengths")
+    rows_q = s_q * group
     if block_q is None:
-        block_q = _auto_block(s_q)
+        block_q = _auto_block(rows_q)
     if block_k is None:
         block_k = _auto_block(s_kv)
-    if s_q % block_q or s_kv % block_k:
-        block_q = min(block_q, s_q)
+    if rows_q % block_q or s_kv % block_k:
+        block_q = min(block_q, rows_q)
         block_k = min(block_k, s_kv)
-        if s_q % block_q or s_kv % block_k:
+        if rows_q % block_q or s_kv % block_k:
             raise ValueError(
                 f"sequence lengths ({s_q}, {s_kv}) must be divisible by "
                 f"block sizes ({block_q}, {block_k})"
             )
     scale = h**-0.5 if scale is None else scale
 
-    # (B, S, N, H) → (B·N, S, H): each (batch, head) slice is independent.
-    def to_bn(x):
-        b_, s_, n_, h_ = x.shape
-        return x.transpose(0, 2, 1, 3).reshape(b_ * n_, s_, h_)
+    # (B, S, N, H) → (B·N_kv, S·group, H): each (batch, kv-head) slice is
+    # independent; under GQA the group's query heads FOLD INTO THE ROW DIM
+    # (row r = position r // group), so k/v enter at their native N_kv heads
+    # — no repeat_kv materialization, and dk/dv reduce over the group for
+    # free in the kernel's q-row sweep. MHA is the group == 1 case.
+    def q_rows(x):
+        return (
+            x.reshape(b, s_q, n_kv, group, h)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(b * n_kv, rows_q, h)
+        )
+
+    def kv_rows(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * n_kv, s_kv, h)
 
     out = _flash(
-        to_bn(q), to_bn(k), to_bn(v), scale, causal, window,
-        block_q, block_k, interpret,
+        q_rows(q), kv_rows(k), kv_rows(v), scale, causal, window,
+        block_q, block_k, interpret, group,
     )
-    return out.reshape(b, n, s_q, h).transpose(0, 2, 1, 3)
+    return (
+        out.reshape(b, n_kv, s_q, group, h)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, s_q, n, h)
+    )
 
 
 def make_flash_attn_fn(mesh=None, rules=None, **kwargs) -> Any:
@@ -614,11 +705,27 @@ def make_flash_attn_fn(mesh=None, rules=None, **kwargs) -> Any:
             (BATCH, None, HEADS, None), tuple(rules)
         )
         in_spec = PartitionSpec(*axes)
+        heads_entry = axes[2]
+        if heads_entry is None:
+            heads_axis_size = 1
+        elif isinstance(heads_entry, (tuple, list)):
+            heads_axis_size = 1
+            for a in heads_entry:
+                heads_axis_size *= mesh.shape[a]
+        else:
+            heads_axis_size = mesh.shape[heads_entry]
 
     def attn_fn(q, k, v, *, causal: bool = False):
         fn = functools.partial(flash_attention, causal=causal, **kwargs)
         if mesh is None:
             return fn(q, k, v)
+        if k.shape[2] != q.shape[2] and k.shape[2] % heads_axis_size:
+            # GQA-native k/v whose kv-head count the heads mesh axis cannot
+            # divide: expand to full heads so the shard_map spec holds (the
+            # pre-GQA-native behavior; costs the repeat materialization).
+            reps = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
         # check_vma=False: pallas_call's out_shape carries no varying-axes
         # metadata, which the static replication checker requires.
         return jax.shard_map(
@@ -627,4 +734,7 @@ def make_flash_attn_fn(mesh=None, rules=None, **kwargs) -> Any:
             check_vma=False,
         )(q, k, v)
 
+    # The kernel reads grouped k/v at their native head count (row folding);
+    # the attention module checks this flag to skip repeat_kv entirely.
+    attn_fn.supports_gqa = True
     return attn_fn

@@ -91,3 +91,124 @@ class TestFlashBackward:
                 np.asarray(fg), np.asarray(dg), rtol=5e-4, atol=5e-5,
                 err_msg=f"d{name} mismatch",
             )
+
+
+class TestGQANativeFlash:
+    """GQA row folding: k/v enter at their native N_kv heads (group query
+    heads fold into kernel q rows), so no repeat_kv expansion materializes
+    and dk/dv reduce over the group inside the q-row sweep (VERDICT r1
+    item 3)."""
+
+    B, S, H = 2, 64, 16
+
+    @pytest.mark.parametrize(
+        "n_kv,group,causal,window",
+        [
+            (2, 3, True, None),   # GQA causal
+            (4, 2, False, None),  # GQA bidirectional
+            (2, 2, True, 16),     # GQA + sliding window (banded grid)
+            (1, 4, True, None),   # MQA
+        ],
+    )
+    def test_matches_dense_expanded(self, rng, n_kv, group, causal, window):
+        from learning_jax_sharding_tpu.ops.attention import (
+            causal_mask,
+            dot_product_attention,
+            sliding_window_mask,
+        )
+
+        n = n_kv * group
+        q = jnp.asarray(rng.normal(size=(self.B, self.S, n, self.H)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(self.B, self.S, n_kv, self.H)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(self.B, self.S, n_kv, self.H)), jnp.float32)
+        if window is not None:
+            mask = sliding_window_mask(self.S, window)
+        else:
+            mask = causal_mask(self.S) if causal else None
+
+        def expand(x):
+            return jnp.repeat(x, group, axis=2)
+
+        with jax.default_matmul_precision("float32"):
+            out = flash_attention(
+                q, k, v, causal=causal, window=window,
+                block_q=16, block_k=16, interpret=True,
+            )
+            ref = dot_product_attention(q, expand(k), expand(v), mask=mask)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)
+
+            def loss_flash(q, k, v):
+                return jnp.sum(
+                    flash_attention(
+                        q, k, v, causal=causal, window=window,
+                        block_q=16, block_k=16, interpret=True,
+                    ) ** 2
+                )
+
+            def loss_dense(q, k, v):
+                return jnp.sum(
+                    dot_product_attention(q, expand(k), expand(v), mask=mask) ** 2
+                )
+
+            gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+            gd = jax.grad(loss_dense, (0, 1, 2))(q, k, v)
+            for a, b in zip(gf, gd):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+    def test_head_divisibility_rejected(self, rng):
+        q = jnp.zeros((1, 8, 3, 8))
+        k = jnp.zeros((1, 8, 2, 8))
+        with pytest.raises(ValueError, match="not a multiple"):
+            flash_attention(q, k, k, interpret=True)
+
+    def test_model_skips_repeat_kv(self, rng):
+        """MultiHeadAttention hands native-width k/v to supports_gqa
+        backends; logits must match the dense GQA path."""
+        import dataclasses
+
+        from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY, Transformer
+        from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+
+        fn = make_flash_attn_fn(block_q=16, block_k=16, interpret=True)
+        assert fn.supports_gqa
+        base = dataclasses.replace(CONFIG_TINY, num_kv_heads=2)
+        cfg_flash = dataclasses.replace(base, attn_fn=fn)
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, base.vocab_size, (2, 32)),
+            jnp.int32,
+        )
+        with jax.default_matmul_precision("float32"):
+            params = Transformer(base).init({"params": jax.random.key(0)}, tokens)[
+                "params"
+            ]
+            want = Transformer(base).apply({"params": params}, tokens)
+            got = Transformer(cfg_flash).apply({"params": params}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32), atol=2e-4
+        )
+
+    def test_shard_map_indivisible_kv_heads_fall_back(self, rng, mesh22):
+        """HEADS→model axis that cannot divide N_kv: the mesh-aware wrapper
+        expands k/v to full heads before shard_map (correctness over the
+        native-width traffic win)."""
+        from learning_jax_sharding_tpu.ops.attention import (
+            causal_mask,
+            dot_product_attention,
+        )
+        from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+        n_kv, group, S, H = 3, 2, 32, 16     # 6 q heads ÷ 2 ok; 3 kv ÷ 2 not
+        q = jnp.asarray(rng.normal(size=(2, S, n_kv * group, H)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(2, S, n_kv, H)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2, S, n_kv, H)), jnp.float32)
+        fn = make_flash_attn_fn(
+            mesh22, RULES_DP_TP, block_q=16, block_k=16, interpret=True
+        )
+        with jax.default_matmul_precision("float32"):
+            out = jax.jit(lambda a, b, c: fn(a, b, c, causal=True))(q, k, v)
+            ref = dot_product_attention(
+                q, jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2),
+                mask=causal_mask(S),
+            )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4)

@@ -276,3 +276,83 @@ class TestInt4:
         params, _ = _trained_params(mesh22, rng)
         with pytest.raises(ValueError, match="bits"):
             quantize_tree(params, bits=2)
+
+
+class TestFusedInt4:
+    """Fused dequant-matmul serving (ops/int4_matmul.py + Int4Dense):
+    packed nibbles stream into the dot; parity with the materializing
+    dequant path is exact in structure (same int values, same scales)."""
+
+    def test_kernel_matches_dequant(self, rng):
+        from learning_jax_sharding_tpu.models.quantize import (
+            dequantize_leaf_int4,
+            quantize_leaf_int4,
+        )
+        from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul
+
+        for k, n, g in [(64, 48, 16), (256, 128, 128), (64, 48, 64)]:
+            w = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+            node = quantize_leaf_int4(w, group_size=g)
+            x = jnp.asarray(rng.normal(size=(4, k)), jnp.float32)
+            with jax.default_matmul_precision("float32"):
+                got = int4_matmul(
+                    x, node["q4"], node["scale"], group=min(g, k), interpret=True
+                )
+                want = x @ dequantize_leaf_int4(node, jnp.float32)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=1e-4
+            )
+
+    def test_kernel_validation(self, rng):
+        from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul
+
+        x = jnp.zeros((2, 64))
+        with pytest.raises(ValueError, match="contraction dim"):
+            int4_matmul(x, jnp.zeros((16, 8), jnp.uint8), jnp.ones((4, 8)))
+        with pytest.raises(ValueError, match="group"):
+            # 3 scale groups over K=96: group 32 does not divide K/2=48.
+            int4_matmul(
+                jnp.zeros((2, 96)), jnp.zeros((48, 8), jnp.uint8),
+                jnp.ones((3, 8)), group=32, interpret=True,
+            )
+
+    def test_fused_generate_matches_dequant(self, mesh22):
+        import dataclasses
+
+        import optax
+
+        from learning_jax_sharding_tpu.models.generate import make_generate_fn
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+        )
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+        from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+
+        cfg = dataclasses.replace(CONFIG_TINY, quantization_group=16)
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 8)),
+            jnp.int32,
+        )
+        x = put(np.asarray(prompt), mesh_sharding(mesh22, "data", None))
+        state, _ = sharded_train_state(
+            Transformer(cfg), optax.sgd(1e-2), x,
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_TP,
+        )
+        import flax.linen as nn
+
+        q4p = quantize_tree(nn.meta.unbox(state.params), bits=4, group_size=16)
+        with jax.default_matmul_precision("float32"):
+            out_deq = np.asarray(
+                make_generate_fn(
+                    cfg, mesh22, RULES_DP_TP, max_new_tokens=6, dequantize=True
+                )(q4p, prompt)
+            )
+            out_fused = np.asarray(
+                make_generate_fn(
+                    cfg, mesh22, RULES_DP_TP, max_new_tokens=6,
+                    dequantize="fused",
+                )(q4p, prompt)
+            )
+        np.testing.assert_array_equal(out_deq, out_fused)
