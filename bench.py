@@ -250,14 +250,19 @@ def bench_decode_125m():
         + decode_mbu(int8_bytes, secs_q / new)
     )
 
-    # int4 variant: nibble-packed, group-wise scales — the footprint point
-    # of the quantization ladder (quarter of bf16); decode pays the per-step
-    # unpack (PERF.md records the measured cost).
+    # int4 variant: nibble-packed, group-wise scales, served through the
+    # FUSED dequant-matmul kernel (ops/int4_matmul.py) — the footprint point
+    # of the quantization ladder (quarter of bf16); PERF.md records the
+    # measured VPU-unpack floor vs int8.
     q4params = quantize_tree(params, bits=4)
-    secs_q4 = time_fn(gen_q, q4params, prompt, jax.random.key(1), min_time=2.0)
+    gen_q4 = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
+        inference_dtype=jnp.bfloat16, dequantize="fused",
+    )
+    secs_q4 = time_fn(gen_q4, q4params, prompt, jax.random.key(1), min_time=2.0)
     int4_bytes = quantized_bytes(map_unquantized(to_bf16, q4params))
     _log(
-        f"[bench] 125M KV-cached decode, int4 weights (same shape): "
+        f"[bench] 125M KV-cached decode, int4 weights (fused kernel): "
         f"{toks / secs_q4:,.0f} tok/s, {secs_q4 / new * 1e3:.2f} ms/token-step, "
         f"served weight bytes {int4_bytes / 1e6:.0f} MB"
         + decode_mbu(int4_bytes, secs_q4 / new)
