@@ -17,8 +17,10 @@ sequence forward used only for timing (`/root/reference/case6_attention.py:
   unchanged — per-step collectives ride the same GSPMD annotations as
   training.
 
-Greedy (``temperature=0``), temperature, top-k, and nucleus (top-p) sampling
-are supported; the filters compose (k-truncation, then p-truncation).
+Greedy (``temperature=0``), temperature, top-k, nucleus (top-p), min-p, and
+vocab-limited sampling plus a CTRL-style repetition penalty are supported;
+filters compose vocab-limit → top-k → top-p → min-p (``filtered_logits`` is
+the single definition of the order, shared with speculative verification).
 """
 
 from __future__ import annotations

@@ -15,6 +15,7 @@ import optax
 import pytest
 
 from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+from learning_jax_sharding_tpu.parallel import build_mesh
 from learning_jax_sharding_tpu.models.transformer import (
     CONFIG_TINY_MOE,
     Transformer,
@@ -153,3 +154,31 @@ class TestMoETransformer:
     def test_param_count_scales_with_experts(self):
         dense = dataclasses.replace(CONFIG_TINY_MOE, num_experts=0)
         assert CONFIG_TINY_MOE.param_count > dense.param_count
+
+
+class TestMoEDecode:
+    def test_moe_generates_under_ep_rules(self):
+        """MoE models serve through the KV-cached decode path unchanged —
+        the routed FF is stateless, so prefill + token steps just work under
+        expert-parallel rules."""
+        from learning_jax_sharding_tpu.models.generate import make_generate_fn
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP_EP
+        from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+
+        mesh = build_mesh((2, 4), ("data", "model"), devices=jax.devices())
+        cfg = CONFIG_TINY_MOE
+        prompt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 8)),
+            jnp.int32,
+        )
+        x = put(np.asarray(prompt), mesh_sharding(mesh, "data", None))
+        state, _ = sharded_train_state(
+            Transformer(cfg), optax.sgd(1e-2), x,
+            {"params": jax.random.key(0)}, mesh, RULES_DP_TP_EP,
+        )
+        out = make_generate_fn(cfg, mesh, RULES_DP_TP_EP, max_new_tokens=6)(
+            state.params, prompt
+        )
+        assert out.shape == (4, 14)
+        assert np.asarray(out[:, :8] == np.asarray(prompt)).all()
