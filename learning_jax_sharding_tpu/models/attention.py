@@ -157,6 +157,8 @@ class MultiHeadAttention(nn.Module):
     # the fused dequant-matmul kernel (ops/int4_matmul.py) — packed nibbles
     # stream into the dot, no dequantized weights in HBM. None = nn.Dense.
     quantization_group: int = 128
+    quantized_matmul_fn: Optional[Callable] = None  # mesh-aware fused-int4
+                                         # matmul (make_int4_matmul_fn)
     decode_attn_fn: Optional[Callable] = None
     # Mesh-aware override for the blocked backend (shard_map-wrapped kernel
     # from ops.decode_attention.make_decode_attn_fn); None calls the kernel
@@ -196,6 +198,7 @@ class MultiHeadAttention(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=self.kernel_init,
             group_size=self.quantization_group,
+            quantized_matmul_fn=self.quantized_matmul_fn,
             name=name,
         )
 

@@ -254,8 +254,18 @@ def make_generate_fn(
     if fused:
         # int4 trees apply VERBATIM through the fused dequant-matmul kernel
         # (models/quantize.py::Int4Dense) — no in-jit dequantize_tree, no
-        # dequantized weights in HBM.
+        # dequantized weights in HBM. On >1-device meshes the kernel runs
+        # under shard_map with per-projection specs (GSPMD cannot partition
+        # the custom call and would gather the packed weights).
         cfg = _dc.replace(cfg, quantization="int4")
+        if mesh.size > 1:
+            from learning_jax_sharding_tpu.ops.int4_matmul import (
+                make_int4_matmul_fn,
+            )
+
+            cfg = _dc.replace(
+                cfg, quantized_matmul_fn=make_int4_matmul_fn(mesh, rules)
+            )
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=bool(dequantize))
     # dequant dtype == inference_dtype when one was given (models.decoding)

@@ -262,11 +262,13 @@ class Int4Dense(nn.Module):
     needs ``group | K/2``) fall back to ``dequantize_leaf_int4`` + XLA
     matmul, trading the fusion win for generality.
 
-    SINGLE-DEVICE (or replicated) serving path: the pallas_call runs under
-    plain GSPMD, which cannot partition a custom call — on a tensor-parallel
-    mesh the packed weights would be gathered at the kernel boundary. For
-    multi-device int4 serving use ``dequantize=True`` (the XLA dequant path
-    shards fine); a shard_map-wrapped kernel is the follow-up.
+    Multi-device serving: GSPMD cannot partition the pallas custom call, so
+    ``make_generate_fn`` injects ``matmul_fn`` (a shard_map wrapper from
+    ``ops.int4_matmul.make_int4_matmul_fn``) on >1-device meshes — q4
+    columns stay local at column-parallel sites, only activations gather at
+    row-parallel ones (test-pinned: no uint8 all-gather in the compiled
+    program). Without the injection the kernel runs direct (single device,
+    or GSPMD-replicated).
     """
 
     features: int
@@ -274,6 +276,11 @@ class Int4Dense(nn.Module):
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     group_size: int = 128
+    kernel_axes: tuple = (None, None)   # the projection's logical axes
+    matmul_fn: Any = None
+    # Mesh-aware override (ops.int4_matmul.make_int4_matmul_fn): shard_map
+    # around the kernel for tensor-parallel serving; None runs it direct
+    # (single-device, or GSPMD-replicated).
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -301,7 +308,12 @@ class Int4Dense(nn.Module):
         q4, scale = _Kernel(name="kernel")()
         x = x.astype(self.dtype)
         if scale.shape[0] == 1 or (k // 2) % g == 0:
-            y = int4_matmul(x, q4, scale, group=g)
+            if self.matmul_fn is not None:
+                y = self.matmul_fn(
+                    x, q4, scale, group=g, kernel_axes=self.kernel_axes
+                )
+            else:
+                y = int4_matmul(x, q4, scale, group=g)
         else:
             w = dequantize_leaf_int4({"q4": q4, "scale": scale}, self.dtype)
             y = x @ w
@@ -326,7 +338,7 @@ def projection_dense(
     kernel_init: Callable,
     name: str,
     group_size: int = 128,
-    head_init_stddev: float | None = None,
+    quantized_matmul_fn: Callable | None = None,
 ):
     """THE dense/Int4Dense dispatch — every projection site (attention
     q/k/v/out, FF up/down, lm_head) builds through here so the quantized
@@ -338,6 +350,8 @@ def projection_dense(
             dtype=dtype,
             param_dtype=param_dtype,
             group_size=group_size,
+            kernel_axes=tuple(kernel_axes),
+            matmul_fn=quantized_matmul_fn,
             name=name,
         )
     if quantization is not None:

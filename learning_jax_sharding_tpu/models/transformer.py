@@ -85,6 +85,7 @@ class FeedForward(nn.Module):
     kernel_init: Callable = nn.initializers.lecun_normal()
     quantization: Optional[str] = None       # "int4" → fused-kernel serving
     quantization_group: int = 128
+    quantized_matmul_fn: Optional[Callable] = None
 
     def _dense(self, features: int, kernel_axes, name: str):
         from learning_jax_sharding_tpu.models.quantize import projection_dense
@@ -98,6 +99,7 @@ class FeedForward(nn.Module):
             param_dtype=self.param_dtype,
             kernel_init=self.kernel_init,
             group_size=self.quantization_group,
+            quantized_matmul_fn=self.quantized_matmul_fn,
             name=name,
         )
 
@@ -172,6 +174,7 @@ class TransformerBlock(nn.Module):
     decode_attn_fn: Optional[Callable] = None
     quantization: Optional[str] = None   # "int4" → fused-kernel projections
     quantization_group: int = 128
+    quantized_matmul_fn: Optional[Callable] = None
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
     scan: bool = False            # under nn.scan: return (x, None) pairs
 
@@ -204,6 +207,7 @@ class TransformerBlock(nn.Module):
             decode_attn_fn=self.decode_attn_fn,
             quantization=self.quantization,
             quantization_group=self.quantization_group,
+            quantized_matmul_fn=self.quantized_matmul_fn,
             name="attn",
         )(h, deterministic=deterministic)
         h = make_norm(
@@ -231,6 +235,7 @@ class TransformerBlock(nn.Module):
                 param_dtype=self.param_dtype,
                 quantization=self.quantization,
                 quantization_group=self.quantization_group,
+                quantized_matmul_fn=self.quantized_matmul_fn,
                 name="ff",
             )(h)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
@@ -295,6 +300,9 @@ class TransformerConfig:
                                      # through the fused dequant-matmul
                                      # kernel (serving path; ops/int4_matmul)
     quantization_group: int = 128    # must match quantize_tree group_size
+    quantized_matmul_fn: Optional[Callable] = None  # mesh-aware fused-int4
+                                     # matmul (make_int4_matmul_fn); injected
+                                     # by make_generate_fn on >1-device meshes
 
     def __post_init__(self):
         # Fail fast on typos; 'nothing' IS the default, so only a policy that
@@ -473,6 +481,7 @@ class Transformer(nn.Module):
             decode_attn_fn=cfg.decode_attn_fn,
             quantization=cfg.quantization,
             quantization_group=cfg.quantization_group,
+            quantized_matmul_fn=cfg.quantized_matmul_fn,
             norm=cfg.norm,
         )
         if cfg.scan_layers:
@@ -551,6 +560,7 @@ class Transformer(nn.Module):
             param_dtype=cfg.param_dtype,
             kernel_init=nn.initializers.normal(stddev=0.02),
             group_size=cfg.quantization_group,
+            quantized_matmul_fn=cfg.quantized_matmul_fn,
             name="lm_head",
         )(x)
         # Keep the vocab dim sharded (VOCAB→model under TP rules): replicating

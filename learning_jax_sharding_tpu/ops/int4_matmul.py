@@ -147,3 +147,72 @@ def int4_matmul(
     if pad:
         out = out[:m]
     return out.reshape(*lead, n)
+
+
+def make_int4_matmul_fn(mesh, rules):
+    """Mesh-aware int4 matmul for tensor-parallel fused serving.
+
+    GSPMD cannot partition the pallas custom call, so without this a TP
+    mesh gathers the packed WEIGHTS at every projection. The returned
+    ``fn(x, q4, scale, *, group, kernel_axes)`` runs the kernel under
+    ``shard_map`` with specs derived from the projection's LOGICAL kernel
+    axes: a column-parallel site (output axis mapped) keeps its q4 columns
+    local and emits a column-sharded output with NO collective; a
+    row-parallel site (contraction axis mapped) all-gathers its ACTIVATION
+    columns — bytes per step: B·K activations vs the K·N weights GSPMD
+    would move — and runs the replicated q4 whole (the int4 packed tree
+    never shards its contraction dim: split-half packing folds row r with
+    row r + K/2, see ``models/quantize.py``).
+    Injected into ``Int4Dense`` by ``make_generate_fn(dequantize="fused")``.
+    """
+    from flax.linen import partitioning as nn_partitioning
+    from jax.sharding import PartitionSpec
+
+    from learning_jax_sharding_tpu.parallel.logical import BATCH
+
+    rules_t = tuple(rules)
+
+    def to_axis(logical):
+        if logical is None:
+            return None
+        return nn_partitioning.logical_to_mesh_axes((logical,), rules_t)[0]
+
+    def names(ax):
+        if ax is None:
+            return set()
+        return set(ax) if isinstance(ax, (tuple, list)) else {ax}
+
+    def fn(x, q4, scale, *, group, kernel_axes):
+        ax_in = to_axis(kernel_axes[0])
+        ax_out = to_axis(kernel_axes[1])
+        batch_ax = to_axis(BATCH)
+        # A spec may name each mesh axis once; when a weight axis collides
+        # with the batch axis (FSDP maps EMBED→data), drop the weight-side
+        # entry everywhere it appears — q4 enters replicated over that axis
+        # and GSPMD reshards around the call. (Dropping it from the output
+        # alone would mislabel per-device column partials as replicated.)
+        if names(ax_in) & names(batch_ax):
+            ax_in = None
+        if names(ax_out) & names(batch_ax):
+            ax_out = None
+        x_spec = PartitionSpec(batch_ax, *([None] * (x.ndim - 2)), ax_in)
+        w_spec = PartitionSpec(None, ax_out)
+        out_spec = PartitionSpec(batch_ax, *([None] * (x.ndim - 2)), ax_out)
+
+        def body(x_l, q4_l, s_l):
+            if ax_in is not None:
+                # Row-parallel: gather the activation columns (cheap) so the
+                # kernel sees the full contraction against replicated q4.
+                x_l = jax.lax.all_gather(
+                    x_l, ax_in, axis=x_l.ndim - 1, tiled=True
+                )
+            return int4_matmul(x_l, q4_l, s_l, group=group)
+
+        # check_vma=False: pallas_call's out_shape carries no varying-axes
+        # metadata, which the static replication checker requires.
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(x_spec, w_spec, w_spec),
+            out_specs=out_spec, check_vma=False,
+        )(x, q4, scale)
+
+    return fn

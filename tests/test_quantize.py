@@ -356,3 +356,76 @@ class TestFusedInt4:
                 )(q4p, prompt)
             )
         np.testing.assert_array_equal(out_deq, out_fused)
+
+    def test_tp_fused_never_gathers_packed_weights(self, mesh22):
+        """On a TP mesh the injected shard_map (make_int4_matmul_fn) keeps
+        q4 columns local (column-parallel) or replicated (row-parallel) and
+        gathers only ACTIVATIONS — the compiled program must contain no
+        uint8 all-gather (packed weights are the only u8 arrays)."""
+        import dataclasses
+        import re
+
+        import flax.linen as nn
+
+        from learning_jax_sharding_tpu.models.generate import make_generate_fn
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+        )
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+        cfg = dataclasses.replace(CONFIG_TINY, quantization_group=16)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 8)),
+            jnp.int32,
+        )
+        params = nn.meta.unbox(
+            Transformer(cfg).init({"params": jax.random.key(0)}, prompt)["params"]
+        )
+        q4p = quantize_tree(params, bits=4, group_size=16)
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=4, dequantize="fused"
+        )
+        hlo = gen.jitted.lower(q4p, prompt, jax.random.key(1)).compile().as_text()
+        gathers = re.findall(r"\bu8\[[^\]]*\][^\n]*all-gather", hlo)
+        gathers += re.findall(r"all-gather[^\n]*\bu8\[", hlo)
+        assert not gathers, f"packed int4 weights gathered: {gathers[:3]}"
+
+    def test_fused_under_fsdp_rules(self, rng):
+        """FSDP maps EMBED→data, colliding with the batch axis inside one
+        spec — the injected shard_map drops the weight-side entry and the
+        tokens still match the single-device fused path."""
+        import dataclasses
+
+        import flax.linen as nn
+
+        from learning_jax_sharding_tpu.models.generate import make_generate_fn
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+        )
+        from learning_jax_sharding_tpu.parallel import build_mesh
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP, RULES_FSDP
+
+        cfg = dataclasses.replace(CONFIG_TINY, quantization_group=16)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 8)),
+            jnp.int32,
+        )
+        params = nn.meta.unbox(
+            Transformer(cfg).init({"params": jax.random.key(0)}, prompt)["params"]
+        )
+        q4p = quantize_tree(params, bits=4, group_size=16)
+        with jax.default_matmul_precision("float32"):
+            single = make_generate_fn(
+                cfg,
+                build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1]),
+                RULES_DP_TP, max_new_tokens=6, dequantize="fused",
+            )
+            fsdp = make_generate_fn(
+                cfg, build_mesh((2, 4), ("data", "model")), RULES_FSDP,
+                max_new_tokens=6, dequantize="fused",
+            )
+            np.testing.assert_array_equal(
+                np.asarray(single(q4p, prompt)), np.asarray(fsdp(q4p, prompt))
+            )
