@@ -74,9 +74,12 @@ def _auto_block_m(m: int, k: int, itemsize: int) -> int:
     rows = max(8, int(4e6 // (k * itemsize)) // 8 * 8)
     if m <= rows:
         return m
-    while m % rows:
-        rows -= 8
-    return max(rows, 8)
+    # The caller pads x to a block_m multiple and slices the output, so the
+    # tile need not divide m (the old divisor search crashed on odd prefill
+    # lengths); balancing m over ceil(m/rows) tiles keeps the pad under 8
+    # rows instead of up to a whole tile.
+    n_tiles = -(-m // rows)
+    return -(-(-(-m // n_tiles)) // 8) * 8
 
 
 def int4_matmul(
@@ -113,6 +116,12 @@ def int4_matmul(
         raise ValueError(
             f"group {group} must divide half the contraction dim {k_half} "
             f"(split-half packing puts rows r and r + K/2 in one byte)"
+        )
+    if ng != 1 and ng * group != k:
+        raise ValueError(
+            f"scale rows {ng} inconsistent with group {group} over K={k}: "
+            f"expected K/group = {k // group} groups (or 1 whole-K group). "
+            f"The tree was likely quantized with a different group_size."
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -315,6 +315,38 @@ class TestFusedInt4:
                 jnp.zeros((2, 96)), jnp.zeros((48, 8), jnp.uint8),
                 jnp.ones((3, 8)), group=32, interpret=True,
             )
+        with pytest.raises(ValueError, match="quantized with a different"):
+            # Tree built with group_size=64 (4 scale rows over K=256) but the
+            # kernel told group=128: must fail loudly, not mis-scale.
+            int4_matmul(
+                jnp.zeros((2, 256)), jnp.zeros((128, 8), jnp.uint8),
+                jnp.ones((4, 8)), group=128, interpret=True,
+            )
+
+    def test_long_odd_prefill_rows(self, rng):
+        """m beyond the VMEM row budget and not a multiple of 8 (advisor
+        round-2 finding: the old divisor search hit m % 0). The caller pads
+        to the tile and slices, so any odd prefill length must work."""
+        from learning_jax_sharding_tpu.models.quantize import (
+            dequantize_leaf_int4,
+            quantize_leaf_int4,
+        )
+        from learning_jax_sharding_tpu.ops.int4_matmul import (
+            _auto_block_m,
+            int4_matmul,
+        )
+
+        assert _auto_block_m(1001, 3072, 2) > 0
+        k, n = 3072, 128
+        w = jnp.asarray(rng.normal(size=(k, n)), jnp.float32)
+        node = quantize_leaf_int4(w, group_size=128)
+        x = jnp.asarray(rng.normal(size=(1001, k)), jnp.float32)
+        with jax.default_matmul_precision("float32"):
+            got = int4_matmul(x, node["q4"], node["scale"], interpret=True)
+            want = x @ dequantize_leaf_int4(node, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=5e-3, rtol=1e-4
+        )
 
     def test_fused_generate_matches_dequant(self, mesh22):
         import dataclasses
