@@ -103,41 +103,27 @@ def bench_attention(dtype, label):
     return tflops
 
 
-def bench_transformer_125m():
-    """North-star context: composed 125M transformer train step, MFU.
-
-    Tuned TPU configuration (each measured on the v5e, b=8 s=1024):
-    * Pallas flash attention, auto block sizes — the dense path's fp32
-      (B, N, S, S) score traffic is the single largest time sink (~26 ms of a
-      102 ms step);
-    * chunked fused cross-entropy head — the full (B, S, V) logits never
-      materialize (~3 ms, and the memory headroom for bigger batches);
-    * MFU from analytic model FLOPs (``TransformerConfig.train_step_flops``):
-      XLA cost analysis cannot see Pallas/scan FLOPs.
+def _timed_train_step(cfg, *, b=8, s=1024, K=8):
+    """Shared sustained train-step harness for the dense and MoE context
+    lines: K full optimizer steps per jitted call (lax.scan, state carried
+    in place — the regime ``fit()`` runs; single-call timing cannot donate,
+    which charges every step a ~2.7 ms fp32 state copy real training never
+    pays), measured drift-robustly — the tunneled chip drifts ±30% across
+    seconds-scale windows (PERF.md methodology), which in round 2 cost the
+    bench artifact 4 ms/step vs the same path measured in-session. Longer
+    chains (≥4 s per run) average the drift; 5 pairs give the median teeth.
     """
-    import dataclasses
-
     from learning_jax_sharding_tpu.models.transformer import fused_next_token_loss
-    from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
 
     mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
-    cfg = dataclasses.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
-    model = Transformer(cfg)
-    b, s = 8, 1024
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
     sh = mesh_sharding(mesh, "data", None)
     batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
     state, state_sh = sharded_train_state(
-        model, optax.adamw(3e-4), batch["inputs"],
+        Transformer(cfg), optax.adamw(3e-4), batch["inputs"],
         {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
     )
-    # Sustained-training regime: K full optimizer steps per jitted call
-    # (lax.scan, state carried in place). Single-call timing cannot donate
-    # (the harness reuses its inputs), which charges every step a full fp32
-    # state copy ≈ 2.7 ms that real training (fit()'s donating loop) never
-    # pays. Per-step K batches, as training would consume.
-    K = 8
     stacked = {
         k: put(
             np.stack([np.asarray(v)] * K),
@@ -152,9 +138,30 @@ def bench_transformer_125m():
         steps_per_call=K,
     )
     result = measure(
-        step, state, stacked, flops=cfg.train_step_flops(b, s) * K, n_devices=1
+        step, state, stacked, flops=cfg.train_step_flops(b, s) * K,
+        n_devices=1, min_time=4.0, repeats=5,
     )
-    per_step = result.seconds_per_iter / K
+    return result, result.seconds_per_iter / K, K
+
+
+def bench_transformer_125m():
+    """North-star context: composed 125M transformer train step, MFU.
+
+    Tuned TPU configuration (each measured on the v5e, b=8 s=1024):
+    * Pallas flash attention, auto block sizes — the dense path's fp32
+      (B, N, S, S) score traffic is the single largest time sink (~26 ms of a
+      102 ms step);
+    * chunked fused cross-entropy head — the full (B, S, V) logits never
+      materialize (~3 ms, and the memory headroom for bigger batches);
+    * MFU from analytic model FLOPs (``TransformerConfig.train_step_flops``):
+      XLA cost analysis cannot see Pallas/scan FLOPs.
+    """
+    import dataclasses
+
+    from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+
+    cfg = dataclasses.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
+    result, per_step, K = _timed_train_step(cfg)
     msg = f"[bench] 125M transformer train step: {per_step * 1e3:.1f} ms/step"
     if result.tflops_per_chip is not None:
         msg += f", {result.tflops_per_chip:.1f} TFLOP/s/chip"
@@ -269,6 +276,82 @@ def bench_decode_125m():
     )
 
 
+def bench_reference_configs():
+    """BASELINE.md's remaining config list, one line each on the real chip.
+
+    The reference shapes are lesson-sized (A(4,16)·B(16,4) — microseconds of
+    work), so each pattern is measured at a ×512-scaled shape that keeps the
+    MXU busy; the multi-device sharding/collective semantics of these cases
+    are pinned on the emulated 8-device mesh in tests/test_matmul_shardings.py
+    (HLO collective asserts) — one chip runs each pattern's compute
+    degenerate.
+
+    * case1a replicated matmul (`/root/reference/case1a.py:49`)
+    * case3 fully-sharded matmul pattern (`/root/reference/case3_fully_sharded.py:23-29`)
+    * case4 DP×MP feed-forward einsum (`/root/reference/case4_gspmd_ff.py:30,52`)
+    """
+    from learning_jax_sharding_tpu.utils.bench import time_fn
+
+    peak = device_peak_flops(jax.devices()[0])
+    rng = np.random.default_rng(0)
+
+    def line(label, fn, *args, flops):
+        secs = time_fn(jax.jit(fn), *args, min_time=1.0)
+        tf = flops / secs / 1e12
+        pct = f" ({tf * 1e12 / peak:.0%} peak)" if peak else ""
+        _log(f"[bench] {label}: {secs * 1e6:.0f} us, {tf:.1f} TFLOP/s/chip{pct}")
+
+    m, k_, n = 2048, 8192, 2048
+    a = jnp.asarray(rng.standard_normal((m, k_)), jnp.bfloat16)
+    bmat = jnp.asarray(rng.standard_normal((k_, n)), jnp.bfloat16)
+    line(
+        "case1a replicated matmul (2048x8192x2048 bf16, 1-chip degenerate)",
+        jax.lax.dot, a, bmat, flops=2 * m * k_ * n,
+    )
+    line(
+        "case3 fully-sharded matmul pattern (same shapes, fp32-accum)",
+        lambda x, y: jax.lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ),
+        a, bmat, flops=2 * m * k_ * n,
+    )
+    bb, s, d, h = 8, 512, 2048, 8192
+    x = jnp.asarray(rng.standard_normal((bb, s, d)), jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((d, h)), jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((h, d)), jnp.bfloat16)
+
+    def ff(x, w1, w2):
+        return jnp.einsum("bsh,hd->bsd", jax.nn.relu(jnp.einsum("bsd,dh->bsh", x, w1)), w2)
+
+    line(
+        "case4 DP*MP feed-forward (8x512x2048, hidden 8192, bf16)",
+        ff, x, w1, w2, flops=2 * bb * s * d * h * 2,
+    )
+
+
+def bench_moe_125m():
+    """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
+    capacity routing, fp32 router — models/moe.py), same harness as the
+    dense 125M step. MFU uses activated-FLOPs (top_k expert FFs + router),
+    the honest denominator for routed models."""
+    import dataclasses
+
+    from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+
+    cfg = dataclasses.replace(
+        CONFIG_125M, attn_fn=make_flash_attn_fn(), num_experts=8, moe_top_k=2,
+    )
+    result, per_step, _ = _timed_train_step(cfg, K=4)
+    msg = (
+        f"[bench] 125M-class MoE (E=8, top-2) train step: "
+        f"{per_step * 1e3:.1f} ms/step"
+    )
+    if result.mfu is not None:
+        msg += f", activated-MFU={result.mfu:.1%}"
+    _log(msg)
+
+
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.
 
@@ -320,6 +403,14 @@ def main():
         bench_decode_125m()
     except Exception as e:
         _log(f"[bench] 125M decode bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_moe_125m()
+    except Exception as e:
+        _log(f"[bench] MoE bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_reference_configs()
+    except Exception as e:
+        _log(f"[bench] reference-config bench skipped: {type(e).__name__}: {e}")
 
     vs_baseline = (ours / baseline) if (ours and baseline) else None
     print(json.dumps({

@@ -73,6 +73,21 @@ def quantize_kv_chunk(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
     return scale, q
 
 
+def row_update(buf: jax.Array, chunk: jax.Array, idx: jax.Array, *, seq_dim: int) -> jax.Array:
+    """Write ``chunk`` into ``buf`` at a PER-ROW offset along ``seq_dim``
+    (both batch-leading): row ``b``'s chunk lands at ``idx[b]`` — the ragged
+    cache write, where every sequence in the batch sits at its own length.
+    A vmapped ``dynamic_update_slice`` (lowers to one scatter); the scalar
+    path keeps its plain ``dynamic_update_slice``."""
+
+    def one(b_buf, b_chunk, i):
+        starts = [0] * b_buf.ndim
+        starts[seq_dim - 1] = i
+        return jax.lax.dynamic_update_slice(b_buf, b_chunk, tuple(starts))
+
+    return jax.vmap(one)(buf, chunk, idx)
+
+
 def repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
     """Broadcast grouped k/v heads ``(B, S, N_kv, H)`` to ``num_heads``.
 
@@ -163,6 +178,13 @@ class MultiHeadAttention(nn.Module):
     # Mesh-aware override for the blocked backend (shard_map-wrapped kernel
     # from ops.decode_attention.make_decode_attn_fn); None calls the kernel
     # directly (single-device, or GSPMD-replicated).
+    decode_ragged: bool = False
+    # Per-ROW cache positions: ``cache_index`` is (B,), writes scatter each
+    # row's chunk at its own offset, and masks/rope use per-row positions —
+    # mixed-length prompt batches (the normal serving case) become
+    # expressible, and rows advance independently (a finished row passes
+    # chunk_lengths 0 and stops consuming cache). False keeps the scalar
+    # rectangular machinery (no scatter on the hot path).
 
     @property
     def inner_dim(self) -> int:
@@ -210,8 +232,21 @@ class MultiHeadAttention(nn.Module):
         return self._dense(heads * self.head_dim, (EMBED, HEADS), name)
 
     @nn.compact
-    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+    def __call__(
+        self,
+        x: jax.Array,
+        *,
+        deterministic: bool = True,
+        chunk_lengths: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """``chunk_lengths``: ragged decode only — per-row count of VALID
+        tokens in this chunk (prefill: the prompt lengths; a frozen row
+        passes 0). Drives how far each row's cache index advances; the
+        chunk's padded tail is still written but never attended (causal
+        masks stop at each row's index)."""
         b, s, m = x.shape
+        if chunk_lengths is not None and not self.decode_ragged:
+            raise ValueError("chunk_lengths requires decode_ragged=True")
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
         q = self._proj("query", self.num_heads)(x)
@@ -239,16 +274,20 @@ class MultiHeadAttention(nn.Module):
                 # advances) this variable; during init it doesn't exist yet
                 # and the chunk starts at position 0.
                 idx = self.get_variable(
-                    "cache", "cache_index", jnp.zeros((), jnp.int32)
+                    "cache", "cache_index",
+                    jnp.zeros((b,) if self.decode_ragged else (), jnp.int32),
                 )
-                positions = idx + jnp.arange(s)
+                if self.decode_ragged:
+                    positions = idx[:, None] + jnp.arange(s)   # (B, S)
+                else:
+                    positions = idx + jnp.arange(s)
             else:
                 positions = jnp.arange(s)
             q = apply_rope(q, positions, self.rope_theta)
             k = apply_rope(k, positions, self.rope_theta)
 
         if self.decode:
-            out = self._cached_attention(q, k, v)
+            out = self._cached_attention(q, k, v, chunk_lengths)
         elif self.attn_fn is None:
             if self.window is not None:
                 if not self.causal:
@@ -292,7 +331,17 @@ class MultiHeadAttention(nn.Module):
             out = nn.Dropout(rate=self.dropout_rate, deterministic=deterministic)(out)
         return out
 
-    def _cached_attention(self, q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    def _advance(self, cache_index, s: int, chunk_lengths) -> jax.Array:
+        """Read the index, advance it by the chunk's VALID length — ``s``
+        (rectangular), or per-row ``chunk_lengths`` (ragged: prefill passes
+        prompt lengths, a frozen row passes 0 and stops consuming cache)."""
+        idx = cache_index.value
+        cache_index.value = idx + (s if chunk_lengths is None else chunk_lengths)
+        return idx
+
+    def _cached_attention(
+        self, q: jax.Array, k: jax.Array, v: jax.Array, chunk_lengths=None
+    ) -> jax.Array:
         """Autoregressive attention against an in-module KV cache.
 
         The cache (absent from the reference, which has no inference path —
@@ -303,6 +352,12 @@ class MultiHeadAttention(nn.Module):
         prompt prefill (S = prompt length) and single-token decode (S = 1).
         Shapes stay static (attention always spans the whole cache buffer):
         XLA compiles exactly two executables for the whole generate loop.
+
+        ``decode_ragged``: the index is per-row ``(B,)`` — writes scatter
+        each row's chunk at its own offset and the causal mask compares
+        per-row positions, so mixed-length batches attend exactly their own
+        valid prefixes (padded prefill rows produce garbage outputs that
+        the caller discards by gathering logits at each row's length).
         """
         if self.attn_fn is not None:
             raise ValueError(
@@ -313,9 +368,10 @@ class MultiHeadAttention(nn.Module):
         if self.max_decode_len <= 0:
             raise ValueError("decode=True requires max_decode_len > 0")
         if resolve_decode_backend(self.decode_attention) == "blocked":
-            return self._blocked_cached_attention(q, k, v)
+            return self._blocked_cached_attention(q, k, v, chunk_lengths)
         b, s, n, h = q.shape
         n_kv = k.shape[2]  # GQA caches only the k/v heads — the GQA win
+        ragged = self.decode_ragged
         length = self.max_decode_len
         store = self.kv_cache_dtype if self.kv_cache_dtype is not None else self.dtype
         quantized = store == jnp.int8
@@ -327,7 +383,8 @@ class MultiHeadAttention(nn.Module):
             "cache", "cached_value", jnp.zeros, (b, length, n_kv, h), store
         )
         cache_index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index",
+            lambda: jnp.zeros((b,) if ragged else (), jnp.int32),
         )
         if quantized:
             # Symmetric per-(token, kv-head) scales, written with the chunk.
@@ -341,12 +398,22 @@ class MultiHeadAttention(nn.Module):
         def write(var, chunk, scale_var=None):
             if quantized:
                 scale, chunk = quantize_kv_chunk(chunk)
-                scale_var.value = jax.lax.dynamic_update_slice(
-                    scale_var.value, scale, (0, idx, 0)
+                if ragged:
+                    scale_var.value = row_update(
+                        scale_var.value, scale, idx, seq_dim=1
+                    )
+                else:
+                    scale_var.value = jax.lax.dynamic_update_slice(
+                        scale_var.value, scale, (0, idx, 0)
+                    )
+            if ragged:
+                var.value = row_update(
+                    var.value, chunk.astype(store), idx, seq_dim=1
                 )
-            var.value = jax.lax.dynamic_update_slice(
-                var.value, chunk.astype(store), (0, idx, 0, 0)
-            )
+            else:
+                var.value = jax.lax.dynamic_update_slice(
+                    var.value, chunk.astype(store), (0, idx, 0, 0)
+                )
 
         def read(var, scale_var=None):
             full = var.value
@@ -359,25 +426,29 @@ class MultiHeadAttention(nn.Module):
                 n,
             )
 
-        idx = cache_index.value
+        idx = self._advance(cache_index, s, chunk_lengths)
         write(cached_k, k, k_scale if quantized else None)
         write(cached_v, v, v_scale if quantized else None)
-        cache_index.value = idx + s
 
         k_full = read(cached_k, k_scale if quantized else None)
         v_full = read(cached_v, v_scale if quantized else None)
         # Query i sits at absolute position idx + i: attend to every cache
         # slot at or before it (this also hides the zero-initialized tail).
-        q_pos = idx + jnp.arange(s)[:, None]
-        k_pos = jnp.arange(length)[None, :]
-        mask = k_pos <= q_pos                          # (S, L)
+        if ragged:
+            q_pos = idx[:, None, None] + jnp.arange(s)[None, :, None]  # (B,S,1)
+            k_pos = jnp.arange(length)[None, None, :]
+        else:
+            q_pos = idx + jnp.arange(s)[:, None]
+            k_pos = jnp.arange(length)[None, :]
+        mask = k_pos <= q_pos                          # (S, L) or (B, S, L)
         if self.window is not None:
             # SWA decode: attend only to the last `window` cache slots.
             mask = mask & (k_pos > q_pos - self.window)
-        return dot_product_attention(q, k_full, v_full, mask=mask[None, None])
+        mask = mask[:, None] if ragged else mask[None, None]
+        return dot_product_attention(q, k_full, v_full, mask=mask)
 
     def _blocked_cached_attention(
-        self, q: jax.Array, k: jax.Array, v: jax.Array
+        self, q: jax.Array, k: jax.Array, v: jax.Array, chunk_lengths=None
     ) -> jax.Array:
         """Length-aware cached attention via the Pallas decode kernel.
 
@@ -394,6 +465,7 @@ class MultiHeadAttention(nn.Module):
 
         b, s, n, h = q.shape
         n_kv = k.shape[2]
+        ragged = self.decode_ragged
         length = self.max_decode_len
         store = self.kv_cache_dtype if self.kv_cache_dtype is not None else self.dtype
         quantized = store == jnp.int8
@@ -405,7 +477,8 @@ class MultiHeadAttention(nn.Module):
             "cache", "cached_value", jnp.zeros, (b, n_kv, length, h), store
         )
         cache_index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index",
+            lambda: jnp.zeros((b,) if ragged else (), jnp.int32),
         )
         if quantized:
             k_scale = self.variable(
@@ -415,23 +488,31 @@ class MultiHeadAttention(nn.Module):
                 "cache", "value_scale", jnp.ones, (b, n_kv, length), jnp.float32
             )
 
-        idx = cache_index.value
+        idx = self._advance(cache_index, s, chunk_lengths)
 
         def write(var, chunk, scale_var=None):
             # chunk (B, S, N_kv, H) → sequence-major (B, N_kv, S, H).
             if quantized:
                 scale, chunk = quantize_kv_chunk(chunk)
-                scale_var.value = jax.lax.dynamic_update_slice(
-                    scale_var.value, scale.transpose(0, 2, 1), (0, 0, idx)
+                if ragged:
+                    scale_var.value = row_update(
+                        scale_var.value, scale.transpose(0, 2, 1), idx,
+                        seq_dim=2,
+                    )
+                else:
+                    scale_var.value = jax.lax.dynamic_update_slice(
+                        scale_var.value, scale.transpose(0, 2, 1), (0, 0, idx)
+                    )
+            chunk = chunk.astype(store).transpose(0, 2, 1, 3)
+            if ragged:
+                var.value = row_update(var.value, chunk, idx, seq_dim=2)
+            else:
+                var.value = jax.lax.dynamic_update_slice(
+                    var.value, chunk, (0, 0, idx, 0)
                 )
-            var.value = jax.lax.dynamic_update_slice(
-                var.value, chunk.astype(store).transpose(0, 2, 1, 3),
-                (0, 0, idx, 0),
-            )
 
         write(cached_k, k, k_scale if quantized else None)
         write(cached_v, v, v_scale if quantized else None)
-        cache_index.value = idx + s
 
         kc = nn.with_logical_constraint(
             cached_k.value, (BATCH, HEADS, None, KV)

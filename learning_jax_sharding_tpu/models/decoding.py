@@ -130,7 +130,7 @@ def make_cached_apply(
     the upcast is its call — ``bench.py`` measures it.
     """
 
-    def apply(params: Any, cache: Any, tokens: jax.Array):
+    def apply(params: Any, cache: Any, tokens: jax.Array, chunk_lengths=None):
         if dequantize:
             from learning_jax_sharding_tpu.models.quantize import dequantize_tree
 
@@ -138,7 +138,12 @@ def make_cached_apply(
         variables = {"params": params}
         if cache is not None:
             variables["cache"] = cache
-        logits, mut = model.apply(variables, tokens, mutable=("cache",))
+        kwargs = {}
+        if chunk_lengths is not None:  # ragged decode only (decode_ragged)
+            kwargs["chunk_lengths"] = chunk_lengths
+        logits, mut = model.apply(
+            variables, tokens, mutable=("cache",), **kwargs
+        )
         return logits.astype(jnp.float32), mut["cache"]
 
     return apply

@@ -179,8 +179,21 @@ def make_generate_fn(
     prefill_chunk_size: int | None = None,
     inference_dtype: Any | None = None,
     dequantize: bool | str = False,
+    ragged: bool = False,
 ):
     """Build ``generate(params, prompt, rng) -> (B, prompt+new) tokens``.
+
+    ``ragged``: mixed-length prompt batches — the normal serving case. The
+    returned function takes ``lengths`` (``(B,)`` int32, each row's true
+    prompt length; the prompt arrives RIGHT-padded to the batch max) and
+    every row generates from its own length: per-row KV-cache positions
+    (``config.decode_ragged``), per-row first-token logits, and per-row
+    output placement — row ``b`` of the result is
+    ``[prompt_b, generated tokens, fill]`` with the generated span starting
+    at ``lengths[b]``, exactly what a per-row single run would produce (test
+    -pinned, dense and blocked backends). With ``eos_id`` set, finished rows
+    STOP consuming cache (their index freezes), so attention traffic tracks
+    live rows only. Not combinable with ``prefill_chunk_size``.
 
     ``eos_id``: rows that emit it are frozen (EOS padding from there on) and
     the decode loop EXITS EARLY once every row has finished — a
@@ -232,7 +245,13 @@ def make_generate_fn(
     the packed nibbles straight into its matmul via the fused Pallas kernel
     (``ops/int4_matmul.py``): no dequantized weight array ever lands in HBM,
     which removes the unpack-then-matmul traffic that made int4 slower than
-    int8 in round 1. ``True`` — the params are an int8 tree from
+    int8 in round 1. ``"fused_w4a8"`` — same packed tree, but activations
+    are quantized per-row to int8 inside the kernel path and the
+    contraction runs int8×int4→int32 on the MXU with group scales applied
+    once to the int32 partials — removes the per-byte dequant VPU work
+    that kept "fused" below int8 throughput, at ~0.8% extra activation
+    rounding error (greedy tokens can differ near ties; measure on your
+    eval set before shipping). ``True`` — the params are an int8 tree from
     ``models.quantize.quantize_tree``; they are dequantized INSIDE the jitted
     program (per step, next to the consuming matmuls), so HBM STORES int8 —
     the guaranteed win is weight memory (half of bf16). Whether the decode
@@ -245,26 +264,41 @@ def make_generate_fn(
     """
     import dataclasses as _dc
 
-    if isinstance(dequantize, str) and dequantize != "fused":
+    if isinstance(dequantize, str) and dequantize not in ("fused", "fused_w4a8"):
         raise ValueError(
-            f"dequantize must be False, True, or 'fused'; got {dequantize!r}"
+            f"dequantize must be False, True, 'fused', or 'fused_w4a8'; "
+            f"got {dequantize!r}"
         )
-    fused = dequantize == "fused"
+    fused = dequantize in ("fused", "fused_w4a8")
+    if ragged and prefill_chunk_size is not None:
+        raise ValueError(
+            "ragged and prefill_chunk_size cannot combine (chunked ragged "
+            "prefill would need per-chunk logit gathers; prefill whole)"
+        )
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
+    if ragged:
+        cfg = _dc.replace(cfg, decode_ragged=True)
     if fused:
         # int4 trees apply VERBATIM through the fused dequant-matmul kernel
         # (models/quantize.py::Int4Dense) — no in-jit dequantize_tree, no
         # dequantized weights in HBM. On >1-device meshes the kernel runs
         # under shard_map with per-projection specs (GSPMD cannot partition
         # the custom call and would gather the packed weights).
-        cfg = _dc.replace(cfg, quantization="int4")
+        # "fused_w4a8" additionally quantizes activations per-row to int8 so
+        # the contraction runs int8×int4→int32 on the MXU — the throughput
+        # point of the ladder (~0.8% extra activation rounding error).
+        w4a8 = dequantize == "fused_w4a8"
+        cfg = _dc.replace(cfg, quantization="int4_w4a8" if w4a8 else "int4")
         if mesh.size > 1:
             from learning_jax_sharding_tpu.ops.int4_matmul import (
                 make_int4_matmul_fn,
             )
 
             cfg = _dc.replace(
-                cfg, quantized_matmul_fn=make_int4_matmul_fn(mesh, rules)
+                cfg,
+                quantized_matmul_fn=make_int4_matmul_fn(
+                    mesh, rules, w4a8=w4a8
+                ),
             )
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=bool(dequantize))
@@ -274,11 +308,11 @@ def make_generate_fn(
         dequant_dtype=cfg.param_dtype,
     )
 
-    def step_apply(params, cache, tokens):
-        logits, cache = apply(params, cache, tokens)
+    def step_apply(params, cache, tokens, chunk_lengths=None):
+        logits, cache = apply(params, cache, tokens, chunk_lengths)
         return logits[:, -1], cache
 
-    def generate(params, prompt, rng):
+    def generate(params, prompt, rng, lengths=None):
         b, prompt_len = prompt.shape
         check_sequence_budget(
             prompt_len + max_new_tokens, cfg.max_seq_len,
@@ -290,7 +324,16 @@ def make_generate_fn(
         # prefill_chunk_size, the prompt streams through the cache chunk by
         # chunk: first chunk creates the caches, full chunks ride a scan,
         # a static remainder finishes — same cache contents, bounded memory.
-        if prefill_chunk_size is None or prompt_len <= prefill_chunk_size:
+        if ragged:
+            # Ragged prefill: the padded prompt runs whole (each row's pad
+            # tail writes garbage K/V BEYOND its length — masked now, then
+            # overwritten as the row generates); the first-token logits come
+            # from each row's own last valid position, not column -1.
+            logits_all, cache = apply(params, None, prompt, lengths)
+            logits = jnp.take_along_axis(
+                logits_all, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]
+        elif prefill_chunk_size is None or prompt_len <= prefill_chunk_size:
             logits, cache = step_apply(params, None, prompt)
         else:
             if prefill_chunk_size < 1:
@@ -338,16 +381,43 @@ def make_generate_fn(
             # (B, V) presence mask of every token in the row so far; a
             # scatter per step keeps it current inside the scan carry.
             seen = jnp.zeros((b, logits.shape[-1]), bool)
-            seen = seen.at[rows[:, None], prompt].set(True)
+            if ragged:
+                # Only VALID prompt positions count as seen — a short row's
+                # pad tail must not penalize the pad id.
+                valid = jnp.arange(prompt_len)[None, :] < lengths[:, None]
+                seen = seen.at[rows[:, None], prompt].max(valid)
+            else:
+                seen = seen.at[rows[:, None], prompt].set(True)
         else:
             seen = None
         tok, seen = pick(logits, seen, rng0)
 
-        def advance(tok, cache, rng, seen):
+        def assemble(new_tokens):
+            # Row b's generated span starts at ITS length, matching what a
+            # per-row single run would return; EVERY cell past the span —
+            # including the caller's prompt padding between lengths[b] and
+            # prompt_len — becomes the fill value (eos when set, a decodable
+            # row terminator), so consumers scanning past the generated span
+            # never read stale pad ids as output.
+            if not ragged:
+                return jnp.concatenate([prompt, new_tokens], axis=1)
+            fill = 0 if eos_id is None else eos_id
+            total = prompt_len + max_new_tokens
+            col = jnp.arange(total)[None, :]
+            out = jnp.where(
+                col < lengths[:, None],
+                jnp.pad(prompt, ((0, 0), (0, max_new_tokens))),
+                fill,
+            )
+            cols = lengths[:, None] + jnp.arange(max_new_tokens)[None, :]
+            return out.at[rows[:, None], cols].set(new_tokens)
+
+        def advance(tok, cache, rng, seen, active=None):
             # The per-token sequence shared by BOTH loop flavors — the eos
             # while_loop must equal the scan truncated at EOS, so there is
-            # exactly one copy of it.
-            logits, cache = step_apply(params, cache, tok[:, None])
+            # exactly one copy of it. ``active`` (ragged + eos): per-row 1/0
+            # advance so finished rows stop consuming cache slots.
+            logits, cache = step_apply(params, cache, tok[:, None], active)
             rng, sub = jax.random.split(rng)
             nxt, seen = pick(logits, seen, sub)
             return nxt, cache, rng, seen
@@ -363,7 +433,7 @@ def make_generate_fn(
                 length=max_new_tokens - 1,
             )
             new_tokens = jnp.concatenate([tok[:, None], rest.T], axis=1)
-            return jnp.concatenate([prompt, new_tokens], axis=1)
+            return assemble(new_tokens)
 
         # EOS early stop: a while_loop that ends as soon as EVERY row has
         # emitted eos_id — short completions don't pay for max_new_tokens
@@ -381,7 +451,8 @@ def make_generate_fn(
 
         def body(carry):
             i, tok, cache, rng, seen, finished, buffer = carry
-            nxt, cache, rng, seen = advance(tok, cache, rng, seen)
+            active = (~finished).astype(jnp.int32) if ragged else None
+            nxt, cache, rng, seen = advance(tok, cache, rng, seen, active)
             nxt = jnp.where(finished, eos_id, nxt)
             buffer = buffer.at[:, i].set(nxt)
             finished = finished | (nxt == eos_id)
@@ -392,14 +463,28 @@ def make_generate_fn(
             (jnp.asarray(1, jnp.int32), tok, cache, rng_loop, seen,
              finished, buffer),
         )
-        return jnp.concatenate([prompt, buffer], axis=1)
+        return assemble(buffer)
 
     jitted = jax.jit(generate, static_argnames=())
 
-    def run(params, prompt: jax.Array, rng: Optional[jax.Array] = None):
+    def run(
+        params,
+        prompt: jax.Array,
+        rng: Optional[jax.Array] = None,
+        lengths: Optional[jax.Array] = None,
+    ):
+        if ragged and lengths is None:
+            raise ValueError(
+                "ragged=True: pass lengths (B,) — each row's true prompt "
+                "length in the right-padded prompt batch"
+            )
+        if not ragged and lengths is not None:
+            raise ValueError("lengths requires make_generate_fn(ragged=True)")
         rng = jax.random.key(0) if rng is None else rng
         params = maybe_cast(params)  # eager; pre-cast params make this a no-op
         with activate(mesh, rules):
+            if ragged:
+                return jitted(params, prompt, rng, jnp.asarray(lengths, jnp.int32))
             return jitted(params, prompt, rng)
 
     run.jitted = jitted

@@ -281,6 +281,10 @@ class Int4Dense(nn.Module):
     # Mesh-aware override (ops.int4_matmul.make_int4_matmul_fn): shard_map
     # around the kernel for tensor-parallel serving; None runs it direct
     # (single-device, or GSPMD-replicated).
+    activation_bits: int = 16
+    # 8 → w4a8: per-row int8 activations, int8×int4→int32 on the MXU,
+    # group scales applied once to the int32 partials (the throughput point
+    # of the quantization ladder — see ops/int4_matmul.py::_kernel_w4a8).
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -307,14 +311,23 @@ class Int4Dense(nn.Module):
         features = self.features
         q4, scale = _Kernel(name="kernel")()
         x = x.astype(self.dtype)
+        w4a8 = self.activation_bits == 8
         if scale.shape[0] == 1 or (k // 2) % g == 0:
             if self.matmul_fn is not None:
                 y = self.matmul_fn(
                     x, q4, scale, group=g, kernel_axes=self.kernel_axes
                 )
             else:
-                y = int4_matmul(x, q4, scale, group=g)
+                y = int4_matmul(x, q4, scale, group=g, w4a8=w4a8)
         else:
+            if w4a8:
+                # Falling back to full-precision activations would silently
+                # change the served numerics the caller measured/accepted.
+                raise ValueError(
+                    f"w4a8 requested but the kernel cannot tile this layout "
+                    f"(scale rows {scale.shape[0]}, group {g} over K={k}); "
+                    f"re-quantize with a group dividing K/2"
+                )
             w = dequantize_leaf_int4({"q4": q4, "scale": scale}, self.dtype)
             y = x @ w
         if self.use_bias:
@@ -343,7 +356,7 @@ def projection_dense(
     """THE dense/Int4Dense dispatch — every projection site (attention
     q/k/v/out, FF up/down, lm_head) builds through here so the quantized
     serving path cannot drift between modules."""
-    if quantization == "int4":
+    if quantization in ("int4", "int4_w4a8"):
         return Int4Dense(
             features=features,
             use_bias=use_bias,
@@ -352,11 +365,13 @@ def projection_dense(
             group_size=group_size,
             kernel_axes=tuple(kernel_axes),
             matmul_fn=quantized_matmul_fn,
+            activation_bits=8 if quantization == "int4_w4a8" else 16,
             name=name,
         )
     if quantization is not None:
         raise ValueError(
-            f"unknown quantization {quantization!r}: expected None or 'int4'"
+            f"unknown quantization {quantization!r}: expected None, 'int4', "
+            f"or 'int4_w4a8'"
         )
     return nn.Dense(
         features,

@@ -172,6 +172,8 @@ class TransformerBlock(nn.Module):
                                   # models.attention.MultiHeadAttention)
     decode_block_k: Optional[int] = None
     decode_attn_fn: Optional[Callable] = None
+    decode_ragged: bool = False   # per-row cache positions (mixed-length
+                                  # serving; see models.attention)
     quantization: Optional[str] = None   # "int4" → fused-kernel projections
     quantization_group: int = 128
     quantized_matmul_fn: Optional[Callable] = None
@@ -179,7 +181,9 @@ class TransformerBlock(nn.Module):
     scan: bool = False            # under nn.scan: return (x, None) pairs
 
     @nn.compact
-    def __call__(self, x: jax.Array, deterministic: bool = True):
+    def __call__(
+        self, x: jax.Array, deterministic: bool = True, chunk_lengths=None
+    ):
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
         h = make_norm(
             self.norm, self.dtype, self.param_dtype, "ln_attn", self.norm_eps
@@ -205,11 +209,12 @@ class TransformerBlock(nn.Module):
             decode_attention=self.decode_attention,
             decode_block_k=self.decode_block_k,
             decode_attn_fn=self.decode_attn_fn,
+            decode_ragged=self.decode_ragged,
             quantization=self.quantization,
             quantization_group=self.quantization_group,
             quantized_matmul_fn=self.quantized_matmul_fn,
             name="attn",
-        )(h, deterministic=deterministic)
+        )(h, deterministic=deterministic, chunk_lengths=chunk_lengths)
         h = make_norm(
             self.norm, self.dtype, self.param_dtype, "ln_ff", self.norm_eps
         )(x)
@@ -295,6 +300,10 @@ class TransformerConfig:
     decode_attn_fn: Optional[Callable] = None  # mesh-aware blocked-kernel
                                      # override (make_decode_attn_fn);
                                      # injected by the serving entry points
+    decode_ragged: bool = False      # per-row cache positions: mixed-length
+                                     # prompt batches serve at each row's own
+                                     # length (ragged prefill + independent
+                                     # row advance; models.attention)
     quantization: Optional[str] = None  # "int4": every projection consumes a
                                      # quantize_tree(bits=4) tree verbatim
                                      # through the fused dequant-matmul
@@ -407,11 +416,19 @@ class Transformer(nn.Module):
         *,
         deterministic: bool = True,
         return_hidden: bool = False,
+        chunk_lengths: Optional[jax.Array] = None,
     ) -> jax.Array:
+        """``chunk_lengths``: ragged decode only (``config.decode_ragged``)
+        — per-row valid-token count of this chunk; see
+        ``models.attention.MultiHeadAttention.__call__``."""
         cfg = self.config
         b, s = tokens.shape
         if s > cfg.max_seq_len:
             raise ValueError(f"sequence length {s} exceeds max_seq_len {cfg.max_seq_len}")
+        if chunk_lengths is not None and not (cfg.decode and cfg.decode_ragged):
+            raise ValueError(
+                "chunk_lengths requires decode=True and decode_ragged=True"
+            )
 
         embed = nn.Embed(
             cfg.vocab_size,
@@ -441,14 +458,23 @@ class Transformer(nn.Module):
                 # Chunked autoregressive input: this chunk's absolute
                 # positions continue from the running cache position (the
                 # per-module KV caches keep their own matching indices).
+                # Ragged: a (B,) position counter and per-row gathers — rows
+                # advance by their own valid counts.
                 pos_var = self.variable(
-                    "cache", "position", lambda: jnp.zeros((), jnp.int32)
+                    "cache", "position",
+                    lambda: jnp.zeros((b,) if cfg.decode_ragged else (), jnp.int32),
                 )
-                positions = pos_var.value + jnp.arange(s)
-                pos_var.value = pos_var.value + s
-                x = embed(tokens) + jnp.take(pos_embed, positions, axis=0)[
-                    None
-                ].astype(cfg.dtype)
+                if cfg.decode_ragged:
+                    positions = pos_var.value[:, None] + jnp.arange(s)  # (B,S)
+                    pos_var.value = pos_var.value + (
+                        s if chunk_lengths is None else chunk_lengths
+                    )
+                    pos_term = jnp.take(pos_embed, positions, axis=0)
+                else:
+                    positions = pos_var.value + jnp.arange(s)
+                    pos_var.value = pos_var.value + s
+                    pos_term = jnp.take(pos_embed, positions, axis=0)[None]
+                x = embed(tokens) + pos_term.astype(cfg.dtype)
             else:
                 x = embed(tokens) + pos_embed[None, :s].astype(cfg.dtype)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
@@ -479,6 +505,7 @@ class Transformer(nn.Module):
             decode_attention=cfg.decode_attention,
             decode_block_k=cfg.decode_block_k,
             decode_attn_fn=cfg.decode_attn_fn,
+            decode_ragged=cfg.decode_ragged,
             quantization=cfg.quantization,
             quantization_group=cfg.quantization_group,
             quantized_matmul_fn=cfg.quantized_matmul_fn,
@@ -536,9 +563,16 @@ class Transformer(nn.Module):
                     policy=resolve_remat_policy(cfg.remat_policy),
                 )
             for i in range(cfg.num_layers):
-                x = block_cls(**block_fields, name=f"block_{i}")(
-                    x, deterministic
-                )
+                if cfg.decode:
+                    # chunk_lengths rides only the decode path (remat wraps
+                    # the training call and pins its positional signature).
+                    x = block_cls(**block_fields, name=f"block_{i}")(
+                        x, deterministic, chunk_lengths
+                    )
+                else:
+                    x = block_cls(**block_fields, name=f"block_{i}")(
+                        x, deterministic
+                    )
 
         x = make_norm(
             cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps

@@ -70,16 +70,18 @@ def auto_block_k(length: int, cap: int = 256) -> int:
     return blk if blk >= 8 else length
 
 
-def _last_block(qi, sref, *, qb: int, s: int, block_k: int):
-    """Last cache block q-tile ``qi`` may touch: its causal frontier
-    (the tile's final query sits at ``index + min((qi+1)·qb, s) - 1``),
-    which never exceeds the valid prefix ``sref[1] - 1``."""
+def _last_block(bi, qi, sref, *, qb: int, s: int, block_k: int):
+    """Last cache block q-tile ``qi`` of row ``bi`` may touch: its causal
+    frontier (the tile's final query sits at ``index_b + min((qi+1)·qb, s)
+    - 1``), which never exceeds the row's valid prefix ``sref[bi, 1] - 1``.
+    Per-ROW: ragged batches (mixed prompt lengths) clamp each row to its own
+    frontier, so short rows fetch fewer cache blocks."""
     last_q = jnp.minimum((qi + 1) * qb, s) - 1
-    return jnp.minimum(sref[1] - 1, (sref[2] + last_q) // block_k)
+    return jnp.minimum(sref[bi, 1] - 1, (sref[bi, 2] + last_q) // block_k)
 
 
 def _kernel(
-    s_ref,                # SMEM (3,): [kstart_block, valid_blocks, index]
+    s_ref,                # SMEM (B, 3): per-row [kstart_block, valid_blocks, index]
     q_ref, k_ref, v_ref,  # (1, N_kv, GQ, H), (1, N_kv, block_k, H) ×2
     *rest,
     scale: float, block_k: int, group: int, qb: int, s: int,
@@ -89,8 +91,8 @@ def _kernel(
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
         o_ref, acc_ref, m_ref, l_ref = rest
-    qi, j = pl.program_id(1), pl.program_id(2)
-    blk = s_ref[0] + j
+    bi, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    blk = s_ref[bi, 0] + j
 
     @pl.when(j == 0)
     def _init():
@@ -98,7 +100,7 @@ def _kernel(
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    @pl.when(blk <= _last_block(qi, s_ref, qb=qb, s=s, block_k=block_k))
+    @pl.when(blk <= _last_block(bi, qi, s_ref, qb=qb, s=s, block_k=block_k))
     def _step():
         q = q_ref[0].astype(jnp.float32) * scale           # (N_kv, GQ, H)
         k = k_ref[0].astype(jnp.float32)                   # (N_kv, bk, H)
@@ -118,7 +120,7 @@ def _kernel(
         # chunk (non-dividing last tile) mask nothing extra — their stores
         # are dropped by the blocked write.
         rows = jax.lax.broadcasted_iota(jnp.int32, (1, gq, 1), 1)
-        qpos = s_ref[2] + qi * qb + (rows // group if group > 1 else rows)
+        qpos = s_ref[bi, 2] + qi * qb + (rows // group if group > 1 else rows)
         cols = blk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, 1, block_k), 2
         )
@@ -173,9 +175,13 @@ def decode_attention(
             length for prefill). N may exceed the cache's head count (GQA).
         k_cache / v_cache: ``(B, N_kv, L, H)`` cache buffers — float, or int8
             with ``k_scale``/``v_scale``.
-        index: int32 scalar — absolute position of the chunk's first query;
-            the chunk's own k/v must already be written at
-            ``[index, index + S)``. Slots ≥ ``index + S`` are never read.
+        index: int32 scalar, or per-row ``(B,)`` for RAGGED batches (mixed
+            prompt/generation lengths) — absolute position of each row's
+            first chunk query; the chunk's own k/v must already be written
+            at ``[index_b, index_b + S)``. Slots past a row's frontier are
+            never read: per-row block clamping means short rows also fetch
+            fewer cache blocks, so ragged decode pays per-row valid-length
+            traffic, not the batch max.
         k_scale / v_scale: ``(B, N_kv, L)`` fp32 per-(token, head) scales for
             int8 caches (both or neither).
         window: causal sliding window — query at position p attends
@@ -214,13 +220,13 @@ def decode_attention(
     gq = qb * group
     nq = pl.cdiv(s, qb)
 
-    idx = jnp.asarray(index, jnp.int32)
+    idx = jnp.broadcast_to(jnp.asarray(index, jnp.int32), (b,))
     valid_blocks = (idx + s + block_k - 1) // block_k
     if window is not None:
         kstart = jnp.maximum(0, (idx - (window - 1)) // block_k)
     else:
-        kstart = jnp.zeros((), jnp.int32)
-    sargs = jnp.stack([kstart, valid_blocks, idx]).astype(jnp.int32)
+        kstart = jnp.zeros((b,), jnp.int32)
+    sargs = jnp.stack([kstart, valid_blocks, idx], axis=1).astype(jnp.int32)
 
     # (B, S, N, H) → (B, N_kv, S·group, H): row r = query (r // group) for
     # in-group head (r % group); q head n belongs to kv head n // group
@@ -234,7 +240,7 @@ def decode_attention(
     last_block = functools.partial(_last_block, qb=qb, s=s, block_k=block_k)
 
     def clamped(bi, qi, j, sref):
-        return (bi, 0, jnp.minimum(sref[0] + j, last_block(qi, sref)), 0)
+        return (bi, 0, jnp.minimum(sref[bi, 0] + j, last_block(bi, qi, sref)), 0)
 
     in_specs = [
         pl.BlockSpec((1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0)),
@@ -247,7 +253,8 @@ def decode_attention(
             pl.BlockSpec(
                 (1, n_kv, block_k),
                 lambda bi, qi, j, sref: (
-                    bi, 0, jnp.minimum(sref[0] + j, last_block(qi, sref))
+                    bi, 0,
+                    jnp.minimum(sref[bi, 0] + j, last_block(bi, qi, sref)),
                 ),
             )
         ] * 2
@@ -307,12 +314,15 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
     q_spec = to_spec((BATCH, None, HEADS, None))
     kv_spec = to_spec((BATCH, HEADS, None, None))
     sc_spec = to_spec((BATCH, HEADS, None))
-    idx_spec = PartitionSpec()
+    row_idx_spec = to_spec((BATCH,))
 
     def attn_fn(
         q, k_cache, v_cache, index, *, k_scale=None, v_scale=None, **call_kwargs
     ):
         fn = functools.partial(decode_attention, **{**kwargs, **call_kwargs})
+        # Scalar index replicates; a per-row (B,) index (ragged serving)
+        # shards with the batch.
+        idx_spec = row_idx_spec if jnp.ndim(index) == 1 else PartitionSpec()
         if k_scale is None:
             body = lambda q_, k_, v_, i_: fn(q_, k_, v_, i_)
             in_specs = (q_spec, kv_spec, kv_spec, idx_spec)

@@ -205,6 +205,7 @@ def measure(
     iters: int | None = None,
     warmup: int = 2,
     min_time: float = 1.0,
+    repeats: int = 3,
     flops: float | None = None,
     n_devices: int | None = None,
     **kwargs,
@@ -214,10 +215,18 @@ def measure(
     Args:
         flops: per-execution FLOPs; if None, read from XLA cost analysis.
         n_devices: chips sharing the work (default: all local devices).
+        repeats: latency-cancelled pairs to median over (see ``time_fn``);
+            raise together with ``min_time`` for drift-robust headline
+            numbers — the tunneled TPU here drifts ±30% across seconds-scale
+            windows, so short chains sample one drift state while long
+            chains average it.
     """
     if flops is None:
         flops = compiled_flops(fn, *args, **kwargs)
-    secs = time_fn(fn, *args, iters=iters, warmup=warmup, min_time=min_time, **kwargs)
+    secs = time_fn(
+        fn, *args, iters=iters, warmup=warmup, min_time=min_time,
+        repeats=repeats, **kwargs,
+    )
     return BenchResult(
         seconds_per_iter=secs,
         iters=iters,

@@ -1,0 +1,167 @@
+"""Flash kernel ceiling at long context (VERDICT r2 item 5).
+
+PERF.md's round-2 diagnosis: at S=8192, head_dim 64, the kernel's per-block
+softmax VPU work (exp, reductions, corrections) is comparable to the MXU
+work, capping the S^2 term at ~24% of peak. The unmeasured claim was that
+head_dim 128 would roughly halve the VPU:MXU ratio. This measures it:
+
+1. kernel microbench — flash fwd / fwd+bwd at (B=2, S=8192), SAME total
+   attention width (16x64 vs 8x128), TFLOP/s;
+2. composed 125M-class train step at S=8192 with head_dim 128
+   (6 heads x 128 = same 768 width as the bench model), causal and
+   banded-window-1024 rows — the ≥40% MFU question;
+3. VPU ablation — the same blockwise loop with softmax pieces knocked out
+   (full / no-exp / dots-only), apportioning block time between MXU and
+   VPU stages without needing a trace parser.
+
+Run from /root/repo:  python - < scripts/perf_flash_ceiling.py
+"""
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.experimental import pallas as pl
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+    fused_next_token_loss,
+)
+from learning_jax_sharding_tpu.ops.flash_attention import (
+    flash_attention,
+    make_flash_attn_fn,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+from learning_jax_sharding_tpu.utils.bench import measure, time_fn
+
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+rng = np.random.default_rng(0)
+PEAK = 197e12
+
+# ---- 1. kernel microbench: head_dim 64 vs 128, same total width ----
+B, S = 2, 8192
+for n, h in ((16, 64), (8, 128)):
+    q = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
+    fwd = jax.jit(functools.partial(flash_attention, causal=True))
+    flops = 4 * B * n * (S * S / 2) * h  # causal half
+    t = time_fn(fwd, q, k, v, min_time=1.5)
+    print(f"flash fwd {n}x{h}: {t*1e3:.2f} ms, {flops/t/1e12:.1f} TFLOP/s "
+          f"({flops/t/PEAK:.0%} peak)", flush=True)
+
+    def loss(q, k, v):
+        return flash_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    t = time_fn(g, q, k, v, min_time=1.5)
+    print(f"flash bwd-only-ish (grad) {n}x{h}: {t*1e3:.2f} ms, "
+          f"{2.5*flops/t/1e12:.1f} TFLOP/s nominal", flush=True)
+
+# ---- 2. composed S=8192 step at head_dim 128 ----
+def composed(label, cfg, b, s, K=2):
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), optax.adamw(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    stacked = {
+        kk: put(np.stack([np.asarray(vv)] * K),
+                mesh_sharding(mesh, None, "data", None))
+        for kk, vv in batch.items()
+    }
+    step = make_train_step(
+        state_sh, {kk: vv.sharding for kk, vv in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=fused_next_token_loss, loss_needs_params=True,
+        apply_kwargs={"return_hidden": True}, donate_state=False,
+        steps_per_call=K,
+    )
+    # Window rows use window-adjusted attention FLOPs (PERF.md convention).
+    flops = cfg.train_step_flops(b, s)
+    if cfg.window is not None:
+        full_attn = 3 * (4 * s * cfg.num_heads * cfg.head_dim
+                         * cfg.num_layers) * 0.5 * b * s
+        win_attn = full_attn * min(1.0, cfg.window / (s / 2))
+        flops = flops - full_attn + win_attn
+    r = measure(step, state, stacked, flops=flops * K, n_devices=1,
+                min_time=3.0)
+    print(f"{label}: {r.seconds_per_iter/K*1e3:.1f} ms/step, "
+          f"MFU={r.mfu:.1%}", flush=True)
+
+
+b8k = dataclasses.replace(
+    CONFIG_125M, num_heads=6, head_dim=128, max_seq_len=8192,
+    attn_fn=make_flash_attn_fn(), remat=False,
+)
+composed("S=8192 b=2 hd=128 flash causal", b8k, 2, 8192)
+b8kw = dataclasses.replace(
+    b8k, window=1024, attn_fn=make_flash_attn_fn(window=1024),
+)
+composed("S=8192 b=2 hd=128 banded window 1024", b8kw, 2, 8192)
+
+# ---- 3. VPU ablation of the blockwise loop ----
+# One (1024 x 1024) block pass over the same bytes: full softmax update,
+# exp->identity, and dots-only variants. Time deltas apportion the block.
+BQ = BK = 1024
+
+
+def _ablate_kernel(q_ref, k_ref, v_ref, o_ref, *, mode):
+    q = q_ref[...]
+    k = k_ref[...]
+    sc = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if mode == "full":
+        m = jnp.max(sc, axis=1, keepdims=True)
+        p = jnp.exp(sc - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        p = p / l
+    elif mode == "noexp":
+        m = jnp.max(sc, axis=1, keepdims=True)
+        p = sc - m
+        l = jnp.sum(p, axis=1, keepdims=True)
+        p = p / l
+    else:  # dots
+        p = sc
+    o_ref[...] = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+for h in (64, 128):
+    nblocks = 16
+    q = jnp.asarray(rng.standard_normal((nblocks * BQ, h)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((nblocks * BK, h)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((nblocks * BK, h)), jnp.bfloat16)
+    base = None
+    for mode in ("full", "noexp", "dots"):
+        f = pl.pallas_call(
+            functools.partial(_ablate_kernel, mode=mode),
+            grid=(nblocks,),
+            in_specs=[
+                pl.BlockSpec((BQ, h), lambda i: (i, 0)),
+                pl.BlockSpec((BK, h), lambda i: (i, 0)),
+                pl.BlockSpec((BK, h), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((BQ, h), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((nblocks * BQ, h), jnp.bfloat16),
+        )
+        jf = jax.jit(f)
+        t = time_fn(jf, q, k, v, min_time=1.0) / nblocks
+        dots_flops = 2 * BQ * BK * h * 2
+        if base is None:
+            base = t
+        print(f"block ablation h={h} {mode}: {t*1e6:.1f} us/block "
+              f"(dots would need {dots_flops/PEAK*1e6:.1f} us at peak; "
+              f"delta vs full {1e6*(base-t):.1f} us)", flush=True)

@@ -1,0 +1,55 @@
+"""int4 kernel variant sweep at the 1.4B decode shapes (one process).
+
+The round-3 w4a8 attempt measured ZERO delta vs w4a16 end-to-end (both
+~4.1 ms/token at 1.4B vs int8's 2.66) — this isolates where the time
+actually goes: group loop? unpack? MXU path? block size? M padding?
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.models.quantize import (
+    dequantize_leaf_int4, quantize_leaf, quantize_leaf_int4,
+)
+from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul
+from learning_jax_sharding_tpu.utils.bench import time_fn
+
+rng = np.random.default_rng(0)
+
+for K, N, tag in ((2048, 8192, "ff-up"), (8192, 2048, "ff-down")):
+    print(f"--- {tag}: M=8, K={K}, N={N} ---", flush=True)
+    w = jnp.asarray(rng.standard_normal((K, N)) * 0.02, jnp.float32)
+    n128 = quantize_leaf_int4(w, group_size=128)
+    nfull = quantize_leaf_int4(w, group_size=K)   # single scale row
+    n8 = quantize_leaf(w)
+    x = jnp.asarray(rng.standard_normal((8, K)), jnp.bfloat16)
+    x32 = jnp.asarray(rng.standard_normal((32, K)), jnp.bfloat16)
+    packed_gb = K / 2 * N / 1e9
+
+    def report(label, f, *args):
+        t = time_fn(jax.jit(f), *args, min_time=1.0)
+        print(f"{label}: {t*1e6:8.1f} us  ({packed_gb/t:.0f} GB/s of packed bytes)",
+              flush=True)
+        return t
+
+    report("w4a16 g=128          ",
+           lambda x, q, s: int4_matmul(x, q, s, group=128), x, n128["q4"], n128["scale"])
+    report("w4a8  g=128          ",
+           lambda x, q, s: int4_matmul(x, q, s, group=128, w4a8=True), x, n128["q4"], n128["scale"])
+    report("w4a16 single-group   ",
+           lambda x, q, s: int4_matmul(x, q, s, group=K), x, nfull["q4"], nfull["scale"])
+    report("w4a8  single-group   ",
+           lambda x, q, s: int4_matmul(x, q, s, group=K, w4a8=True), x, nfull["q4"], nfull["scale"])
+    report("w4a8  g=128 M=32     ",
+           lambda x, q, s: int4_matmul(x, q, s, group=128, w4a8=True), x32, n128["q4"], n128["scale"])
+    report("w4a16 g=128 bn=1024  ",
+           lambda x, q, s: int4_matmul(x, q, s, group=128, block_n=1024), x, n128["q4"], n128["scale"])
+    report("w4a8  g=128 bn=1024  ",
+           lambda x, q, s: int4_matmul(x, q, s, group=128, block_n=1024, w4a8=True), x, n128["q4"], n128["scale"])
+    report("int8 dequant+dot (XLA)",
+           lambda x, q, s: x @ (q.astype(jnp.float32) * s[None, :]).astype(jnp.bfloat16),
+           x, n8["q"], n8["scale"])
+    wbf = w.astype(jnp.bfloat16)
+    report("bf16 dot             ", lambda x, w: x @ w, x, wbf)

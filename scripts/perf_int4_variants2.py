@@ -1,0 +1,53 @@
+"""int4 kernel variants, chained INSIDE one jit (dispatch-free timing).
+
+Each variant runs 32 back-to-back calls inside a fori_loop with a data
+dependency (x += eps * out[:, :1]) so XLA cannot hoist or elide; per-call
+time = total / 32. This is the regime the decode scan actually runs.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.models.quantize import (
+    quantize_leaf, quantize_leaf_int4,
+)
+from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul
+from learning_jax_sharding_tpu.utils.bench import time_fn
+
+rng = np.random.default_rng(0)
+CH = 32
+
+
+def chained(fn_one):
+    def run(x):
+        def body(i, x):
+            out = fn_one(x)
+            return x + (out[:, :1] * 1e-30).astype(x.dtype)
+        return jax.lax.fori_loop(0, CH, body, x)
+    return jax.jit(run)
+
+
+for K, N, tag in ((2048, 8192, "ff-up"), (8192, 2048, "ff-down"),
+                  (2048, 50304, "lm_head")):
+    print(f"--- {tag}: M=8, K={K}, N={N} ---", flush=True)
+    w = jnp.asarray(rng.standard_normal((K, N)) * 0.02, jnp.float32)
+    n128 = quantize_leaf_int4(w, group_size=128)
+    n8 = quantize_leaf(w)
+    wbf = w.astype(jnp.bfloat16)
+    x = jnp.asarray(rng.standard_normal((8, K)), jnp.bfloat16)
+    packed_gb = K / 2 * N / 1e9
+
+    def report(label, fn_one, bytes_gb):
+        f = chained(fn_one)
+        t = time_fn(f, x, min_time=1.0) / CH
+        print(f"{label}: {t*1e6:7.1f} us  ({bytes_gb/t:.0f} GB/s served bytes)",
+              flush=True)
+
+    report("w4a16 g=128        ", lambda x: int4_matmul(x, n128["q4"], n128["scale"], group=128), packed_gb)
+    report("w4a8  g=128        ", lambda x: int4_matmul(x, n128["q4"], n128["scale"], group=128, w4a8=True), packed_gb)
+    for bn in (256, 512):
+        if N % bn == 0 and K >= 8192:
+            report(f"w4a16 g=128 bn={bn:4d}", lambda x, bn=bn: int4_matmul(x, n128["q4"], n128["scale"], group=128, block_n=bn), packed_gb)
+            report(f"w4a8  g=128 bn={bn:4d}", lambda x, bn=bn: int4_matmul(x, n128["q4"], n128["scale"], group=128, block_n=bn, w4a8=True), packed_gb)
+    report("int8 dequant+dot   ", lambda x: x @ (n8["q"].astype(jnp.float32) * n8["scale"][None, :]).astype(jnp.bfloat16), 2 * packed_gb)
+    report("bf16 dot           ", lambda x: x @ wbf, 4 * packed_gb)

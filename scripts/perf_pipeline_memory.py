@@ -1,0 +1,82 @@
+"""Pipeline-schedule memory accounting (VERDICT r2 item 6).
+
+Question: does the interleaved schedule (V>1) cut per-stage activation
+memory, or only bubble ticks? The backward is jax.grad's transpose of the
+whole tick scan (parallel/pipeline.py), so ALL microbatch activations live
+through the forward — GPipe's memory profile. This measures it instead of
+assuming: XLA's memory_analysis of the compiled pp train step at
+P=2/4 x M=4/8 x V=1/2 on the emulated 8-device mesh.
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python - < scripts/perf_pipeline_memory.py   (from /root/repo)
+"""
+import dataclasses
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+from learning_jax_sharding_tpu.models.pipelined import (  # noqa: E402
+    PipelinedTransformer,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh  # noqa: E402
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+
+cfg = dataclasses.replace(
+    CONFIG_TINY, num_layers=8, features=128, hidden=512, max_seq_len=128,
+)
+B, S = 16, 128
+rng = np.random.default_rng(0)
+tokens = np.asarray(
+    rng.integers(0, cfg.vocab_size, size=(B, S + 1)), np.int32
+)
+batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+print(f"model: L={cfg.num_layers} d={cfg.features} h={cfg.hidden} "
+      f"B={B} S={S}", flush=True)
+print(f"{'P':>2} {'M':>2} {'V':>2} {'temp_MB':>9} {'output_MB':>9} "
+      f"{'arg_MB':>8}", flush=True)
+
+for p in (2, 4):
+    mesh = build_mesh(
+        (p, 2, 8 // (2 * p)), ("pipe", "data", "model")
+    )
+    for m in (4, 8):
+        for v in (1, 2):
+            model = PipelinedTransformer(
+                cfg, mesh, RULES_DP_TP, num_stages=p,
+                num_microbatches=m, interleave=v,
+            )
+            params, _ = model.init_sharded(jax.random.key(0), batch["inputs"])
+            opt = optax.sgd(1e-3)
+            carry = (params, model.init_optimizer(params, opt))
+            step = model.make_train_step(opt, next_token_loss)
+            jitted = getattr(step, "jitted", step)
+            try:
+                mem = (
+                    jax.jit(jitted)
+                    .lower(carry, batch)
+                    .compile()
+                    .memory_analysis()
+                )
+            except Exception as e:
+                print(f"{p:>2} {m:>2} {v:>2}  memory_analysis failed: {e}")
+                continue
+            if mem is None:
+                print(f"{p:>2} {m:>2} {v:>2}  (no analysis on this backend)")
+                continue
+            print(
+                f"{p:>2} {m:>2} {v:>2} "
+                f"{mem.temp_size_in_bytes / 1e6:>9.2f} "
+                f"{mem.output_size_in_bytes / 1e6:>9.2f} "
+                f"{mem.argument_size_in_bytes / 1e6:>8.2f}",
+                flush=True,
+            )
