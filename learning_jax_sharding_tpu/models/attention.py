@@ -489,21 +489,34 @@ class MultiHeadAttention(nn.Module):
             )
 
         idx = self._advance(cache_index, s, chunk_lengths)
+        # Ragged single-token steps FOLD the write into the kernel: the new
+        # k/v merge in-VMEM at each row's slot and flush back through cache
+        # outputs aliased to the inputs — the per-row scatter (measured at
+        # ~18 µs of serial launch per layer, PERF.md "Ragged serving") never
+        # exists. Multi-token ragged chunks (prefill) still scatter — once
+        # per generation, amortized.
+        fold = ragged and s == 1
 
-        def write(var, chunk, scale_var=None):
-            # chunk (B, S, N_kv, H) → sequence-major (B, N_kv, S, H).
+        def to_seq_major(chunk):
             if quantized:
                 scale, chunk = quantize_kv_chunk(chunk)
+                return (
+                    chunk.astype(store).transpose(0, 2, 1, 3),
+                    scale.transpose(0, 2, 1),
+                )
+            return chunk.astype(store).transpose(0, 2, 1, 3), None
+
+        def write(var, chunk, scale_var=None):
+            chunk, scale = to_seq_major(chunk)
+            if quantized:
                 if ragged:
                     scale_var.value = row_update(
-                        scale_var.value, scale.transpose(0, 2, 1), idx,
-                        seq_dim=2,
+                        scale_var.value, scale, idx, seq_dim=2
                     )
                 else:
                     scale_var.value = jax.lax.dynamic_update_slice(
-                        scale_var.value, scale.transpose(0, 2, 1), (0, 0, idx)
+                        scale_var.value, scale, (0, 0, idx)
                     )
-            chunk = chunk.astype(store).transpose(0, 2, 1, 3)
             if ragged:
                 var.value = row_update(var.value, chunk, idx, seq_dim=2)
             else:
@@ -511,8 +524,16 @@ class MultiHeadAttention(nn.Module):
                     var.value, chunk, (0, 0, idx, 0)
                 )
 
-        write(cached_k, k, k_scale if quantized else None)
-        write(cached_v, v, v_scale if quantized else None)
+        fold_args = {}
+        if fold:
+            k_sm, ks_sm = to_seq_major(k)
+            v_sm, vs_sm = to_seq_major(v)
+            fold_args = dict(k_new=k_sm, v_new=v_sm)
+            if quantized:
+                fold_args.update(ks_new=ks_sm, vs_new=vs_sm)
+        else:
+            write(cached_k, k, k_scale if quantized else None)
+            write(cached_v, v, v_scale if quantized else None)
 
         kc = nn.with_logical_constraint(
             cached_k.value, (BATCH, HEADS, None, KV)
@@ -534,6 +555,18 @@ class MultiHeadAttention(nn.Module):
         # window/block_k pass at CALL time either way: the module is the
         # single source of truth, so a mesh-aware wrapper built without them
         # cannot silently drop the sliding window.
+        if fold:
+            result = fn(
+                q, kc, vc, idx,
+                window=self.window, block_k=self.decode_block_k,
+                **scales, **fold_args,
+            )
+            out, new_k, new_v = result[:3]
+            cached_k.value = new_k
+            cached_v.value = new_v
+            if quantized:
+                k_scale.value, v_scale.value = result[3:]
+            return out
         return fn(
             q, kc, vc, idx,
             window=self.window, block_k=self.decode_block_k, **scales,

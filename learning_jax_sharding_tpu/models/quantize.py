@@ -340,6 +340,35 @@ class Int4Dense(nn.Module):
 
 
 
+class Int4ProjParams(nn.Module):
+    """Parameter-only twin of :class:`Int4Dense`: declares the SAME
+    ``<name>/kernel/{q4, scale}`` layout (so ``quantize_tree`` output
+    applies verbatim) but returns the arrays instead of computing — for
+    multi-projection fused kernels (``ops/int4_ff.py``) that consume
+    several packed weights in one call."""
+
+    rows: int        # packed rows (in_features / 2)
+    cols: int
+    scale_rows: int  # in_features / group (1 when one group covers all)
+
+    @nn.compact
+    def __call__(self):
+        class _Kernel(nn.Module):
+            @nn.compact
+            def __call__(self, rows, cols, scale_rows):
+                q4 = self.param(
+                    "q4", nn.initializers.zeros_init(),
+                    (rows, cols), jnp.uint8,
+                )
+                scale = self.param(
+                    "scale", nn.initializers.ones_init(),
+                    (scale_rows, cols), jnp.float32,
+                )
+                return q4, scale
+
+        return _Kernel(name="kernel")(self.rows, self.cols, self.scale_rows)
+
+
 def projection_dense(
     *,
     quantization,

@@ -106,11 +106,45 @@ class FeedForward(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
+        k = x.shape[-1]
+        if self._use_fused_ff(k):
+            # Whole-FF fused kernel: up, GELU, and down in ONE pallas call —
+            # the hidden activation never leaves VMEM, and decode's serial
+            # launch chain shrinks by one dependent kernel per block
+            # (PERF.md "int4 decode: where the time actually goes").
+            # Single-device/replicated serving only: under TP the hidden dim
+            # is sharded and the per-projection shard_map path applies.
+            from learning_jax_sharding_tpu.models.quantize import Int4ProjParams
+            from learning_jax_sharding_tpu.ops.int4_ff import int4_ff
+
+            g = self.quantization_group
+            q4_up, s_up = Int4ProjParams(
+                k // 2, self.hidden, k // min(g, k), name="up"
+            )()
+            q4_dn, s_dn = Int4ProjParams(
+                self.hidden // 2, self.features,
+                self.hidden // min(g, self.hidden), name="down",
+            )()
+            out = int4_ff(
+                x.astype(self.dtype), q4_up, s_up, q4_dn, s_dn, group=g
+            )
+            return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
         h = self._dense(self.hidden, (EMBED, MLP), "up")(x)
         h = nn.with_logical_constraint(h, (BATCH, SEQ, HIDDEN))
         h = nn.gelu(h)
         out = self._dense(self.features, (MLP, EMBED), "down")(h)
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
+
+    def _use_fused_ff(self, k: int) -> bool:
+        from learning_jax_sharding_tpu.ops.int4_ff import int4_ff_eligible
+
+        return (
+            self.quantization == "int4"
+            and self.quantized_matmul_fn is None
+            and not self.use_bias
+            and self.features == k
+            and int4_ff_eligible(k, self.hidden, self.quantization_group)
+        )
 
 
 def make_norm(kind: str, dtype, param_dtype, name: str, eps: float = 1e-6) -> nn.Module:

@@ -81,16 +81,26 @@ def _last_block(bi, qi, sref, *, qb: int, s: int, block_k: int):
 
 
 def _kernel(
-    s_ref,                # SMEM (B, 3): per-row [kstart_block, valid_blocks, index]
+    s_ref,                # SMEM (B, 5): [kstart_block, valid_blocks, index,
+    #                       write_block, write_offset] per row
     q_ref, k_ref, v_ref,  # (1, N_kv, GQ, H), (1, N_kv, block_k, H) ×2
     *rest,
     scale: float, block_k: int, group: int, qb: int, s: int,
-    window, quantized: bool,
+    window, quantized: bool, fold: bool,
 ):
+    rest = list(rest)
     if quantized:
-        ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
-    else:
-        o_ref, acc_ref, m_ref, l_ref = rest
+        ks_ref, vs_ref = rest.pop(0), rest.pop(0)
+    if fold:
+        kn_ref, vn_ref = rest.pop(0), rest.pop(0)
+        if quantized:
+            ksn_ref, vsn_ref = rest.pop(0), rest.pop(0)
+    o_ref = rest.pop(0)
+    if fold:
+        ok_ref, ov_ref = rest.pop(0), rest.pop(0)
+        if quantized:
+            oks_ref, ovs_ref = rest.pop(0), rest.pop(0)
+    acc_ref, m_ref, l_ref = rest
     bi, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     blk = s_ref[bi, 0] + j
 
@@ -102,8 +112,46 @@ def _kernel(
 
     @pl.when(blk <= _last_block(bi, qi, s_ref, qb=qb, s=s, block_k=block_k))
     def _step():
+        k_blk = k_ref[0]                                   # (N_kv, bk, H)
+        v_blk = v_ref[0]
+        if quantized:
+            ks_blk, vs_blk = ks_ref[0], vs_ref[0]          # (N_kv, bk)
+        if fold:
+            # The new token's k/v merge IN-VMEM at this row's write slot —
+            # the separate per-row cache scatter (and its serial launch)
+            # never exists. Merged blocks flush back through the aliased
+            # cache outputs below.
+            slot = jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_k, 1), 1
+            ) == s_ref[bi, 4]
+            here = blk == s_ref[bi, 3]
+
+            def merge(blk_vals, new_ref):
+                return jnp.where(
+                    jnp.logical_and(here, slot), new_ref[0], blk_vals
+                )
+
+            k_blk = merge(k_blk, kn_ref)
+            v_blk = merge(v_blk, vn_ref)
+            if quantized:
+                slot2 = slot[..., 0]
+                ks_blk = jnp.where(
+                    jnp.logical_and(here, slot2), ksn_ref[0], ks_blk
+                )
+                vs_blk = jnp.where(
+                    jnp.logical_and(here, slot2), vsn_ref[0], vs_blk
+                )
+
+            @pl.when(here)
+            def _write_back():
+                ok_ref[0] = k_blk
+                ov_ref[0] = v_blk
+                if quantized:
+                    oks_ref[0] = ks_blk
+                    ovs_ref[0] = vs_blk
+
         q = q_ref[0].astype(jnp.float32) * scale           # (N_kv, GQ, H)
-        k = k_ref[0].astype(jnp.float32)                   # (N_kv, bk, H)
+        k = k_blk.astype(jnp.float32)
         sc = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -112,7 +160,7 @@ def _kernel(
             # Per-(token, head) k scales are constant over H, so they commute
             # with the contraction: scale the score COLUMNS instead of
             # dequantizing the k block.
-            sc = sc * ks_ref[0][:, None, :]
+            sc = sc * ks_blk[:, None, :]
 
         gq = q.shape[1]
         # Tile row r is query (qi·qb + r // group) at absolute position
@@ -136,8 +184,8 @@ def _kernel(
         l_new = corr * l_ref[:, :, :1] + jnp.sum(p, axis=2, keepdims=True)
         if quantized:
             # v scales are per cache row = per probability column.
-            p = p * vs_ref[0][:, None, :]
-        v = v_ref[0].astype(jnp.float32)
+            p = p * vs_blk[:, None, :]
+        v = v_blk.astype(jnp.float32)
         acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
             p, v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -162,12 +210,16 @@ def decode_attention(
     *,
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
+    k_new: jax.Array | None = None,
+    v_new: jax.Array | None = None,
+    ks_new: jax.Array | None = None,
+    vs_new: jax.Array | None = None,
     window: int | None = None,
     scale: float | None = None,
     block_k: int | None = None,
     block_q: int = _BLOCK_Q,
     interpret: bool | None = None,
-) -> jax.Array:
+):
     """Attend chunk queries against the valid prefix of a KV cache.
 
     Args:
@@ -187,12 +239,23 @@ def decode_attention(
         window: causal sliding window — query at position p attends
             ``(p - window, p]``; blocks before every query's window are not
             even fetched.
+        k_new / v_new: FOLDED WRITE (ragged decode, S = 1 only):
+            ``(B, N_kv, 1, H)`` sequence-major new-token k/v, merged
+            IN-KERNEL at each row's ``index_b`` slot before attention and
+            flushed back through cache outputs ALIASED to the cache inputs
+            — one modified block per row moves, and the per-row cache
+            scatter (measured at ~18 µs of serial launch per layer,
+            PERF.md "Ragged serving") never exists. The chunk must NOT
+            already be written to the cache. With int8 caches pass
+            ``ks_new``/``vs_new`` ``(B, N_kv, 1)`` chunk scales too.
         block_k: cache block size; None auto-selects (≤256 dividing L).
         block_q: q rows per grid tile (VMEM bound for long chunks).
         interpret: run the Pallas interpreter; None = auto (True off-TPU).
 
     Returns:
-        ``(B, S, N, H)`` attention output in ``q.dtype``.
+        ``(B, S, N, H)`` attention output in ``q.dtype`` — plus, when
+        ``k_new`` is given, the updated cache buffers (and scale buffers
+        for int8): ``(out, k_cache, v_cache[, k_scale, v_scale])``.
     """
     b, s, n, h = q.shape
     bk, n_kv, length, hk = k_cache.shape
@@ -220,13 +283,24 @@ def decode_attention(
     gq = qb * group
     nq = pl.cdiv(s, qb)
 
+    fold = k_new is not None
+    if fold:
+        if v_new is None:
+            raise ValueError("k_new and v_new must be given together")
+        if s != 1:
+            raise ValueError(f"folded cache write requires S = 1, got {s}")
+        if quantized and (ks_new is None or vs_new is None):
+            raise ValueError("int8 folded write needs ks_new and vs_new")
+
     idx = jnp.broadcast_to(jnp.asarray(index, jnp.int32), (b,))
     valid_blocks = (idx + s + block_k - 1) // block_k
     if window is not None:
         kstart = jnp.maximum(0, (idx - (window - 1)) // block_k)
     else:
         kstart = jnp.zeros((b,), jnp.int32)
-    sargs = jnp.stack([kstart, valid_blocks, idx], axis=1).astype(jnp.int32)
+    sargs = jnp.stack(
+        [kstart, valid_blocks, idx, idx // block_k, idx % block_k], axis=1
+    ).astype(jnp.int32)
 
     # (B, S, N, H) → (B, N_kv, S·group, H): row r = query (r // group) for
     # in-group head (r % group); q head n belongs to kv head n // group
@@ -260,33 +334,80 @@ def decode_attention(
         ] * 2
         operands += [k_scale, v_scale]
 
-    out = pl.pallas_call(
+    out_specs = [
+        pl.BlockSpec((1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0))
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((b, n_kv, s * group, h), q.dtype)]
+    aliases = {}
+    if fold:
+        # New-token chunks enter whole; the merged cache block flushes back
+        # through outputs ALIASED to the cache inputs (alias indices count
+        # the scalar-prefetch operand), so only each row's one modified
+        # block moves.
+        chunk_spec = pl.BlockSpec(
+            (1, n_kv, 1, h), lambda bi, qi, j, sref: (bi, 0, 0, 0)
+        )
+        in_specs += [chunk_spec, chunk_spec]
+        operands += [k_new, v_new]
+        wb = lambda bi, qi, j, sref: (bi, 0, sref[bi, 3], 0)
+        out_specs += [
+            pl.BlockSpec((1, n_kv, block_k, h), wb),
+            pl.BlockSpec((1, n_kv, block_k, h), wb),
+        ]
+        out_shapes += [
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ]
+        aliases[2] = 1   # k_cache (operand 2, after sargs+q) → output 1
+        aliases[3] = 2   # v_cache → output 2
+        if quantized:
+            sc_chunk = pl.BlockSpec(
+                (1, n_kv, 1), lambda bi, qi, j, sref: (bi, 0, 0)
+            )
+            in_specs += [sc_chunk, sc_chunk]
+            operands += [ks_new, vs_new]
+            wbs = lambda bi, qi, j, sref: (bi, 0, sref[bi, 3])
+            out_specs += [
+                pl.BlockSpec((1, n_kv, block_k), wbs),
+                pl.BlockSpec((1, n_kv, block_k), wbs),
+            ]
+            out_shapes += [
+                jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+            ]
+            aliases[4] = 3   # k_scale → output 3
+            aliases[5] = 4   # v_scale → output 4
+
+    result = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, block_k=block_k, group=group, qb=qb, s=s,
-            window=window, quantized=quantized,
+            window=window, quantized=quantized, fold=fold,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, nq, nk),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec(
-                (1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0)
-            ),
+            out_specs=out_specs if fold else out_specs[0],
             scratch_shapes=[
                 pltpu.VMEM((n_kv, gq, h), jnp.float32),
                 pltpu.VMEM((n_kv, gq, LANES), jnp.float32),
                 pltpu.VMEM((n_kv, gq, LANES), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, n_kv, s * group, h), q.dtype),
+        out_shape=out_shapes if fold else out_shapes[0],
+        input_output_aliases=aliases,
         interpret=interpret,
     )(sargs, *operands)
 
-    return (
+    out = result[0] if fold else result
+    out = (
         out.reshape(b, n_kv, s, group, h)
         .transpose(0, 2, 1, 3, 4)
         .reshape(b, s, n, h)
     )
+    if fold:
+        return (out, *result[1:])
+    return out
 
 
 def make_decode_attn_fn(mesh, rules, **kwargs):
@@ -317,26 +438,47 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
     row_idx_spec = to_spec((BATCH,))
 
     def attn_fn(
-        q, k_cache, v_cache, index, *, k_scale=None, v_scale=None, **call_kwargs
+        q, k_cache, v_cache, index, *,
+        k_scale=None, v_scale=None,
+        k_new=None, v_new=None, ks_new=None, vs_new=None,
+        **call_kwargs,
     ):
         fn = functools.partial(decode_attention, **{**kwargs, **call_kwargs})
         # Scalar index replicates; a per-row (B,) index (ragged serving)
         # shards with the batch.
         idx_spec = row_idx_spec if jnp.ndim(index) == 1 else PartitionSpec()
-        if k_scale is None:
-            body = lambda q_, k_, v_, i_: fn(q_, k_, v_, i_)
-            in_specs = (q_spec, kv_spec, kv_spec, idx_spec)
-            args = (q, k_cache, v_cache, index)
-        else:
-            body = lambda q_, k_, v_, i_, ks_, vs_: fn(
-                q_, k_, v_, i_, k_scale=ks_, v_scale=vs_
-            )
-            in_specs = (q_spec, kv_spec, kv_spec, idx_spec, sc_spec, sc_spec)
-            args = (q, k_cache, v_cache, index, k_scale, v_scale)
+        quantized = k_scale is not None
+        fold = k_new is not None
+        in_specs = [q_spec, kv_spec, kv_spec, idx_spec]
+        args = [q, k_cache, v_cache, index]
+        keys = []
+        if quantized:
+            in_specs += [sc_spec, sc_spec]
+            args += [k_scale, v_scale]
+            keys += ["k_scale", "v_scale"]
+        if fold:
+            in_specs += [kv_spec, kv_spec]
+            args += [k_new, v_new]
+            keys += ["k_new", "v_new"]
+            if quantized:
+                in_specs += [sc_spec, sc_spec]
+                args += [ks_new, vs_new]
+                keys += ["ks_new", "vs_new"]
+        # Folded writes return the updated cache (+ scale) buffers alongside
+        # the attention output; each keeps its input's sharding.
+        out_specs = q_spec
+        if fold:
+            out_specs = (q_spec, kv_spec, kv_spec)
+            if quantized:
+                out_specs += (sc_spec, sc_spec)
+
+        def body(q_, k_, v_, i_, *rest):
+            return fn(q_, k_, v_, i_, **dict(zip(keys, rest)))
+
         # check_vma=False: pallas_call's out_shape carries no varying-axes
         # metadata, which the static replication checker requires.
         return jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=q_spec,
+            body, mesh=mesh, in_specs=tuple(in_specs), out_specs=out_specs,
             check_vma=False,
         )(*args)
 

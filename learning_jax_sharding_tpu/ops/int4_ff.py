@@ -1,0 +1,226 @@
+"""Whole-FF fused int4 kernel: up-project → GELU → down-project, one call.
+
+Round-3 measurement (PERF.md "int4 decode: where the time actually goes"):
+at 1.4B the per-projection fused int4 kernel sits ~3.7× off its HBM byte
+roofline while int8 sits at ~1.2× — not VPU unpack (the w4a8 variant that
+halves VPU work measured level), and not grid geometry (block sweeps flat),
+but the serial CHAIN of kernel boundaries: at M = 8 decode every projection
+is a dependent launch whose latency nothing hides. The fix is fewer,
+bigger kernels on the critical path.
+
+This kernel runs the ENTIRE feed-forward block — both packed weight
+matrices, the GELU, and the hidden activation — inside one ``pallas_call``:
+
+* grid over hidden blocks; step ``j`` streams W1's packed columns for the
+  PAIRED hidden ranges ``[j·bh, (j+1)·bh)`` and ``[H/2 + j·bh, ...)`` and
+  W2's packed rows ``[j·bh, (j+1)·bh)`` — split-half packing
+  (``models/quantize.py::quantize_leaf_int4``) puts exactly those two
+  hidden ranges in one W2 byte row, so each step's up-activation tile is
+  precisely what its down-partial needs;
+* the hidden activation ``u`` (M × H — the array that crossed HBM between
+  the two per-projection calls) never leaves VMEM;
+* the down output accumulates in an f32 scratch across grid steps — both
+  weight matrices stream exactly once.
+
+Inference-only (no VJP). Single-device / replicated serving: under tensor
+parallelism the hidden dim is sharded and the per-projection
+``make_int4_matmul_fn`` shard_map path applies instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _unpack(p, s, *, group: int, dtype):
+    """Packed ``(R, C)`` + scales ``(2·R/group or 1, C)`` → two scaled
+    ``(R, C)`` halves (lo = original rows [0, R), hi = rows [R, 2R))."""
+    rows, cols = p.shape
+    pi = p.astype(jnp.int32)
+    lo = ((pi & 0xF) - 8).astype(jnp.float32)
+    hi = ((pi >> 4) - 8).astype(jnp.float32)
+    if s.shape[0] == 1:
+        return (lo * s).astype(dtype), (hi * s).astype(dtype)
+    ng = rows // group
+    lo = (lo.reshape(ng, group, cols) * s[:ng][:, None, :]).reshape(rows, cols)
+    hi = (hi.reshape(ng, group, cols) * s[ng:][:, None, :]).reshape(rows, cols)
+    return lo.astype(dtype), hi.astype(dtype)
+
+
+def _kernel(
+    x_ref,                      # (block_m, K)
+    up_lo_ref, up_hi_ref,       # (K/2, bh) packed W1 column blocks ×2
+    sup_lo_ref, sup_hi_ref,     # (ng_up or 1, bh) up scales for those blocks
+    dn_ref,                     # (bh, K) packed W2 row block
+    sdn_ref,                    # (1, 2·bh/g or 1, K) block-arranged dn scales
+    o_ref,
+    acc_ref,
+    *,
+    k_half: int, group: int, g_dn: int,
+):
+    j = pl.program_id(1)        # hidden-block dim (m tiles on the outer dim)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                  # (block_m, K)
+    dt = x.dtype
+    dims = (((1,), (0,)), ((), ()))
+
+    def up(p_ref, s_ref):
+        w_lo, w_hi = _unpack(p_ref[...], s_ref[...], group=group, dtype=dt)
+        u = jax.lax.dot_general(
+            x[:, :k_half], w_lo, dims, preferred_element_type=jnp.float32
+        )
+        u += jax.lax.dot_general(
+            x[:, k_half:], w_hi, dims, preferred_element_type=jnp.float32
+        )
+        return jax.nn.gelu(u)                       # (M, bh) f32
+
+    u_lo = up(up_lo_ref, sup_lo_ref)                # hidden rows j·bh …
+    u_hi = up(up_hi_ref, sup_hi_ref)                # hidden rows H/2 + j·bh …
+
+    # W2's packed row r of this block holds hidden rows (j·bh + r, lo
+    # nibble) and (H/2 + j·bh + r, hi) — exactly u_lo's / u_hi's positions.
+    w_lo, w_hi = _unpack(dn_ref[...], sdn_ref[0], group=g_dn, dtype=jnp.float32)
+    acc_ref[:] += jax.lax.dot_general(
+        u_lo, w_lo, dims, preferred_element_type=jnp.float32
+    )
+    acc_ref[:] += jax.lax.dot_general(
+        u_hi, w_hi, dims, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[...] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _pick_block_h(h_half: int, g_dn: int, block_h: int) -> int | None:
+    """Hidden rows per grid step (per half): ≤ ``block_h`` when possible,
+    rounded to cover whole down-scale groups, dividing ``h_half``. None
+    when no such block exists."""
+    bh = min(block_h, h_half)
+    if g_dn > 1:
+        if h_half % g_dn:
+            return None
+        bh = max(bh - bh % g_dn, g_dn)
+    while h_half % bh:
+        bh -= g_dn if g_dn > 1 else 1
+        if bh <= 0:
+            return None
+    return bh
+
+
+def int4_ff_eligible(k: int, hidden: int, group: int, block_h: int = 256) -> bool:
+    """Shapes the fused kernel can tile: even dims, scale groups dividing
+    each packed half, hidden half splitting into whole blocks that cover
+    whole down-scale groups."""
+    if k % 2 or hidden % 2:
+        return False
+    g_up = min(group, k)
+    if g_up < k and (k // 2) % g_up:   # g_up == k → one whole-K group
+        return False
+    g_dn = min(group, hidden)
+    if g_dn == hidden:                 # one whole-H group: any block works
+        g_dn = 1
+    return _pick_block_h(hidden // 2, g_dn, block_h) is not None
+
+
+def int4_ff(
+    x: jax.Array,
+    q4_up: jax.Array,
+    s_up: jax.Array,
+    q4_dn: jax.Array,
+    s_dn: jax.Array,
+    *,
+    group: int = 128,
+    block_h: int = 256,
+    block_m: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``gelu(x @ W1) @ W2`` with both weights int4-packed, one kernel call.
+
+    Args:
+        x: ``(..., K)`` activations.
+        q4_up / s_up: packed ``(K/2, H)`` + scales ``(K/group or 1, H)``.
+        q4_dn / s_dn: packed ``(H/2, K)`` + scales ``(H/group or 1, K)``.
+        group: quantization group of BOTH trees (``quantize_tree`` int4).
+        block_h: hidden rows per grid step per half (VMEM-bound; 256 keeps
+            the four f32 unpack temporaries ≈8 MB at K = 2048).
+        block_m: activation rows per outer grid tile — decode (m ≤ 128)
+            rides one tile; prefill tiles its rows and re-streams the
+            weights per tile, bounding the x block + f32 accumulator
+            inside VMEM (the same trade ``int4_matmul`` makes).
+
+    Returns:
+        ``(..., K)`` in ``x.dtype``.
+    """
+    *lead, k = x.shape
+    k_half, hidden = q4_up.shape
+    h_half, k_out = q4_dn.shape
+    if k != 2 * k_half or k_out != k or hidden != 2 * h_half:
+        raise ValueError(
+            f"shape mismatch: x K={k}, up {q4_up.shape}, down {q4_dn.shape}"
+        )
+    if not int4_ff_eligible(k, hidden, group, block_h):
+        raise ValueError(
+            f"int4_ff cannot tile K={k}, H={hidden}, group={group}; use the "
+            f"per-projection int4_matmul path"
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+    bm = min(block_m, m)
+    pad = (-m) % bm
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    nm = x2.shape[0] // bm
+    g_dn = min(group, hidden)
+    bh = _pick_block_h(h_half, 1 if g_dn == hidden else g_dn, block_h)
+    nsteps = h_half // bh
+    ng_up = s_up.shape[0]
+    if s_dn.shape[0] == 1:
+        # One group over all of H: every block shares the single scale row.
+        sdn_blocks = jnp.broadcast_to(s_dn[None], (nsteps, 1, k))
+        srows = 1
+    else:
+        # Arrange each block's lo+hi scale rows contiguously OUTSIDE the
+        # kernel (they are h_half/g apart in s_dn, which no contiguous
+        # BlockSpec can deliver): block j = [lo rows of j, hi rows of j].
+        rpb = bh // g_dn
+        lo = s_dn[: h_half // g_dn].reshape(nsteps, rpb, k)
+        hi = s_dn[h_half // g_dn :].reshape(nsteps, rpb, k)
+        sdn_blocks = jnp.concatenate([lo, hi], axis=1)  # (nsteps, 2·rpb, K)
+        srows = 2 * rpb
+
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, k_half=k_half, group=min(group, k), g_dn=g_dn,
+        ),
+        grid=(nm, nsteps),
+        in_specs=[
+            pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+            pl.BlockSpec((k_half, bh), lambda i, j: (0, j)),
+            pl.BlockSpec((k_half, bh), lambda i, j, ns=nsteps: (0, j + ns)),
+            pl.BlockSpec((ng_up, bh), lambda i, j: (0, j)),
+            pl.BlockSpec((ng_up, bh), lambda i, j, ns=nsteps: (0, j + ns)),
+            pl.BlockSpec((bh, k), lambda i, j: (j, 0)),
+            pl.BlockSpec((1, srows, k), lambda i, j: (j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, k), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((x2.shape[0], k), x.dtype),
+        scratch_shapes=[pltpu.VMEM((bm, k), jnp.float32)],
+        interpret=interpret,
+    )(x2, q4_up, q4_up, s_up, s_up, q4_dn, sdn_blocks)
+    if pad:
+        out = out[:m]
+    return out.reshape(*lead, k)

@@ -1,4 +1,4 @@
-"""Flash kernel ceiling at long context (VERDICT r2 item 5).
+"""Flash ceiling part 2 (windowed + ablation) (VERDICT r2 item 5).
 
 PERF.md's round-2 diagnosis: at S=8192, head_dim 64, the kernel's per-block
 softmax VPU work (exp, reductions, corrections) is comparable to the MXU
@@ -48,7 +48,7 @@ PEAK = 197e12
 
 # ---- 1. kernel microbench: head_dim 64 vs 128, same total width ----
 B, S = 2, 8192
-for n, h in ((16, 64), (8, 128)):
+for n, h in ():
     q = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
     k = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
     v = jnp.asarray(rng.standard_normal((B, S, n, h)), jnp.bfloat16)
@@ -103,7 +103,6 @@ b8k = dataclasses.replace(
     CONFIG_125M, num_heads=6, head_dim=128, max_seq_len=8192,
     attn_fn=make_flash_attn_fn(), remat=False,
 )
-composed("S=8192 b=2 hd=128 flash causal", b8k, 2, 8192)
 b8kw = dataclasses.replace(b8k, attn_fn=make_flash_attn_fn(window=1024))
 composed("S=8192 b=2 hd=128 banded window 1024", b8kw, 2, 8192, window=1024)
 

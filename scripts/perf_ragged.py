@@ -68,3 +68,21 @@ print(
     f"{secs_uni/new*1e3:.2f} ms/token-step "
     f"(overhead vs rect {secs_uni/secs_rect:.2f}x)", flush=True,
 )
+
+# Deeper skew: one 960-token row pins the batch max (960 + 64 new fills
+# the 1024 cache); pad-to-max decodes EVERY row at position 960+t while
+# ragged rows sit at 64+t.
+lengths2 = np.asarray([960] + [64] * 7, np.int32)
+pmax2 = int(lengths2.max())
+tokens2 = rng.integers(0, cfg.vocab_size, size=(b, pmax2)).astype(np.int32)
+prompt2 = put(tokens2, mesh_sharding(mesh, "data", None))
+secs_rect2 = time_fn(gen_rect, params, prompt2, jax.random.key(1), min_time=2.0)
+print(f"pad-to-max (1024): {b*new/secs_rect2:,.0f} tok/s, "
+      f"{secs_rect2/new*1e3:.2f} ms/token-step", flush=True)
+secs_rag2 = time_fn(
+    gen_rag, params, prompt2, jax.random.key(1), jnp.asarray(lengths2),
+    min_time=2.0,
+)
+print(f"ragged (1024 skew): {b*new/secs_rag2:,.0f} tok/s, "
+      f"{secs_rag2/new*1e3:.2f} ms/token-step ({secs_rect2/secs_rag2:.2f}x)",
+      flush=True)

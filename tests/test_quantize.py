@@ -414,6 +414,83 @@ class TestFusedInt4:
         np.testing.assert_array_equal(out_w4a8[:, :8], out_deq[:, :8])
         assert (out_w4a8[:, 8:] == out_deq[:, 8:]).mean() >= 0.5
 
+    def test_fused_ff_kernel_matches_two_calls(self, rng):
+        """ops/int4_ff.py: the whole-FF kernel (up → GELU → down in one
+        pallas call) must equal gelu(x @ deq(up)) @ deq(down) on the same
+        packed values — the two-call reference it replaces."""
+        from learning_jax_sharding_tpu.models.quantize import (
+            dequantize_leaf_int4,
+            quantize_leaf_int4,
+        )
+        from learning_jax_sharding_tpu.ops.int4_ff import int4_ff
+
+        # m=4 rides one tile; m=37 exercises the prefill row tiling (block_m
+        # 16 → padded non-dividing tiles — the VMEM bound for long prompts).
+        for m, bm, (k, hidden, g) in [
+            (4, 128, (64, 256, 16)),
+            (4, 128, (128, 256, 128)),
+            (37, 16, (64, 128, 64)),
+        ]:
+            w1 = jnp.asarray(rng.normal(size=(k, hidden)), jnp.float32)
+            w2 = jnp.asarray(rng.normal(size=(hidden, k)), jnp.float32)
+            n1 = quantize_leaf_int4(w1, group_size=g)
+            n2 = quantize_leaf_int4(w2, group_size=g)
+            x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+            with jax.default_matmul_precision("float32"):
+                got = int4_ff(
+                    x, n1["q4"], n1["scale"], n2["q4"], n2["scale"],
+                    group=g, block_h=64, block_m=bm, interpret=True,
+                )
+                import flax.linen as nn
+
+                want = nn.gelu(
+                    x @ dequantize_leaf_int4(n1, jnp.float32)
+                ) @ dequantize_leaf_int4(n2, jnp.float32)
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-4
+            )
+
+    def test_fused_ff_generate_single_device(self, rng):
+        """End to end on ONE device (the config where FeedForward routes
+        through int4_ff): fused generate ≡ the dequantize path."""
+        import dataclasses
+
+        import flax.linen as nn
+
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY,
+            Transformer,
+        )
+        from learning_jax_sharding_tpu.parallel import build_mesh
+
+        mesh1 = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+        cfg = dataclasses.replace(CONFIG_TINY, quantization_group=16)
+        prompt = jnp.asarray(
+            np.random.default_rng(5).integers(0, cfg.vocab_size, (2, 8)),
+            jnp.int32,
+        )
+        model = Transformer(cfg)
+        params = nn.meta.unbox(
+            jax.jit(lambda r, t: model.init({"params": r}, t))(
+                jax.random.key(0), prompt
+            )["params"]
+        )
+        q4p = quantize_tree(params, bits=4, group_size=16)
+        with jax.default_matmul_precision("float32"):
+            out_deq = np.asarray(
+                make_generate_fn(
+                    cfg, mesh1, RULES_DP_TP, max_new_tokens=6, dequantize=True
+                )(q4p, prompt)
+            )
+            out_fused = np.asarray(
+                make_generate_fn(
+                    cfg, mesh1, RULES_DP_TP, max_new_tokens=6,
+                    dequantize="fused",
+                )(q4p, prompt)
+            )
+        np.testing.assert_array_equal(out_deq[:, :8], out_fused[:, :8])
+        assert (out_deq[:, 8:] == out_fused[:, 8:]).mean() >= 0.5
+
     def test_long_odd_prefill_rows(self, rng):
         """m beyond the VMEM row budget and not a multiple of 8 (advisor
         round-2 finding: the old divisor search hit m % 0). The caller pads

@@ -129,11 +129,13 @@ class TestRaggedGenerate:
                 err_msg=f"row {row} (length {l}, backend {backend})",
             )
 
-    def test_int8_cache_ragged(self, tiny_setup, mesh22):
-        """Per-row scale writes land at per-row offsets too."""
+    @pytest.mark.parametrize("backend", ["dense", "blocked"])
+    def test_int8_cache_ragged(self, tiny_setup, mesh22, backend):
+        """Per-row scale writes land at per-row offsets too — including the
+        blocked backend's FOLDED in-kernel write of values AND scales."""
         cfg, params, prompt = tiny_setup
         cfg = dataclasses.replace(
-            cfg, kv_cache_dtype=jnp.int8, decode_attention="dense"
+            cfg, kv_cache_dtype=jnp.int8, decode_attention=backend
         )
         gen = make_generate_fn(
             cfg, mesh22, RULES_DP_TP, max_new_tokens=NEW, ragged=True
