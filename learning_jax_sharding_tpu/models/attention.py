@@ -224,6 +224,22 @@ class MultiHeadAttention(nn.Module):
             name=name,
         )
 
+    def _fused_qkv(self, m: int) -> bool:
+        """Route q/k/v through one ``int4_matmul3`` launch: int4 serving,
+        single-device (no TP shard_map injection), MHA (equal projection
+        widths; GQA's narrower k/v keep per-projection calls), no biases,
+        and a group layout the kernel can tile."""
+        if (
+            self.quantization != "int4"
+            or self.quantized_matmul_fn is not None
+            or self.use_bias
+            or self.kv_heads != self.num_heads
+            or m % 2
+        ):
+            return False
+        g = min(self.quantization_group, m)
+        return g == m or (m // 2) % g == 0
+
     def _proj(self, name: str, heads: int) -> nn.Module:
         # Kernel (M, heads*H) carries logical axes (EMBED, HEADS): under the
         # reference rules EMBED→model splits its rows
@@ -249,9 +265,26 @@ class MultiHeadAttention(nn.Module):
             raise ValueError("chunk_lengths requires decode_ragged=True")
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
 
-        q = self._proj("query", self.num_heads)(x)
-        k = self._proj("key", self.kv_heads)(x)
-        v = self._proj("value", self.kv_heads)(x)
+        if self._fused_qkv(m):
+            # q/k/v in ONE kernel launch: at M = 8 decode the serial launch
+            # chain, not bytes, is the int4 floor (PERF.md round 3) — the
+            # three projections share x, so two dependent boundaries per
+            # block vanish. Param layout matches Int4Dense verbatim
+            # (quantized trees apply unchanged).
+            from learning_jax_sharding_tpu.models.quantize import Int4ProjParams
+            from learning_jax_sharding_tpu.ops.int4_matmul import int4_matmul3
+
+            g = min(self.quantization_group, m)
+            n_out = self.num_heads * self.head_dim
+            pairs = [
+                Int4ProjParams(m // 2, n_out, m // g, name=nm)()
+                for nm in ("query", "key", "value")
+            ]
+            q, k, v = int4_matmul3(x.astype(self.dtype), pairs, group=g)
+        else:
+            q = self._proj("query", self.num_heads)(x)
+            k = self._proj("key", self.kv_heads)(x)
+            v = self._proj("value", self.kv_heads)(x)
         # Projections emerge (B, S, N*H); constrain before the head split
         # (the reference constrains the same three activations,
         # `case6_attention.py:105-116`, but names dim 1 'embed').

@@ -28,14 +28,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 
-def _kernel(x_ref, q4_ref, s_ref, o_ref, *, k_half: int, group: int):
-    p = q4_ref[...]                                    # (K/2, bn) uint8
-    # i8 vector bit/arith ops don't legalize in Mosaic; do ALL nibble math
-    # in i32 (the HBM traffic is already paid at uint8 width by the load).
+def _dequant_dot(x, p, s, *, k_half: int, group: int):
+    """One packed block's dequant + dot: ``x (M, K)`` against packed
+    ``(K/2, bn)`` with scales ``(2·K/2/g or 1, bn)`` → f32 ``(M, bn)``.
+    i8 vector bit/arith ops don't legalize in Mosaic; ALL nibble math runs
+    in i32 (the HBM traffic is already paid at uint8 width by the load)."""
     pi = p.astype(jnp.int32)
     lo = ((pi & 0xF) - 8).astype(jnp.float32)
     hi = ((pi >> 4) - 8).astype(jnp.float32)
-    s = s_ref[...]                                     # (K/g or 1, bn) f32
     bn = lo.shape[-1]
     if s.shape[0] == 1:
         lo = lo * s
@@ -44,7 +44,6 @@ def _kernel(x_ref, q4_ref, s_ref, o_ref, *, k_half: int, group: int):
         ng = k_half // group
         lo = (lo.reshape(ng, group, bn) * s[:ng][:, None, :]).reshape(k_half, bn)
         hi = (hi.reshape(ng, group, bn) * s[ng:][:, None, :]).reshape(k_half, bn)
-    x = x_ref[...]                                     # (M, K) input dtype
     dt = x.dtype
     acc = jax.lax.dot_general(
         x[:, :k_half], lo.astype(dt), (((1,), (0,)), ((), ())),
@@ -54,7 +53,30 @@ def _kernel(x_ref, q4_ref, s_ref, o_ref, *, k_half: int, group: int):
         x[:, k_half:], hi.astype(dt), (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    o_ref[...] = acc.astype(o_ref.dtype)
+    return acc
+
+
+def _kernel(x_ref, q4_ref, s_ref, o_ref, *, k_half: int, group: int):
+    o_ref[...] = _dequant_dot(
+        x_ref[...], q4_ref[...], s_ref[...], k_half=k_half, group=group
+    ).astype(o_ref.dtype)
+
+
+def _kernel3(
+    x_ref, qa_ref, sa_ref, qb_ref, sb_ref, qc_ref, sc_ref,
+    oa_ref, ob_ref, oc_ref, *, k_half: int, group: int,
+):
+    """Three same-shape projections of ONE activation block per grid step —
+    the attention q/k/v triple in a single launch (see int4_matmul3)."""
+    x = x_ref[...]
+    for p_ref, s_ref, o_ref in (
+        (qa_ref, sa_ref, oa_ref),
+        (qb_ref, sb_ref, ob_ref),
+        (qc_ref, sc_ref, oc_ref),
+    ):
+        o_ref[...] = _dequant_dot(
+            x, p_ref[...], s_ref[...], k_half=k_half, group=group
+        ).astype(o_ref.dtype)
 
 
 def _kernel_w4a8(x_ref, q4_ref, s_ref, sx_ref, o_ref, *, k_half: int, group: int):
@@ -131,6 +153,47 @@ def _auto_block_m(m: int, k: int, itemsize: int) -> int:
     return -(-(-(-m // n_tiles)) // 8) * 8
 
 
+def _validate_and_tile(
+    x, k_half: int, n: int, ng: int, group: int, block_n, interpret, *,
+    cap: int = 512, itemsize: int | None = None,
+):
+    """Shared wrapper plumbing for the fused int4 matmul entry points:
+    layout validation, interpret default, block selection, M flattening and
+    padding. One copy, so the single- and triple-weight paths cannot drift
+    (and both reject every layout the kernel cannot tile, loudly)."""
+    *lead, k = x.shape
+    if k != 2 * k_half:
+        raise ValueError(f"x contraction dim {k} != 2 × packed rows {k_half}")
+    if ng > 1 and k_half % group:
+        raise ValueError(
+            f"group {group} must divide half the contraction dim {k_half} "
+            f"(split-half packing puts rows r and r + K/2 in one byte)"
+        )
+    if ng != 1 and ng * group != k:
+        raise ValueError(
+            f"scale rows {ng} inconsistent with group {group} over K={k}: "
+            f"expected K/group = {k // group} groups (or 1 whole-K group). "
+            f"The tree was likely quantized with a different group_size."
+        )
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_n is None:
+        block_n = _auto_block_n(n, k, cap=cap)
+    if n % block_n:
+        raise ValueError(f"N {n} not divisible by block_n {block_n}")
+    m = 1
+    for d in lead:
+        m *= d
+    x2 = x.reshape(m, k)
+    block_m = _auto_block_m(
+        m, k, x2.dtype.itemsize if itemsize is None else itemsize
+    )
+    pad = (-m) % block_m
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    return lead, k, m, x2, block_m, block_n, pad, interpret
+
+
 def int4_matmul(
     x: jax.Array,
     q4: jax.Array,
@@ -162,40 +225,19 @@ def int4_matmul(
     Returns:
         ``(..., N)`` in ``x.dtype``.
     """
-    *lead, k = x.shape
     k_half, n = q4.shape
-    if k != 2 * k_half:
-        raise ValueError(f"x contraction dim {k} != 2 × packed rows {k_half}")
     ng = scale.shape[0]
-    if ng > 1 and k_half % group:
-        raise ValueError(
-            f"group {group} must divide half the contraction dim {k_half} "
-            f"(split-half packing puts rows r and r + K/2 in one byte)"
-        )
-    if ng != 1 and ng * group != k:
-        raise ValueError(
-            f"scale rows {ng} inconsistent with group {group} over K={k}: "
-            f"expected K/group = {k // group} groups (or 1 whole-K group). "
-            f"The tree was likely quantized with a different group_size."
-        )
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if block_n is None:
-        block_n = _auto_block_n(n, k)
-    if n % block_n:
-        raise ValueError(f"N {n} not divisible by block_n {block_n}")
-    m = 1
-    for d in lead:
-        m *= d
-    x2 = x.reshape(m, k)
-    block_m = _auto_block_m(m, k, 1 if w4a8 else x2.dtype.itemsize)
-    pad = (-m) % block_m
+    lead, k, m, x2, block_m, block_n, pad, interpret = _validate_and_tile(
+        x, k_half, n, ng, group, block_n, interpret,
+        itemsize=1 if w4a8 else None,
+    )
+    # m tiled on the OUTER grid dim: each n block's unpack runs once per m
+    # tile (nm = 1 for decode, the perf-critical case; prefill trades some
+    # repeated unpack for bounded VMEM).
     if w4a8:
+        # Padded rows quantize to zero activations with unit scales —
+        # they contribute zeros, exactly like the padded bf16 rows.
         xq, sx = quantize_rows_int8(x2)
-        if pad:
-            # Padded rows: zero activations, unit scale — contribute zeros.
-            xq = jnp.pad(xq, ((0, pad), (0, 0)))
-            sx = jnp.pad(sx, ((0, pad), (0, 0)), constant_values=1.0)
         out = pl.pallas_call(
             functools.partial(_kernel_w4a8, k_half=k_half, group=group),
             grid=(xq.shape[0] // block_m, n // block_n),
@@ -209,30 +251,83 @@ def int4_matmul(
             out_shape=jax.ShapeDtypeStruct((xq.shape[0], n), x.dtype),
             interpret=interpret,
         )(xq, q4, scale, sx)
-        if pad:
-            out = out[:m]
-        return out.reshape(*lead, n)
-    if pad:
-        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
-
-    # m tiled on the OUTER grid dim: each n block's unpack runs once per m
-    # tile (nm = 1 for decode, the perf-critical case; prefill trades some
-    # repeated unpack for bounded VMEM).
-    out = pl.pallas_call(
-        functools.partial(_kernel, k_half=k_half, group=group),
-        grid=(x2.shape[0] // block_m, n // block_n),
-        in_specs=[
-            pl.BlockSpec((block_m, k), lambda i, j: (i, 0)),
-            pl.BlockSpec((k_half, block_n), lambda i, j: (0, j)),
-            pl.BlockSpec((ng, block_n), lambda i, j: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((x2.shape[0], n), x.dtype),
-        interpret=interpret,
-    )(x2, q4, scale)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_kernel, k_half=k_half, group=group),
+            grid=(x2.shape[0] // block_m, n // block_n),
+            in_specs=[
+                pl.BlockSpec((block_m, k), lambda i, j: (i, 0)),
+                pl.BlockSpec((k_half, block_n), lambda i, j: (0, j)),
+                pl.BlockSpec((ng, block_n), lambda i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((block_m, block_n), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((x2.shape[0], n), x.dtype),
+            interpret=interpret,
+        )(x2, q4, scale)
     if pad:
         out = out[:m]
     return out.reshape(*lead, n)
+
+
+def int4_matmul3(
+    x: jax.Array,
+    weights: list[tuple[jax.Array, jax.Array]],
+    *,
+    group: int = 128,
+    block_n: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, ...]:
+    """THREE same-shape fused dequant-matmuls of one input in ONE kernel
+    launch — the attention q/k/v triple.
+
+    At M = 8 decode the binding cost is the serial launch chain, not bytes
+    or VPU work (PERF.md round 3): fusing the three projections that share
+    an input removes two dependent kernel boundaries per attention block.
+
+    Args:
+        x: ``(..., K)`` activations.
+        weights: three ``(q4, scale)`` pairs, ALL ``(K/2, N)`` /
+            ``(K/group or 1, N)`` with the SAME N (MHA; GQA's narrower k/v
+            use the per-projection path).
+        group / block_n / interpret: as :func:`int4_matmul` (block_n
+            default halves to bound three unpack temporaries in VMEM).
+
+    Returns:
+        Three ``(..., N)`` arrays in ``x.dtype``.
+    """
+    if len(weights) != 3:
+        raise ValueError(f"int4_matmul3 takes exactly 3 weights, got {len(weights)}")
+    k_half, n = weights[0][0].shape
+    ng = weights[0][1].shape[0]
+    for q4, scale in weights:
+        if q4.shape != (k_half, n):
+            raise ValueError(
+                f"all packed weights must share one shape; got {q4.shape} "
+                f"vs {(k_half, n)}"
+            )
+        if scale.shape[0] != ng:
+            raise ValueError("all three scales must share one group layout")
+    lead, k, m, x2, block_m, block_n, pad, interpret = _validate_and_tile(
+        x, k_half, n, ng, group, block_n, interpret,
+        cap=256,   # 3 unpack temporaries share the VMEM budget
+    )
+
+    w_spec = pl.BlockSpec((k_half, block_n), lambda i, j: (0, j))
+    s_spec = pl.BlockSpec((ng, block_n), lambda i, j: (0, j))
+    o_spec = pl.BlockSpec((block_m, block_n), lambda i, j: (i, j))
+    o_shape = jax.ShapeDtypeStruct((x2.shape[0], n), x.dtype)
+    outs = pl.pallas_call(
+        functools.partial(_kernel3, k_half=k_half, group=group),
+        grid=(x2.shape[0] // block_m, n // block_n),
+        in_specs=[pl.BlockSpec((block_m, k), lambda i, j: (i, 0))]
+        + [spec for _ in weights for spec in (w_spec, s_spec)],
+        out_specs=[o_spec] * 3,
+        out_shape=[o_shape] * 3,
+        interpret=interpret,
+    )(x2, *(a for pair in weights for a in pair))
+    if pad:
+        outs = [o[:m] for o in outs]
+    return tuple(o.reshape(*lead, n) for o in outs)
 
 
 def make_int4_matmul_fn(mesh, rules, *, w4a8: bool = False):

@@ -414,6 +414,42 @@ class TestFusedInt4:
         np.testing.assert_array_equal(out_w4a8[:, :8], out_deq[:, :8])
         assert (out_w4a8[:, 8:] == out_deq[:, 8:]).mean() >= 0.5
 
+    def test_qkv_triple_matches_three_calls(self, rng):
+        """ops/int4_matmul.py::int4_matmul3 — three projections of one
+        input in one launch must equal three int4_matmul calls exactly
+        (same unpack, same dots)."""
+        from learning_jax_sharding_tpu.models.quantize import quantize_leaf_int4
+        from learning_jax_sharding_tpu.ops.int4_matmul import (
+            int4_matmul,
+            int4_matmul3,
+        )
+
+        for m, k, n, g in [(4, 64, 48, 16), (9, 256, 128, 128)]:
+            nodes = [
+                quantize_leaf_int4(
+                    jnp.asarray(rng.normal(size=(k, n)), jnp.float32),
+                    group_size=g,
+                )
+                for _ in range(3)
+            ]
+            x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+            with jax.default_matmul_precision("float32"):
+                fused = int4_matmul3(
+                    x, [(nd["q4"], nd["scale"]) for nd in nodes],
+                    group=min(g, k), interpret=True,
+                )
+                singles = [
+                    int4_matmul(
+                        x, nd["q4"], nd["scale"], group=min(g, k),
+                        interpret=True,
+                    )
+                    for nd in nodes
+                ]
+            for got, want in zip(fused, singles):
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), atol=1e-5
+                )
+
     def test_fused_ff_kernel_matches_two_calls(self, rng):
         """ops/int4_ff.py: the whole-FF kernel (up → GELU → down in one
         pallas call) must equal gelu(x @ deq(up)) @ deq(down) on the same
