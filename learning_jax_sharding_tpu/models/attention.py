@@ -88,6 +88,42 @@ def row_update(buf: jax.Array, chunk: jax.Array, idx: jax.Array, *, seq_dim: int
     return jax.vmap(one)(buf, chunk, idx)
 
 
+def row_update_masked(
+    buf: jax.Array, chunk: jax.Array, idx: jax.Array, lengths: jax.Array,
+    *, seq_dim: int,
+) -> jax.Array:
+    """Length-aware :func:`row_update`: row ``b`` writes only its first
+    ``lengths[b]`` chunk positions at ``idx[b]``; the rest of the window
+    writes back the buffer's OWN values.
+
+    Why this exists (continuous batching): a refill chunk runs for EVERY
+    row, and a zero-length row near the buffer end would have its
+    ``dynamic_update_slice`` start CLAMPED below its index — overwriting
+    valid attended history with chunk padding. The masked read-modify-write
+    makes any clamped or zero-length window a no-op on existing data (and
+    aligns a clamped partial chunk to its true offset), so mixed
+    refill/decode batches can never corrupt a row's cache.
+    """
+    s = chunk.shape[seq_dim]
+    cap = buf.shape[seq_dim]
+
+    def one(b_buf, b_chunk, i, n):
+        start_v = jnp.minimum(i, cap - s)
+        starts = [jnp.zeros((), jnp.int32)] * b_buf.ndim
+        starts[seq_dim - 1] = start_v
+        win = jax.lax.dynamic_slice(b_buf, tuple(starts), b_chunk.shape)
+        off = i - start_v          # 0 unless the window start clamped
+        pos = jnp.arange(s)
+        shape = [1] * b_buf.ndim
+        shape[seq_dim - 1] = s
+        mask = ((pos >= off) & (pos < off + n)).reshape(shape)
+        rolled = jnp.roll(b_chunk, off, axis=seq_dim - 1)
+        merged = jnp.where(mask, rolled, win)
+        return jax.lax.dynamic_update_slice(b_buf, merged, tuple(starts))
+
+    return jax.vmap(one)(buf, chunk, idx, lengths)
+
+
 def repeat_kv(kv: jax.Array, num_heads: int) -> jax.Array:
     """Broadcast grouped k/v heads ``(B, S, N_kv, H)`` to ``num_heads``.
 
@@ -428,21 +464,27 @@ class MultiHeadAttention(nn.Module):
                 "cache", "value_scale", jnp.ones, (b, length, n_kv), jnp.float32
             )
 
+        def ragged_write(buf, chunk, seq_dim):
+            # Length-aware when per-row valid counts ride the call: rows
+            # with 0 valid tokens (and clamped near-end windows) must not
+            # disturb existing cache (see row_update_masked).
+            if chunk_lengths is not None:
+                return row_update_masked(
+                    buf, chunk, idx, chunk_lengths, seq_dim=seq_dim
+                )
+            return row_update(buf, chunk, idx, seq_dim=seq_dim)
+
         def write(var, chunk, scale_var=None):
             if quantized:
                 scale, chunk = quantize_kv_chunk(chunk)
                 if ragged:
-                    scale_var.value = row_update(
-                        scale_var.value, scale, idx, seq_dim=1
-                    )
+                    scale_var.value = ragged_write(scale_var.value, scale, 1)
                 else:
                     scale_var.value = jax.lax.dynamic_update_slice(
                         scale_var.value, scale, (0, idx, 0)
                     )
             if ragged:
-                var.value = row_update(
-                    var.value, chunk.astype(store), idx, seq_dim=1
-                )
+                var.value = ragged_write(var.value, chunk.astype(store), 1)
             else:
                 var.value = jax.lax.dynamic_update_slice(
                     var.value, chunk.astype(store), (0, idx, 0, 0)
@@ -539,19 +581,27 @@ class MultiHeadAttention(nn.Module):
                 )
             return chunk.astype(store).transpose(0, 2, 1, 3), None
 
+        def ragged_write(buf, chunk):
+            # Length-aware when per-row valid counts ride the call (see
+            # row_update_masked: zero-length / clamped windows must be
+            # no-ops on existing cache).
+            if chunk_lengths is not None:
+                return row_update_masked(
+                    buf, chunk, idx, chunk_lengths, seq_dim=2
+                )
+            return row_update(buf, chunk, idx, seq_dim=2)
+
         def write(var, chunk, scale_var=None):
             chunk, scale = to_seq_major(chunk)
             if quantized:
                 if ragged:
-                    scale_var.value = row_update(
-                        scale_var.value, scale, idx, seq_dim=2
-                    )
+                    scale_var.value = ragged_write(scale_var.value, scale)
                 else:
                     scale_var.value = jax.lax.dynamic_update_slice(
                         scale_var.value, scale, (0, 0, idx)
                     )
             if ragged:
-                var.value = row_update(var.value, chunk, idx, seq_dim=2)
+                var.value = ragged_write(var.value, chunk)
             else:
                 var.value = jax.lax.dynamic_update_slice(
                     var.value, chunk, (0, 0, idx, 0)

@@ -1,0 +1,288 @@
+"""Continuous batching: slot reuse over the ragged KV cache.
+
+The last piece of serving realism the rectangular stack could not express
+(after ragged batches, round 3): a REQUEST QUEUE served through a fixed
+batch of cache slots, where a finished row's slot is immediately refilled
+with the next queued prompt instead of idling until the whole batch
+drains. The reference has no inference path at all (SURVEY.md §5); this is
+the engine loop that production serving runs.
+
+TPU-shaped design — the host drives, the device stays static:
+
+* two steady-state compiled programs serve any workload — ``refill_step``
+  (a fixed ``(B, refill_chunk)`` chunk; each row's valid length rides the
+  ragged ``chunk_lengths``, so any mix of fresh prompts, continuing long
+  prompts, and idle/decoding rows shares one executable) and
+  ``decode_block`` (K tokens per active row, scanned on device) — plus
+  the one-shot cache-creating first refill;
+* admission is a pure cache-index RESET (per-row counters zero; stale K/V
+  beyond a row's new index is invisible to the causal-at-index masks and
+  overwritten as the new request advances) — no cache clearing, no
+  reallocation;
+* prompts longer than ``refill_chunk`` stream through several refill
+  calls (the row stays inactive between them; its slot advances by each
+  chunk's valid count while every other row advances by 0);
+* decoding rows keep decoding while other slots refill — the batch never
+  drains to admit work.
+
+Oracle (test-pinned): under GREEDY decoding every request's output is
+bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
+slot reuse and chunk scheduling change throughput, never results. With
+``temperature > 0`` the engine draws per-dispatch keys, so sampled
+outputs depend on scheduling (queue composition and slot assignment);
+use greedy when reproducibility against single runs matters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from learning_jax_sharding_tpu.models.decoding import (
+    check_sequence_budget,
+    derive_decode_config,
+    make_cached_apply,
+    make_param_caster,
+)
+from learning_jax_sharding_tpu.models.generate import _sample
+from learning_jax_sharding_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+
+
+def _reset_rows(cache: Any, mask: jax.Array) -> Any:
+    """Zero the per-row decode counters (``cache_index`` and ``position``)
+    where ``mask`` is True — request admission. Stale K/V past a reset
+    row's index is masked by causal-at-index attention and overwritten as
+    the new request writes (same invariant speculative rollback relies
+    on, ``models/speculative.py::_rollback``)."""
+
+    def leaf(path, x):
+        if getattr(path[-1], "key", None) in ("cache_index", "position"):
+            return jnp.where(mask, jnp.zeros_like(x), x)
+        return x
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+def make_continuous_engine(
+    config: TransformerConfig,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    batch_size: int,
+    max_new_tokens: int,
+    eos_id: Optional[int] = None,
+    refill_chunk: int = 64,
+    decode_block_steps: int = 16,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    min_p: float | None = None,
+    vocab_limit: int | None = None,
+    inference_dtype: Any | None = None,
+):
+    """Build ``serve(params, prompts, rng) -> list[np.ndarray]``.
+
+    ``prompts`` is any number of 1-D int32 arrays (the request queue, in
+    arrival order); the result list matches its order, each entry
+    ``[prompt, generated...]`` — generation stops at ``eos_id`` (included
+    in the output) or after ``max_new_tokens``.
+
+    ``batch_size`` fixes the device batch (cache slots); ``refill_chunk``
+    fixes the admission chunk length (longer prompts stream through
+    several refill calls); ``decode_block_steps`` fixes how many tokens
+    each decode dispatch scans on device (the host loop pays one
+    round-trip per block — rows that retire mid-block on BUDGET waste at
+    most block−1 device steps before their slot resets at refill; EOS
+    rows freeze in-scan). All are compile-time shapes: the whole engine
+    runs on two executables regardless of queue size or length mix.
+    """
+    if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
+        raise ValueError(
+            "batch_size, refill_chunk, decode_block_steps must be >= 1"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if refill_chunk > config.max_seq_len:
+        raise ValueError(
+            f"refill_chunk ({refill_chunk}) exceeds max_seq_len "
+            f"({config.max_seq_len})"
+        )
+    cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
+    cfg = dataclasses.replace(cfg, decode_ragged=True)
+    model = Transformer(cfg)
+    apply = make_cached_apply(model)
+    maybe_cast = make_param_caster(inference_dtype)
+
+    def sample(logits, rng):
+        return _sample(
+            logits, temperature, rng, top_k, top_p, min_p, vocab_limit
+        )
+
+    @jax.jit
+    def refill_step(params, cache, chunk, lengths, reset_mask, rng):
+        # Admission: zero the admitted rows' counters, then run the chunk —
+        # every row's cache advance is its own valid length (0 for rows
+        # that are decoding or idle this call).
+        if cache is not None:
+            cache = _reset_rows(cache, reset_mask)
+        logits, cache = apply(params, cache, chunk, lengths)
+        pick = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return sample(pick, rng), cache
+
+    # Cache creation needs an apply without a cache; same program shape as
+    # refill_step minus the reset (Flax creates the zeroed caches).
+    @jax.jit
+    def first_refill(params, chunk, lengths, rng):
+        logits, cache = apply(params, None, chunk, lengths)
+        pick = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        return sample(pick, rng), cache
+
+    @jax.jit
+    def decode_block(params, cache, tok, active, rng):
+        """``decode_block_steps`` tokens per call, scanned ON DEVICE — the
+        host loop costs one dispatch/readback per BLOCK, not per token
+        (measured on the tunneled chip: per-token host stepping ran 30×
+        slower than the same work scanned). Rows that emit ``eos`` flip
+        inactive IN-scan — chunk_lengths 0, so they stop consuming cache
+        mid-block exactly like the stepwise path."""
+
+        def body(carry, rng_step):
+            tok, active, cache = carry
+            logits, cache = apply(params, cache, tok[:, None], active)
+            nxt = sample(logits[:, -1], rng_step)
+            nxt = jnp.where(active == 1, nxt, tok)
+            if eos_id is not None:
+                active = active * (nxt != eos_id).astype(jnp.int32)
+            return (nxt, active, cache), nxt
+
+        rngs = jax.random.split(rng, decode_block_steps)
+        (tok, active, cache), toks = jax.lax.scan(
+            body, (tok, active, cache), rngs
+        )
+        return toks.T, active, cache   # (B, K) tokens
+
+    def serve(params, prompts, rng=None):
+        rng = jax.random.key(0) if rng is None else rng
+        b = batch_size
+        prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        for p in prompts:
+            if p.size < 1:
+                raise ValueError("empty prompt")
+            check_sequence_budget(
+                p.size + max_new_tokens, cfg.max_seq_len,
+                f"prompt ({p.size}) + max_new_tokens ({max_new_tokens})",
+            )
+        params = maybe_cast(params)
+        queue = deque(enumerate(prompts))
+        results: dict[int, list[int]] = {}
+
+        # Host-side slot state. A slot is: idle (req < 0), refilling
+        # (pending prompt tokens remain), or decoding (active).
+        req = [-1] * b                 # request id per slot
+        pending: list[np.ndarray] = [np.zeros((0,), np.int32)] * b
+        emitted = [0] * b
+        out: list[list[int]] = [[] for _ in range(b)]
+        tok = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        cache = None
+        step = 0
+
+        def retire(slot):
+            results[req[slot]] = out[slot]
+            req[slot] = -1
+            active[slot] = False
+
+        with activate(mesh, rules):
+            while queue or any(r >= 0 for r in req):
+                # 1. Admit queued requests into idle slots.
+                reset = np.zeros((b,), bool)
+                for slot in range(b):
+                    if req[slot] < 0 and queue:
+                        rid, prompt = queue.popleft()
+                        req[slot] = rid
+                        pending[slot] = prompt
+                        emitted[slot] = 0
+                        out[slot] = list(prompt)
+                        reset[slot] = True
+
+                # 2. One refill chunk for every slot with pending prompt
+                #    tokens (fresh or continuing); decoding rows ride along
+                #    with length 0.
+                lengths = np.zeros((b,), np.int32)
+                chunk = np.zeros((b, refill_chunk), np.int32)
+                for slot in range(b):
+                    n = min(pending[slot].size, refill_chunk)
+                    if n:
+                        chunk[slot, :n] = pending[slot][:n]
+                        lengths[slot] = n
+                if lengths.any():
+                    step += 1
+                    sub = jax.random.fold_in(rng, step)
+                    if cache is None:
+                        tok_new, cache = first_refill(
+                            params, jnp.asarray(chunk), jnp.asarray(lengths),
+                            sub,
+                        )
+                    else:
+                        tok_new, cache = refill_step(
+                            params, cache, jnp.asarray(chunk),
+                            jnp.asarray(lengths), jnp.asarray(reset), sub,
+                        )
+                    tok_new = np.asarray(tok_new)
+                    for slot in range(b):
+                        if lengths[slot]:
+                            pending[slot] = pending[slot][lengths[slot]:]
+                            if pending[slot].size == 0 and req[slot] >= 0:
+                                # Prompt complete: its first token came from
+                                # this chunk's last valid position.
+                                t = int(tok_new[slot])
+                                out[slot].append(t)
+                                emitted[slot] = 1
+                                tok[slot] = t
+                                if (eos_id is not None and t == eos_id) or (
+                                    max_new_tokens == 1
+                                ):
+                                    retire(slot)
+                                else:
+                                    active[slot] = True
+                    continue   # admit/refill until no prompt tokens remain
+
+                # 3. One decode BLOCK for the active rows.
+                if active.any():
+                    step += 1
+                    sub = jax.random.fold_in(rng, step)
+                    toks, _, cache = decode_block(
+                        params, cache, jnp.asarray(tok),
+                        jnp.asarray(active.astype(np.int32)), sub,
+                    )
+                    toks = np.asarray(toks)
+                    for slot in range(b):
+                        if not active[slot]:
+                            continue
+                        for t in toks[slot].tolist():
+                            out[slot].append(int(t))
+                            emitted[slot] += 1
+                            tok[slot] = int(t)
+                            if (eos_id is not None and t == eos_id) or (
+                                emitted[slot] >= max_new_tokens
+                            ):
+                                retire(slot)
+                                break
+
+        return [np.asarray(results[i], np.int32) for i in range(len(prompts))]
+
+    return serve
