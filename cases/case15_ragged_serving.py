@@ -1,0 +1,118 @@
+"""Case 15 — production-shaped serving: ragged batches + continuous batching.
+
+Not in the reference (it has no inference path at all, SURVEY.md §5). The
+round-3 serving stack, demonstrated end to end on a (data, model) mesh:
+
+1. Train the tiny transformer on a perfectly learnable cyclic stream.
+2. RAGGED batch: mixed-length prompts decode together, each row at its own
+   length (per-row cache positions; per-row kernel clamps on the blocked
+   backend) — outputs proven bit-identical to per-prompt runs.
+3. CONTINUOUS BATCHING: a queue of requests through a fixed batch of cache
+   slots — retired slots refill immediately, long prompts stream through
+   fixed refill chunks, greedy outputs again bit-identical.
+
+Run: ``python cases/case15_ragged_serving.py``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY, Transformer
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+
+
+class CyclicDataset:
+    """token(i+1) = token(i) + 1 (mod V): learnable in a few steps."""
+
+    def __init__(self, vocab_size, seq_len):
+        self.vocab_size, self.seq_len = vocab_size, seq_len
+
+    def batch(self, index, rows=None, batch_size=8):
+        rng = np.random.default_rng((15, index))
+        starts = rng.integers(0, self.vocab_size, size=batch_size)
+        if rows is not None:
+            starts = starts[rows]
+        toks = (starts[:, None] + np.arange(self.seq_len + 1)[None]) % self.vocab_size
+        return {"inputs": toks[:, :-1].astype(np.int32),
+                "targets": toks[:, 1:].astype(np.int32)}
+
+
+def main():
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jax.numpy.float32)
+    new = 6
+
+    print("training 40 steps on the cyclic stream ...")
+    state, history = fit(
+        Transformer(cfg), CyclicDataset(cfg.vocab_size, 32), mesh, RULES_DP_TP,
+        TrainLoopConfig(steps=40, global_batch_size=16, learning_rate=3e-3,
+                        log_every=20),
+    )
+    print(f"loss: {history[0]['loss']:.3f} -> {history[-1]['loss']:.3f}")
+    import flax.linen as nn
+
+    params = nn.meta.unbox(state.params)
+
+    # Single-prompt references (the oracle for everything below).
+    gen = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=new)
+
+    def reference(prompt):
+        out = np.asarray(
+            gen(params, np.repeat(prompt[None], 2, axis=0), jax.random.key(0))
+        )
+        return out[0]
+
+    # --- 2. Ragged batch: four prompts of different lengths, one batch ---
+    lengths = np.asarray([3, 10, 6, 2], np.int32)
+    pmax = int(lengths.max())
+    rng = np.random.default_rng(2)
+    prompt_mat = np.zeros((4, pmax), np.int32)
+    prompts = []
+    for i, ln in enumerate(lengths):
+        start = int(rng.integers(0, cfg.vocab_size))
+        p = (start + np.arange(ln)) % cfg.vocab_size
+        prompts.append(p.astype(np.int32))
+        prompt_mat[i, :ln] = p
+    rag = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=new, ragged=True
+    )
+    out = np.asarray(rag(params, prompt_mat, jax.random.key(0), lengths))
+    for i, (p, ln) in enumerate(zip(prompts, lengths)):
+        ref = reference(p)
+        assert (out[i, : ln + new] == ref).all(), (i, out[i], ref)
+    print(f"PASS: ragged batch of lengths {lengths.tolist()} — every row "
+          f"bit-identical to its single-prompt run")
+
+    # --- 3. Continuous batching: 6 requests through 2 cache slots ---
+    queue = [
+        ((int(rng.integers(0, cfg.vocab_size)) + np.arange(n)) % cfg.vocab_size)
+        .astype(np.int32)
+        for n in (4, 12, 2, 30, 7, 5)   # the 30-token prompt streams
+    ]                                    # through several 8-token refills
+    serve = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, batch_size=2, max_new_tokens=new,
+        refill_chunk=8, decode_block_steps=2,
+    )
+    outs = serve(params, queue)
+    for p, got in zip(queue, outs):
+        ref = reference(p)
+        assert (got == ref[: len(got)]).all(), (p, got, ref)
+    print(f"PASS: {len(queue)} queued requests through 2 slots (slot reuse, "
+          f"multi-chunk refill) — all bit-identical to single runs")
+    print("PASS: case15 — ragged + continuous serving, proven against "
+          "single-prompt decoding")
+
+
+if __name__ == "__main__":
+    main()

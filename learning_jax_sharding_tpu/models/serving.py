@@ -22,8 +22,10 @@ TPU-shaped design — the host drives, the device stays static:
 * prompts longer than ``refill_chunk`` stream through several refill
   calls (the row stays inactive between them; its slot advances by each
   chunk's valid count while every other row advances by 0);
-* decoding rows keep decoding while other slots refill — the batch never
-  drains to admit work.
+* decoding rows keep their state while other slots refill (they ride the
+  refill chunk with length 0 and resume on the next decode block) — the
+  batch never DRAINS to admit work, though rows pause for the refill
+  dispatches themselves.
 
 Oracle (test-pinned): under GREEDY decoding every request's output is
 bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
@@ -132,9 +134,9 @@ def make_continuous_engine(
     def refill_step(params, cache, chunk, lengths, reset_mask, rng):
         # Admission: zero the admitted rows' counters, then run the chunk —
         # every row's cache advance is its own valid length (0 for rows
-        # that are decoding or idle this call).
-        if cache is not None:
-            cache = _reset_rows(cache, reset_mask)
+        # that are decoding or idle this call). The cache-None first call
+        # routes to first_refill instead.
+        cache = _reset_rows(cache, reset_mask)
         logits, cache = apply(params, cache, chunk, lengths)
         pick = jnp.take_along_axis(
             logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1

@@ -45,8 +45,10 @@ serve = make_continuous_engine(
     cfg, mesh, RULES_DP_TP, batch_size=8, max_new_tokens=NEW, eos_id=eos,
     refill_chunk=64,
 )
-# Warm both executables, then time the whole queue.
-serve(params, prompts[:8])
+# Warm ALL THREE executables (9 > batch_size forces a slot-reuse refill,
+# compiling refill_step; 8 would compile only first_refill + decode_block
+# and leave a compile inside the timed region), then time the whole queue.
+serve(params, prompts[:9])
 t0 = time.perf_counter()
 outs = serve(params, prompts)
 t1 = time.perf_counter()
