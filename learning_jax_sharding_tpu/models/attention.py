@@ -614,6 +614,11 @@ class MultiHeadAttention(nn.Module):
             fold_args = dict(k_new=k_sm, v_new=v_sm)
             if quantized:
                 fold_args.update(ks_new=ks_sm, vs_new=vs_sm)
+            if chunk_lengths is not None:
+                # Frozen rows (length 0) must not have their garbage token
+                # merged into the cache — the kernel pushes their write
+                # slot out of range and flushes the block unchanged.
+                fold_args["write_enable"] = chunk_lengths
         else:
             write(cached_k, k, k_scale if quantized else None)
             write(cached_v, v, v_scale if quantized else None)

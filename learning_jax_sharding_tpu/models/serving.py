@@ -25,14 +25,28 @@ TPU-shaped design — the host drives, the device stays static:
 * decoding rows keep their state while other slots refill (they ride the
   refill chunk with length 0 and resume on the next decode block) — the
   batch never DRAINS to admit work, though rows pause for the refill
-  dispatches themselves.
+  dispatches themselves;
+* rows freeze IN-SCAN at their generation budget (a per-row ``remaining``
+  counter carried through the decode block), so a retired row's
+  ``cache_index`` can never advance past ``prompt + max_new_tokens`` —
+  the cache-capacity invariant holds on device, not just in host
+  bookkeeping;
+* SPECULATIVE decoding (``draft_config``): each decode-block step drafts
+  ``num_draft`` tokens with the draft model, verifies them in ONE target
+  chunk, and accepts PER-ROW — rollback rewinds each row's own
+  ``cache_index`` (``models/speculative.py``'s ragged machinery inside
+  the engine), so one round emits 1..num_draft+1 tokens per row and the
+  block returns per-row counts. Greedy only (speculative sampling inside
+  the engine would need per-request rejection streams).
 
-Oracle (test-pinned): under GREEDY decoding every request's output is
+Oracles (test-pinned): under GREEDY decoding every request's output is
 bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
-slot reuse and chunk scheduling change throughput, never results. With
-``temperature > 0`` the engine draws per-dispatch keys, so sampled
-outputs depend on scheduling (queue composition and slot assignment);
-use greedy when reproducibility against single runs matters.
+slot reuse, chunk scheduling, and speculation change throughput, never
+results. With ``temperature > 0`` every sampling draw is keyed by
+(REQUEST id, generated position), so a request's sampled stream is
+reproducible across schedules too: the same queue served with any batch
+size, arrival order, or slot assignment yields the same tokens per
+request (given the same ``rng``).
 """
 
 from __future__ import annotations
@@ -52,7 +66,13 @@ from learning_jax_sharding_tpu.models.decoding import (
     make_cached_apply,
     make_param_caster,
 )
-from learning_jax_sharding_tpu.models.generate import _sample
+from learning_jax_sharding_tpu.models.attention import row_update_masked
+from learning_jax_sharding_tpu.models.generate import filtered_logits
+from learning_jax_sharding_tpu.models.speculative import (
+    _greedy as greedy_pick,
+    _rollback,
+    greedy_accept_emit,
+)
 from learning_jax_sharding_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
@@ -91,8 +111,10 @@ def make_continuous_engine(
     min_p: float | None = None,
     vocab_limit: int | None = None,
     inference_dtype: Any | None = None,
+    draft_config: Optional[TransformerConfig] = None,
+    num_draft: int = 4,
 ):
-    """Build ``serve(params, prompts, rng) -> list[np.ndarray]``.
+    """Build ``serve(params, prompts, rng, draft_params) -> list[np.ndarray]``.
 
     ``prompts`` is any number of 1-D int32 arrays (the request queue, in
     arrival order); the result list matches its order, each entry
@@ -101,12 +123,24 @@ def make_continuous_engine(
 
     ``batch_size`` fixes the device batch (cache slots); ``refill_chunk``
     fixes the admission chunk length (longer prompts stream through
-    several refill calls); ``decode_block_steps`` fixes how many tokens
-    each decode dispatch scans on device (the host loop pays one
-    round-trip per block — rows that retire mid-block on BUDGET waste at
-    most block−1 device steps before their slot resets at refill; EOS
-    rows freeze in-scan). All are compile-time shapes: the whole engine
-    runs on two executables regardless of queue size or length mix.
+    several refill calls); ``decode_block_steps`` fixes how many decode
+    rounds each dispatch scans on device (the host loop pays one
+    round-trip per block; rows freeze in-scan at EOS or at their budget,
+    so a retired row's cache index never advances past
+    ``prompt + max_new_tokens``). All are compile-time shapes: the whole
+    engine runs on two executables regardless of queue size or length mix.
+
+    ``draft_config``: enable SPECULATIVE decode blocks — a draft model
+    proposes ``num_draft`` tokens per round, the target verifies them in
+    one chunked forward, acceptance and cache rollback are PER-ROW. Pass
+    the draft params as ``serve(..., draft_params=...)``. Greedy only
+    (``temperature == 0``); output stays bit-identical to non-speculative
+    greedy serving (test-pinned) — the draft changes only how many target
+    dispatches the tokens cost.
+
+    ``temperature > 0``: every draw is keyed by (request id, generated
+    position) folded into ``rng`` — sampled outputs are reproducible
+    across schedules (batch size, arrival order, slot assignment).
     """
     if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
         raise ValueError(
@@ -119,94 +153,290 @@ def make_continuous_engine(
             f"refill_chunk ({refill_chunk}) exceeds max_seq_len "
             f"({config.max_seq_len})"
         )
+    speculative = draft_config is not None
+    if speculative:
+        if temperature != 0.0:
+            raise ValueError(
+                "speculative serving is greedy-only (temperature == 0): "
+                "in-engine speculative sampling would need per-request "
+                "rejection streams"
+            )
+        if num_draft < 1:
+            raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+        if draft_config.vocab_size != config.vocab_size:
+            raise ValueError(
+                f"target vocab {config.vocab_size} != draft vocab "
+                f"{draft_config.vocab_size}"
+            )
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     cfg = dataclasses.replace(cfg, decode_ragged=True)
     model = Transformer(cfg)
     apply = make_cached_apply(model)
     maybe_cast = make_param_caster(inference_dtype)
-
-    def sample(logits, rng):
-        return _sample(
-            logits, temperature, rng, top_k, top_p, min_p, vocab_limit
+    if speculative:
+        d_cfg = derive_decode_config(
+            draft_config, inference_dtype, mesh=mesh, rules=rules
         )
+        d_cfg = dataclasses.replace(d_cfg, decode_ragged=True)
+        d_apply = make_cached_apply(Transformer(d_cfg))
+
+    def _greedy(logits):
+        return greedy_pick(logits, vocab_limit)
+
+    def row_keys(rng, rid, pos):
+        """(B,) keys from (request id, generated position): the stream a
+        request samples from depends only on its own identity and how far
+        it has generated — never on scheduling."""
+
+        def one(r, p):
+            return jax.random.fold_in(jax.random.fold_in(rng, r), p)
+
+        return jax.vmap(one)(rid, pos)
+
+    def sample_rows(logits, rng, rid, pos):
+        """Per-row sampling with (request, position) keys; greedy ignores
+        the keys entirely (deterministic)."""
+        if temperature == 0.0:
+            return _greedy(logits)
+        fl = filtered_logits(
+            logits, temperature, top_k, top_p, min_p, vocab_limit
+        )
+        return jax.vmap(jax.random.categorical)(
+            row_keys(rng, rid, pos), fl
+        ).astype(jnp.int32)
+
+    def _refill(params, d_params, cache, chunk, lengths, rid, rng):
+        # Run the chunk through the target (and the draft, whose cache
+        # must mirror the target's valid prefix for verification); the
+        # pick is each row's first generated token — position 0 of its
+        # stream.
+        if speculative:
+            t_cache, d_cache = cache
+            logits, t_cache = apply(params, t_cache, chunk, lengths)
+            _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
+            cache = (t_cache, d_cache)
+        else:
+            logits, cache = apply(params, cache, chunk, lengths)
+        pick = jnp.take_along_axis(
+            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+        )[:, 0]
+        tok = sample_rows(pick, rng, rid, jnp.zeros_like(rid))
+        return tok, cache
 
     @jax.jit
-    def refill_step(params, cache, chunk, lengths, reset_mask, rng):
+    def refill_step(params, d_params, cache, chunk, lengths, reset_mask, rid, rng):
         # Admission: zero the admitted rows' counters, then run the chunk —
         # every row's cache advance is its own valid length (0 for rows
         # that are decoding or idle this call). The cache-None first call
         # routes to first_refill instead.
-        cache = _reset_rows(cache, reset_mask)
-        logits, cache = apply(params, cache, chunk, lengths)
-        pick = jnp.take_along_axis(
-            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-        )[:, 0]
-        return sample(pick, rng), cache
+        if speculative:
+            cache = tuple(_reset_rows(c, reset_mask) for c in cache)
+        else:
+            cache = _reset_rows(cache, reset_mask)
+        return _refill(params, d_params, cache, chunk, lengths, rid, rng)
 
     # Cache creation needs an apply without a cache; same program shape as
-    # refill_step minus the reset (Flax creates the zeroed caches).
+    # refill_step minus the reset (Flax creates the zeroed caches —
+    # make_cached_apply treats a None cache as the creating call).
     @jax.jit
-    def first_refill(params, chunk, lengths, rng):
-        logits, cache = apply(params, None, chunk, lengths)
-        pick = jnp.take_along_axis(
-            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-        )[:, 0]
-        return sample(pick, rng), cache
+    def first_refill(params, d_params, chunk, lengths, rid, rng):
+        cache = (None, None) if speculative else None
+        return _refill(params, d_params, cache, chunk, lengths, rid, rng)
 
     @jax.jit
-    def decode_block(params, cache, tok, active, rng):
+    def decode_block(params, cache, tok, active, remaining, rid, rng):
         """``decode_block_steps`` tokens per call, scanned ON DEVICE — the
         host loop costs one dispatch/readback per BLOCK, not per token
         (measured on the tunneled chip: per-token host stepping ran 30×
-        slower than the same work scanned). Rows that emit ``eos`` flip
-        inactive IN-scan — chunk_lengths 0, so they stop consuming cache
-        mid-block exactly like the stepwise path."""
+        slower than the same work scanned). Rows that emit ``eos`` OR
+        exhaust their per-row ``remaining`` budget flip inactive IN-scan —
+        chunk_lengths 0 from then on, so a retired row stops consuming
+        cache mid-block and its index can never pass its admission
+        budget."""
 
-        def body(carry, rng_step):
-            tok, active, cache = carry
+        def body(carry, _):
+            tok, active, remaining, cache = carry
             logits, cache = apply(params, cache, tok[:, None], active)
-            nxt = sample(logits[:, -1], rng_step)
+            # This draw's generated position: the row has already emitted
+            # max_new_tokens - remaining tokens.
+            pos = max_new_tokens - remaining
+            nxt = sample_rows(logits[:, -1], rng, rid, pos)
             nxt = jnp.where(active == 1, nxt, tok)
+            remaining = remaining - active
             if eos_id is not None:
                 active = active * (nxt != eos_id).astype(jnp.int32)
-            return (nxt, active, cache), nxt
+            active = active * (remaining > 0).astype(jnp.int32)
+            return (nxt, active, remaining, cache), nxt
 
-        rngs = jax.random.split(rng, decode_block_steps)
-        (tok, active, cache), toks = jax.lax.scan(
-            body, (tok, active, cache), rngs
+        (tok, active, remaining, cache), toks = jax.lax.scan(
+            body, (tok, active, remaining, cache), None,
+            length=decode_block_steps,
         )
-        return toks.T, active, cache   # (B, K) tokens
+        return toks.T, active, remaining, cache   # (B, K) tokens
 
-    def serve(params, prompts, rng=None):
+    @jax.jit
+    def decode_block_spec(
+        params, d_params, t_cache, d_cache, tok, active, pos, remaining, rng
+    ):
+        """Speculative decode block: ``decode_block_steps`` draft-verify
+        ROUNDS, each emitting 1..num_draft+1 tokens per row with PER-ROW
+        acceptance and rollback (the ragged-cache machinery of
+        ``models/speculative.py::generate_ragged``, driven inside the
+        engine's scan). ``pos`` is each row's current cache index
+        (prompt_len + emitted - 1); EOS and budget truncate a round's
+        per-row emission exactly, so the buffer/counts the block returns
+        are final — the host appends them verbatim."""
+        del rng  # greedy only
+        width = decode_block_steps * (num_draft + 1)
+        idx = jnp.arange(num_draft + 1)
+
+        def body(carry, _):
+            tok, active, pos, remaining, count, buffer, t_cache, d_cache = carry
+
+            # 1. Draft proposes per row (frozen rows ride with length 0).
+            def draft_step(c, _):
+                prev, dc = c
+                lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
+                return (nxt, dc), nxt
+
+            (last_d, d_cache), drafts = jax.lax.scan(
+                draft_step, (tok, d_cache), None, length=num_draft
+            )
+            drafts = drafts.T
+            _, d_cache = d_apply(d_params, d_cache, last_d[:, None], active)
+
+            # 2. One chunked target verify.
+            chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+            t_logits, t_cache = apply(
+                params, t_cache, chunk, active * (num_draft + 1)
+            )
+            choices = _greedy(t_logits)
+
+            # 3. Per-row acceptance; emitted = accepted drafts + bonus
+            #    (the shared core, models/speculative.py).
+            m, emitted, _ = greedy_accept_emit(drafts, choices)
+
+            # 4. Truncate each row's emission at EOS and at its budget.
+            raw = 1 + m
+            if eos_id is not None:
+                hit = (emitted == eos_id) & (idx[None, :] < raw[:, None])
+                any_hit = jnp.any(hit, axis=1)
+                first = jnp.argmax(hit, axis=1)
+                n_stop = jnp.where(any_hit, first + 1, raw)
+            else:
+                any_hit = jnp.zeros_like(active, dtype=bool)
+                n_stop = raw
+            n_emit = jnp.minimum(n_stop, remaining) * active
+
+            # 5. Append at each row's own offset; advance the pending
+            #    token to the last emitted one.
+            buffer = row_update_masked(
+                buffer, emitted, count, n_emit, seq_dim=1
+            )
+            new_tok = jnp.take_along_axis(
+                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0]
+            tok = jnp.where(active == 1, new_tok, tok)
+
+            # 6. Per-row rollback: the row's new index is pos + n_emit
+            #    (frozen rows: +0, i.e. their current index — one
+            #    broadcast serves all rows).
+            pos = pos + n_emit
+            t_cache = _rollback(t_cache, pos)
+            d_cache = _rollback(d_cache, pos)
+
+            remaining = remaining - n_emit
+            count = count + n_emit
+            stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
+            active = (
+                active
+                * (remaining > 0).astype(jnp.int32)
+                * (1 - stopped_eos.astype(jnp.int32))
+            )
+            return (
+                tok, active, pos, remaining, count, buffer, t_cache, d_cache
+            ), None
+
+        b = tok.shape[0]
+        buffer = jnp.zeros((b, width), jnp.int32)
+        count = jnp.zeros((b,), jnp.int32)
+        (tok, active, pos, remaining, count, buffer, t_cache, d_cache), _ = (
+            jax.lax.scan(
+                body,
+                (tok, active, pos, remaining, count, buffer, t_cache, d_cache),
+                None,
+                length=decode_block_steps,
+            )
+        )
+        return buffer, count, active, remaining, t_cache, d_cache
+
+    def serve(params, prompts, rng=None, draft_params=None):
+        if speculative and draft_params is None:
+            raise ValueError(
+                "draft_config was given: pass draft_params to serve()"
+            )
+        if not speculative and draft_params is not None:
+            raise ValueError("draft_params requires draft_config")
         rng = jax.random.key(0) if rng is None else rng
         b = batch_size
+        headroom = num_draft + 1 if speculative else 0
         prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        budget_cfgs = (
+            [("target", cfg), ("draft", d_cfg)] if speculative
+            else [("target", cfg)]
+        )
         for p in prompts:
             if p.size < 1:
                 raise ValueError("empty prompt")
-            check_sequence_budget(
-                p.size + max_new_tokens, cfg.max_seq_len,
-                f"prompt ({p.size}) + max_new_tokens ({max_new_tokens})",
-            )
+            for name, c in budget_cfgs:
+                # The draft cache must fit the same worst case as the
+                # target's: its index walks in lockstep through prefill,
+                # proposals, and rollback.
+                check_sequence_budget(
+                    p.size + max_new_tokens + headroom, c.max_seq_len,
+                    f"prompt ({p.size}) + max_new_tokens ({max_new_tokens})"
+                    + (f" + draft headroom ({headroom})" if headroom else "")
+                    + f" for {name}",
+                )
         params = maybe_cast(params)
+        if speculative:
+            draft_params = maybe_cast(draft_params)
         queue = deque(enumerate(prompts))
         results: dict[int, list[int]] = {}
 
         # Host-side slot state. A slot is: idle (req < 0), refilling
         # (pending prompt tokens remain), or decoding (active).
         req = [-1] * b                 # request id per slot
+        plen = [0] * b                 # admitted prompt length per slot
         pending: list[np.ndarray] = [np.zeros((0,), np.int32)] * b
         emitted = [0] * b
         out: list[list[int]] = [[] for _ in range(b)]
         tok = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         cache = None
-        step = 0
 
         def retire(slot):
             results[req[slot]] = out[slot]
             req[slot] = -1
             active[slot] = False
+
+        def consume(slot, tokens):
+            # Append a decode dispatch's tokens for one slot; retire at
+            # EOS or budget — ONE copy of the retirement rule for both
+            # engine modes.
+            for t in tokens:
+                out[slot].append(int(t))
+                emitted[slot] += 1
+                tok[slot] = int(t)
+                if (eos_id is not None and t == eos_id) or (
+                    emitted[slot] >= max_new_tokens
+                ):
+                    retire(slot)
+                    break
+
+        def rid_arr():
+            return jnp.asarray(np.maximum(req, 0), jnp.int32)
 
         with activate(mesh, rules):
             while queue or any(r >= 0 for r in req):
@@ -216,6 +446,7 @@ def make_continuous_engine(
                     if req[slot] < 0 and queue:
                         rid, prompt = queue.popleft()
                         req[slot] = rid
+                        plen[slot] = prompt.size
                         pending[slot] = prompt
                         emitted[slot] = 0
                         out[slot] = list(prompt)
@@ -232,17 +463,16 @@ def make_continuous_engine(
                         chunk[slot, :n] = pending[slot][:n]
                         lengths[slot] = n
                 if lengths.any():
-                    step += 1
-                    sub = jax.random.fold_in(rng, step)
                     if cache is None:
                         tok_new, cache = first_refill(
-                            params, jnp.asarray(chunk), jnp.asarray(lengths),
-                            sub,
+                            params, draft_params, jnp.asarray(chunk),
+                            jnp.asarray(lengths), rid_arr(), rng,
                         )
                     else:
                         tok_new, cache = refill_step(
-                            params, cache, jnp.asarray(chunk),
-                            jnp.asarray(lengths), jnp.asarray(reset), sub,
+                            params, draft_params, cache, jnp.asarray(chunk),
+                            jnp.asarray(lengths), jnp.asarray(reset),
+                            rid_arr(), rng,
                         )
                     tok_new = np.asarray(tok_new)
                     for slot in range(b):
@@ -265,25 +495,43 @@ def make_continuous_engine(
 
                 # 3. One decode BLOCK for the active rows.
                 if active.any():
-                    step += 1
-                    sub = jax.random.fold_in(rng, step)
-                    toks, _, cache = decode_block(
-                        params, cache, jnp.asarray(tok),
-                        jnp.asarray(active.astype(np.int32)), sub,
+                    remaining = np.asarray(
+                        [max(0, max_new_tokens - e) for e in emitted],
+                        np.int32,
                     )
-                    toks = np.asarray(toks)
-                    for slot in range(b):
-                        if not active[slot]:
-                            continue
-                        for t in toks[slot].tolist():
-                            out[slot].append(int(t))
-                            emitted[slot] += 1
-                            tok[slot] = int(t)
-                            if (eos_id is not None and t == eos_id) or (
-                                emitted[slot] >= max_new_tokens
-                            ):
-                                retire(slot)
-                                break
+                    if speculative:
+                        # Each row's current cache index: prompt + emitted
+                        # - 1 (its pending token is not yet in the cache).
+                        pos = np.asarray(
+                            [max(0, p + e - 1) for p, e in zip(plen, emitted)],
+                            np.int32,
+                        )
+                        t_cache, d_cache = cache
+                        buffer, counts, _, _, t_cache, d_cache = (
+                            decode_block_spec(
+                                params, draft_params, t_cache, d_cache,
+                                jnp.asarray(tok),
+                                jnp.asarray(active.astype(np.int32)),
+                                jnp.asarray(pos), jnp.asarray(remaining),
+                                rng,
+                            )
+                        )
+                        cache = (t_cache, d_cache)
+                        buffer = np.asarray(buffer)
+                        counts = np.asarray(counts)
+                        for slot in range(b):
+                            if active[slot]:
+                                consume(slot, buffer[slot, : counts[slot]].tolist())
+                    else:
+                        toks, _, _, cache = decode_block(
+                            params, cache, jnp.asarray(tok),
+                            jnp.asarray(active.astype(np.int32)),
+                            jnp.asarray(remaining), rid_arr(), rng,
+                        )
+                        toks = np.asarray(toks)
+                        for slot in range(b):
+                            if active[slot]:
+                                consume(slot, toks[slot].tolist())
 
         return [np.asarray(results[i], np.int32) for i in range(len(prompts))]
 

@@ -214,6 +214,7 @@ def decode_attention(
     v_new: jax.Array | None = None,
     ks_new: jax.Array | None = None,
     vs_new: jax.Array | None = None,
+    write_enable: jax.Array | None = None,
     window: int | None = None,
     scale: float | None = None,
     block_k: int | None = None,
@@ -248,6 +249,12 @@ def decode_attention(
             PERF.md "Ragged serving") never exists. The chunk must NOT
             already be written to the cache. With int8 caches pass
             ``ks_new``/``vs_new`` ``(B, N_kv, 1)`` chunk scales too.
+        write_enable: folded write only — per-row ``(B,)`` mask (nonzero =
+            write). Rows with 0 (frozen rows riding a mixed batch with a
+            zero chunk length) have their merge slot pushed out of range,
+            so their cache block flushes back UNCHANGED — no garbage token
+            ever lands in the cache, even transiently. ``None`` writes
+            every row.
         block_k: cache block size; None auto-selects (≤256 dividing L).
         block_q: q rows per grid tile (VMEM bound for long chunks).
         interpret: run the Pallas interpreter; None = auto (True off-TPU).
@@ -298,8 +305,19 @@ def decode_attention(
         kstart = jnp.maximum(0, (idx - (window - 1)) // block_k)
     else:
         kstart = jnp.zeros((b,), jnp.int32)
+    # Disabled rows get a write offset of block_k — outside the kernel's
+    # slot iota (0..block_k-1) — so the merge never matches and the block
+    # flushes back bit-identical (the write-back itself still runs; it
+    # rewrites unchanged data).
+    woff = idx % block_k
+    if write_enable is not None:
+        if not fold:
+            raise ValueError("write_enable requires the folded write (k_new)")
+        woff = jnp.where(
+            jnp.broadcast_to(write_enable, (b,)) != 0, woff, block_k
+        )
     sargs = jnp.stack(
-        [kstart, valid_blocks, idx, idx // block_k, idx % block_k], axis=1
+        [kstart, valid_blocks, idx, idx // block_k, woff], axis=1
     ).astype(jnp.int32)
 
     # (B, S, N, H) → (B, N_kv, S·group, H): row r = query (r // group) for
@@ -441,6 +459,7 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
         q, k_cache, v_cache, index, *,
         k_scale=None, v_scale=None,
         k_new=None, v_new=None, ks_new=None, vs_new=None,
+        write_enable=None,
         **call_kwargs,
     ):
         fn = functools.partial(decode_attention, **{**kwargs, **call_kwargs})
@@ -464,6 +483,14 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
                 in_specs += [sc_spec, sc_spec]
                 args += [ks_new, vs_new]
                 keys += ["ks_new", "vs_new"]
+            if write_enable is not None:
+                in_specs += [row_idx_spec]
+                args += [write_enable]
+                keys += ["write_enable"]
+        elif write_enable is not None:
+            # Mirror decode_attention's own guard — the wrapper must not
+            # silently drop a misused mask.
+            raise ValueError("write_enable requires the folded write (k_new)")
         # Folded writes return the updated cache (+ scale) buffers alongside
         # the attention output; each keeps its input's sharding.
         out_specs = q_spec

@@ -212,3 +212,51 @@ class TestModelParity:
                 mesh22, prompt,
             )
         np.testing.assert_array_equal(dense, blocked)
+
+
+class TestFoldedWriteEnable:
+    """``write_enable``: a frozen row (zero chunk length in a mixed ragged
+    batch) must leave its cache buffers BIT-IDENTICAL through a folded
+    write — no garbage token at its un-advanced slot, not even
+    transiently (the round-3 advisor finding)."""
+
+    def test_disabled_row_cache_untouched(self):
+        rng = np.random.default_rng(3)
+        b, n_kv, length, h = 2, 2, 64, 16
+        kc = jnp.asarray(rng.normal(size=(b, n_kv, length, h)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(b, n_kv, length, h)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(b, 1, n_kv, h)), jnp.float32)
+        k_new = jnp.asarray(rng.normal(size=(b, n_kv, 1, h)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(b, n_kv, 1, h)), jnp.float32)
+        idx = jnp.asarray([17, 9], jnp.int32)
+        enable = jnp.asarray([1, 0], jnp.int32)
+
+        out, k_out, v_out = decode_attention(
+            q, kc, vc, idx, k_new=k_new, v_new=v_new,
+            write_enable=enable, block_k=16, interpret=True,
+        )
+        k_out, v_out = np.asarray(k_out), np.asarray(v_out)
+        # Row 0 (enabled): new token lands at its slot, rest unchanged.
+        np.testing.assert_array_equal(k_out[0, :, 17], np.asarray(k_new)[0, :, 0])
+        np.testing.assert_array_equal(v_out[0, :, 17], np.asarray(v_new)[0, :, 0])
+        np.testing.assert_array_equal(k_out[0, :, :17], np.asarray(kc)[0, :, :17])
+        # Row 1 (disabled): every buffer bit-identical.
+        np.testing.assert_array_equal(k_out[1], np.asarray(kc)[1])
+        np.testing.assert_array_equal(v_out[1], np.asarray(vc)[1])
+        # Row 0's output equals the dense oracle over the merged cache.
+        merged_k = kc.at[0, :, 17].set(k_new[0, :, 0])
+        merged_v = vc.at[0, :, 17].set(v_new[0, :, 0])
+        ref = _dense_oracle(q[:1], merged_k[:1], merged_v[:1], 17)
+        np.testing.assert_allclose(
+            np.asarray(out)[0], np.asarray(ref)[0], rtol=1e-5, atol=1e-5
+        )
+
+    def test_write_enable_requires_fold(self):
+        rng = np.random.default_rng(0)
+        kc = jnp.asarray(rng.normal(size=(1, 1, 16, 8)), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+        with pytest.raises(ValueError, match="write_enable"):
+            decode_attention(
+                q, kc, kc, 3, write_enable=jnp.ones((1,), jnp.int32),
+                interpret=True,
+            )

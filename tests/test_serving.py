@@ -160,3 +160,128 @@ class TestContinuousBatching:
         )
         with pytest.raises(ValueError, match="max_seq_len"):
             serve(params, [np.ones((8,), np.int32)])
+
+
+DRAFT_CFG = dataclasses.replace(
+    CONFIG_TINY, num_layers=1, hidden=64, dtype=jnp.float32
+)
+
+
+def _draft_params():
+    model = Transformer(DRAFT_CFG)
+    toks = np.zeros((2, 8), np.int32)
+    return nn.meta.unbox(
+        model.init({"params": jax.random.key(7)}, toks)["params"]
+    )
+
+
+class TestSpeculativeEngine:
+    """Speculative decode blocks inside the continuous engine: a draft
+    model proposes inside every decode dispatch, acceptance and cache
+    rollback are per-row. Oracle: output bit-identical to the plain
+    (non-speculative) greedy engine — which is itself pinned to
+    rectangular single runs — whatever the draft proposes."""
+
+    @pytest.mark.parametrize("backend", ["dense", "blocked"])
+    def test_matches_plain_engine(self, setup, mesh22, backend):
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(cfg, decode_attention=backend)
+        dcfg = dataclasses.replace(DRAFT_CFG, decode_attention=backend)
+        plain = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4,
+        )
+        spec = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, draft_config=dcfg, num_draft=3,
+        )
+        ref = plain(params, prompts)
+        got = spec(params, prompts, draft_params=_draft_params())
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(g, r)
+
+    def test_eos_truncates_in_round(self, setup, mesh22):
+        """EOS emitted mid-round (inside an accepted draft run) must
+        truncate that row's emission exactly where the plain engine
+        stops."""
+        cfg, params, prompts = setup
+        plain_out = _rect_reference(cfg, mesh22, params, prompts[0])
+        eos = int(plain_out[len(prompts[0]) + 1])
+        plain = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, eos_id=eos,
+        )
+        spec = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, eos_id=eos, draft_config=DRAFT_CFG, num_draft=3,
+        )
+        ref = plain(params, prompts)
+        got = spec(params, prompts, draft_params=_draft_params())
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(g, r)
+
+    def test_self_draft_matches_too(self, setup, mesh22):
+        """Draft == target: the all-accept path (every round emits
+        num_draft+1 tokens) — still bit-identical."""
+        cfg, params, prompts = setup
+        plain = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        )
+        spec = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            draft_config=cfg, num_draft=2,
+        )
+        ref = plain(params, prompts[:3])
+        got = spec(params, prompts[:3], draft_params=params)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(g, r)
+
+    def test_validation(self, setup, mesh22):
+        cfg, params, prompts = setup
+        with pytest.raises(ValueError, match="greedy-only"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+                draft_config=DRAFT_CFG, temperature=1.0,
+            )
+        spec = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+            draft_config=DRAFT_CFG,
+        )
+        with pytest.raises(ValueError, match="draft_params"):
+            spec(params, prompts[:1])
+        plain = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+        )
+        with pytest.raises(ValueError, match="draft_config"):
+            plain(params, prompts[:1], draft_params=_draft_params())
+
+
+class TestReproducibleSampling:
+    """temperature > 0: every draw is keyed by (request id, generated
+    position), so a request's sampled stream is a function of (rng,
+    request index, its own prompt) — NOT of scheduling. The same queue
+    served under any batch size / chunking yields identical outputs."""
+
+    def test_schedule_independent(self, setup, mesh22):
+        cfg, params, prompts = setup
+        key = jax.random.key(5)
+        outs = []
+        for bs, chunk in ((2, 4), (3, 8), (4, 16)):
+            serve = make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=bs, max_new_tokens=NEW,
+                refill_chunk=chunk, temperature=1.0, top_k=16,
+            )
+            outs.append(serve(params, prompts, rng=key))
+        for other in outs[1:]:
+            for a, b in zip(outs[0], other):
+                np.testing.assert_array_equal(a, b)
+
+    def test_rng_varies(self, setup, mesh22):
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            temperature=1.0, top_k=16,
+        )
+        a = serve(params, prompts[:3], rng=jax.random.key(5))
+        b = serve(params, prompts[:3], rng=jax.random.key(6))
+        assert any((x.shape != y.shape) or (x != y).any() for x, y in zip(a, b))

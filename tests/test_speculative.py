@@ -288,3 +288,180 @@ class TestSpeculativeSampling:
         out = np.asarray(gen(t_params, t_params, prompt, jax.random.key(3)))
         assert out.shape == (4, 16)
         assert ((0 <= out) & (out < CONFIG_TINY.vocab_size)).all()
+
+
+class TestSpeculativeRagged:
+    """``ragged=True``: PER-ROW acceptance and rollback over the per-row
+    ``cache_index`` machinery. The oracles:
+
+    * greedy output bit-identical to ``make_generate_fn(ragged=True)``'s
+      per-row greedy decode (mixed prompt lengths, dense AND blocked);
+    * per-row acceptance counts are exact (self-draft pins the formula);
+    * a row's output is independent of every other row (greedy AND
+      sampled — the (row, position)-keyed randomness makes this hold for
+      temperature > 0 too, which the batch-min path cannot promise).
+    """
+
+    LENGTHS = np.array([8, 5, 3, 7], np.int32)
+
+    def _ragged_prompt(self, tokens):
+        prompt = tokens[:4, :8].copy()
+        for b, n in enumerate(self.LENGTHS):
+            prompt[b, n:] = 0  # right padding (value irrelevant)
+        return prompt
+
+    @pytest.mark.parametrize("num_draft", [1, 3])
+    def test_matches_plain_ragged_greedy(self, mesh22, rng, num_draft):
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()  # untrained draft: rejections + rewinds
+        sh = mesh_sharding(mesh22, "data", None)
+        prompt = put(self._ragged_prompt(tokens), sh)
+        lengths = jnp.asarray(self.LENGTHS)
+
+        plain = make_generate_fn(
+            CONFIG_TINY, mesh22, RULES_DP_TP, max_new_tokens=12, ragged=True
+        )
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=12, num_draft=num_draft, ragged=True,
+        )
+        out_plain = np.asarray(
+            plain(t_params, prompt, jax.random.key(0), lengths=lengths)
+        )
+        out_spec = np.asarray(
+            spec(t_params, d_params, prompt, lengths=lengths)
+        )
+        np.testing.assert_array_equal(out_spec, out_plain)
+
+    def test_blocked_matches_plain_ragged_greedy(self, mesh22, rng):
+        """The production path: per-row rollback over the sequence-major
+        blocked cache with FOLDED single-token writes (draft steps) and
+        scattered chunk writes (verification)."""
+        cfg = dataclasses.replace(CONFIG_TINY, decode_attention="blocked")
+        dcfg = dataclasses.replace(DRAFT_CFG, decode_attention="blocked")
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        sh = mesh_sharding(mesh22, "data", None)
+        prompt = put(self._ragged_prompt(tokens), sh)
+        lengths = jnp.asarray(self.LENGTHS)
+
+        plain = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=10, ragged=True
+        )
+        spec = make_speculative_generate_fn(
+            cfg, dcfg, mesh22, RULES_DP_TP,
+            max_new_tokens=10, num_draft=2, ragged=True,
+        )
+        out_plain = np.asarray(
+            plain(t_params, prompt, jax.random.key(0), lengths=lengths)
+        )
+        out_spec = np.asarray(
+            spec(t_params, d_params, prompt, lengths=lengths)
+        )
+        np.testing.assert_array_equal(out_spec, out_plain)
+
+    def test_per_row_acceptance_stats_self_draft(self, mesh22, rng):
+        """Draft == target: every row accepts all num_draft proposals every
+        round, so the stats are an exact formula — rounds =
+        ceil((max_new - 1) / (num_draft + 1)), accepted = rounds*num_draft,
+        emitted = 1 + rounds*(num_draft+1) — per ROW (no batch-min)."""
+        t_params, tokens = _trained_target(mesh22, rng)
+        sh = mesh_sharding(mesh22, "data", None)
+        prompt = put(self._ragged_prompt(tokens), sh)
+        lengths = jnp.asarray(self.LENGTHS)
+        max_new, nd = 12, 3
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, CONFIG_TINY, mesh22, RULES_DP_TP,
+            max_new_tokens=max_new, num_draft=nd, ragged=True,
+        )
+        _, stats = spec(
+            t_params, t_params, prompt, lengths=lengths, return_stats=True
+        )
+        rounds = -(-(max_new - 1) // (nd + 1))
+        np.testing.assert_array_equal(
+            np.asarray(stats["accepted"]), np.full(4, rounds * nd)
+        )
+        assert int(stats["rounds"]) == rounds
+        np.testing.assert_array_equal(
+            np.asarray(stats["emitted"]), np.full(4, 1 + rounds * (nd + 1))
+        )
+
+    def test_rows_independent_greedy(self, mesh22, rng):
+        """Per-row acceptance means row b's output cannot depend on any
+        other row — decode the batch, then the batch with every OTHER row's
+        prompt replaced; row b must be bit-identical."""
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        sh = mesh_sharding(mesh22, "data", None)
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=10, num_draft=3, ragged=True,
+        )
+        prompt = self._ragged_prompt(tokens)
+        a = np.asarray(
+            spec(t_params, d_params, put(prompt, sh),
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        other = prompt.copy()
+        other[0] = tokens[5, :8]  # different row-0 prompt, same length
+        b = np.asarray(
+            spec(t_params, d_params, put(other, sh),
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        np.testing.assert_array_equal(a[1:], b[1:])
+
+    def test_rows_independent_sampled(self, mesh22, rng):
+        """(row, position)-keyed randomness: a row's SAMPLED stream is also
+        independent of the rest of the batch — the property per-dispatch
+        keys (and batch-min rollback) cannot provide."""
+        t_params, tokens = _trained_target(mesh22, rng, steps=2)
+        d_params = _draft_params()
+        sh = mesh_sharding(mesh22, "data", None)
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=10, num_draft=3, temperature=1.0, top_k=16,
+            ragged=True,
+        )
+        prompt = self._ragged_prompt(tokens)
+        key = jax.random.key(11)
+        a = np.asarray(
+            spec(t_params, d_params, put(prompt, sh), key,
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        other = prompt.copy()
+        other[0] = tokens[5, :8]
+        b = np.asarray(
+            spec(t_params, d_params, put(other, sh), key,
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        np.testing.assert_array_equal(a[1:], b[1:])
+        # Determinism: same rng reproduces; different rng varies.
+        c = np.asarray(
+            spec(t_params, d_params, put(prompt, sh), key,
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        np.testing.assert_array_equal(a, c)
+        d = np.asarray(
+            spec(t_params, d_params, put(prompt, sh), jax.random.key(12),
+                 lengths=jnp.asarray(self.LENGTHS))
+        )
+        assert (a != d).any()
+
+    def test_lengths_validation(self, mesh22, rng):
+        t_params, tokens = _trained_target(mesh22, rng, steps=1)
+        d_params = _draft_params()
+        sh = mesh_sharding(mesh22, "data", None)
+        prompt = put(tokens[:4, :8], sh)
+        spec_r = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=4, ragged=True,
+        )
+        with pytest.raises(ValueError, match="lengths"):
+            spec_r(t_params, d_params, prompt)
+        spec = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP, max_new_tokens=4,
+        )
+        with pytest.raises(ValueError, match="lengths"):
+            spec(t_params, d_params, prompt, lengths=jnp.full((4,), 8))
+        with pytest.raises(ValueError, match="return_stats"):
+            spec(t_params, d_params, prompt, return_stats=True)
