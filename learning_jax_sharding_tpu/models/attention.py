@@ -221,6 +221,15 @@ class MultiHeadAttention(nn.Module):
     # expressible, and rows advance independently (a finished row passes
     # chunk_lengths 0 and stops consuming cache). False keeps the scalar
     # rectangular machinery (no scatter on the hot path).
+    decode_paged: bool = False
+    # PAGED cache (blocked backend + ragged only): K/V live in per-layer
+    # physical page POOLS of ``decode_page_count`` pages ×
+    # ``decode_block_k`` tokens, indirected through a per-row
+    # ``block_table`` cache variable that the HOST allocator owns
+    # (models/serving.py) — cache HBM scales with pages allocated, not
+    # B × max_decode_len. Page 0 is a reserved scratch target for masked
+    # writes; this module never touches the table.
+    decode_page_count: int = 0
 
     @property
     def inner_dim(self) -> int:
@@ -438,6 +447,11 @@ class MultiHeadAttention(nn.Module):
             raise ValueError("decode=True requires max_decode_len > 0")
         if resolve_decode_backend(self.decode_attention) == "blocked":
             return self._blocked_cached_attention(q, k, v, chunk_lengths)
+        if self.decode_paged:
+            raise ValueError(
+                "decode_paged requires the blocked decode backend (the "
+                "dense path attends per-row buffers, not page pools)"
+            )
         b, s, n, h = q.shape
         n_kv = k.shape[2]  # GQA caches only the k/v heads — the GQA win
         ragged = self.decode_ragged
@@ -541,15 +555,33 @@ class MultiHeadAttention(nn.Module):
         b, s, n, h = q.shape
         n_kv = k.shape[2]
         ragged = self.decode_ragged
+        paged = self.decode_paged
         length = self.max_decode_len
         store = self.kv_cache_dtype if self.kv_cache_dtype is not None else self.dtype
         quantized = store == jnp.int8
 
+        if paged:
+            if not ragged:
+                raise ValueError("decode_paged requires decode_ragged")
+            page = self.decode_block_k
+            if not page or length % page:
+                raise ValueError(
+                    f"decode_paged needs decode_block_k (page size) "
+                    f"dividing max_decode_len ({length}); got {page}"
+                )
+            pool = self.decode_page_count
+            kv_shape, sc_shape = (pool, n_kv, page, h), (pool, n_kv, page)
+            block_table = self.variable(
+                "cache", "block_table", jnp.zeros, (b, length // page),
+                jnp.int32,
+            )
+        else:
+            kv_shape, sc_shape = (b, n_kv, length, h), (b, n_kv, length)
         cached_k = self.variable(
-            "cache", "cached_key", jnp.zeros, (b, n_kv, length, h), store
+            "cache", "cached_key", jnp.zeros, kv_shape, store
         )
         cached_v = self.variable(
-            "cache", "cached_value", jnp.zeros, (b, n_kv, length, h), store
+            "cache", "cached_value", jnp.zeros, kv_shape, store
         )
         cache_index = self.variable(
             "cache", "cache_index",
@@ -557,10 +589,10 @@ class MultiHeadAttention(nn.Module):
         )
         if quantized:
             k_scale = self.variable(
-                "cache", "key_scale", jnp.ones, (b, n_kv, length), jnp.float32
+                "cache", "key_scale", jnp.ones, sc_shape, jnp.float32
             )
             v_scale = self.variable(
-                "cache", "value_scale", jnp.ones, (b, n_kv, length), jnp.float32
+                "cache", "value_scale", jnp.ones, sc_shape, jnp.float32
             )
 
         idx = self._advance(cache_index, s, chunk_lengths)
@@ -591,18 +623,44 @@ class MultiHeadAttention(nn.Module):
                 )
             return row_update(buf, chunk, idx, seq_dim=2)
 
+        def paged_write(pool_buf, chunk):
+            # Scatter a sequence-major chunk through the block table: cache
+            # position idx_b + t lives at (table[b, pos // page], pos %
+            # page) in the pool. Invalid positions (padding past a row's
+            # chunk_lengths) are redirected to the reserved scratch page 0,
+            # so masked writes can never touch live pages.
+            tbl = block_table.value
+            pos = idx[:, None] + jnp.arange(s)[None, :]          # (B, S)
+            t_cap = tbl.shape[1]
+            pages = jnp.take_along_axis(
+                tbl, jnp.minimum(pos // page, t_cap - 1), axis=1
+            )
+            slots = pos % page
+            if chunk_lengths is not None:
+                valid = jnp.arange(s)[None, :] < chunk_lengths[:, None]
+            else:
+                valid = pos < length
+            pages = jnp.where(valid, pages, 0)
+            # chunk (B, N_kv, S, ...) → (B, S, N_kv, ...): advanced indices
+            # on pool axes 0 and 2 put the (B, S) index shape in front.
+            upd = jnp.moveaxis(chunk, 2, 1)
+            return pool_buf.at[pages, :, slots].set(upd)
+
         def write(var, chunk, scale_var=None):
             chunk, scale = to_seq_major(chunk)
-            if quantized:
-                if ragged:
+            if paged:
+                if quantized:
+                    scale_var.value = paged_write(scale_var.value, scale)
+                var.value = paged_write(var.value, chunk)
+            elif ragged:
+                if quantized:
                     scale_var.value = ragged_write(scale_var.value, scale)
-                else:
+                var.value = ragged_write(var.value, chunk)
+            else:
+                if quantized:
                     scale_var.value = jax.lax.dynamic_update_slice(
                         scale_var.value, scale, (0, 0, idx)
                     )
-            if ragged:
-                var.value = ragged_write(var.value, chunk)
-            else:
                 var.value = jax.lax.dynamic_update_slice(
                     var.value, chunk, (0, 0, idx, 0)
                 )
@@ -623,23 +681,23 @@ class MultiHeadAttention(nn.Module):
             write(cached_k, k, k_scale if quantized else None)
             write(cached_v, v, v_scale if quantized else None)
 
-        kc = nn.with_logical_constraint(
-            cached_k.value, (BATCH, HEADS, None, KV)
-        )
-        vc = nn.with_logical_constraint(
-            cached_v.value, (BATCH, HEADS, None, KV)
-        )
+        # Paged pools lead with the PAGE axis (shared across rows), so only
+        # the heads dim carries a sharding hint; per-row buffers shard
+        # batch × heads as before.
+        kv_axes = (None, HEADS, None, KV) if paged else (BATCH, HEADS, None, KV)
+        sc_axes = kv_axes[:-1]
+        kc = nn.with_logical_constraint(cached_k.value, kv_axes)
+        vc = nn.with_logical_constraint(cached_v.value, kv_axes)
         scales = {}
         if quantized:
             scales = dict(
-                k_scale=nn.with_logical_constraint(
-                    k_scale.value, (BATCH, HEADS, None)
-                ),
-                v_scale=nn.with_logical_constraint(
-                    v_scale.value, (BATCH, HEADS, None)
-                ),
+                k_scale=nn.with_logical_constraint(k_scale.value, sc_axes),
+                v_scale=nn.with_logical_constraint(v_scale.value, sc_axes),
             )
         fn = self.decode_attn_fn if self.decode_attn_fn is not None else decode_attention
+        table_args = {}
+        if paged:
+            table_args = dict(block_table=block_table.value)
         # window/block_k pass at CALL time either way: the module is the
         # single source of truth, so a mesh-aware wrapper built without them
         # cannot silently drop the sliding window.
@@ -647,7 +705,7 @@ class MultiHeadAttention(nn.Module):
             result = fn(
                 q, kc, vc, idx,
                 window=self.window, block_k=self.decode_block_k,
-                **scales, **fold_args,
+                **scales, **fold_args, **table_args,
             )
             out, new_k, new_v = result[:3]
             cached_k.value = new_k
@@ -657,5 +715,6 @@ class MultiHeadAttention(nn.Module):
             return out
         return fn(
             q, kc, vc, idx,
-            window=self.window, block_k=self.decode_block_k, **scales,
+            window=self.window, block_k=self.decode_block_k,
+            **scales, **table_args,
         )

@@ -113,6 +113,8 @@ def make_continuous_engine(
     inference_dtype: Any | None = None,
     draft_config: Optional[TransformerConfig] = None,
     num_draft: int = 4,
+    paged_pages: Optional[int] = None,
+    page_size: int = 64,
 ):
     """Build ``serve(params, prompts, rng, draft_params) -> list[np.ndarray]``.
 
@@ -141,6 +143,19 @@ def make_continuous_engine(
     ``temperature > 0``: every draw is keyed by (request id, generated
     position) folded into ``rng`` — sampled outputs are reproducible
     across schedules (batch size, arrival order, slot assignment).
+
+    ``paged_pages``: PAGED KV cache — each layer's K/V live in a physical
+    pool of ``paged_pages`` pages of ``page_size`` tokens (page 0 is a
+    reserved scratch target), indirected through per-row block tables
+    that THIS host loop owns: pages are allocated on demand as a row's
+    index approaches a page boundary and freed the moment the request
+    retires, so cache HBM scales with tokens actually in flight instead
+    of ``batch_size × max_seq_len`` — and slot count is no longer bounded
+    by worst-case length. Requires the blocked decode backend. Outputs
+    are bit-identical to the unpaged engine (test-pinned); the allocator
+    raises if a dispatch would need more pages than the pool holds.
+    After each ``serve`` call, ``serve.last_stats`` reports
+    ``page_high_water`` / ``pages_total`` — the measured footprint.
     """
     if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
         raise ValueError(
@@ -168,8 +183,33 @@ def make_continuous_engine(
                 f"target vocab {config.vocab_size} != draft vocab "
                 f"{draft_config.vocab_size}"
             )
+    paged = paged_pages is not None
+    if paged:
+        from learning_jax_sharding_tpu.models.attention import (
+            resolve_decode_backend,
+        )
+
+        if resolve_decode_backend(config.decode_attention) != "blocked":
+            raise ValueError(
+                "paged_pages requires the blocked decode backend "
+                "(decode_attention='blocked', or 'auto' on TPU)"
+            )
+        if paged_pages < 2:
+            raise ValueError(
+                "paged_pages must be >= 2 (page 0 is the scratch page)"
+            )
+        if config.max_seq_len % page_size:
+            raise ValueError(
+                f"max_seq_len ({config.max_seq_len}) must be a multiple of "
+                f"page_size ({page_size})"
+            )
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     cfg = dataclasses.replace(cfg, decode_ragged=True)
+    if paged:
+        cfg = dataclasses.replace(
+            cfg, decode_paged=True, decode_page_count=paged_pages,
+            decode_block_k=page_size,
+        )
     model = Transformer(cfg)
     apply = make_cached_apply(model)
     maybe_cast = make_param_caster(inference_dtype)
@@ -178,6 +218,26 @@ def make_continuous_engine(
             draft_config, inference_dtype, mesh=mesh, rules=rules
         )
         d_cfg = dataclasses.replace(d_cfg, decode_ragged=True)
+        if paged:
+            from learning_jax_sharding_tpu.models.attention import (
+                resolve_decode_backend,
+            )
+
+            if resolve_decode_backend(draft_config.decode_attention) != "blocked":
+                raise ValueError(
+                    "paged_pages requires the blocked decode backend for "
+                    "the draft_config too (its cache pages alongside the "
+                    "target's)"
+                )
+            if draft_config.max_seq_len % page_size:
+                raise ValueError(
+                    f"draft max_seq_len ({draft_config.max_seq_len}) must "
+                    f"be a multiple of page_size ({page_size})"
+                )
+            d_cfg = dataclasses.replace(
+                d_cfg, decode_paged=True, decode_page_count=paged_pages,
+                decode_block_k=page_size,
+            )
         d_apply = make_cached_apply(Transformer(d_cfg))
 
     def _greedy(logits):
@@ -416,10 +476,67 @@ def make_continuous_engine(
         active = np.zeros((b,), bool)
         cache = None
 
+        if paged:
+            # Host-owned page allocator: page 0 is scratch; a slot holds a
+            # prefix of logical blocks mapped to arbitrary physical pages.
+            free_pages = list(range(paged_pages - 1, 0, -1))
+            held: list[list[int]] = [[] for _ in range(b)]
+            t_cap = cfg.max_seq_len // page_size
+            table_np = np.zeros((b, t_cap), np.int32)
+            high_water = 0
+            tables_dirty = True
+
+            def ensure(slot, tokens_through):
+                # Allocate pages so positions [0, tokens_through) are
+                # mapped before the dispatch that writes them.
+                nonlocal high_water, tables_dirty
+                need = -(-int(tokens_through) // page_size)
+                while len(held[slot]) < need:
+                    if not free_pages:
+                        raise RuntimeError(
+                            f"page pool exhausted ({paged_pages - 1} pages "
+                            f"× {page_size} tokens): raise paged_pages or "
+                            "lower concurrency"
+                        )
+                    p = free_pages.pop()
+                    table_np[slot, len(held[slot])] = p
+                    held[slot].append(p)
+                    tables_dirty = True
+                high_water = max(
+                    high_water, (paged_pages - 1) - len(free_pages)
+                )
+
+            def release(slot):
+                nonlocal tables_dirty
+                free_pages.extend(held[slot])
+                held[slot] = []
+                table_np[slot, :] = 0
+                tables_dirty = True
+
+            def set_tables(cache):
+                # Push the host tables into every layer's block_table leaf
+                # (target AND draft trees; the draft's table may be
+                # narrower — same prefix, same page ids). Skipped entirely
+                # when no allocation changed since the last push — the
+                # steady-state decode loop mostly doesn't allocate.
+                nonlocal tables_dirty
+                if not tables_dirty:
+                    return cache
+                tables_dirty = False
+
+                def leaf(path, x):
+                    if getattr(path[-1], "key", None) == "block_table":
+                        return jnp.asarray(table_np[:, : x.shape[1]])
+                    return x
+
+                return jax.tree_util.tree_map_with_path(leaf, cache)
+
         def retire(slot):
             results[req[slot]] = out[slot]
             req[slot] = -1
             active[slot] = False
+            if paged:
+                release(slot)
 
         def consume(slot, tokens):
             # Append a decode dispatch's tokens for one slot; retire at
@@ -438,101 +555,155 @@ def make_continuous_engine(
         def rid_arr():
             return jnp.asarray(np.maximum(req, 0), jnp.int32)
 
-        with activate(mesh, rules):
-            while queue or any(r >= 0 for r in req):
-                # 1. Admit queued requests into idle slots.
-                reset = np.zeros((b,), bool)
-                for slot in range(b):
-                    if req[slot] < 0 and queue:
-                        rid, prompt = queue.popleft()
-                        req[slot] = rid
-                        plen[slot] = prompt.size
-                        pending[slot] = prompt
-                        emitted[slot] = 0
-                        out[slot] = list(prompt)
-                        reset[slot] = True
-
-                # 2. One refill chunk for every slot with pending prompt
-                #    tokens (fresh or continuing); decoding rows ride along
-                #    with length 0.
-                lengths = np.zeros((b,), np.int32)
-                chunk = np.zeros((b, refill_chunk), np.int32)
-                for slot in range(b):
-                    n = min(pending[slot].size, refill_chunk)
-                    if n:
-                        chunk[slot, :n] = pending[slot][:n]
-                        lengths[slot] = n
-                if lengths.any():
-                    if cache is None:
-                        tok_new, cache = first_refill(
-                            params, draft_params, jnp.asarray(chunk),
-                            jnp.asarray(lengths), rid_arr(), rng,
-                        )
-                    else:
-                        tok_new, cache = refill_step(
-                            params, draft_params, cache, jnp.asarray(chunk),
-                            jnp.asarray(lengths), jnp.asarray(reset),
-                            rid_arr(), rng,
-                        )
-                    tok_new = np.asarray(tok_new)
+        try:
+            with activate(mesh, rules):
+                while queue or any(r >= 0 for r in req):
+                    # 1. Admit queued requests into idle slots.
+                    reset = np.zeros((b,), bool)
                     for slot in range(b):
-                        if lengths[slot]:
-                            pending[slot] = pending[slot][lengths[slot]:]
-                            if pending[slot].size == 0 and req[slot] >= 0:
-                                # Prompt complete: its first token came from
-                                # this chunk's last valid position.
-                                t = int(tok_new[slot])
-                                out[slot].append(t)
-                                emitted[slot] = 1
-                                tok[slot] = t
-                                if (eos_id is not None and t == eos_id) or (
-                                    max_new_tokens == 1
-                                ):
-                                    retire(slot)
-                                else:
-                                    active[slot] = True
-                    continue   # admit/refill until no prompt tokens remain
+                        if req[slot] < 0 and queue:
+                            rid, prompt = queue.popleft()
+                            req[slot] = rid
+                            plen[slot] = prompt.size
+                            pending[slot] = prompt
+                            emitted[slot] = 0
+                            out[slot] = list(prompt)
+                            reset[slot] = True
 
-                # 3. One decode BLOCK for the active rows.
-                if active.any():
-                    remaining = np.asarray(
-                        [max(0, max_new_tokens - e) for e in emitted],
-                        np.int32,
-                    )
-                    if speculative:
-                        # Each row's current cache index: prompt + emitted
-                        # - 1 (its pending token is not yet in the cache).
-                        pos = np.asarray(
-                            [max(0, p + e - 1) for p, e in zip(plen, emitted)],
+                    # 2. One refill chunk for every slot with pending prompt
+                    #    tokens (fresh or continuing); decoding rows ride along
+                    #    with length 0.
+                    lengths = np.zeros((b,), np.int32)
+                    chunk = np.zeros((b, refill_chunk), np.int32)
+                    for slot in range(b):
+                        n = min(pending[slot].size, refill_chunk)
+                        if n:
+                            chunk[slot, :n] = pending[slot][:n]
+                            lengths[slot] = n
+                    if lengths.any():
+                        if paged:
+                            for slot in range(b):
+                                if lengths[slot]:
+                                    consumed = plen[slot] - pending[slot].size
+                                    ensure(slot, consumed + int(lengths[slot]))
+                            if cache is None:
+                                # Create faithful zero caches with a NO-OP
+                                # refill (every length 0 — no writes, no
+                                # advances), so the real first chunk runs
+                                # through the steady-state path with the
+                                # block tables already installed.
+                                _, cache = first_refill(
+                                    params, draft_params,
+                                    jnp.zeros_like(jnp.asarray(chunk)),
+                                    jnp.zeros((b,), jnp.int32), rid_arr(), rng,
+                                )
+                            cache = set_tables(cache)
+                        if cache is None:
+                            tok_new, cache = first_refill(
+                                params, draft_params, jnp.asarray(chunk),
+                                jnp.asarray(lengths), rid_arr(), rng,
+                            )
+                        else:
+                            tok_new, cache = refill_step(
+                                params, draft_params, cache, jnp.asarray(chunk),
+                                jnp.asarray(lengths), jnp.asarray(reset),
+                                rid_arr(), rng,
+                            )
+                        tok_new = np.asarray(tok_new)
+                        for slot in range(b):
+                            if lengths[slot]:
+                                pending[slot] = pending[slot][lengths[slot]:]
+                                if pending[slot].size == 0 and req[slot] >= 0:
+                                    # Prompt complete: its first token came from
+                                    # this chunk's last valid position.
+                                    t = int(tok_new[slot])
+                                    out[slot].append(t)
+                                    emitted[slot] = 1
+                                    tok[slot] = t
+                                    if (eos_id is not None and t == eos_id) or (
+                                        max_new_tokens == 1
+                                    ):
+                                        retire(slot)
+                                    else:
+                                        active[slot] = True
+                        continue   # admit/refill until no prompt tokens remain
+
+                    # 3. One decode BLOCK for the active rows.
+                    if active.any():
+                        remaining = np.asarray(
+                            [max(0, max_new_tokens - e) for e in emitted],
                             np.int32,
                         )
-                        t_cache, d_cache = cache
-                        buffer, counts, _, _, t_cache, d_cache = (
-                            decode_block_spec(
-                                params, draft_params, t_cache, d_cache,
-                                jnp.asarray(tok),
-                                jnp.asarray(active.astype(np.int32)),
-                                jnp.asarray(pos), jnp.asarray(remaining),
-                                rng,
+                        if paged:
+                            # Cover every position this block can write: K new
+                            # tokens per row (plain), or K rounds of up to
+                            # num_draft+1 plus the verify chunk's headroom
+                            # (speculative) — capped by the row's remaining
+                            # budget either way.
+                            for slot in range(b):
+                                if not active[slot]:
+                                    continue
+                                pos_s = plen[slot] + emitted[slot] - 1
+                                if speculative:
+                                    span = (
+                                        min(
+                                            int(remaining[slot]),
+                                            decode_block_steps * (num_draft + 1),
+                                        )
+                                        + num_draft + 1
+                                    )
+                                else:
+                                    span = min(
+                                        int(remaining[slot]), decode_block_steps
+                                    )
+                                ensure(slot, pos_s + span)
+                            cache = set_tables(cache)
+                        if speculative:
+                            # Each row's current cache index: prompt + emitted
+                            # - 1 (its pending token is not yet in the cache).
+                            pos = np.asarray(
+                                [max(0, p + e - 1) for p, e in zip(plen, emitted)],
+                                np.int32,
                             )
-                        )
-                        cache = (t_cache, d_cache)
-                        buffer = np.asarray(buffer)
-                        counts = np.asarray(counts)
-                        for slot in range(b):
-                            if active[slot]:
-                                consume(slot, buffer[slot, : counts[slot]].tolist())
-                    else:
-                        toks, _, _, cache = decode_block(
-                            params, cache, jnp.asarray(tok),
-                            jnp.asarray(active.astype(np.int32)),
-                            jnp.asarray(remaining), rid_arr(), rng,
-                        )
-                        toks = np.asarray(toks)
-                        for slot in range(b):
-                            if active[slot]:
-                                consume(slot, toks[slot].tolist())
+                            t_cache, d_cache = cache
+                            buffer, counts, _, _, t_cache, d_cache = (
+                                decode_block_spec(
+                                    params, draft_params, t_cache, d_cache,
+                                    jnp.asarray(tok),
+                                    jnp.asarray(active.astype(np.int32)),
+                                    jnp.asarray(pos), jnp.asarray(remaining),
+                                    rng,
+                                )
+                            )
+                            cache = (t_cache, d_cache)
+                            buffer = np.asarray(buffer)
+                            counts = np.asarray(counts)
+                            for slot in range(b):
+                                if active[slot]:
+                                    consume(slot, buffer[slot, : counts[slot]].tolist())
+                        else:
+                            toks, _, _, cache = decode_block(
+                                params, cache, jnp.asarray(tok),
+                                jnp.asarray(active.astype(np.int32)),
+                                jnp.asarray(remaining), rid_arr(), rng,
+                            )
+                            toks = np.asarray(toks)
+                            for slot in range(b):
+                                if active[slot]:
+                                    consume(slot, toks[slot].tolist())
 
+        finally:
+            # Stats must reflect THIS call even when it raises — pool
+            # exhaustion is exactly when the measured footprint matters.
+            serve.last_stats = (
+                {
+                    "page_high_water": high_water,
+                    "pages_total": paged_pages - 1,
+                    "page_size": page_size,
+                }
+                if paged else None
+            )
         return [np.asarray(results[i], np.int32) for i in range(len(prompts))]
 
+    serve.last_stats = None
     return serve

@@ -208,6 +208,8 @@ class TransformerBlock(nn.Module):
     decode_attn_fn: Optional[Callable] = None
     decode_ragged: bool = False   # per-row cache positions (mixed-length
                                   # serving; see models.attention)
+    decode_paged: bool = False    # paged KV pools + host-owned block tables
+    decode_page_count: int = 0
     quantization: Optional[str] = None   # "int4" → fused-kernel projections
     quantization_group: int = 128
     quantized_matmul_fn: Optional[Callable] = None
@@ -244,6 +246,8 @@ class TransformerBlock(nn.Module):
             decode_block_k=self.decode_block_k,
             decode_attn_fn=self.decode_attn_fn,
             decode_ragged=self.decode_ragged,
+            decode_paged=self.decode_paged,
+            decode_page_count=self.decode_page_count,
             quantization=self.quantization,
             quantization_group=self.quantization_group,
             quantized_matmul_fn=self.quantized_matmul_fn,
@@ -338,6 +342,18 @@ class TransformerConfig:
                                      # prompt batches serve at each row's own
                                      # length (ragged prefill + independent
                                      # row advance; models.attention)
+    decode_paged: bool = False       # PAGED KV cache: per-layer physical page
+                                     # POOLS (decode_page_count pages of
+                                     # decode_block_k tokens each) indirected
+                                     # through per-row block tables — cache
+                                     # HBM scales with allocated pages, not
+                                     # B × max_seq_len. Requires decode_ragged
+                                     # + the blocked backend + an explicit
+                                     # decode_block_k (the page size); the
+                                     # host allocator owns the tables
+                                     # (models/serving.py)
+    decode_page_count: int = 0       # physical pages per layer pool, incl.
+                                     # the reserved scratch page 0
     quantization: Optional[str] = None  # "int4": every projection consumes a
                                      # quantize_tree(bits=4) tree verbatim
                                      # through the fused dequant-matmul
@@ -355,6 +371,27 @@ class TransformerConfig:
                 "remat_policy is set but remat=False — the policy would "
                 "be silently ignored; set remat=True (or drop the policy)"
             )
+        if self.decode_paged:
+            if not self.decode_ragged:
+                raise ValueError(
+                    "decode_paged requires decode_ragged=True (per-row "
+                    "cache positions drive the block tables)"
+                )
+            if not self.decode_block_k:
+                raise ValueError(
+                    "decode_paged requires an explicit decode_block_k — "
+                    "it is the page size"
+                )
+            if self.max_seq_len % self.decode_block_k:
+                raise ValueError(
+                    f"max_seq_len ({self.max_seq_len}) must be a multiple "
+                    f"of the page size ({self.decode_block_k})"
+                )
+            if self.decode_page_count < 2:
+                raise ValueError(
+                    "decode_page_count must be >= 2 (page 0 is the "
+                    "reserved scratch page)"
+                )
 
     def train_step_flops(self, batch: int, seq: int) -> float:
         """Analytic model FLOPs of one train step (fwd + bwd ≈ 3× fwd).
@@ -540,6 +577,8 @@ class Transformer(nn.Module):
             decode_block_k=cfg.decode_block_k,
             decode_attn_fn=cfg.decode_attn_fn,
             decode_ragged=cfg.decode_ragged,
+            decode_paged=cfg.decode_paged,
+            decode_page_count=cfg.decode_page_count,
             quantization=cfg.quantization,
             quantization_group=cfg.quantization_group,
             quantized_matmul_fn=cfg.quantized_matmul_fn,

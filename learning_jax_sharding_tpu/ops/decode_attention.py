@@ -83,12 +83,16 @@ def _last_block(bi, qi, sref, *, qb: int, s: int, block_k: int):
 def _kernel(
     s_ref,                # SMEM (B, 5): [kstart_block, valid_blocks, index,
     #                       write_block, write_offset] per row
-    q_ref, k_ref, v_ref,  # (1, N_kv, GQ, H), (1, N_kv, block_k, H) ×2
-    *rest,
+    *rest,                # [t_ref (paged block table, index maps only),]
+    #                       q_ref (1, N_kv, GQ, H),
+    #                       k_ref/v_ref (1, N_kv, block_k, H), ...
     scale: float, block_k: int, group: int, qb: int, s: int,
-    window, quantized: bool, fold: bool,
+    window, quantized: bool, fold: bool, paged: bool = False,
 ):
     rest = list(rest)
+    if paged:
+        rest.pop(0)  # the block table feeds the index maps, not the body
+    q_ref, k_ref, v_ref = rest.pop(0), rest.pop(0), rest.pop(0)
     if quantized:
         ks_ref, vs_ref = rest.pop(0), rest.pop(0)
     if fold:
@@ -215,6 +219,7 @@ def decode_attention(
     ks_new: jax.Array | None = None,
     vs_new: jax.Array | None = None,
     write_enable: jax.Array | None = None,
+    block_table: jax.Array | None = None,
     window: int | None = None,
     scale: float | None = None,
     block_k: int | None = None,
@@ -255,6 +260,18 @@ def decode_attention(
             so their cache block flushes back UNCHANGED — no garbage token
             ever lands in the cache, even transiently. ``None`` writes
             every row.
+        block_table: PAGED cache — ``(B, T)`` int32 mapping each row's
+            logical block ``t`` (cache positions ``[t·page, (t+1)·page)``)
+            to a physical PAGE in a shared pool. The caches then arrive as
+            ``(P, N_kv, page, H)`` pools (scales ``(P, N_kv, page)``)
+            instead of per-row buffers: physical HBM scales with pages
+            actually allocated, not ``B × max_len`` — the block table is
+            a SECOND scalar-prefetch operand, and every BlockSpec index
+            map simply indirects its logical block through it (the kernel
+            body is untouched: all its arithmetic is logical). The folded
+            write flushes through the row's mapped page. Unallocated
+            entries are never read (per-row frontier clamping) but should
+            point at a reserved scratch page for masked writes.
         block_k: cache block size; None auto-selects (≤256 dividing L).
         block_q: q rows per grid tile (VMEM bound for long chunks).
         interpret: run the Pallas interpreter; None = auto (True off-TPU).
@@ -265,11 +282,29 @@ def decode_attention(
         for int8): ``(out, k_cache, v_cache[, k_scale, v_scale])``.
     """
     b, s, n, h = q.shape
-    bk, n_kv, length, hk = k_cache.shape
+    paged = block_table is not None
+    if paged:
+        pool, n_kv, page, hk = k_cache.shape
+        if block_table.shape[0] != b or block_table.ndim != 2:
+            raise ValueError(
+                f"block_table {block_table.shape} must be (B, T) = ({b}, *)"
+            )
+        if block_k is not None and block_k != page:
+            raise ValueError(
+                f"paged cache: block_k ({block_k}) must equal the page "
+                f"size ({page})"
+            )
+        block_k = page
+        length = block_table.shape[1] * page   # logical per-row capacity
+        bk = b
+    else:
+        bk, n_kv, length, hk = k_cache.shape
     if (bk, hk) != (b, h) or v_cache.shape != k_cache.shape:
         raise ValueError(
             f"cache shapes {k_cache.shape}/{v_cache.shape} do not match "
-            f"queries {q.shape} (want (B, N_kv, L, H) = ({b}, *, *, {h}))"
+            f"queries {q.shape} (want "
+            f"{'(P, N_kv, page, H)' if paged else '(B, N_kv, L, H)'} "
+            f"with H = {h})"
         )
     if n % n_kv:
         raise ValueError(f"num_heads {n} not a multiple of kv heads {n_kv}")
@@ -331,43 +366,50 @@ def decode_attention(
 
     last_block = functools.partial(_last_block, qb=qb, s=s, block_k=block_k)
 
-    def clamped(bi, qi, j, sref):
-        return (bi, 0, jnp.minimum(sref[bi, 0] + j, last_block(bi, qi, sref)), 0)
+    # All index maps take the scalar-prefetch refs as varargs: ``pf[0]`` is
+    # sargs, ``pf[1]`` (paged only) the block table. Paged maps indirect the
+    # LOGICAL block through the table into the page pool's leading axis —
+    # the only difference between the layouts; the kernel body is shared.
+    def qmap(bi, qi, j, *pf):
+        return (bi, 0, qi, 0)
+
+    def clamped(bi, qi, j, *pf):
+        lb = jnp.minimum(pf[0][bi, 0] + j, last_block(bi, qi, pf[0]))
+        return (pf[1][bi, lb], 0, 0, 0) if paged else (bi, 0, lb, 0)
+
+    def clamped_sc(bi, qi, j, *pf):
+        lb = jnp.minimum(pf[0][bi, 0] + j, last_block(bi, qi, pf[0]))
+        return (pf[1][bi, lb], 0, 0) if paged else (bi, 0, lb)
 
     in_specs = [
-        pl.BlockSpec((1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0)),
+        pl.BlockSpec((1, n_kv, gq, h), qmap),
         pl.BlockSpec((1, n_kv, block_k, h), clamped),
         pl.BlockSpec((1, n_kv, block_k, h), clamped),
     ]
     operands = [qr, k_cache, v_cache]
     if quantized:
-        in_specs += [
-            pl.BlockSpec(
-                (1, n_kv, block_k),
-                lambda bi, qi, j, sref: (
-                    bi, 0,
-                    jnp.minimum(sref[bi, 0] + j, last_block(bi, qi, sref)),
-                ),
-            )
-        ] * 2
+        in_specs += [pl.BlockSpec((1, n_kv, block_k), clamped_sc)] * 2
         operands += [k_scale, v_scale]
 
-    out_specs = [
-        pl.BlockSpec((1, n_kv, gq, h), lambda bi, qi, j, sref: (bi, 0, qi, 0))
-    ]
+    out_specs = [pl.BlockSpec((1, n_kv, gq, h), qmap)]
     out_shapes = [jax.ShapeDtypeStruct((b, n_kv, s * group, h), q.dtype)]
     aliases = {}
+    prefetch = 2 if paged else 1
     if fold:
         # New-token chunks enter whole; the merged cache block flushes back
         # through outputs ALIASED to the cache inputs (alias indices count
-        # the scalar-prefetch operand), so only each row's one modified
+        # the scalar-prefetch operands), so only each row's one modified
         # block moves.
         chunk_spec = pl.BlockSpec(
-            (1, n_kv, 1, h), lambda bi, qi, j, sref: (bi, 0, 0, 0)
+            (1, n_kv, 1, h), lambda bi, qi, j, *pf: (bi, 0, 0, 0)
         )
         in_specs += [chunk_spec, chunk_spec]
         operands += [k_new, v_new]
-        wb = lambda bi, qi, j, sref: (bi, 0, sref[bi, 3], 0)
+
+        def wb(bi, qi, j, *pf):
+            blk = pf[0][bi, 3]
+            return (pf[1][bi, blk], 0, 0, 0) if paged else (bi, 0, blk, 0)
+
         out_specs += [
             pl.BlockSpec((1, n_kv, block_k, h), wb),
             pl.BlockSpec((1, n_kv, block_k, h), wb),
@@ -376,15 +418,20 @@ def decode_attention(
             jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
             jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
         ]
-        aliases[2] = 1   # k_cache (operand 2, after sargs+q) → output 1
-        aliases[3] = 2   # v_cache → output 2
+        kidx = prefetch + 1              # operand index of k_cache
+        aliases[kidx] = 1                # k_cache → output 1
+        aliases[kidx + 1] = 2            # v_cache → output 2
         if quantized:
             sc_chunk = pl.BlockSpec(
-                (1, n_kv, 1), lambda bi, qi, j, sref: (bi, 0, 0)
+                (1, n_kv, 1), lambda bi, qi, j, *pf: (bi, 0, 0)
             )
             in_specs += [sc_chunk, sc_chunk]
             operands += [ks_new, vs_new]
-            wbs = lambda bi, qi, j, sref: (bi, 0, sref[bi, 3])
+
+            def wbs(bi, qi, j, *pf):
+                blk = pf[0][bi, 3]
+                return (pf[1][bi, blk], 0, 0) if paged else (bi, 0, blk)
+
             out_specs += [
                 pl.BlockSpec((1, n_kv, block_k), wbs),
                 pl.BlockSpec((1, n_kv, block_k), wbs),
@@ -393,16 +440,19 @@ def decode_attention(
                 jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
                 jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
             ]
-            aliases[4] = 3   # k_scale → output 3
-            aliases[5] = 4   # v_scale → output 4
+            aliases[kidx + 2] = 3        # k_scale → output 3
+            aliases[kidx + 3] = 4        # v_scale → output 4
 
+    prefetch_args = (
+        (sargs, block_table.astype(jnp.int32)) if paged else (sargs,)
+    )
     result = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, block_k=block_k, group=group, qb=qb, s=s,
-            window=window, quantized=quantized, fold=fold,
+            window=window, quantized=quantized, fold=fold, paged=paged,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=prefetch,
             grid=(b, nq, nk),
             in_specs=in_specs,
             out_specs=out_specs if fold else out_specs[0],
@@ -415,7 +465,7 @@ def decode_attention(
         out_shape=out_shapes if fold else out_shapes[0],
         input_output_aliases=aliases,
         interpret=interpret,
-    )(sargs, *operands)
+    )(*prefetch_args, *operands)
 
     out = result[0] if fold else result
     out = (
@@ -451,32 +501,59 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
         )
 
     q_spec = to_spec((BATCH, None, HEADS, None))
-    kv_spec = to_spec((BATCH, HEADS, None, None))
     sc_spec = to_spec((BATCH, HEADS, None))
     row_idx_spec = to_spec((BATCH,))
+    # Paged pools lead with the shared PAGE axis: heads-only sharding. Any
+    # row may read any page, so the batch must NOT be sharded in paged mode
+    # (checked in attn_fn) — the engine serves with TP over heads.
+    paged_kv_spec = to_spec((None, HEADS, None, None))
+    paged_sc_spec = to_spec((None, HEADS, None))
 
     def attn_fn(
         q, k_cache, v_cache, index, *,
         k_scale=None, v_scale=None,
         k_new=None, v_new=None, ks_new=None, vs_new=None,
-        write_enable=None,
+        write_enable=None, block_table=None,
         **call_kwargs,
     ):
         fn = functools.partial(decode_attention, **{**kwargs, **call_kwargs})
+        paged = block_table is not None
+        if paged:
+            batch_axes = nn_partitioning.logical_to_mesh_axes(
+                (BATCH,), tuple(rules)
+            )[0]
+            axes = (
+                (batch_axes,) if isinstance(batch_axes, str)
+                else tuple(batch_axes or ())
+            )
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a]
+            if size > 1:
+                raise ValueError(
+                    "paged serving cannot shard the batch (any row may "
+                    "read any page): use rules that leave BATCH unmapped "
+                    "(TP over heads) or a batch mesh axis of size 1"
+                )
+        kv_spec = paged_kv_spec if paged else to_spec((BATCH, HEADS, None, None))
         # Scalar index replicates; a per-row (B,) index (ragged serving)
         # shards with the batch.
         idx_spec = row_idx_spec if jnp.ndim(index) == 1 else PartitionSpec()
-        quantized = k_scale is not None
-        fold = k_new is not None
         in_specs = [q_spec, kv_spec, kv_spec, idx_spec]
         args = [q, k_cache, v_cache, index]
+        quantized = k_scale is not None
+        fold = k_new is not None
         keys = []
+        cache_sc_spec = paged_sc_spec if paged else sc_spec
         if quantized:
-            in_specs += [sc_spec, sc_spec]
+            in_specs += [cache_sc_spec, cache_sc_spec]
             args += [k_scale, v_scale]
             keys += ["k_scale", "v_scale"]
         if fold:
-            in_specs += [kv_spec, kv_spec]
+            # New-token chunks (and their scales) are PER-ROW even in paged
+            # mode — only the pools lose their batch axis.
+            chunk_spec = to_spec((BATCH, HEADS, None, None))
+            in_specs += [chunk_spec, chunk_spec]
             args += [k_new, v_new]
             keys += ["k_new", "v_new"]
             if quantized:
@@ -491,13 +568,17 @@ def make_decode_attn_fn(mesh, rules, **kwargs):
             # Mirror decode_attention's own guard — the wrapper must not
             # silently drop a misused mask.
             raise ValueError("write_enable requires the folded write (k_new)")
+        if paged:
+            in_specs += [to_spec((BATCH, None))]
+            args += [block_table]
+            keys += ["block_table"]
         # Folded writes return the updated cache (+ scale) buffers alongside
         # the attention output; each keeps its input's sharding.
         out_specs = q_spec
         if fold:
             out_specs = (q_spec, kv_spec, kv_spec)
             if quantized:
-                out_specs += (sc_spec, sc_spec)
+                out_specs += (cache_sc_spec, cache_sc_spec)
 
         def body(q_, k_, v_, i_, *rest):
             return fn(q_, k_, v_, i_, **dict(zip(keys, rest)))

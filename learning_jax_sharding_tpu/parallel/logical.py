@@ -95,6 +95,18 @@ RULES_DP_TP_EP: Rules = (
     (VOCAB, "model"),
 )
 
+#: Serving layout for the PAGED KV cache: tensor parallelism only. The
+#: batch stays replicated because any row's block table may point at any
+#: physical page — a batch shard would need its own page pool and
+#: allocator (models/serving.py ``paged_pages``). Kernel axes shard over
+#: 'model' exactly as RULES_DP_TP.
+RULES_TP_SERVING: Rules = (
+    (HEADS, "model"),
+    (HIDDEN, "model"),
+    (MLP, "model"),
+    (VOCAB, "model"),
+)
+
 #: Fully-sharded data parallel flavor: parameters sharded over the data axis
 #: too (the case-3 zero-redundancy pattern, `/root/reference/case3_fully_sharded.py`).
 RULES_FSDP: Rules = (

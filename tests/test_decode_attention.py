@@ -260,3 +260,90 @@ class TestFoldedWriteEnable:
                 q, kc, kc, 3, write_enable=jnp.ones((1,), jnp.int32),
                 interpret=True,
             )
+
+
+class TestPagedCache:
+    """Paged layout: (P, N_kv, page, H) pools indirected through per-row
+    block tables. Oracle: bit-identical attention (and folded writes) to
+    the contiguous layout holding the same logical contents, for ANY page
+    permutation — the table is pure indirection."""
+
+    def _paged_from_contiguous(self, kc, vc, page, rng):
+        b, n_kv, L, h = kc.shape
+        T = L // page
+        P = b * T + 1
+        table = rng.permutation(np.arange(1, P)).reshape(b, T)
+        pool_k = np.zeros((P, n_kv, page, h), np.float32)
+        pool_v = np.zeros((P, n_kv, page, h), np.float32)
+        for bi in range(b):
+            for t in range(T):
+                pool_k[table[bi, t]] = np.asarray(kc)[bi, :, t*page:(t+1)*page]
+                pool_v[table[bi, t]] = np.asarray(vc)[bi, :, t*page:(t+1)*page]
+        return (
+            jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table, jnp.int32),
+        )
+
+    @pytest.mark.parametrize("s,group", [(1, 1), (1, 2), (5, 1)])
+    def test_read_parity(self, s, group):
+        rng = np.random.default_rng(0)
+        b, n_kv, page, h, T = 3, 2, 16, 8, 4
+        L = T * page
+        kc = jnp.asarray(rng.normal(size=(b, n_kv, L, h)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(b, n_kv, L, h)), jnp.float32)
+        idx = jnp.asarray([17, 33, 5], jnp.int32)
+        q = jnp.asarray(
+            rng.normal(size=(b, s, n_kv * group, h)), jnp.float32
+        )
+        ref = decode_attention(q, kc, vc, idx, block_k=page, interpret=True)
+        pk, pv, table = self._paged_from_contiguous(kc, vc, page, rng)
+        out = decode_attention(
+            q, pk, pv, idx, block_table=table, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6
+        )
+
+    def test_folded_write_parity(self):
+        rng = np.random.default_rng(1)
+        b, n_kv, page, h, T = 2, 2, 16, 8, 4
+        L = T * page
+        kc = jnp.asarray(rng.normal(size=(b, n_kv, L, h)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=(b, n_kv, L, h)), jnp.float32)
+        idx = jnp.asarray([17, 9], jnp.int32)
+        q = jnp.asarray(rng.normal(size=(b, 1, n_kv, h)), jnp.float32)
+        k_new = jnp.asarray(rng.normal(size=(b, n_kv, 1, h)), jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(b, n_kv, 1, h)), jnp.float32)
+        ref, rk, rv = decode_attention(
+            q, kc, vc, idx, k_new=k_new, v_new=v_new, block_k=page,
+            interpret=True,
+        )
+        pk, pv, table = self._paged_from_contiguous(kc, vc, page, rng)
+        out, ok, ov = decode_attention(
+            q, pk, pv, idx, k_new=k_new, v_new=v_new, block_table=table,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6
+        )
+        ok, ov = np.asarray(ok), np.asarray(ov)
+        tbl = np.asarray(table)
+        for bi in range(b):
+            i = int(idx[bi])
+            t, o = i // page, i % page
+            np.testing.assert_array_equal(
+                ok[tbl[bi, t], :, o], np.asarray(rk)[bi, :, i]
+            )
+            np.testing.assert_array_equal(
+                ov[tbl[bi, t], :, o], np.asarray(rv)[bi, :, i]
+            )
+
+    def test_block_k_mismatch_rejected(self):
+        rng = np.random.default_rng(0)
+        pk = jnp.zeros((5, 1, 16, 8), jnp.float32)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+        table = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="page"):
+            decode_attention(
+                q, pk, pk, 3, block_table=table, block_k=8, interpret=True
+            )
