@@ -50,13 +50,15 @@ NEG_INF = -1e9
 
 def _gather_beams(tree: Any, parent: jax.Array, batch: int, k: int) -> Any:
     """Reorder the leading ``B·K`` dim of every array leaf to follow
-    ``parent`` (B, K) beam indices."""
+    ``parent`` (B, K) beam indices. Ragged counters (``cache_index`` /
+    ``position`` at ``(B·K,)``) gather too — a no-op value-wise, since a
+    row's beams always hold equal positions."""
     flat = (jnp.arange(batch)[:, None] * k + parent).reshape(-1)  # (B·K,)
 
     def leaf(x):
         if getattr(x, "ndim", 0) >= 1 and x.shape[0] == batch * k:
             return jnp.take(x, flat, axis=0)
-        return x  # scalars: cache_index / position, shared across beams
+        return x  # scalars: rectangular cache_index / position
 
     return jax.tree.map(leaf, tree)
 
@@ -73,6 +75,7 @@ def make_beam_search_fn(
     length_penalty: float = 1.0,
     inference_dtype: Any | None = None,
     dequantize: bool = False,
+    ragged: bool = False,
 ):
     """Build ``search(params, prompt) -> (tokens, scores)``.
 
@@ -82,6 +85,17 @@ def make_beam_search_fn(
     config; the decode variant is derived here. ``inference_dtype`` /
     ``dequantize`` follow ``make_generate_fn`` (eager cast; int8 trees
     dequantized in-jit).
+
+    ``ragged``: mixed-length prompt batches. ``search(params, prompt,
+    lengths)`` takes the right-padded prompt plus per-row true lengths;
+    every row's beams expand from ITS last valid position over per-row
+    cache positions (beams of one row always advance together, so the
+    beam fold needs no freezing — only the prefill gather and the output
+    placement are per-row). Output rows follow the ragged
+    ``make_generate_fn`` convention: ``[prompt_b, best hypothesis...,
+    fill]`` with the generated span starting at ``lengths[b]``. Per-row
+    results are bit-identical to a rectangular search of each row alone
+    at its true length (test-pinned, dense and blocked backends).
     """
     if beam_size < 1:
         raise ValueError(f"beam_size must be >= 1, got {beam_size}")
@@ -90,7 +104,17 @@ def make_beam_search_fn(
             f"vocab_size ({config.vocab_size}) must be >= 2*beam_size "
             f"({2 * beam_size}) for the 2K candidate expansion"
         )
+    if config.decode_paged:
+        raise ValueError(
+            "beam search over a paged cache is not supported: beams tile "
+            "the batch, which would need per-beam block tables (use the "
+            "continuous engine for paged serving)"
+        )
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
+    if ragged:
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, decode_ragged=True)
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=dequantize)
     apply = make_cached_apply(
@@ -128,7 +152,7 @@ def make_beam_search_fn(
         )
         return fin_scores, fin_buf, live_scores, live_idx
 
-    def search(params, prompt):
+    def search(params, prompt, lengths=None):
         b, prompt_len = prompt.shape
         check_sequence_budget(
             prompt_len + max_new_tokens, cfg.max_seq_len,
@@ -144,13 +168,22 @@ def make_beam_search_fn(
             limit = lambda lg: vocab_limit_filter(lg, vocab_limit)
         else:
             limit = lambda lg: lg
-        logits, cache = apply(params, None, prompt)
+        if ragged:
+            # Ragged prefill: per-row cache positions; the seed logits come
+            # from each row's own last VALID position, not column -1.
+            logits_all, cache = apply(params, None, prompt, lengths)
+            last_logits = jnp.take_along_axis(
+                logits_all, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]
+        else:
+            logits, cache = apply(params, None, prompt)
+            last_logits = logits[:, -1]
         cache = jax.tree.map(
             lambda x: jnp.repeat(x, k, axis=0)
             if getattr(x, "ndim", 0) >= 1 and x.shape[0] == b else x,
             cache,
         )
-        logp0 = jax.nn.log_softmax(limit(logits[:, -1]))  # (B, V)
+        logp0 = jax.nn.log_softmax(limit(last_logits))  # (B, V)
         vocab = logp0.shape[-1]
 
         fin_scores = jnp.full((b, k), NEG_INF)
@@ -213,15 +246,41 @@ def make_beam_search_fn(
             all_buf, best[:, None, None], axis=1
         )[:, 0]
         best_score = jnp.take_along_axis(all_scores, best[:, None], axis=1)[:, 0]
-        return (
-            jnp.concatenate([prompt, best_tokens], axis=1),
-            best_score,
+        if not ragged:
+            return (
+                jnp.concatenate([prompt, best_tokens], axis=1),
+                best_score,
+            )
+        # Ragged assembly: row b's hypothesis starts at ITS length; every
+        # cell past it — including the caller's prompt padding — becomes
+        # the fill value (eos when set), matching make_generate_fn.
+        fill = 0 if eos_id is None else eos_id
+        total = prompt_len + max_new_tokens
+        col = jnp.arange(total)[None, :]
+        outp = jnp.where(
+            col < lengths[:, None],
+            jnp.pad(prompt, ((0, 0), (0, max_new_tokens))),
+            fill,
         )
+        rows = jnp.arange(b)[:, None]
+        cols = lengths[:, None] + jnp.arange(max_new_tokens)[None, :]
+        return outp.at[rows, cols].set(best_tokens), best_score
 
     jitted = jax.jit(search)
 
-    def run(params: Any, prompt: jax.Array):
+    def run(params: Any, prompt: jax.Array, lengths=None):
+        if ragged and lengths is None:
+            raise ValueError(
+                "ragged=True: pass lengths (B,) — each row's true prompt "
+                "length in the right-padded prompt batch"
+            )
+        if not ragged and lengths is not None:
+            raise ValueError("lengths requires make_beam_search_fn(ragged=True)")
         with activate(mesh, rules):
+            if ragged:
+                return jitted(
+                    maybe_cast(params), prompt, jnp.asarray(lengths, jnp.int32)
+                )
             return jitted(maybe_cast(params), prompt)
 
     run.jitted = jitted

@@ -66,7 +66,10 @@ from learning_jax_sharding_tpu.models.decoding import (
     make_cached_apply,
     make_param_caster,
 )
-from learning_jax_sharding_tpu.models.attention import row_update_masked
+from learning_jax_sharding_tpu.models.attention import (
+    resolve_decode_backend,
+    row_update_masked,
+)
 from learning_jax_sharding_tpu.models.generate import filtered_logits
 from learning_jax_sharding_tpu.models.speculative import (
     _greedy as greedy_pick,
@@ -184,60 +187,50 @@ def make_continuous_engine(
                 f"{draft_config.vocab_size}"
             )
     paged = paged_pages is not None
-    if paged:
-        from learning_jax_sharding_tpu.models.attention import (
-            resolve_decode_backend,
+
+    def check_paged(name, c):
+        # ONE copy of the paged preconditions, applied to the target and
+        # (when speculative) the draft — their caches page side by side.
+        if resolve_decode_backend(c.decode_attention) != "blocked":
+            raise ValueError(
+                f"paged_pages requires the blocked decode backend for the "
+                f"{name} config (decode_attention='blocked', or 'auto' on "
+                f"TPU)"
+            )
+        if c.max_seq_len % page_size:
+            raise ValueError(
+                f"{name} max_seq_len ({c.max_seq_len}) must be a multiple "
+                f"of page_size ({page_size})"
+            )
+
+    def pagedify(c):
+        return dataclasses.replace(
+            c, decode_paged=True, decode_page_count=paged_pages,
+            decode_block_k=page_size,
         )
 
-        if resolve_decode_backend(config.decode_attention) != "blocked":
-            raise ValueError(
-                "paged_pages requires the blocked decode backend "
-                "(decode_attention='blocked', or 'auto' on TPU)"
-            )
+    if paged:
         if paged_pages < 2:
             raise ValueError(
                 "paged_pages must be >= 2 (page 0 is the scratch page)"
             )
-        if config.max_seq_len % page_size:
-            raise ValueError(
-                f"max_seq_len ({config.max_seq_len}) must be a multiple of "
-                f"page_size ({page_size})"
-            )
+        check_paged("target", config)
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     cfg = dataclasses.replace(cfg, decode_ragged=True)
     if paged:
-        cfg = dataclasses.replace(
-            cfg, decode_paged=True, decode_page_count=paged_pages,
-            decode_block_k=page_size,
-        )
+        cfg = pagedify(cfg)
     model = Transformer(cfg)
     apply = make_cached_apply(model)
     maybe_cast = make_param_caster(inference_dtype)
     if speculative:
+        if paged:
+            check_paged("draft", draft_config)
         d_cfg = derive_decode_config(
             draft_config, inference_dtype, mesh=mesh, rules=rules
         )
         d_cfg = dataclasses.replace(d_cfg, decode_ragged=True)
         if paged:
-            from learning_jax_sharding_tpu.models.attention import (
-                resolve_decode_backend,
-            )
-
-            if resolve_decode_backend(draft_config.decode_attention) != "blocked":
-                raise ValueError(
-                    "paged_pages requires the blocked decode backend for "
-                    "the draft_config too (its cache pages alongside the "
-                    "target's)"
-                )
-            if draft_config.max_seq_len % page_size:
-                raise ValueError(
-                    f"draft max_seq_len ({draft_config.max_seq_len}) must "
-                    f"be a multiple of page_size ({page_size})"
-                )
-            d_cfg = dataclasses.replace(
-                d_cfg, decode_paged=True, decode_page_count=paged_pages,
-                decode_block_k=page_size,
-            )
+            d_cfg = pagedify(d_cfg)
         d_apply = make_cached_apply(Transformer(d_cfg))
 
     def _greedy(logits):
