@@ -171,6 +171,51 @@ def make_norm(kind: str, dtype, param_dtype, name: str, eps: float = 1e-6) -> nn
     raise ValueError(f"unknown norm {kind!r}: expected 'layernorm' or 'rmsnorm'")
 
 
+class FusedNorm(nn.Module):
+    """Param-compatible replacement for :func:`make_norm` backed by the
+    Pallas fused residual+norm kernel (``ops/fused_norm.py``): identical
+    ``scale``/``bias`` param names, shapes, and ``(EMBED,)`` logical axes
+    as ``nn.LayerNorm``/``nn.RMSNorm``, so checkpoints transfer verbatim
+    across the ``fused_norm`` flag. Called as ``module(x, resid)`` →
+    ``(normed, x + resid)`` — the whole block boundary (residual add +
+    norm) in one HBM pass. Single-device oriented: GSPMD cannot partition
+    the custom call, so multi-device training should keep the flag off
+    (the math is identical either way)."""
+
+    kind: str
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, resid=None):
+        from learning_jax_sharding_tpu.ops.fused_norm import (
+            fused_residual_norm,
+        )
+
+        m = x.shape[-1]
+        scale = self.param(
+            "scale",
+            nn.with_logical_partitioning(nn.initializers.ones_init(), (EMBED,)),
+            (m,), self.param_dtype,
+        )
+        bias = None
+        if self.kind == "layernorm":
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (EMBED,)
+                ),
+                (m,), self.param_dtype,
+            )
+        x = x.astype(self.dtype)
+        if resid is not None:
+            resid = resid.astype(self.dtype)
+        return fused_residual_norm(
+            x, resid, scale, bias, eps=self.eps, kind=self.kind
+        )
+
+
 class TransformerBlock(nn.Module):
     """Pre-LN block: x + Attn(LN(x)); x + FF(LN(x)).
 
@@ -214,17 +259,31 @@ class TransformerBlock(nn.Module):
     quantization_group: int = 128
     quantized_matmul_fn: Optional[Callable] = None
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
+    fused_norm: bool = False      # block boundaries through the Pallas
+                                  # fused residual+norm kernel (param-tree
+                                  # identical; see FusedNorm)
     scan: bool = False            # under nn.scan: return (x, None) pairs
+
+    def _norm(self, name: str):
+        if self.fused_norm:
+            return FusedNorm(
+                kind=self.norm, eps=self.norm_eps, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name,
+            )
+        mod = make_norm(
+            self.norm, self.dtype, self.param_dtype, name, self.norm_eps
+        )
+        return lambda x, resid=None: (
+            (mod(x), x) if resid is None else (mod(x + resid), x + resid)
+        )
 
     @nn.compact
     def __call__(
         self, x: jax.Array, deterministic: bool = True, chunk_lengths=None
     ):
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
-        h = make_norm(
-            self.norm, self.dtype, self.param_dtype, "ln_attn", self.norm_eps
-        )(x)
-        x = x + MultiHeadAttention(
+        h, _ = self._norm("ln_attn")(x)
+        attn_out = MultiHeadAttention(
             features=self.features,
             num_heads=self.num_heads,
             head_dim=self.head_dim,
@@ -253,9 +312,9 @@ class TransformerBlock(nn.Module):
             quantized_matmul_fn=self.quantized_matmul_fn,
             name="attn",
         )(h, deterministic=deterministic, chunk_lengths=chunk_lengths)
-        h = make_norm(
-            self.norm, self.dtype, self.param_dtype, "ln_ff", self.norm_eps
-        )(x)
+        # The block boundary: residual add + norm — ONE fused HBM pass
+        # under fused_norm, the plain pair otherwise (identical math).
+        h, x = self._norm("ln_ff")(attn_out, x)
         if self.num_experts > 0:
             from learning_jax_sharding_tpu.models.moe import MoEFeedForward
 
@@ -324,6 +383,13 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
+    fused_norm: bool = False         # block boundaries (residual add + norm)
+                                     # through the Pallas fused kernel
+                                     # (ops/fused_norm.py); param-tree
+                                     # identical to the plain path, so the
+                                     # flag can flip on existing checkpoints.
+                                     # Single-device oriented (GSPMD cannot
+                                     # partition the custom call)
     decode: bool = False             # inference mode: KV cache, chunked input
     kv_cache_dtype: Optional[Any] = None  # decode KV-cache storage dtype:
                                      # None = compute dtype; jnp.int8 =
@@ -583,6 +649,7 @@ class Transformer(nn.Module):
             quantization_group=cfg.quantization_group,
             quantized_matmul_fn=cfg.quantized_matmul_fn,
             norm=cfg.norm,
+            fused_norm=cfg.fused_norm,
         )
         if cfg.scan_layers:
             if cfg.decode:
@@ -647,9 +714,15 @@ class Transformer(nn.Module):
                         x, deterministic
                     )
 
-        x = make_norm(
-            cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps
-        )(x)
+        if cfg.fused_norm:
+            x, _ = FusedNorm(
+                kind=cfg.norm, eps=cfg.norm_eps, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype, name="ln_out",
+            )(x)
+        else:
+            x = make_norm(
+                cfg.norm, cfg.dtype, cfg.param_dtype, "ln_out", cfg.norm_eps
+            )(x)
         if return_hidden:
             # Skip the logits projection: callers pairing this with
             # :func:`fused_next_token_loss` apply the lm_head kernel chunk by

@@ -558,9 +558,10 @@ def _auto_block(s: int, cap: int = 1024) -> int:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11)
 )
-def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret, group):
+def _flash(q, k, v, scale, causal, window, block_q, block_k,
+           bwd_block_q, bwd_block_k, interpret, group):
     out, _ = _fwd(
         q, k, v, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, interpret=interpret, group=group,
@@ -568,7 +569,8 @@ def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret, group):
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret, group):
+def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k,
+               bwd_block_q, bwd_block_k, interpret, group):
     out, lse = _fwd(
         q, k, v, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, interpret=interpret, group=group,
@@ -577,10 +579,17 @@ def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k, interpret, grou
 
 
 def _flash_bwd(
-    scale, causal, window, block_q, block_k, interpret, group, residuals, do
+    scale, causal, window, block_q, block_k, bwd_block_q, bwd_block_k,
+    interpret, group, residuals, do,
 ):
+    # The backward's optimal tiles differ from the forward's (it holds
+    # more live tensors per block: do, lse, delta, two accumulators) —
+    # tunable independently; None inherits the forward tiles.
     return _bwd(
-        scale, causal, window, block_q, block_k, interpret, group, residuals, do
+        scale, causal, window,
+        block_q if bwd_block_q is None else bwd_block_q,
+        block_k if bwd_block_k is None else bwd_block_k,
+        interpret, group, residuals, do,
     )
 
 
@@ -598,6 +607,8 @@ def flash_attention(
     scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Blockwise-softmax attention over ``(B, S, N, H)`` inputs.
@@ -622,6 +633,10 @@ def flash_attention(
             k-step's matmuls are MXU-sized instead of sliver-sized; 1024×1024
             fp32 scores are 4 MB, comfortably inside the ~16 MB/core VMEM
             alongside the q/k/v tiles.
+        bwd_block_q / bwd_block_k: BACKWARD tile sizes; None inherits the
+            forward's. The backward holds more live VMEM per block (do,
+            lse, delta, two fp32 accumulators), so its optimum can sit at
+            smaller tiles than the forward's — tune on-chip per shape.
         interpret: run the Pallas interpreter (CPU testing).
     """
     if mask is not None:
@@ -671,9 +686,15 @@ def flash_attention(
     def kv_rows(x):
         return x.transpose(0, 2, 1, 3).reshape(b * n_kv, s_kv, h)
 
+    for bwd_blk, rows in ((bwd_block_q, rows_q), (bwd_block_k, s_kv)):
+        if bwd_blk is not None and rows % bwd_blk:
+            raise ValueError(
+                f"sequence rows ({rows}) must be divisible by the backward "
+                f"block size ({bwd_blk})"
+            )
     out = _flash(
         q_rows(q), kv_rows(k), kv_rows(v), scale, causal, window,
-        block_q, block_k, interpret, group,
+        block_q, block_k, bwd_block_q, bwd_block_k, interpret, group,
     )
     return (
         out.reshape(b, n_kv, s_q, group, h)

@@ -1,0 +1,313 @@
+"""Fused residual-add + LayerNorm/RMSNorm as a Pallas TPU kernel.
+
+The transformer block boundary is ``x = x + sublayer(h); h' = norm(x)`` —
+pure VPU + HBM-bandwidth work that sits between every pair of matmuls. XLA
+fuses the elementwise pieces well but still materializes the residual sum
+and runs the norm as separate reduce + normalize passes over HBM; this
+kernel does the whole boundary in ONE pass per tile: read ``x`` and
+``resid`` once, form the sum in VMEM, reduce mean/rstd, scale, and write
+both the normalized output and the new residual stream. The backward is a
+second single-pass kernel emitting ``dx`` plus per-tile ``dgamma`` /
+``dbeta`` partials (summed outside — a tiny (tiles, M) reduction).
+
+PERF.md round 3 named "fused LN/residual" as the remaining honest train-
+MFU lever past 49.8% at 125M (`/root/reference` has no training loop at
+all — SURVEY.md §5; this is framework-original kernel work). Whether it
+wins on the chip is measured in ``scripts/perf_fused_norm.py`` and
+recorded either way.
+
+Numerics: reductions and the normalize run in fp32 regardless of input
+dtype (same policy as ``ops.attention``'s softmax); outputs cast back to
+the input dtype. Gradients match the reference JAX implementation to
+fp32 tolerance (test-pinned, including through ``jax.grad`` composition).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block_r(rows: int, cap: int = 256, whole_cap: int = 4096) -> int:
+    blk = 1
+    while blk < cap and rows % (blk * 2) == 0:
+        blk *= 2
+    if blk >= 8:
+        return blk
+    # No usable power-of-two factor: one whole-array tile, but only while
+    # it fits VMEM comfortably (mirrors flash_attention._auto_block's
+    # guard — a silent multi-MB tile would fail Mosaic lowering instead).
+    if rows <= whole_cap:
+        return rows
+    raise ValueError(
+        f"row count {rows} has no power-of-two factor >= 8 and is too "
+        f"large for a single tile; pad the batch*seq rows or pass a "
+        f"dividing block_r"
+    )
+
+
+def _fwd_kernel(x_ref, res_ref, g_ref, b_ref, y_ref, r_ref, mu_ref, rs_ref,
+                *, eps: float, kind: str, has_resid: bool):
+    x = x_ref[...].astype(jnp.float32)
+    if has_resid:
+        x = x + res_ref[...].astype(jnp.float32)
+        r_ref[...] = x.astype(r_ref.dtype)
+    if kind == "layernorm":
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        xc = x - mu
+        var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        y = xc * rstd * g_ref[...].astype(jnp.float32)
+        if b_ref is not None:
+            y = y + b_ref[...].astype(jnp.float32)
+        if mu_ref is not None:
+            mu_ref[...] = mu
+    else:  # rmsnorm
+        ms = jnp.mean(x * x, axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(ms + eps)
+        y = x * rstd * g_ref[...].astype(jnp.float32)
+    if rs_ref is not None:
+        rs_ref[...] = rstd
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def _bwd_kernel(do_ref, r_ref, g_ref, mu_ref, rs_ref,
+                dx_ref, dg_ref, db_ref,
+                *, kind: str):
+    do = do_ref[...].astype(jnp.float32)
+    x = r_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    rstd = rs_ref[...]                              # (br, 1)
+    if kind == "layernorm":
+        xhat = (x - mu_ref[...]) * rstd
+    else:
+        xhat = x * rstd
+    # Parameter grads: per-TILE partial sums over the rows (summed by the
+    # caller — (tiles, M) is tiny next to the activations).
+    dg_ref[...] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    if db_ref is not None:
+        db_ref[...] = jnp.sum(do, axis=0, keepdims=True)
+    dxhat = do * g
+    c2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
+    if kind == "layernorm":
+        c1 = jnp.mean(dxhat, axis=-1, keepdims=True)
+        dx = rstd * (dxhat - c1 - xhat * c2)
+    else:
+        dx = rstd * (dxhat - xhat * c2)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _fwd(x, resid, gamma, beta, *, eps, kind, block_r, interpret, needs_stats):
+    shape = x.shape
+    m = shape[-1]
+    rows = x.size // m
+    x2 = x.reshape(rows, m)
+    has_resid = resid is not None
+    has_beta = beta is not None
+    br = _pick_block_r(rows) if block_r is None else block_r
+    if rows % br:
+        raise ValueError(
+            f"rows ({rows} = batch*seq) must be divisible by block_r ({br})"
+        )
+    grid = (rows // br,)
+
+    row_spec = pl.BlockSpec((br, m), lambda i: (i, 0))
+    par_spec = pl.BlockSpec((1, m), lambda i: (0, 0))
+    # Per-row stats save as (rows, 1) — only what the backward reads.
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0))
+
+    in_specs = [row_spec]
+    operands = [x2]
+    if has_resid:
+        in_specs.append(row_spec)
+        operands.append(resid.reshape(rows, m))
+    in_specs.append(par_spec)
+    operands.append(gamma.reshape(1, m))
+    if has_beta:
+        in_specs.append(par_spec)
+        operands.append(beta.reshape(1, m))
+
+    out_specs = [row_spec]
+    out_shapes = [jax.ShapeDtypeStruct((rows, m), x.dtype)]
+    if has_resid:
+        out_specs.append(row_spec)
+        out_shapes.append(jax.ShapeDtypeStruct((rows, m), x.dtype))
+    save_mu = needs_stats and kind == "layernorm"
+    if save_mu:
+        out_specs.append(stat_spec)
+        out_shapes.append(jax.ShapeDtypeStruct((rows, 1), jnp.float32))
+    if needs_stats:
+        out_specs.append(stat_spec)
+        out_shapes.append(jax.ShapeDtypeStruct((rows, 1), jnp.float32))
+
+    def kernel(*refs):
+        refs = list(refs)
+        x_ref = refs.pop(0)
+        res_ref = refs.pop(0) if has_resid else None
+        g_ref = refs.pop(0)
+        b_ref = refs.pop(0) if has_beta else None
+        y_ref = refs.pop(0)
+        r_ref = refs.pop(0) if has_resid else None
+        mu_ref = refs.pop(0) if save_mu else None
+        rs_ref = refs.pop(0) if needs_stats else None
+        _fwd_kernel(
+            x_ref, res_ref, g_ref, b_ref, y_ref, r_ref, mu_ref, rs_ref,
+            eps=eps, kind=kind, has_resid=has_resid,
+        )
+
+    result = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*operands)
+    result = list(result)
+    y = result.pop(0).reshape(shape)
+    r = result.pop(0).reshape(shape) if has_resid else None
+    mu = result.pop(0) if save_mu else None
+    rs = result.pop(0) if needs_stats else None
+    return y, r, mu, rs, br
+
+
+def _bwd(dy, r2, gamma, mu, rs, *, kind, br, has_beta, interpret, m):
+    rows = r2.shape[0]
+    grid = (rows // br,)
+    row_spec = pl.BlockSpec((br, m), lambda i: (i, 0))
+    par_spec = pl.BlockSpec((1, m), lambda i: (0, 0))
+    part_spec = pl.BlockSpec((1, m), lambda i: (i, 0))
+    stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0))
+
+    in_specs = [row_spec, row_spec, par_spec]
+    operands = [dy, r2, gamma.reshape(1, m)]
+    if kind == "layernorm":
+        in_specs.append(stat_spec)
+        operands.append(mu)
+    in_specs.append(stat_spec)
+    operands.append(rs)
+
+    ntiles = grid[0]
+    out_specs = [row_spec, part_spec]
+    out_shapes = [
+        jax.ShapeDtypeStruct((rows, m), dy.dtype),
+        jax.ShapeDtypeStruct((ntiles, m), jnp.float32),
+    ]
+    if has_beta:
+        out_specs.append(part_spec)
+        out_shapes.append(jax.ShapeDtypeStruct((ntiles, m), jnp.float32))
+
+    def kernel(*refs):
+        refs = list(refs)
+        do_ref = refs.pop(0)
+        r_ref = refs.pop(0)
+        g_ref = refs.pop(0)
+        mu_ref = refs.pop(0) if kind == "layernorm" else None
+        rs_ref = refs.pop(0)
+        dx_ref = refs.pop(0)
+        dg_ref = refs.pop(0)
+        db_ref = refs.pop(0) if has_beta else None
+        _bwd_kernel(
+            do_ref, r_ref, g_ref, mu_ref, rs_ref, dx_ref, dg_ref, db_ref,
+            kind=kind,
+        )
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*operands)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7)
+)
+def _fused(x, resid, gamma, beta, eps, kind, block_r, interpret):
+    # Inference path: no stats are computed or stored (one clean HBM pass).
+    y, r, _, _, _ = _fwd(
+        x, resid, gamma, beta, eps=eps, kind=kind, block_r=block_r,
+        interpret=interpret, needs_stats=False,
+    )
+    return (y, r) if resid is not None else (y, x)
+
+
+def _fused_fwd(x, resid, gamma, beta, eps, kind, block_r, interpret):
+    y, r, mu, rs, br = _fwd(
+        x, resid, gamma, beta, eps=eps, kind=kind, block_r=block_r,
+        interpret=interpret, needs_stats=True,
+    )
+    r_full = r if resid is not None else x
+    residuals = (r_full, gamma, mu, rs, br, beta is not None, resid is not None)
+    return ((y, r_full), residuals)
+
+
+def _fused_bwd(eps, kind, block_r, interpret, residuals, cotangents):
+    dy, dr_out = cotangents
+    r_full, gamma, mu, rs, br, has_beta, has_resid = residuals
+    shape = r_full.shape
+    m = shape[-1]
+    rows = r_full.size // m
+    out = _bwd(
+        dy.reshape(rows, m), r_full.reshape(rows, m), gamma, mu, rs,
+        kind=kind, br=br, has_beta=has_beta, interpret=interpret, m=m,
+    )
+    dx = out[0].reshape(shape)
+    dgamma = jnp.sum(out[1], axis=0).astype(gamma.dtype).reshape(gamma.shape)
+    dbeta = (
+        jnp.sum(out[2], axis=0).astype(gamma.dtype).reshape(gamma.shape)
+        if has_beta else None
+    )
+    # The second output (the residual stream) passes straight through the
+    # sum, so its cotangent adds to BOTH inputs of the add.
+    dx_total = dx + dr_out
+    if has_resid:
+        return (dx_total, dx_total, dgamma, dbeta)
+    return (dx_total, None, dgamma, dbeta)
+
+
+_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_residual_norm(
+    x: jax.Array,
+    resid: jax.Array | None,
+    gamma: jax.Array,
+    beta: jax.Array | None = None,
+    *,
+    eps: float = 1e-6,
+    kind: str = "layernorm",
+    block_r: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """``(norm(x + resid) * gamma [+ beta], x + resid)`` in one HBM pass.
+
+    Args:
+        x: ``(..., M)`` sublayer output (any float dtype; fp32 math inside).
+        resid: the incoming residual stream, same shape — or ``None`` for a
+            plain (unfused) norm, in which case the second return is ``x``.
+        gamma: ``(M,)`` scale. beta: ``(M,)`` shift (layernorm only; None
+            for scale-only layernorm or rmsnorm).
+        kind: ``"layernorm"`` | ``"rmsnorm"``.
+        block_r: rows per kernel tile (None auto-selects ≤256 dividing R).
+        interpret: run the Pallas interpreter; None = auto (True off-TPU).
+
+    Returns:
+        ``(normed, new_resid)`` — feed ``normed`` to the next sublayer and
+        carry ``new_resid`` as the stream. Differentiable (custom VJP; the
+        backward is one fused pass emitting dx and per-tile dgamma/dbeta
+        partials).
+    """
+    if kind not in ("layernorm", "rmsnorm"):
+        raise ValueError(f"unknown kind {kind!r}")
+    if kind == "rmsnorm" and beta is not None:
+        raise ValueError("rmsnorm has no beta")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused(x, resid, gamma, beta, eps, kind, block_r, interpret)

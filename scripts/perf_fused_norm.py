@@ -1,0 +1,163 @@
+"""Round-4 train-MFU levers, measured in ONE process (drift rules).
+
+PERF.md round 3 fixed the honest 125M fp32-AdamW figure at ~66.5 ms
+(49.8% MFU) and named the remaining path: "kernel work on the step itself
+(fused LN/residual, a faster flash backward)". This script measures both
+levers against an in-process anchor:
+
+1. anchor — the bench configuration exactly (flash + fused CE, fp32
+   AdamW, K-step scan);
+2. + fused_norm — block boundaries through the Pallas fused
+   residual+norm kernel (ops/fused_norm.py);
+3. flash backward tile ladder — fwd+bwd grad time per (bwd_block_q,
+   bwd_block_k) at the bench shape, fwd-only time for reference;
+4. composed best — fused_norm + the ladder's best backward tiles.
+
+Also prints a standalone kernel microbench (fused vs XLA layernorm,
+fwd and grad) to separate kernel quality from step-level visibility.
+
+Run from /root/repo:  python - < scripts/perf_fused_norm.py
+"""
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+    fused_next_token_loss,
+)
+from learning_jax_sharding_tpu.ops.flash_attention import (
+    flash_attention,
+    make_flash_attn_fn,
+)
+from learning_jax_sharding_tpu.ops.fused_norm import fused_residual_norm
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+from learning_jax_sharding_tpu.utils.bench import measure, time_fn
+
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+rng = np.random.default_rng(0)
+B, S, K = 8, 1024, 8
+
+
+def timed_step(cfg, label):
+    tokens = rng.integers(0, cfg.vocab_size, size=(B, S + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), optax.adamw(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    stacked = {
+        k: put(
+            np.stack([np.asarray(v)] * K),
+            mesh_sharding(mesh, None, "data", None),
+        )
+        for k, v in batch.items()
+    }
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=fused_next_token_loss, loss_needs_params=True,
+        apply_kwargs={"return_hidden": True}, donate_state=False,
+        steps_per_call=K,
+    )
+    result = measure(
+        step, state, stacked, flops=cfg.train_step_flops(B, S) * K,
+        n_devices=1, min_time=4.0, repeats=5,
+    )
+    per = result.seconds_per_iter / K
+    print(
+        f"[fused_norm] {label}: {per * 1e3:.1f} ms/step, MFU={result.mfu:.1%}",
+        flush=True,
+    )
+    return per
+
+
+# ---- 1+2. step-level A/B: anchor vs fused_norm ----
+base = dataclasses.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
+t_anchor = timed_step(base, "anchor (r3 config, fp32 AdamW)")
+t_fused = timed_step(
+    dataclasses.replace(base, fused_norm=True), "+ fused residual+norm"
+)
+
+# ---- 3. flash backward tile ladder (kernel-level, same process) ----
+N, H = 12, 64
+q = jnp.asarray(rng.standard_normal((B, S, N, H)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B, S, N, H)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B, S, N, H)), jnp.bfloat16)
+fwd = jax.jit(functools.partial(flash_attention, causal=True))
+t_fwd = time_fn(fwd, q, k, v, min_time=1.5)
+print(f"[fused_norm] flash fwd only: {t_fwd * 1e3:.2f} ms", flush=True)
+best = (None, None, float("inf"))
+for bq, bk in [
+    (None, None), (512, 512), (256, 256), (512, 1024), (1024, 512),
+    (256, 1024), (128, 128),
+]:
+    g = jax.jit(
+        jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(
+                    q, k, v, causal=True, bwd_block_q=bq, bwd_block_k=bk
+                ).astype(jnp.float32)
+            ),
+            argnums=(0, 1, 2),
+        )
+    )
+    t = time_fn(g, q, k, v, min_time=1.5)
+    tag = f"bwd tiles ({bq or 'fwd'}, {bk or 'fwd'})"
+    print(f"[fused_norm] flash fwd+bwd {tag}: {t * 1e3:.2f} ms", flush=True)
+    if t < best[2]:
+        best = (bq, bk, t)
+print(
+    f"[fused_norm] best bwd tiles: ({best[0]}, {best[1]}) at "
+    f"{best[2] * 1e3:.2f} ms", flush=True,
+)
+
+# ---- 4. composed best ----
+if best[0] is not None:
+    composed = dataclasses.replace(
+        base,
+        fused_norm=t_fused < t_anchor,
+        attn_fn=make_flash_attn_fn(bwd_block_q=best[0], bwd_block_k=best[1]),
+    )
+    timed_step(composed, "composed best (fused_norm if it won + bwd tiles)")
+
+# ---- 5. standalone kernel microbench ----
+R, M = B * S, 768
+x = jnp.asarray(rng.standard_normal((R, M)), jnp.bfloat16)
+res = jnp.asarray(rng.standard_normal((R, M)), jnp.bfloat16)
+g_ = jnp.ones((M,), jnp.float32)
+b_ = jnp.zeros((M,), jnp.float32)
+
+
+def ref_ln(x, res, g, b, eps=1e-6):
+    r = (x + res).astype(jnp.float32)
+    mu = jnp.mean(r, -1, keepdims=True)
+    var = jnp.mean((r - mu) ** 2, -1, keepdims=True)
+    return ((r - mu) * jax.lax.rsqrt(var + eps) * g + b).astype(x.dtype), r.astype(x.dtype)
+
+
+for name, fn in (
+    ("pallas", lambda x, res: fused_residual_norm(x, res, g_, b_)),
+    ("xla", lambda x, res: ref_ln(x, res, g_, b_)),
+):
+    f = jax.jit(lambda x, res: fn(x, res)[0].astype(jnp.float32).sum())
+    t = time_fn(f, x, res, min_time=1.0)
+    gr = jax.jit(jax.grad(
+        lambda x, res: fn(x, res)[0].astype(jnp.float32).sum(), argnums=(0, 1)
+    ))
+    tg = time_fn(gr, x, res, min_time=1.0)
+    print(
+        f"[fused_norm] kernel {name}: fwd+sum {t * 1e6:.0f} us, "
+        f"grad {tg * 1e6:.0f} us ({R}x{M})", flush=True,
+    )
