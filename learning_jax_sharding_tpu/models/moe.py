@@ -67,6 +67,19 @@ class MoEFeedForward(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
+    dispatch: str = "einsum"
+    # Token routing implementation — identical math, different cost model:
+    # "einsum" builds (T, E, C) one-hot dispatch/combine tensors whose
+    #   contractions cost O(E·C·M·T) MXU FLOPs (≈40% of MoE step time at
+    #   E=8 top-2, PERF.md round 3) but shard cleanly under EXPERT→model
+    #   rules (GSPMD lowers them to the expert all-to-all) — the
+    #   multi-device EP path;
+    # "scatter" computes each (token, rank)'s slot index directly from the
+    #   shared cumsum (expert·C + position-in-expert) and moves rows by
+    #   .at[].set scatter / gather — O(k·T·M) bytes, no routing FLOPs.
+    #   Slot assignment is bit-identical to the einsum path (same cumsum,
+    #   same GShard rank-major priority). Single-device oriented:
+    #   data-dependent gathers don't partition over EXPERT.
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -117,13 +130,31 @@ class MoEFeedForward(nn.Module):
         else:
             gate_vals = gate_vals * jnp.sum(masks * fits, axis=-1)
 
-        slot = jax.nn.one_hot(
-            jnp.sum(pos * masks.astype(jnp.int32), axis=-1), capacity,
-            dtype=jnp.float32,
-        )                                                           # (T, k, C)
-        # (T,k,E) × (T,k,C) → (T,E,C): one-hot routing tensors.
-        dispatch = jnp.einsum("tke,tkc->tec", fits, slot)
-        combine = jnp.einsum("tke,tkc,tk->tec", fits, slot, gate_vals)
+        if self.dispatch == "einsum":
+            slot = jax.nn.one_hot(
+                jnp.sum(pos * masks.astype(jnp.int32), axis=-1), capacity,
+                dtype=jnp.float32,
+            )                                                       # (T, k, C)
+            # (T,k,E) × (T,k,C) → (T,E,C): one-hot routing tensors.
+            dispatch = jnp.einsum("tke,tkc->tec", fits, slot)
+            combine = jnp.einsum("tke,tkc,tk->tec", fits, slot, gate_vals)
+        elif self.dispatch == "scatter":
+            # Same priority/capacity assignment, but tokens MOVE by
+            # scatter/gather instead of (T,E,C) contractions: each
+            # accepted (token, rank) owns slot expert·C + position
+            # (unique — ranks pick distinct experts); dropped entries
+            # target a dump slot past the pool. The expensive part of the
+            # einsum path was never the int cumsum above — it is the
+            # O(E·C·M·T) dispatch/combine MXU work this branch deletes.
+            slot_pos = jnp.sum(pos * masks.astype(jnp.int32), axis=-1)  # (T,k)
+            kept = jnp.sum(masks * fits, axis=-1) > 0                    # (T,k)
+            flat_slot = jnp.where(
+                kept, gate_idx * capacity + slot_pos, e * capacity
+            ).reshape(-1)                                                # (T·k,)
+        else:
+            raise ValueError(
+                f"unknown dispatch {self.dispatch!r}: 'einsum' or 'scatter'"
+            )
 
         # --- Load-balancing aux loss (Switch eq. 4, on rank-0 choices) -----
         load = jnp.mean(masks[:, 0], axis=0)                        # (E,)
@@ -138,7 +169,15 @@ class MoEFeedForward(nn.Module):
 
         # --- Expert computation --------------------------------------------
         xf = x.reshape(t, m)
-        expert_in = jnp.einsum("tec,tm->ecm", dispatch.astype(self.dtype), xf.astype(self.dtype))
+        if self.dispatch == "scatter":
+            token_of = jnp.repeat(jnp.arange(t), self.top_k)         # (T·k,)
+            pool = jnp.zeros((e * capacity + 1, m), self.dtype)
+            pool = pool.at[flat_slot].set(xf.astype(self.dtype)[token_of])
+            expert_in = pool[:-1].reshape(e, capacity, m)
+        else:
+            expert_in = jnp.einsum(
+                "tec,tm->ecm", dispatch.astype(self.dtype), xf.astype(self.dtype)
+            )
         expert_in = nn.with_logical_constraint(expert_in, (EXPERT, None, EMBED))
 
         w_up = self.param(
@@ -159,6 +198,24 @@ class MoEFeedForward(nn.Module):
         expert_out = jnp.einsum("ech,ehm->ecm", h, w_down.astype(self.dtype))
         expert_out = nn.with_logical_constraint(expert_out, (EXPERT, None, EMBED))
 
-        out = jnp.einsum("tec,ecm->tm", combine.astype(self.dtype), expert_out)
+        if self.dispatch == "scatter":
+            # Each (token, rank) gathers its slot's output (dump slot reads
+            # zero) and the gate weights fold in one tiny contraction —
+            # gate_vals already carries the kept mask and normalization,
+            # exactly as the combine einsum's gating.
+            eflat = jnp.concatenate(
+                [
+                    expert_out.reshape(e * capacity, m),
+                    jnp.zeros((1, m), expert_out.dtype),
+                ]
+            )
+            per_rank = eflat[flat_slot].reshape(t, self.top_k, m)
+            out = jnp.einsum(
+                "tkm,tk->tm", per_rank, gate_vals.astype(self.dtype)
+            )
+        else:
+            out = jnp.einsum(
+                "tec,ecm->tm", combine.astype(self.dtype), expert_out
+            )
         out = out.reshape(b, s, m)
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))

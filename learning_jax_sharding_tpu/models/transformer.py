@@ -243,6 +243,8 @@ class TransformerBlock(nn.Module):
     num_experts: int = 0          # >0 swaps the dense FF for a routed MoE FF
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "einsum"  # "einsum" (EP-shardable) | "scatter"
+                                  # (scatter/gather, single-device; moe.py)
     decode: bool = False          # KV-cached autoregressive attention
     max_decode_len: int = 0
     kv_cache_dtype: Optional[Any] = None  # decode-cache storage: None =
@@ -324,6 +326,7 @@ class TransformerBlock(nn.Module):
                 num_experts=self.num_experts,
                 top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
+                dispatch=self.moe_dispatch,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="moe",
@@ -382,6 +385,10 @@ class TransformerConfig:
     num_experts: int = 0             # >0: MoE FF in every block (EP over mesh)
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_dispatch: str = "einsum"     # routing implementation (models/moe.py):
+                                     # "einsum" shards under EP rules;
+                                     # "scatter" deletes the O(E*C*M*T) routing
+                                     # FLOPs via scatter/gather (1-device)
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     fused_norm: bool = False         # block boundaries (residual add + norm)
                                      # through the Pallas fused kernel
@@ -636,6 +643,7 @@ class Transformer(nn.Module):
             num_experts=cfg.num_experts,
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
+            moe_dispatch=cfg.moe_dispatch,
             decode=cfg.decode,
             max_decode_len=cfg.max_seq_len if cfg.decode else 0,
             kv_cache_dtype=cfg.kv_cache_dtype,

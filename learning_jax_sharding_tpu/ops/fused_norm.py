@@ -32,20 +32,24 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _pick_block_r(rows: int, cap: int = 256, whole_cap: int = 4096) -> int:
+def _pick_block_r(rows: int, m: int, tile_bytes: int = 2 << 20) -> int:
+    """Rows per tile: the largest power of two dividing ``rows`` whose fp32
+    tile stays under ``tile_bytes`` (the kernels hold ~4-6 such buffers
+    live, so 2 MB/tile keeps well inside the ~16 MB VMEM at any M)."""
+    cap = max(8, tile_bytes // (m * 4))
     blk = 1
     while blk < cap and rows % (blk * 2) == 0:
         blk *= 2
     if blk >= 8:
         return blk
-    # No usable power-of-two factor: one whole-array tile, but only while
-    # it fits VMEM comfortably (mirrors flash_attention._auto_block's
-    # guard — a silent multi-MB tile would fail Mosaic lowering instead).
-    if rows <= whole_cap:
+    # No usable power-of-two factor: one whole-array tile, only while it
+    # fits the same byte budget — otherwise fail loudly instead of a
+    # Mosaic lowering error.
+    if rows <= cap:
         return rows
     raise ValueError(
-        f"row count {rows} has no power-of-two factor >= 8 and is too "
-        f"large for a single tile; pad the batch*seq rows or pass a "
+        f"row count {rows} (features {m}) has no power-of-two factor >= 8 "
+        f"and one whole tile would exceed VMEM; pad batch*seq or pass a "
         f"dividing block_r"
     )
 
@@ -108,7 +112,7 @@ def _fwd(x, resid, gamma, beta, *, eps, kind, block_r, interpret, needs_stats):
     x2 = x.reshape(rows, m)
     has_resid = resid is not None
     has_beta = beta is not None
-    br = _pick_block_r(rows) if block_r is None else block_r
+    br = _pick_block_r(rows, m) if block_r is None else block_r
     if rows % br:
         raise ValueError(
             f"rows ({rows} = batch*seq) must be divisible by block_r ({br})"

@@ -1,0 +1,108 @@
+"""MoE routing-cost treatment (round-4): the levers, measured in ONE process.
+
+Round 3 measured the disease — 26.6% activated-MFU vs the dense control's
+46%, a 1.73× routing cost attributed to the (T, E, C) one-hot
+dispatch/combine einsums and padded capacity slots — and named the levers
+without trying them. This script runs the ladder:
+
+1. anchor — E=8 top-2 cap 1.25, einsum dispatch (round-3 configuration);
+2. sort dispatch — same routing semantics, scatter/gather movement
+   (``moe_dispatch="scatter"``): deletes the O(E·C·M·T) routing FLOPs;
+3. top-1 (Switch) — half the expert compute AND half the routed traffic;
+4. E=4 wider — fewer/larger experts (hidden 2×) at the same activated
+   FLOPs per token;
+5. capacity 1.0 rows for the ≥35% activated-MFU bar;
+6. the dense control (activated-width FF) re-measured in-process.
+
+All rows: b=4 s=1024, sgd, remat, flash + fused CE, K=2 scan — identical
+to the round-3 harness so deltas compose with PERF.md's table.
+
+Run from /root/repo:  python - < scripts/perf_moe2.py
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+    fused_next_token_loss,
+)
+from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.pipeline import (
+    make_train_step,
+    sharded_train_state,
+)
+from learning_jax_sharding_tpu.utils.bench import measure
+
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+b, s = 4, 1024
+rng = np.random.default_rng(0)
+
+
+def step_time(cfg, K=2):
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), optax.sgd(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    stacked = {
+        k: put(
+            np.stack([np.asarray(v)] * K),
+            mesh_sharding(mesh, None, "data", None),
+        )
+        for k, v in batch.items()
+    }
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=fused_next_token_loss, loss_needs_params=True,
+        apply_kwargs={"return_hidden": True}, donate_state=False,
+        steps_per_call=K,
+    )
+    r = measure(
+        step, state, stacked, flops=cfg.train_step_flops(b, s) * K,
+        n_devices=1, min_time=2.0,
+    )
+    return r.seconds_per_iter / K, r.mfu
+
+
+base = dataclasses.replace(
+    CONFIG_125M, attn_fn=make_flash_attn_fn(), remat=True
+)
+
+ROWS = [
+    ("anchor E=8 top-2 cap1.25 einsum", dict(num_experts=8)),
+    ("sort   E=8 top-2 cap1.25", dict(num_experts=8, moe_dispatch="scatter")),
+    ("sort   E=8 top-2 cap1.0", dict(
+        num_experts=8, moe_dispatch="scatter", moe_capacity_factor=1.0)),
+    ("einsum E=8 top-2 cap1.0", dict(
+        num_experts=8, moe_capacity_factor=1.0)),
+    ("sort   E=8 top-1 cap1.25", dict(
+        num_experts=8, moe_top_k=1, moe_dispatch="scatter")),
+    ("einsum E=8 top-1 cap1.25", dict(num_experts=8, moe_top_k=1)),
+    ("sort   E=4 wide(2xH) top-2 cap1.25", dict(
+        num_experts=4, hidden=2 * CONFIG_125M.hidden, moe_dispatch="scatter")),
+    ("einsum E=4 wide(2xH) top-2 cap1.25", dict(
+        num_experts=4, hidden=2 * CONFIG_125M.hidden)),
+]
+for label, kw in ROWS:
+    cfg = dataclasses.replace(base, **kw)
+    per, mfu = step_time(cfg)
+    print(
+        f"[moe2] {label}: {per * 1e3:.1f} ms/step, activated-MFU={mfu:.1%}",
+        flush=True,
+    )
+
+# Dense control: FF at the activated width (2x hidden ~ top-2's per-token
+# expert FLOPs, no routing) — the routing-cost denominator.
+dense = dataclasses.replace(base, hidden=2 * CONFIG_125M.hidden)
+per, mfu = step_time(dense)
+print(f"[moe2] dense control (2xH FF): {per * 1e3:.1f} ms/step, MFU={mfu:.1%}",
+      flush=True)

@@ -8,6 +8,7 @@ the MoE transformer trains end-to-end with the sown load-balancing loss.
 
 import dataclasses
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -182,3 +183,80 @@ class TestMoEDecode:
         )
         assert out.shape == (4, 14)
         assert np.asarray(out[:, :8] == np.asarray(prompt)).all()
+
+
+class TestSortDispatch:
+    """dispatch="scatter": identical routing semantics to the einsum path
+    (same priority, capacity, drops, gating) with scatter/gather movement
+    instead of (T,E,C) contractions — outputs and gradients must match to
+    fp32 reduction tolerance under the SAME params."""
+
+    def _moe(self, dispatch, **kw):
+        from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+
+        return MoEFeedForward(
+            features=32, hidden=64, num_experts=4, dtype=jnp.float32,
+            dispatch=dispatch, **kw,
+        )
+
+    @pytest.mark.parametrize("top_k,cap", [(1, 1.0), (2, 1.25), (2, 0.5)])
+    def test_matches_einsum_path(self, top_k, cap):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+        ein = self._moe("einsum", top_k=top_k, capacity_factor=cap)
+        srt = self._moe("scatter", top_k=top_k, capacity_factor=cap)
+        params = ein.init({"params": jax.random.key(0)}, x)["params"]
+
+        def run(mod, p):
+            out, aux = mod.apply(
+                {"params": p}, x, mutable=("losses",)
+            )
+            return out, aux["losses"]["load_balancing"]
+
+        oe, le = run(ein, params)
+        os_, ls = run(srt, params)
+        np.testing.assert_allclose(
+            np.asarray(os_), np.asarray(oe), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(float(ls), float(le), rtol=1e-6)
+
+        ge = jax.grad(lambda p: jnp.sum(jnp.sin(run(ein, p)[0])))(params)
+        gs = jax.grad(lambda p: jnp.sum(jnp.sin(run(srt, p)[0])))(params)
+        for (kp, a), (_, e) in zip(
+            jax.tree_util.tree_leaves_with_path(gs),
+            jax.tree_util.tree_leaves_with_path(ge),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4,
+                err_msg=str(kp),
+            )
+
+    def test_unknown_dispatch_rejected(self):
+        x = jnp.zeros((1, 4, 32))
+        bad = self._moe("scatter")
+        with pytest.raises(ValueError, match="dispatch"):
+            bad.init({"params": jax.random.key(0)}, x)
+
+    def test_config_plumbing(self):
+        import dataclasses as dc
+
+        from learning_jax_sharding_tpu.models.transformer import (
+            CONFIG_TINY_MOE,
+            Transformer,
+        )
+
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(
+            0, CONFIG_TINY_MOE.vocab_size, size=(2, 16)
+        ).astype(np.int32)
+        cfg_e = dc.replace(CONFIG_TINY_MOE, dtype=jnp.float32)
+        cfg_s = dc.replace(cfg_e, moe_dispatch="scatter")
+        me, ms = Transformer(cfg_e), Transformer(cfg_s)
+        params = nn.meta.unbox(
+            me.init({"params": jax.random.key(0)}, tokens)["params"]
+        )
+        oe = me.apply({"params": params}, tokens, mutable=("losses",))[0]
+        os_ = ms.apply({"params": params}, tokens, mutable=("losses",))[0]
+        np.testing.assert_allclose(
+            np.asarray(os_), np.asarray(oe), rtol=2e-5, atol=2e-5
+        )
