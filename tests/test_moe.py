@@ -233,7 +233,7 @@ class TestSortDispatch:
 
     def test_unknown_dispatch_rejected(self):
         x = jnp.zeros((1, 4, 32))
-        bad = self._moe("scatter")
+        bad = self._moe("gather-scatter")
         with pytest.raises(ValueError, match="dispatch"):
             bad.init({"params": jax.random.key(0)}, x)
 
