@@ -7,8 +7,8 @@ and runs the norm as separate reduce + normalize passes over HBM; this
 kernel does the whole boundary in ONE pass per tile: read ``x`` and
 ``resid`` once, form the sum in VMEM, reduce mean/rstd, scale, and write
 both the normalized output and the new residual stream. The backward is a
-second single-pass kernel emitting ``dx`` plus per-tile ``dgamma`` /
-``dbeta`` partials (summed outside — a tiny (tiles, M) reduction).
+second single-pass kernel emitting ``dx`` plus ``dgamma`` / ``dbeta``
+accumulated across the (sequential on TPU) grid into one (1, M) block.
 
 PERF.md round 3 named "fused LN/residual" as the remaining honest train-
 MFU lever past 49.8% at 125M (`/root/reference` has no training loop at
@@ -90,11 +90,19 @@ def _bwd_kernel(do_ref, r_ref, g_ref, mu_ref, rs_ref,
         xhat = (x - mu_ref[...]) * rstd
     else:
         xhat = x * rstd
-    # Parameter grads: per-TILE partial sums over the rows (summed by the
-    # caller — (tiles, M) is tiny next to the activations).
-    dg_ref[...] = jnp.sum(do * xhat, axis=0, keepdims=True)
+    # Parameter grads: accumulated across the (sequential on TPU) grid into
+    # one (1, M) block — a (tiles, M) partials array with (1, M) blocks
+    # would violate Mosaic's second-minor-divisible-by-8 rule for any
+    # tiles > 1.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        if db_ref is not None:
+            db_ref[...] = jnp.zeros_like(db_ref)
+
+    dg_ref[...] += jnp.sum(do * xhat, axis=0, keepdims=True)
     if db_ref is not None:
-        db_ref[...] = jnp.sum(do, axis=0, keepdims=True)
+        db_ref[...] += jnp.sum(do, axis=0, keepdims=True)
     dxhat = do * g
     c2 = jnp.mean(dxhat * xhat, axis=-1, keepdims=True)
     if kind == "layernorm":
@@ -183,8 +191,7 @@ def _bwd(dy, r2, gamma, mu, rs, *, kind, br, has_beta, interpret, m):
     rows = r2.shape[0]
     grid = (rows // br,)
     row_spec = pl.BlockSpec((br, m), lambda i: (i, 0))
-    par_spec = pl.BlockSpec((1, m), lambda i: (0, 0))
-    part_spec = pl.BlockSpec((1, m), lambda i: (i, 0))
+    par_spec = pl.BlockSpec((1, m), lambda i: (0, 0))    # params + accumulators
     stat_spec = pl.BlockSpec((br, 1), lambda i: (i, 0))
 
     in_specs = [row_spec, row_spec, par_spec]
@@ -195,15 +202,14 @@ def _bwd(dy, r2, gamma, mu, rs, *, kind, br, has_beta, interpret, m):
     in_specs.append(stat_spec)
     operands.append(rs)
 
-    ntiles = grid[0]
-    out_specs = [row_spec, part_spec]
+    out_specs = [row_spec, par_spec]
     out_shapes = [
         jax.ShapeDtypeStruct((rows, m), dy.dtype),
-        jax.ShapeDtypeStruct((ntiles, m), jnp.float32),
+        jax.ShapeDtypeStruct((1, m), jnp.float32),
     ]
     if has_beta:
-        out_specs.append(part_spec)
-        out_shapes.append(jax.ShapeDtypeStruct((ntiles, m), jnp.float32))
+        out_specs.append(par_spec)
+        out_shapes.append(jax.ShapeDtypeStruct((1, m), jnp.float32))
 
     def kernel(*refs):
         refs = list(refs)
@@ -263,9 +269,10 @@ def _fused_bwd(eps, kind, block_r, interpret, residuals, cotangents):
         kind=kind, br=br, has_beta=has_beta, interpret=interpret, m=m,
     )
     dx = out[0].reshape(shape)
-    dgamma = jnp.sum(out[1], axis=0).astype(gamma.dtype).reshape(gamma.shape)
+    # The kernel already accumulated across tiles — (1, M) holds the total.
+    dgamma = out[1].astype(gamma.dtype).reshape(gamma.shape)
     dbeta = (
-        jnp.sum(out[2], axis=0).astype(gamma.dtype).reshape(gamma.shape)
+        out[2].astype(gamma.dtype).reshape(gamma.shape)
         if has_beta else None
     )
     # The second output (the residual stream) passes straight through the
@@ -305,8 +312,8 @@ def fused_residual_norm(
     Returns:
         ``(normed, new_resid)`` — feed ``normed`` to the next sublayer and
         carry ``new_resid`` as the stream. Differentiable (custom VJP; the
-        backward is one fused pass emitting dx and per-tile dgamma/dbeta
-        partials).
+        backward is one fused pass emitting dx, with dgamma/dbeta
+        accumulated in-kernel across the sequential grid).
     """
     if kind not in ("layernorm", "rmsnorm"):
         raise ValueError(f"unknown kind {kind!r}")
