@@ -176,16 +176,27 @@ def bench_transformer_125m():
     return result
 
 
-def bench_decode_125m():
-    """Serving context: KV-cached greedy decode throughput on the 125M model."""
+def _decode_ladder(cfg, label, *, b, prompt_len, new, rounds=3):
+    """bf16 / int8 / int4-fused greedy decode, measured INTERLEAVED.
+
+    Round 3's sequential ladder let the tunnel's ±30% drift reorder the
+    125M variants between runs (VERDICT r3 item 1): each variant sampled a
+    different drift window. Here every round times all three variants
+    back-to-back and the per-variant MEDIAN across rounds is reported, so
+    the ordering is a within-window comparison whichever way the tunnel
+    drifts.
+    """
     import flax.linen as nn
 
     from learning_jax_sharding_tpu.models.generate import make_generate_fn
-    from learning_jax_sharding_tpu.utils.bench import time_fn
+    from learning_jax_sharding_tpu.models.quantize import (
+        map_unquantized,
+        quantize_tree,
+        quantized_bytes,
+    )
+    from learning_jax_sharding_tpu.utils.bench import mbu, time_fn
 
     mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
-    cfg = CONFIG_125M
-    b, prompt_len, new = 8, 128, 128
     model = Transformer(cfg)
     rng = np.random.default_rng(0)
     prompt = put(
@@ -193,32 +204,10 @@ def bench_decode_125m():
         mesh_sharding(mesh, "data", None),
     )
     params = nn.meta.unbox(
-        jax.jit(lambda r, t: model.init({"params": r}, t))(jax.random.key(0), prompt)[
-            "params"
-        ]
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), prompt
+        )["params"]
     )
-    gen = make_generate_fn(
-        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
-        inference_dtype=jnp.bfloat16,
-    )
-    secs = time_fn(gen, params, prompt, jax.random.key(1), min_time=2.0)
-    toks = b * new
-
-    def decode_mbu(weight_bytes: float, secs_per_tok: float) -> str:
-        """Per-token-step HBM roofline: served weights + the VALID KV cache
-        (mean over the run: prompt + new/2 slots — the blocked decode kernel
-        reads only valid blocks, which is the whole point; the dense path
-        would read all max_seq_len slots). Reported as MBU because decode is
-        bandwidth-bound — its matmuls are too thin for MFU to mean anything."""
-        from learning_jax_sharding_tpu.utils.bench import mbu
-
-        n_kv = cfg.num_kv_heads or cfg.num_heads
-        avg_valid = prompt_len + new / 2
-        cache_bytes = (
-            cfg.num_layers * b * n_kv * avg_valid * cfg.head_dim * 2 * 2
-        )  # K+V, bf16
-        frac = mbu(weight_bytes + cache_bytes, secs_per_tok)
-        return "" if frac is None else f", MBU={frac:.1%}"
 
     def to_bf16(x):
         return (
@@ -226,59 +215,98 @@ def bench_decode_125m():
             if jnp.issubdtype(x.dtype, jnp.floating) else x
         )
 
-    from learning_jax_sharding_tpu.models.quantize import quantized_bytes
+    def decode_mbu(weight_bytes: float, secs_per_tok: float) -> str:
+        # Per-token-step HBM roofline: served weights + the VALID KV cache
+        # (mean over the run: prompt + new/2 slots — the blocked decode
+        # kernel reads only valid blocks). MBU because decode is
+        # bandwidth-bound; its matmuls are too thin for MFU to mean much.
+        n_kv = cfg.num_kv_heads or cfg.num_heads
+        cache_bytes = (
+            cfg.num_layers * b * n_kv * (prompt_len + new / 2)
+            * cfg.head_dim * 2 * 2
+        )  # K+V, bf16
+        frac = mbu(weight_bytes + cache_bytes, secs_per_tok)
+        return "" if frac is None else f", MBU={frac:.1%}"
 
-    bf16_bytes = quantized_bytes(jax.tree.map(to_bf16, params))
+    def make(deq):
+        return make_generate_fn(
+            cfg, mesh, RULES_DP_TP, max_new_tokens=new,
+            inference_dtype=jnp.bfloat16, dequantize=deq,
+        )
+
+    variants = [
+        ("bf16", jax.tree.map(to_bf16, params), make(False)),
+        ("int8", quantize_tree(params), make(True)),
+        ("int4-fused", quantize_tree(params, bits=4), make("fused")),
+    ]
+    del params
+    times = {name: [] for name, _, _ in variants}
+    # time_fn's own warmup (1 untimed call) covers compile on the first
+    # round; keeping it minimal holds the variants' timed samples as close
+    # together as the tunnel allows, which is the point of interleaving.
+    for _ in range(rounds):
+        for name, tree, gen in variants:
+            times[name].append(
+                time_fn(gen, tree, prompt, jax.random.key(1),
+                        min_time=1.0, repeats=1, warmup=1)
+            )
+    order = sorted(times, key=lambda n: float(np.median(times[n])))
+    for name, tree, gen in variants:
+        served = quantized_bytes(map_unquantized(to_bf16, tree))
+        secs = float(np.median(times[name]))
+        _log(
+            f"[bench] {label} decode, {name} (b={b}, prompt {prompt_len}, "
+            f"+{new} new): {b * new / secs:,.0f} tok/s, "
+            f"{secs / new * 1e3:.2f} ms/token-step, served "
+            f"{served / 1e6:,.0f} MB" + decode_mbu(served, secs / new)
+        )
     _log(
-        f"[bench] 125M KV-cached decode, bf16 weights (b={b}, prompt "
-        f"{prompt_len}, +{new} new): {toks / secs:,.0f} tok/s, "
-        f"{secs / new * 1e3:.2f} ms/token-step"
-        + decode_mbu(bf16_bytes, secs / new)
+        f"[bench] {label} decode ladder ordering (interleaved medians, "
+        f"fastest first): {' > '.join(order)}"
     )
 
-    # int8 weight-only variant: same harness, quantized tree + in-jit dequant.
-    from learning_jax_sharding_tpu.models.quantize import (
-        quantize_tree,
-        quantized_bytes,
-    )
 
-    qparams = quantize_tree(params)
-    gen_q = make_generate_fn(
-        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
-        inference_dtype=jnp.bfloat16, dequantize=True,
-    )
-    secs_q = time_fn(gen_q, qparams, prompt, jax.random.key(1), min_time=2.0)
-    # Apples-to-apples SERVED bytes: the bf16 baseline serves bf16-cast
-    # weights, and the int8 path also casts its non-quantized leaves
-    # (embeddings/norms) to bf16 via maybe_cast — mirror both casts here.
-    from learning_jax_sharding_tpu.models.quantize import map_unquantized
+def bench_decode_125m():
+    """Serving context: KV-cached greedy decode ladder on the 125M model."""
+    _decode_ladder(CONFIG_125M, "125M", b=8, prompt_len=128, new=128)
 
-    int8_bytes = quantized_bytes(map_unquantized(to_bf16, qparams))
-    _log(
-        f"[bench] 125M KV-cached decode, int8 weights (same shape): "
-        f"{toks / secs_q:,.0f} tok/s, {secs_q / new * 1e3:.2f} ms/token-step, "
-        f"served weight bytes {bf16_bytes / 1e6:.0f} (bf16)→"
-        f"{int8_bytes / 1e6:.0f} MB"
-        + decode_mbu(int8_bytes, secs_q / new)
-    )
 
-    # int4 variant: nibble-packed, group-wise scales, served through the
-    # FUSED dequant-matmul kernel (ops/int4_matmul.py) — the footprint point
-    # of the quantization ladder (quarter of bf16); PERF.md records the
-    # measured VPU-unpack floor vs int8.
-    q4params = quantize_tree(params, bits=4)
-    gen_q4 = make_generate_fn(
-        cfg, mesh, RULES_DP_TP, max_new_tokens=new,
-        inference_dtype=jnp.bfloat16, dequantize="fused",
+def bench_decode_1p4b():
+    """The weight-BANDWIDTH-bound ladder shape (24×2048, 16 heads×128):
+    decode streams 0.9-2.8 GB of weights per token, so the quantization
+    ladder separates on served bytes instead of launch overhead — the
+    shape where PERF.md claims int4-fused ≥ int8 ("whole-FF kernel"
+    section), now in the driver artifact (VERDICT r3 item 2)."""
+    from learning_jax_sharding_tpu.models.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=24, features=2048, num_heads=16, head_dim=128,
+        hidden=8192, max_seq_len=256,
     )
-    secs_q4 = time_fn(gen_q4, q4params, prompt, jax.random.key(1), min_time=2.0)
-    int4_bytes = quantized_bytes(map_unquantized(to_bf16, q4params))
-    _log(
-        f"[bench] 125M KV-cached decode, int4 weights (fused kernel): "
-        f"{toks / secs_q4:,.0f} tok/s, {secs_q4 / new * 1e3:.2f} ms/token-step, "
-        f"served weight bytes {int4_bytes / 1e6:.0f} MB"
-        + decode_mbu(int4_bytes, secs_q4 / new)
+    _decode_ladder(cfg, "1.4B", b=8, prompt_len=64, new=64)
+
+
+def bench_longcontext():
+    """Long-context train line (SURVEY §5): S=8192, head_dim 128 — the
+    configuration of record from PERF.md's round-3 VPU:MXU verification
+    (hd=64 is VPU-floored at ~24% of peak on the v5e; doubling the
+    contraction dim doubles kernel throughput)."""
+    import dataclasses
+
+    from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+
+    cfg = dataclasses.replace(
+        CONFIG_125M, num_heads=6, head_dim=128, max_seq_len=8192,
+        attn_fn=make_flash_attn_fn(), remat=False,
     )
+    result, per_step, K = _timed_train_step(cfg, b=2, s=8192, K=2)
+    msg = (
+        f"[bench] long-context train step (S=8192, b=2, hd=128, flash "
+        f"causal): {per_step * 1e3:.1f} ms/step"
+    )
+    if result.mfu is not None:
+        msg += f", MFU={result.mfu:.1%} (sustained, {K}-step scan)"
+    _log(msg)
 
 
 def bench_reference_configs():
@@ -350,16 +378,20 @@ def bench_moe_125m():
 
     cfg = dataclasses.replace(
         CONFIG_125M, attn_fn=make_flash_attn_fn(), num_experts=8, moe_top_k=2,
-        remat=True,
+        moe_dispatch="scatter",
     )
-    # sgd + remat + b=4: non-donating timing holds INPUT and OUTPUT states
-    # at once, and 2× the E=8 fp32 AdamW state (~6.8 GB each) exhausts the
-    # 16 GB chip; sgd state is params-only and remat drops the stacked
-    # GShard dispatch tensors (how MoE trains at scale anyway).
+    # sgd + b=4: non-donating timing holds INPUT and OUTPUT states at once,
+    # and 2× the E=8 fp32 AdamW state (~6.8 GB each) exhausts the 16 GB
+    # chip; sgd state is params-only. Round 4: scatter dispatch (routing
+    # bit-identical to the einsum path, no (T,E,C) one-hot contractions)
+    # replaced remat+einsum — without the stacked dispatch tensors the
+    # activations fit un-rematerialized, and the measured ladder
+    # (PERF.md round 4) has scatter+noremat at 67.8 ms vs the round-3
+    # einsum+remat anchor's 97.8 in the same process.
     result, per_step, _ = _timed_train_step(cfg, b=4, K=2, opt=optax.sgd(3e-4))
     msg = (
-        f"[bench] 125M-class MoE (E=8, top-2) train step (b=4, sgd): "
-        f"{per_step * 1e3:.1f} ms/step"
+        f"[bench] 125M-class MoE (E=8, top-2, scatter dispatch) train step "
+        f"(b=4, sgd): {per_step * 1e3:.1f} ms/step"
     )
     if result.mfu is not None:
         msg += f", activated-MFU={result.mfu:.1%}"
@@ -414,9 +446,17 @@ def main():
     except Exception as e:  # context only — never break the headline line
         _log(f"[bench] 125M transformer bench skipped: {type(e).__name__}: {e}")
     try:
+        bench_longcontext()
+    except Exception as e:
+        _log(f"[bench] long-context bench skipped: {type(e).__name__}: {e}")
+    try:
         bench_decode_125m()
     except Exception as e:
         _log(f"[bench] 125M decode bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_decode_1p4b()
+    except Exception as e:
+        _log(f"[bench] 1.4B decode bench skipped: {type(e).__name__}: {e}")
     try:
         bench_moe_125m()
     except Exception as e:
