@@ -1,0 +1,154 @@
+"""Speculative decoding with a TRAINED draft/target pair: the realistic
+midpoint of the round-4 ladder.
+
+`scripts/perf_serving2.py` bracketed the engine's speculative mechanism
+with random-init weights (self-draft ceiling 1.58×, random-draft floor
+0.58×) because a random draft never agrees with a random target. This
+script produces the missing REAL point: train a small BPE LM target and a
+4× smaller draft on the same corpus with the framework's own `fit()`,
+then measure actual acceptance and throughput — generate-level (ragged,
+per-row stats) and engine-level — in one process.
+
+Run from /root/repo:  python - < scripts/perf_spec_trained.py
+"""
+import tempfile
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.data import MemmapTokenDataset, write_token_file
+from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+from learning_jax_sharding_tpu.models.generate import make_generate_fn
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.speculative import (
+    make_speculative_generate_fn,
+)
+from learning_jax_sharding_tpu.models.transformer import TransformerConfig
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+from learning_jax_sharding_tpu.utils.bench import time_fn
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog. "
+    "pack my box with five dozen liquor jugs. "
+    "how vexingly quick daft zebras jump! "
+    "sphinx of black quartz, judge my vow. "
+) * 150
+
+SEQ = 64
+TARGET = TransformerConfig(
+    vocab_size=384, num_layers=4, features=256, num_heads=4, head_dim=64,
+    rope=True, hidden=1024, max_seq_len=SEQ * 8,
+    dtype=np.float32, param_dtype=np.float32,
+)
+DRAFT = TransformerConfig(
+    vocab_size=384, num_layers=1, features=128, num_heads=4, head_dim=32,
+    rope=True, hidden=256, max_seq_len=SEQ * 8,
+    dtype=np.float32, param_dtype=np.float32,
+)
+
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+tok = BPETokenizer.train(CORPUS, vocab_size=TARGET.vocab_size)
+tokens = tok.encode_to_array(CORPUS)
+print(f"[spec-t] corpus {len(tokens)} BPE tokens", flush=True)
+
+with tempfile.TemporaryDirectory() as tmp:
+    path = write_token_file(Path(tmp) / "corpus.bin", tokens)
+    data = MemmapTokenDataset(path, seq_len=SEQ)
+
+    def train(cfg, steps, label):
+        from learning_jax_sharding_tpu.models.transformer import Transformer
+
+        t0 = time.perf_counter()
+        state, hist = fit(
+            Transformer(cfg), data, mesh, RULES_DP_TP,
+            TrainLoopConfig(
+                steps=steps, global_batch_size=16, learning_rate=1e-3,
+                log_every=10**9,
+            ),
+        )
+        print(
+            f"[spec-t] {label}: {steps} steps in "
+            f"{time.perf_counter() - t0:.0f}s, final loss "
+            f"{hist[-1]['loss']:.3f}",
+            flush=True,
+        )
+        return state.params
+
+    t_params = train(TARGET, 400, "target 4L x 256")
+    d_params = train(DRAFT, 300, "draft 1L x 128")
+
+# Skewed prompt batch: corpus snippets at mixed lengths, right-padded.
+rng = np.random.default_rng(0)
+B, NEW, ND = 8, 64, 4
+lens = rng.integers(8, 33, size=B)
+starts = rng.integers(0, len(tokens) - 40, size=B)
+maxlen = int(lens.max())
+prompt = np.zeros((B, maxlen), np.int32)
+for i, (st, ln) in enumerate(zip(starts, lens)):
+    prompt[i, :ln] = tokens[st : st + ln]
+lengths = jnp.asarray(lens, jnp.int32)
+
+spec = make_speculative_generate_fn(
+    TARGET, DRAFT, mesh, RULES_DP_TP, max_new_tokens=NEW, num_draft=ND,
+    inference_dtype=jnp.bfloat16, ragged=True,
+)
+plain = make_generate_fn(
+    TARGET, mesh, RULES_DP_TP, max_new_tokens=NEW,
+    inference_dtype=jnp.bfloat16, ragged=True,
+)
+
+out, stats = spec(t_params, d_params, prompt, lengths=lengths,
+                  return_stats=True)
+acc = np.asarray(stats["accepted"], np.float64)
+rounds = np.asarray(stats["rounds"], np.float64)
+rate = acc / np.maximum(rounds * ND, 1)
+print(
+    f"[spec-t] trained-pair acceptance per row: "
+    f"{np.array2string(rate, precision=2)} (mean {rate.mean():.0%}); "
+    f"tokens/round {np.asarray(stats['emitted']) / np.maximum(rounds, 1)}",
+    flush=True,
+)
+
+t_spec = time_fn(
+    spec, t_params, d_params, prompt, lengths=lengths, min_time=2.0
+)
+t_plain = time_fn(plain, t_params, prompt, jax.random.key(0),
+                  lengths=lengths, min_time=2.0)
+print(
+    f"[spec-t] ragged generate: plain {B * NEW / t_plain:,.0f} tok/s, "
+    f"speculative {B * NEW / t_spec:,.0f} tok/s ({t_plain / t_spec:.2f}x)",
+    flush=True,
+)
+
+# Engine-level: same trained pair through the continuous engine.
+NREQ = 24
+prompts = [
+    tokens[int(s) : int(s) + int(n)].astype(np.int32)
+    for s, n in zip(
+        rng.integers(0, len(tokens) - 40, size=NREQ),
+        rng.integers(8, 33, size=NREQ),
+    )
+]
+common = dict(batch_size=8, max_new_tokens=NEW, refill_chunk=32,
+              inference_dtype=jnp.bfloat16)
+eng_plain = make_continuous_engine(TARGET, mesh, RULES_DP_TP, **common)
+eng_spec = make_continuous_engine(
+    TARGET, mesh, RULES_DP_TP, draft_config=DRAFT, num_draft=ND, **common
+)
+for label, serve, kw in (
+    ("plain engine", eng_plain, {}),
+    ("speculative engine (trained draft)", eng_spec,
+     {"draft_params": d_params}),
+):
+    serve(t_params, prompts[:9], **kw)      # warm all executables
+    t0 = time.perf_counter()
+    outs = serve(t_params, prompts, **kw)
+    dt = time.perf_counter() - t0
+    toks = sum(len(o) - p.size for o, p in zip(outs, prompts))
+    print(f"[spec-t] {label}: {toks / dt:,.0f} tok/s ({dt:.2f} s)",
+          flush=True)
