@@ -36,8 +36,11 @@ TPU-shaped design — the host drives, the device stays static:
   chunk, and accepts PER-ROW — rollback rewinds each row's own
   ``cache_index`` (``models/speculative.py``'s ragged machinery inside
   the engine), so one round emits 1..num_draft+1 tokens per row and the
-  block returns per-row counts. Greedy only (speculative sampling inside
-  the engine would need per-request rejection streams).
+  block returns per-row counts. With ``temperature > 0`` the block runs
+  speculative SAMPLING (Leviathan rejection) whose per-request rejection
+  streams are keyed by (request id, generated position, stream tag) —
+  sampled speculative outputs are schedule-independent like every other
+  engine mode.
 
 Oracles (test-pinned): under GREEDY decoding every request's output is
 bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
@@ -73,7 +76,9 @@ from learning_jax_sharding_tpu.models.attention import (
 from learning_jax_sharding_tpu.models.generate import filtered_logits
 from learning_jax_sharding_tpu.models.speculative import (
     _greedy as greedy_pick,
+    _pos_key,
     _rollback,
+    emit_vector,
     greedy_accept_emit,
 )
 from learning_jax_sharding_tpu.models.transformer import (
@@ -138,10 +143,15 @@ def make_continuous_engine(
     ``draft_config``: enable SPECULATIVE decode blocks — a draft model
     proposes ``num_draft`` tokens per round, the target verifies them in
     one chunked forward, acceptance and cache rollback are PER-ROW. Pass
-    the draft params as ``serve(..., draft_params=...)``. Greedy only
-    (``temperature == 0``); output stays bit-identical to non-speculative
+    the draft params as ``serve(..., draft_params=...)``. At
+    ``temperature == 0`` output stays bit-identical to non-speculative
     greedy serving (test-pinned) — the draft changes only how many target
-    dispatches the tokens cost.
+    dispatches the tokens cost. At ``temperature > 0`` the block runs
+    speculative sampling (acceptance ``u·q < p``, residual draws from
+    ``norm(max(p − q, 0))``) with draws keyed by (request id, generated
+    position, stream tag): outputs follow the target's filtered sampling
+    distribution and are schedule-independent, though not token-identical
+    to non-speculative sampling (different draw structure).
 
     ``temperature > 0``: every draw is keyed by (request id, generated
     position) folded into ``rng`` — sampled outputs are reproducible
@@ -173,12 +183,6 @@ def make_continuous_engine(
         )
     speculative = draft_config is not None
     if speculative:
-        if temperature != 0.0:
-            raise ValueError(
-                "speculative serving is greedy-only (temperature == 0): "
-                "in-engine speculative sampling would need per-request "
-                "rejection streams"
-            )
         if num_draft < 1:
             raise ValueError(f"num_draft must be >= 1, got {num_draft}")
         if draft_config.vocab_size != config.vocab_size:
@@ -245,6 +249,27 @@ def make_continuous_engine(
             return jax.random.fold_in(jax.random.fold_in(rng, r), p)
 
         return jax.vmap(one)(rid, pos)
+
+    def spec_keys(rng, rid, pos, tag):
+        """Per-REQUEST rejection streams: ``speculative._pos_key``'s
+        position+tag derivation (THE definition of the three stream roles)
+        under a request-id fold — position-keyed, so a rolled-back
+        position re-derives its draws and a round/block boundary lands
+        nowhere in the stream (schedule independence, test-pinned)."""
+
+        def one(r, p):
+            return _pos_key(jax.random.fold_in(rng, r), p, tag)
+
+        return jax.vmap(one)(rid, pos)
+
+    def to_flogits(logits):
+        """The filtered sampling distribution in logit space — shared with
+        ``sample_rows`` via ``generate.filtered_logits`` (THE definition
+        of the filter order) so the speculative acceptance distribution
+        cannot drift from what plain sampling draws."""
+        return filtered_logits(
+            logits, temperature, top_k, top_p, min_p, vocab_limit
+        )
 
     def sample_rows(logits, rng, rid, pos):
         """Per-row sampling with (request, position) keys; greedy ignores
@@ -329,7 +354,8 @@ def make_continuous_engine(
 
     @jax.jit
     def decode_block_spec(
-        params, d_params, t_cache, d_cache, tok, active, pos, remaining, rng
+        params, d_params, t_cache, d_cache, tok, active, pos, remaining,
+        rid, rng,
     ):
         """Speculative decode block: ``decode_block_steps`` draft-verify
         ROUNDS, each emitting 1..num_draft+1 tokens per row with PER-ROW
@@ -338,24 +364,52 @@ def make_continuous_engine(
         engine's scan). ``pos`` is each row's current cache index
         (prompt_len + emitted - 1); EOS and budget truncate a round's
         per-row emission exactly, so the buffer/counts the block returns
-        are final — the host appends them verbatim."""
-        del rng  # greedy only
+        are final — the host appends them verbatim.
+
+        ``temperature > 0``: speculative SAMPLING (Leviathan rejection) —
+        the draft proposes from the filtered distribution, acceptance is
+        ``u·q < p`` per position, the slot-m token samples the residual
+        ``norm(max(p − q, 0))`` — with every draw keyed by (request id,
+        generated position, stream tag) via ``spec_keys``, so a request's
+        sampled output is independent of batch composition, round
+        boundaries, and block boundaries (rollback re-derives draws)."""
         width = decode_block_steps * (num_draft + 1)
         idx = jnp.arange(num_draft + 1)
 
         def body(carry, _):
             tok, active, pos, remaining, count, buffer, t_cache, d_cache = carry
+            # Each row's next GENERATED position (the refill's pick was
+            # position 0 of its stream).
+            gen = max_new_tokens - remaining
 
             # 1. Draft proposes per row (frozen rows ride with length 0).
-            def draft_step(c, _):
-                prev, dc = c
-                lg, dc = d_apply(d_params, dc, prev[:, None], active)
-                nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
-                return (nxt, dc), nxt
+            if temperature == 0.0:
 
-            (last_d, d_cache), drafts = jax.lax.scan(
-                draft_step, (tok, d_cache), None, length=num_draft
-            )
+                def draft_step(c, j):
+                    prev, dc = c
+                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                    nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
+                    return (nxt, dc), nxt
+
+                (last_d, d_cache), drafts = jax.lax.scan(
+                    draft_step, (tok, d_cache), jnp.arange(num_draft)
+                )
+                q_all = None
+            else:
+
+                def draft_step(c, j):
+                    prev, dc = c
+                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                    fl = to_flogits(lg[:, -1])
+                    nxt = jax.vmap(jax.random.categorical)(
+                        spec_keys(rng, rid, gen + j, 0), fl
+                    ).astype(jnp.int32)
+                    nxt = jnp.where(active == 1, nxt, prev)
+                    return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
+
+                (last_d, d_cache), (drafts, q_all) = jax.lax.scan(
+                    draft_step, (tok, d_cache), jnp.arange(num_draft)
+                )
             drafts = drafts.T
             _, d_cache = d_apply(d_params, d_cache, last_d[:, None], active)
 
@@ -364,11 +418,48 @@ def make_continuous_engine(
             t_logits, t_cache = apply(
                 params, t_cache, chunk, active * (num_draft + 1)
             )
-            choices = _greedy(t_logits)
 
-            # 3. Per-row acceptance; emitted = accepted drafts + bonus
-            #    (the shared core, models/speculative.py).
-            m, emitted, _ = greedy_accept_emit(drafts, choices)
+            # 3. Per-row acceptance; emitted = accepted drafts + the
+            #    bonus/correction (greedy) or residual sample (sampling) —
+            #    the shared cores, models/speculative.py.
+            if temperature == 0.0:
+                m, emitted, _ = greedy_accept_emit(drafts, _greedy(t_logits))
+            else:
+                q_all = jnp.moveaxis(q_all, 0, 1)        # (B, num_draft, V)
+                p_all = jax.nn.softmax(to_flogits(t_logits), axis=-1)
+                p_at = jnp.take_along_axis(
+                    p_all[:, :num_draft], drafts[..., None], axis=-1
+                )[..., 0]
+                q_at = jnp.take_along_axis(
+                    q_all, drafts[..., None], axis=-1
+                )[..., 0]
+                u = jax.vmap(
+                    lambda j: jax.vmap(jax.random.uniform)(
+                        spec_keys(rng, rid, gen + j, 1)
+                    ),
+                    out_axes=1,
+                )(jnp.arange(num_draft))                 # (B, num_draft)
+                accept = u * q_at < p_at
+                m = jnp.sum(
+                    jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+                )
+                q_pad = jnp.concatenate(
+                    [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
+                )
+
+                def take_m(x):
+                    return jnp.take_along_axis(
+                        x, m[:, None, None], axis=1
+                    )[:, 0]
+
+                p_m = take_m(p_all)
+                residual = jnp.maximum(p_m - take_m(q_pad), 0.0)
+                mass = jnp.sum(residual, axis=-1, keepdims=True)
+                residual = jnp.where(mass > 0, residual / mass, p_m)
+                token_m = jax.vmap(jax.random.categorical)(
+                    spec_keys(rng, rid, gen + m, 2), jnp.log(residual)
+                ).astype(jnp.int32)
+                emitted = emit_vector(drafts, m, token_m)
 
             # 4. Truncate each row's emission at EOS and at its budget.
             raw = 1 + m
@@ -665,7 +756,7 @@ def make_continuous_engine(
                                     jnp.asarray(tok),
                                     jnp.asarray(active.astype(np.int32)),
                                     jnp.asarray(pos), jnp.asarray(remaining),
-                                    rng,
+                                    rid_arr(), rng,
                                 )
                             )
                             cache = (t_cache, d_cache)
