@@ -276,11 +276,8 @@ def make_continuous_engine(
         the keys entirely (deterministic)."""
         if temperature == 0.0:
             return _greedy(logits)
-        fl = filtered_logits(
-            logits, temperature, top_k, top_p, min_p, vocab_limit
-        )
         return jax.vmap(jax.random.categorical)(
-            row_keys(rng, rid, pos), fl
+            row_keys(rng, rid, pos), to_flogits(logits)
         ).astype(jnp.int32)
 
     def _refill(params, d_params, cache, chunk, lengths, rid, rng):

@@ -485,6 +485,21 @@ class TestPagedKVCache:
         for r, g in zip(ref, got):
             np.testing.assert_array_equal(g, r)
 
+    def test_paged_int8_kv_matches_unpaged(self, setup, mesh22):
+        """Paged pools carry the int8 KV scales in page-shaped pools of
+        their own — the quantized cache must page bit-identically to its
+        unpaged (quantized) self."""
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, decode_attention="blocked", kv_cache_dtype=jnp.int8
+        )
+        plain = self._engine(cfg, mesh22)
+        paged = self._engine(cfg, mesh22, paged_pages=9, page_size=self.PAGE)
+        ref = plain(params, prompts)
+        got = paged(params, prompts)
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(g, r)
+
     def test_pool_exhaustion_raises(self, setup, mesh22):
         cfg, params, prompts = setup
         cfg = dataclasses.replace(cfg, decode_attention="blocked")

@@ -447,6 +447,62 @@ class TestSpeculativeRagged:
         )
         assert (a != d).any()
 
+    def test_ragged_sampled_joint_matches_target_distribution(
+        self, mesh22, rng
+    ):
+        """The ragged path's OWN rejection math (generate_ragged_sampled is
+        a separate implementation from the rectangular verifier), pinned
+        distributionally: 4096 identical rows with (row, position)-keyed
+        draws are 4096 iid 2-token samples; their empirical joint must
+        match the exact target joint under the same top-k filter."""
+        from learning_jax_sharding_tpu.models.generate import top_k_filter
+
+        t_params, tokens = _trained_target(mesh22, rng)
+        d_params = _draft_params()
+        b = 4096
+        prompt_row = tokens[:1, :8]
+        prompt = jnp.asarray(np.repeat(prompt_row, b, axis=0))
+        gen = make_speculative_generate_fn(
+            CONFIG_TINY, DRAFT_CFG, mesh22, RULES_DP_TP,
+            max_new_tokens=2, num_draft=2, temperature=1.0, top_k=4,
+            ragged=True,
+        )
+        out = np.asarray(
+            gen(
+                t_params, d_params, prompt, jax.random.key(17),
+                lengths=jnp.full((b,), 8, jnp.int32),
+            )
+        )
+        pairs = out[:, 8:10]
+
+        model = Transformer(CONFIG_TINY)
+        v = CONFIG_TINY.vocab_size
+
+        def filtered_probs(toks):
+            logits = model.apply({"params": t_params}, jnp.asarray(toks))
+            return np.asarray(
+                jax.nn.softmax(
+                    top_k_filter(logits[:, -1].astype(jnp.float32), 4),
+                    axis=-1,
+                )
+            )
+
+        p0 = filtered_probs(prompt_row)[0]
+        exact = np.zeros((v, v))
+        (support0,) = np.nonzero(p0)
+        for t0 in support0:
+            row = np.concatenate(
+                [prompt_row, [[t0]]], axis=1
+            ).astype(np.int32)
+            exact[t0] = p0[t0] * filtered_probs(row)[0]
+        emp = np.zeros((v, v))
+        for t0, t1 in pairs:
+            emp[t0, t1] += 1.0 / b
+        assert (emp[exact == 0] == 0).all()
+        tv = 0.5 * np.abs(emp - exact).sum()
+        # 4096 samples over <=16(+ties) cells: expected TV ~0.03.
+        assert tv < 0.1, f"total variation {tv:.3f}"
+
     def test_lengths_validation(self, mesh22, rng):
         t_params, tokens = _trained_target(mesh22, rng, steps=1)
         d_params = _draft_params()
