@@ -76,6 +76,50 @@ def derive_decode_config(
     return cfg
 
 
+def apply_dequantize_policy(
+    cfg: TransformerConfig, dequantize: bool | str, mesh: Any, rules: Any
+) -> tuple[TransformerConfig, bool]:
+    """THE quantized-serving policy, shared by every decoder
+    (``make_generate_fn``, the continuous engine) so it cannot drift:
+    validates the ``dequantize`` mode, and for the fused modes sets the
+    config's ``quantization`` so int4 trees apply VERBATIM through the
+    fused dequant-matmul kernels (``models/quantize.py::Int4Dense``) — no
+    in-jit dequantize_tree, no dequantized weights in HBM. On >1-device
+    meshes the kernel runs under an injected shard_map matmul (GSPMD
+    cannot partition the custom call and would gather the packed
+    weights). ``"fused_w4a8"`` additionally quantizes activations per-row
+    to int8 so the contraction runs int8×int4→int32 on the MXU.
+
+    Returns ``(cfg, fused)`` — callers build their cached apply with
+    ``dequantize=bool(dequantize) and not fused`` and their param caster
+    with ``dequantize=bool(dequantize)``."""
+    if isinstance(dequantize, str) and dequantize not in (
+        "fused", "fused_w4a8"
+    ):
+        raise ValueError(
+            f"dequantize must be False, True, 'fused', or 'fused_w4a8'; "
+            f"got {dequantize!r}"
+        )
+    fused = dequantize in ("fused", "fused_w4a8")
+    if fused:
+        w4a8 = dequantize == "fused_w4a8"
+        cfg = dataclasses.replace(
+            cfg, quantization="int4_w4a8" if w4a8 else "int4"
+        )
+        if mesh.size > 1:
+            from learning_jax_sharding_tpu.ops.int4_matmul import (
+                make_int4_matmul_fn,
+            )
+
+            cfg = dataclasses.replace(
+                cfg,
+                quantized_matmul_fn=make_int4_matmul_fn(
+                    mesh, rules, w4a8=w4a8
+                ),
+            )
+    return cfg, fused
+
+
 def make_param_caster(
     inference_dtype: Any | None, *, dequantize: bool = False
 ) -> Callable[[Any], Any]:

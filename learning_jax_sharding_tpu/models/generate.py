@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import Mesh
 
 from learning_jax_sharding_tpu.models.decoding import (
+    apply_dequantize_policy,
     check_sequence_budget,
     derive_decode_config,
     make_cached_apply,
@@ -264,12 +265,6 @@ def make_generate_fn(
     """
     import dataclasses as _dc
 
-    if isinstance(dequantize, str) and dequantize not in ("fused", "fused_w4a8"):
-        raise ValueError(
-            f"dequantize must be False, True, 'fused', or 'fused_w4a8'; "
-            f"got {dequantize!r}"
-        )
-    fused = dequantize in ("fused", "fused_w4a8")
     if ragged and prefill_chunk_size is not None:
         raise ValueError(
             "ragged and prefill_chunk_size cannot combine (chunked ragged "
@@ -278,28 +273,10 @@ def make_generate_fn(
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     if ragged:
         cfg = _dc.replace(cfg, decode_ragged=True)
-    if fused:
-        # int4 trees apply VERBATIM through the fused dequant-matmul kernel
-        # (models/quantize.py::Int4Dense) — no in-jit dequantize_tree, no
-        # dequantized weights in HBM. On >1-device meshes the kernel runs
-        # under shard_map with per-projection specs (GSPMD cannot partition
-        # the custom call and would gather the packed weights).
-        # "fused_w4a8" additionally quantizes activations per-row to int8 so
-        # the contraction runs int8×int4→int32 on the MXU — the throughput
-        # point of the ladder (~0.8% extra activation rounding error).
-        w4a8 = dequantize == "fused_w4a8"
-        cfg = _dc.replace(cfg, quantization="int4_w4a8" if w4a8 else "int4")
-        if mesh.size > 1:
-            from learning_jax_sharding_tpu.ops.int4_matmul import (
-                make_int4_matmul_fn,
-            )
-
-            cfg = _dc.replace(
-                cfg,
-                quantized_matmul_fn=make_int4_matmul_fn(
-                    mesh, rules, w4a8=w4a8
-                ),
-            )
+    # The quantized-serving policy (mode validation, fused int4 config,
+    # TP shard_map injection) is decoding.apply_dequantize_policy — ONE
+    # copy shared with the continuous engine.
+    cfg, fused = apply_dequantize_policy(cfg, dequantize, mesh, rules)
     model = Transformer(cfg)
     maybe_cast = make_param_caster(inference_dtype, dequantize=bool(dequantize))
     # dequant dtype == inference_dtype when one was given (models.decoding)

@@ -64,6 +64,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from learning_jax_sharding_tpu.models.decoding import (
+    apply_dequantize_policy,
     check_sequence_budget,
     derive_decode_config,
     make_cached_apply,
@@ -119,6 +120,7 @@ def make_continuous_engine(
     min_p: float | None = None,
     vocab_limit: int | None = None,
     inference_dtype: Any | None = None,
+    dequantize: bool | str = False,
     draft_config: Optional[TransformerConfig] = None,
     num_draft: int = 4,
     paged_pages: Optional[int] = None,
@@ -156,6 +158,16 @@ def make_continuous_engine(
     ``temperature > 0``: every draw is keyed by (request id, generated
     position) folded into ``rng`` — sampled outputs are reproducible
     across schedules (batch size, arrival order, slot assignment).
+
+    ``dequantize``: serve QUANTIZED target weights, exactly as
+    ``make_generate_fn`` does — ``True`` for an int8/int4 tree from
+    ``quantize_tree`` dequantized inside the jitted steps, ``"fused"`` /
+    ``"fused_w4a8"`` for an int4 tree streamed through the fused
+    dequant-matmul kernels (whole-FF + q/k/v on single-device serving; an
+    injected shard_map matmul under TP). Applies to the TARGET tree only;
+    a speculative draft serves at ``inference_dtype``. Greedy engine
+    outputs are bit-identical to the corresponding
+    ``make_generate_fn(dequantize=...)`` single runs (test-pinned).
 
     ``paged_pages``: PAGED KV cache — each layer's K/V live in a physical
     pool of ``paged_pages`` pages of ``page_size`` tokens (page 0 is a
@@ -221,11 +233,19 @@ def make_continuous_engine(
         check_paged("target", config)
     cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
     cfg = dataclasses.replace(cfg, decode_ragged=True)
+    cfg, fused = apply_dequantize_policy(cfg, dequantize, mesh, rules)
     if paged:
         cfg = pagedify(cfg)
     model = Transformer(cfg)
-    apply = make_cached_apply(model)
-    maybe_cast = make_param_caster(inference_dtype)
+    # The quantization options apply to the TARGET tree only — a draft is
+    # small by design and serves at inference_dtype.
+    apply = make_cached_apply(
+        model, dequantize=bool(dequantize) and not fused,
+        dequant_dtype=cfg.param_dtype,
+    )
+    maybe_cast = make_param_caster(
+        inference_dtype, dequantize=bool(dequantize)
+    )
     if speculative:
         if paged:
             check_paged("draft", draft_config)

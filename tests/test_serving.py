@@ -285,6 +285,46 @@ class TestReproducibleSampling:
         assert any((x.shape != y.shape) or (x != y).any() for x, y in zip(a, b))
 
 
+class TestQuantizedEngine:
+    """Quantized weights through the continuous engine (`dequantize=`,
+    mirroring make_generate_fn). Oracle: every request bit-identical to
+    the same-dequantize rectangular single run."""
+
+    def _ref(self, cfg, mesh22, tree, prompt, dequantize):
+        gen = make_generate_fn(
+            cfg, mesh22, RULES_DP_TP, max_new_tokens=NEW,
+            dequantize=dequantize,
+        )
+        out = np.asarray(
+            gen(tree, np.repeat(prompt[None, :], 2, axis=0),
+                jax.random.key(0))
+        )
+        return out[0]
+
+    @pytest.mark.parametrize("dequantize,bits", [(True, 8), ("fused", 4)])
+    def test_matches_single_runs(self, setup, mesh22, dequantize, bits):
+        from learning_jax_sharding_tpu.models.quantize import quantize_tree
+
+        cfg, params, prompts = setup
+        tree = quantize_tree(params, bits=bits)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, dequantize=dequantize,
+        )
+        outs = serve(tree, prompts[:4])
+        for p, got in zip(prompts[:4], outs):
+            ref = self._ref(cfg, mesh22, tree, p, dequantize)
+            np.testing.assert_array_equal(got, ref[: len(got)])
+
+    def test_validation(self, setup, mesh22):
+        cfg, _, _ = setup
+        with pytest.raises(ValueError, match="dequantize"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+                dequantize="nope",
+            )
+
+
 class TestSampledSpeculativeEngine:
     """Speculative SAMPLING inside the engine: Leviathan rejection with
     draws keyed by (request id, generated position, stream tag). Oracles:
