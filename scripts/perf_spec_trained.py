@@ -147,12 +147,22 @@ eng_plain = make_continuous_engine(TARGET, mesh, RULES_DP_TP, **common)
 eng_spec = make_continuous_engine(
     TARGET, mesh, RULES_DP_TP, draft_config=DRAFT, num_draft=ND, **common
 )
+eng_plain_s = make_continuous_engine(
+    TARGET, mesh, RULES_DP_TP, temperature=0.9, top_k=20, **common
+)
+eng_spec_s = make_continuous_engine(
+    TARGET, mesh, RULES_DP_TP, draft_config=DRAFT, num_draft=ND,
+    temperature=0.9, top_k=20, **common
+)
 for label, serve, kw in (
     ("plain engine", eng_plain, {}),
     ("speculative engine (trained draft)", eng_spec,
      {"draft_params": d_params}),
     ("speculative engine (weak draft)", eng_spec,
      {"draft_params": d_weak}),
+    ("plain engine, sampled t=0.9", eng_plain_s, {}),
+    ("speculative engine, SAMPLED t=0.9 (trained draft)", eng_spec_s,
+     {"draft_params": d_params}),
 ):
     serve(t_params, prompts[:9], **kw)      # warm all executables
     t0 = time.perf_counter()
@@ -161,3 +171,22 @@ for label, serve, kw in (
     toks = sum(len(o) - p.size for o, p in zip(outs, prompts))
     print(f"[spec-t] {label}: {toks / dt:,.0f} tok/s ({dt:.2f} s)",
           flush=True)
+
+# SAMPLED acceptance is genuinely partial even for a converged pair
+# (u·q < p rejects wherever the draft's distribution is off, not just
+# where its argmax is) — the partial-acceptance point the greedy rows
+# can't produce. Measured via the ragged generate's per-row stats.
+spec_s = make_speculative_generate_fn(
+    TARGET, DRAFT, mesh, RULES_DP_TP, max_new_tokens=NEW, num_draft=ND,
+    temperature=0.9, top_k=20, inference_dtype=jnp.bfloat16, ragged=True,
+)
+_, stats = spec_s(t_params, d_params, prompt, jax.random.key(1),
+                  lengths=lengths, return_stats=True)
+acc = np.asarray(stats["accepted"], np.float64)
+rounds = np.asarray(stats["rounds"], np.float64)
+rate = acc / np.maximum(rounds * ND, 1)
+print(
+    f"[spec-t] SAMPLED acceptance per row (t=0.9, trained pair): "
+    f"{np.array2string(rate, precision=2)} (mean {rate.mean():.0%})",
+    flush=True,
+)
