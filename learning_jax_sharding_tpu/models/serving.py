@@ -179,8 +179,12 @@ def make_continuous_engine(
     by worst-case length. Requires the blocked decode backend. Outputs
     are bit-identical to the unpaged engine (test-pinned); the allocator
     raises if a dispatch would need more pages than the pool holds.
-    After each ``serve`` call, ``serve.last_stats`` reports
-    ``page_high_water`` / ``pages_total`` — the measured footprint.
+    After each ``serve`` call, ``serve.last_stats`` reports what the run
+    measured: ``page_high_water`` / ``pages_total`` (paged — the
+    footprint) and ``spec_accepted`` / ``spec_proposed`` /
+    ``spec_accept_rate`` (speculative — verifier acceptance before
+    EOS/budget truncation, the number to tune ``num_draft`` against);
+    ``None`` when neither mode is on.
     """
     if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
         raise ValueError(
@@ -394,7 +398,8 @@ def make_continuous_engine(
         idx = jnp.arange(num_draft + 1)
 
         def body(carry, _):
-            tok, active, pos, remaining, count, buffer, t_cache, d_cache = carry
+            (tok, active, pos, remaining, count, buffer, acc, prop,
+             t_cache, d_cache) = carry
             # Each row's next GENERATED position (the refill's pick was
             # position 0 of its stream).
             gen = max_new_tokens - remaining
@@ -509,6 +514,11 @@ def make_continuous_engine(
 
             remaining = remaining - n_emit
             count = count + n_emit
+            # Acceptance telemetry: verifier acceptance per live round
+            # (before EOS/budget truncation — the DRAFT's quality, which
+            # is what the operator tunes num_draft against).
+            acc = acc + m * active
+            prop = prop + active * num_draft
             stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
             active = (
                 active
@@ -516,21 +526,26 @@ def make_continuous_engine(
                 * (1 - stopped_eos.astype(jnp.int32))
             )
             return (
-                tok, active, pos, remaining, count, buffer, t_cache, d_cache
+                tok, active, pos, remaining, count, buffer, acc, prop,
+                t_cache, d_cache
             ), None
 
         b = tok.shape[0]
         buffer = jnp.zeros((b, width), jnp.int32)
         count = jnp.zeros((b,), jnp.int32)
-        (tok, active, pos, remaining, count, buffer, t_cache, d_cache), _ = (
+        acc = jnp.zeros((b,), jnp.int32)
+        prop = jnp.zeros((b,), jnp.int32)
+        (tok, active, pos, remaining, count, buffer, acc, prop,
+         t_cache, d_cache), _ = (
             jax.lax.scan(
                 body,
-                (tok, active, pos, remaining, count, buffer, t_cache, d_cache),
+                (tok, active, pos, remaining, count, buffer, acc, prop,
+                 t_cache, d_cache),
                 None,
                 length=decode_block_steps,
             )
         )
-        return buffer, count, active, remaining, t_cache, d_cache
+        return buffer, count, acc, prop, active, remaining, t_cache, d_cache
 
     def serve(params, prompts, rng=None, draft_params=None):
         if speculative and draft_params is None:
@@ -576,6 +591,7 @@ def make_continuous_engine(
         tok = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         cache = None
+        spec_accepted = spec_proposed = 0   # acceptance telemetry
 
         if paged:
             # Host-owned page allocator: page 0 is scratch; a slot holds a
@@ -767,7 +783,7 @@ def make_continuous_engine(
                                 np.int32,
                             )
                             t_cache, d_cache = cache
-                            buffer, counts, _, _, t_cache, d_cache = (
+                            buffer, counts, acc, prop, _, _, t_cache, d_cache = (
                                 decode_block_spec(
                                     params, draft_params, t_cache, d_cache,
                                     jnp.asarray(tok),
@@ -779,6 +795,8 @@ def make_continuous_engine(
                             cache = (t_cache, d_cache)
                             buffer = np.asarray(buffer)
                             counts = np.asarray(counts)
+                            spec_accepted += int(np.asarray(acc).sum())
+                            spec_proposed += int(np.asarray(prop).sum())
                             for slot in range(b):
                                 if active[slot]:
                                     consume(slot, buffer[slot, : counts[slot]].tolist())
@@ -796,14 +814,22 @@ def make_continuous_engine(
         finally:
             # Stats must reflect THIS call even when it raises — pool
             # exhaustion is exactly when the measured footprint matters.
-            serve.last_stats = (
-                {
-                    "page_high_water": high_water,
-                    "pages_total": paged_pages - 1,
-                    "page_size": page_size,
-                }
-                if paged else None
-            )
+            stats = {}
+            if paged:
+                stats.update(
+                    page_high_water=high_water,
+                    pages_total=paged_pages - 1,
+                    page_size=page_size,
+                )
+            if speculative:
+                stats.update(
+                    spec_accepted=spec_accepted,
+                    spec_proposed=spec_proposed,
+                    spec_accept_rate=(
+                        spec_accepted / spec_proposed if spec_proposed else None
+                    ),
+                )
+            serve.last_stats = stats or None
         return [np.asarray(results[i], np.int32) for i in range(len(prompts))]
 
     serve.last_stats = None

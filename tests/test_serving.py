@@ -239,6 +239,31 @@ class TestSpeculativeEngine:
         for r, g in zip(ref, got):
             np.testing.assert_array_equal(g, r)
 
+    def test_acceptance_stats(self, setup, mesh22):
+        """serve.last_stats surfaces verifier acceptance: self-draft is
+        exactly 1.0; an untrained draft against the trained-ish target is
+        below it; the plain engine reports None."""
+        cfg, params, prompts = setup
+        spec = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, draft_config=cfg, num_draft=3,
+        )
+        spec(params, prompts[:3], draft_params=params)
+        stats = spec.last_stats
+        assert stats["spec_accept_rate"] == 1.0
+        assert stats["spec_proposed"] > 0
+        weak = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, draft_config=DRAFT_CFG, num_draft=3,
+        )
+        weak(params, prompts[:3], draft_params=_draft_params())
+        assert weak.last_stats["spec_accept_rate"] < 1.0
+        plain = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        )
+        plain(params, prompts[:3])
+        assert plain.last_stats is None
+
     def test_validation(self, setup, mesh22):
         cfg, params, prompts = setup
         spec = make_continuous_engine(
