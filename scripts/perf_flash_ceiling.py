@@ -163,3 +163,12 @@ for h in (64, 128):
         print(f"block ablation h={h} {mode}: {t*1e6:.1f} us/block "
               f"(dots would need {dots_flops/PEAK*1e6:.1f} us at peak; "
               f"delta vs full {1e6*(base-t):.1f} us)", flush=True)
+
+
+# ---- 4. round-4 addendum: the same composed harness at S=16384 ----
+# (b=1 keeps the fp32 hidden states inside HBM without remat.)
+b16k = dataclasses.replace(b8k, max_seq_len=16384)
+composed("S=16384 b=1 hd=128 flash causal", b16k, 1, 16384)
+b16kw = dataclasses.replace(b16k, attn_fn=make_flash_attn_fn(window=1024))
+composed("S=16384 b=1 hd=128 banded window 1024", b16kw, 1, 16384,
+         window=1024)
