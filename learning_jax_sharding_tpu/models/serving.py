@@ -89,16 +89,25 @@ from learning_jax_sharding_tpu.models.transformer import (
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 
 
-def _reset_rows(cache: Any, mask: jax.Array) -> Any:
-    """Zero the per-row decode counters (``cache_index`` and ``position``)
-    where ``mask`` is True — request admission. Stale K/V past a reset
-    row's index is masked by causal-at-index attention and overwritten as
-    the new request writes (same invariant speculative rollback relies
-    on, ``models/speculative.py::_rollback``)."""
+def _reset_rows(
+    cache: Any, mask: jax.Array, values: jax.Array | None = None
+) -> Any:
+    """Set the per-row decode counters (``cache_index`` and ``position``)
+    where ``mask`` is True — request admission. ``values`` (``(B,)``,
+    default zeros) is the admission index: 0 for a fresh prompt, or the
+    shared-prefix length when prefix caching hands the row pre-filled
+    pages. Stale K/V past a reset row's index is masked by causal-at-index
+    attention and overwritten as the new request writes (same invariant
+    speculative rollback relies on, ``models/speculative.py::_rollback``)."""
 
     def leaf(path, x):
         if getattr(path[-1], "key", None) in ("cache_index", "position"):
-            return jnp.where(mask, jnp.zeros_like(x), x)
+            v = (
+                jnp.zeros_like(x)
+                if values is None
+                else jnp.broadcast_to(values.astype(x.dtype), x.shape)
+            )
+            return jnp.where(mask, v, x)
         return x
 
     return jax.tree_util.tree_map_with_path(leaf, cache)
@@ -125,6 +134,7 @@ def make_continuous_engine(
     num_draft: int = 4,
     paged_pages: Optional[int] = None,
     page_size: int = 64,
+    prefix_cache: bool = False,
 ):
     """Build ``serve(params, prompts, rng, draft_params) -> list[np.ndarray]``.
 
@@ -179,12 +189,28 @@ def make_continuous_engine(
     by worst-case length. Requires the blocked decode backend. Outputs
     are bit-identical to the unpaged engine (test-pinned); the allocator
     raises if a dispatch would need more pages than the pool holds.
+    ``prefix_cache`` (paged only): PREFIX CACHING — when a request
+    retires, the pages fully covered by its prompt are RETAINED (keyed by
+    their page-aligned token prefix) instead of freed; a later request in
+    the same ``serve`` call whose prompt starts with the same tokens is
+    admitted with those pages already in its block table and its counters
+    set to the shared length, so the shared prefix is neither re-stored
+    nor re-prefilled — both the HBM and the prefill compute are saved.
+    Sharing is all-or-nothing per page, capped at ``len(prompt) - 1`` (the
+    last prompt token always recomputes: its logits seed generation), and
+    reference-counted; retained pages with no references are evicted LRU
+    when the allocator runs dry, so the pool never shrinks. Outputs are
+    bit-identical to the uncached engine (test-pinned): shared pages hold
+    exactly the bytes the evicted computation wrote. Scope: one ``serve``
+    call (the caches themselves live per call).
+
     After each ``serve`` call, ``serve.last_stats`` reports what the run
     measured: ``page_high_water`` / ``pages_total`` (paged — the
-    footprint) and ``spec_accepted`` / ``spec_proposed`` /
+    footprint), ``prefix_hits`` / ``prefix_pages_reused`` (prefix
+    caching), and ``spec_accepted`` / ``spec_proposed`` /
     ``spec_accept_rate`` (speculative — verifier acceptance before
     EOS/budget truncation, the number to tune ``num_draft`` against);
-    ``None`` when neither mode is on.
+    ``None`` when none of the modes are on.
     """
     if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
         raise ValueError(
@@ -207,6 +233,11 @@ def make_continuous_engine(
                 f"{draft_config.vocab_size}"
             )
     paged = paged_pages is not None
+    if prefix_cache and not paged:
+        raise ValueError(
+            "prefix_cache requires the paged KV cache (paged_pages=N): "
+            "sharing is expressed through block-table entries"
+        )
 
     def check_paged(name, c):
         # ONE copy of the paged preconditions, applied to the target and
@@ -323,15 +354,21 @@ def make_continuous_engine(
         return tok, cache
 
     @jax.jit
-    def refill_step(params, d_params, cache, chunk, lengths, reset_mask, rid, rng):
-        # Admission: zero the admitted rows' counters, then run the chunk —
-        # every row's cache advance is its own valid length (0 for rows
-        # that are decoding or idle this call). The cache-None first call
+    def refill_step(
+        params, d_params, cache, chunk, lengths, reset_mask, reset_to,
+        rid, rng,
+    ):
+        # Admission: set the admitted rows' counters (0, or the shared-
+        # prefix length under prefix caching), then run the chunk — every
+        # row's cache advance is its own valid length (0 for rows that
+        # are decoding or idle this call). The cache-None first call
         # routes to first_refill instead.
         if speculative:
-            cache = tuple(_reset_rows(c, reset_mask) for c in cache)
+            cache = tuple(
+                _reset_rows(c, reset_mask, reset_to) for c in cache
+            )
         else:
-            cache = _reset_rows(cache, reset_mask)
+            cache = _reset_rows(cache, reset_mask, reset_to)
         return _refill(params, d_params, cache, chunk, lengths, rid, rng)
 
     # Cache creation needs an apply without a cache; same program shape as
@@ -602,6 +639,33 @@ def make_continuous_engine(
             table_np = np.zeros((b, t_cap), np.int32)
             high_water = 0
             tables_dirty = True
+            # Prefix-cache state: page-aligned token-prefix bytes → the
+            # page holding that prefix's LAST page of K/V; refcounts for
+            # pages shared by live slots; ref-0 registered pages stay
+            # evictable in LRU order (dict preserves insertion order).
+            registry: dict[bytes, int] = {}
+            key_of_page: dict[int, bytes] = {}
+            refcnt: dict[int, int] = {}
+            cached_lru: dict[int, None] = {}
+            shared_count = [0] * b     # leading registry pages per slot
+            prefix_hits = prefix_pages_reused = 0
+
+            def take_page():
+                if free_pages:
+                    return free_pages.pop()
+                if cached_lru:
+                    # Evict the oldest reference-free cached page — the
+                    # pool must serve live requests before retained ones.
+                    pid = next(iter(cached_lru))
+                    del cached_lru[pid]
+                    del registry[key_of_page.pop(pid)]
+                    del refcnt[pid]
+                    return pid
+                raise RuntimeError(
+                    f"page pool exhausted ({paged_pages - 1} pages "
+                    f"× {page_size} tokens): raise paged_pages or "
+                    "lower concurrency"
+                )
 
             def ensure(slot, tokens_through):
                 # Allocate pages so positions [0, tokens_through) are
@@ -609,13 +673,7 @@ def make_continuous_engine(
                 nonlocal high_water, tables_dirty
                 need = -(-int(tokens_through) // page_size)
                 while len(held[slot]) < need:
-                    if not free_pages:
-                        raise RuntimeError(
-                            f"page pool exhausted ({paged_pages - 1} pages "
-                            f"× {page_size} tokens): raise paged_pages or "
-                            "lower concurrency"
-                        )
-                    p = free_pages.pop()
+                    p = take_page()
                     table_np[slot, len(held[slot])] = p
                     held[slot].append(p)
                     tables_dirty = True
@@ -625,7 +683,38 @@ def make_continuous_engine(
 
             def release(slot):
                 nonlocal tables_dirty
-                free_pages.extend(held[slot])
+                if prefix_cache:
+                    pages, ns = held[slot], shared_count[slot]
+                    # Private pages: RETAIN the ones fully inside the
+                    # prompt (immutable once written — generation never
+                    # rewrites earlier positions) under their token-prefix
+                    # key; free the rest (generated-region K/V). DEEPEST
+                    # page first into the LRU — admission chains break at
+                    # the first missing page, so eviction must take chain
+                    # tails before roots or the stranded descendants
+                    # retain HBM with zero hit potential.
+                    p_toks = np.asarray(
+                        out[slot][: plen[slot]], np.int32
+                    )
+                    full = plen[slot] // page_size
+                    for j in range(len(pages) - 1, ns - 1, -1):
+                        pid = pages[j]
+                        if j < full:
+                            key = p_toks[: (j + 1) * page_size].tobytes()
+                            if key not in registry:
+                                registry[key] = pid
+                                key_of_page[pid] = key
+                                refcnt[pid] = 0
+                                cached_lru[pid] = None
+                                continue
+                        free_pages.append(pid)
+                    for pid in reversed(pages[:ns]):  # drop shared refs,
+                        refcnt[pid] -= 1              # tails first too
+                        if refcnt[pid] == 0:
+                            cached_lru[pid] = None
+                    shared_count[slot] = 0
+                else:
+                    free_pages.extend(held[slot])
                 held[slot] = []
                 table_np[slot, :] = 0
                 tables_dirty = True
@@ -677,6 +766,7 @@ def make_continuous_engine(
                 while queue or any(r >= 0 for r in req):
                     # 1. Admit queued requests into idle slots.
                     reset = np.zeros((b,), bool)
+                    reset_to = np.zeros((b,), np.int32)
                     for slot in range(b):
                         if req[slot] < 0 and queue:
                             rid, prompt = queue.popleft()
@@ -686,6 +776,34 @@ def make_continuous_engine(
                             emitted[slot] = 0
                             out[slot] = list(prompt)
                             reset[slot] = True
+                            if paged and prefix_cache:
+                                # Longest chain of retained pages whose
+                                # token prefix matches; the last prompt
+                                # token always recomputes (its logits
+                                # seed generation).
+                                shared = []
+                                for k in range(
+                                    1, (prompt.size - 1) // page_size + 1
+                                ):
+                                    pid = registry.get(
+                                        prompt[: k * page_size].tobytes()
+                                    )
+                                    if pid is None:
+                                        break
+                                    shared.append(pid)
+                                for j, pid in enumerate(shared):
+                                    refcnt[pid] = refcnt.get(pid, 0) + 1
+                                    cached_lru.pop(pid, None)
+                                    table_np[slot, j] = pid
+                                    held[slot].append(pid)
+                                    tables_dirty = True
+                                shared_count[slot] = len(shared)
+                                if shared:
+                                    s_len = len(shared) * page_size
+                                    pending[slot] = prompt[s_len:]
+                                    reset_to[slot] = s_len
+                                    prefix_hits += 1
+                                    prefix_pages_reused += len(shared)
 
                     # 2. One refill chunk for every slot with pending prompt
                     #    tokens (fresh or continuing); decoding rows ride along
@@ -724,7 +842,7 @@ def make_continuous_engine(
                             tok_new, cache = refill_step(
                                 params, draft_params, cache, jnp.asarray(chunk),
                                 jnp.asarray(lengths), jnp.asarray(reset),
-                                rid_arr(), rng,
+                                jnp.asarray(reset_to), rid_arr(), rng,
                             )
                         tok_new = np.asarray(tok_new)
                         for slot in range(b):
@@ -821,6 +939,11 @@ def make_continuous_engine(
                     pages_total=paged_pages - 1,
                     page_size=page_size,
                 )
+                if prefix_cache:
+                    stats.update(
+                        prefix_hits=prefix_hits,
+                        prefix_pages_reused=prefix_pages_reused,
+                    )
             if speculative:
                 stats.update(
                     spec_accepted=spec_accepted,
