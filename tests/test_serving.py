@@ -635,6 +635,34 @@ class TestPagedKVCache:
             np.testing.assert_array_equal(g, r)
         assert pfx.last_stats["prefix_hits"] >= 1
 
+    def test_everything_composes(self, setup, mesh22):
+        """The whole round-4 serving stack AT ONCE — int4-fused weights +
+        paged KV + prefix cache + speculative decode blocks — must still
+        be bit-identical to the plain int4 engine. The features were each
+        pinned alone; this is the composition oracle."""
+        from learning_jax_sharding_tpu.models.quantize import quantize_tree
+
+        cfg, params, _ = setup
+        cfg = dataclasses.replace(cfg, decode_attention="blocked")
+        dcfg = dataclasses.replace(DRAFT_CFG, decode_attention="blocked")
+        rng = np.random.default_rng(12)
+        base = rng.integers(1, cfg.vocab_size, size=(20,)).astype(np.int32)
+        queue = [base, base.copy(), base.copy(), base.copy()]
+        q4 = quantize_tree(params, bits=4)
+        plain = self._engine(cfg, mesh22, dequantize="fused")
+        ref = plain(q4, queue)
+        allon = self._engine(
+            cfg, mesh22, dequantize="fused", paged_pages=9,
+            page_size=self.PAGE, prefix_cache=True, draft_config=dcfg,
+            num_draft=2,
+        )
+        got = allon(q4, queue, draft_params=_draft_params())
+        for r, g in zip(ref, got):
+            np.testing.assert_array_equal(g, r)
+        stats = allon.last_stats
+        assert stats["prefix_hits"] >= 1
+        assert stats["spec_proposed"] > 0
+
     def test_prefix_cache_requires_paged(self, setup, mesh22):
         cfg, _, _ = setup
         with pytest.raises(ValueError, match="prefix_cache"):
