@@ -283,7 +283,10 @@ def bench_decode_1p4b():
         num_layers=24, features=2048, num_heads=16, head_dim=128,
         hidden=8192, max_seq_len=256,
     )
-    _decode_ladder(cfg, "1.4B", b=8, prompt_len=64, new=64)
+    # rounds=5 (vs the ladder default 3): the ABSOLUTE int4 number is a
+    # claim here, not just the ordering — round 4's artifact/shakedown
+    # spread (3,036-4,056 tok/s) needs the deeper median (VERDICT item 6).
+    _decode_ladder(cfg, "1.4B", b=8, prompt_len=64, new=64, rounds=5)
 
 
 def bench_longcontext():
@@ -398,6 +401,197 @@ def bench_moe_125m():
     _log(msg)
 
 
+def bench_moe_headline():
+    """The MoE configuration the README headlines (VERDICT r4 item 5):
+    E=4 WIDER experts (2x hidden), top-2, capacity 1.0, scatter dispatch,
+    remat OFF — scatter has no (T,E,C) dispatch tensors to fit, so the
+    activations fit un-rematerialized and routing cost vs the dense
+    control collapses (PERF.md round-4 ladder: 46.3% vs 45.9% dense).
+    ``bench_moe_125m`` keeps the E=8 cap1.25 workload for cross-round
+    comparability; this line is the tuned configuration of record."""
+    import dataclasses
+
+    from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+
+    cfg = dataclasses.replace(
+        CONFIG_125M, attn_fn=make_flash_attn_fn(), num_experts=4,
+        hidden=2 * CONFIG_125M.hidden, moe_top_k=2,
+        moe_capacity_factor=1.0, moe_dispatch="scatter", remat=False,
+    )
+    result, per_step, _ = _timed_train_step(cfg, b=4, K=2, opt=optax.sgd(3e-4))
+    msg = (
+        f"[bench] 125M-class MoE HEADLINE (E=4 wide, top-2, cap 1.0, "
+        f"scatter, noremat) train step (b=4, sgd): {per_step * 1e3:.1f} ms/step"
+    )
+    if result.mfu is not None:
+        msg += f", activated-MFU={result.mfu:.1%}"
+    _log(msg)
+
+
+def bench_serving_125m():
+    """The serving-engine story, in the driver artifact (VERDICT r4 item
+    2): the shared-system-prompt workload from
+    ``scripts/perf_prefix_cache.py`` (512-token system prefix + 32
+    request tokens, 24 requests through 8 slots, +32 generated) served by
+
+    * the plain bf16 continuous engine,
+    * the COMPOSED stack — int4-fused weights + paged KV (+ prefix), and
+    * the prefix cache COLD (registry flushed per call — within-call
+      sharing only, the round-4 comparison) and WARM (registry persisted
+      from the previous call — the round-5 persistent-engine payoff: the
+      system prompt is never re-prefilled).
+
+    Interleaved rounds with per-variant medians, like the decode ladders
+    (the tunnel drifts ±30%; only within-window comparisons order
+    reliably). Also reports the refill-pause share of engine time
+    (VERDICT r4 item 9) and the warm prefix hit rate.
+    """
+    import dataclasses
+    import time as _time
+
+    import flax.linen as nn
+
+    from learning_jax_sharding_tpu.models.quantize import quantize_tree
+    from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+
+    cfg = dataclasses.replace(
+        CONFIG_125M, max_seq_len=1024, decode_attention="blocked"
+    )
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    rng = np.random.default_rng(0)
+    model = Transformer(cfg)
+    probe = np.zeros((8, 64), np.int32)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), probe
+        )["params"]
+    )
+    q4 = quantize_tree(params, bits=4)
+    system = rng.integers(1, cfg.vocab_size, size=(512,)).astype(np.int32)
+    NREQ, NEW = 24, 32
+    prompts = [
+        np.concatenate(
+            [system,
+             rng.integers(1, cfg.vocab_size, size=(32,)).astype(np.int32)]
+        )
+        for _ in range(NREQ)
+    ]
+    common = dict(
+        batch_size=8, max_new_tokens=NEW, refill_chunk=64,
+        inference_dtype=jnp.bfloat16,
+    )
+    PAGES = 8 * 10 + 1 + 12   # 8 slots x ceil(608/64) + scratch + slack
+    plain = make_continuous_engine(cfg, mesh, RULES_DP_TP, **common)
+    paged4 = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, **common, dequantize="fused",
+        paged_pages=PAGES, page_size=64,
+    )
+    pfx4 = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, **common, dequantize="fused",
+        paged_pages=PAGES, page_size=64, prefix_cache=True,
+    )
+
+    def timed(serve, tree):
+        t0 = _time.perf_counter()
+        outs = serve(tree, prompts)
+        dt = _time.perf_counter() - t0
+        return dt, sum(len(o) - 544 for o in outs)
+
+    variants = [
+        ("bf16 engine", plain, params, None),
+        ("int4-fused + paged", paged4, q4, None),
+        ("int4 + paged + prefix (cold)", pfx4, q4, "cold"),
+        ("int4 + paged + prefix (warm)", pfx4, q4, "warm"),
+    ]
+    # Warm every executable once (compiles excluded from the ladder).
+    for _, serve, tree, mode in variants[:3]:
+        serve(tree, prompts[:8])
+    times = {name: [] for name, *_ in variants}
+    toks = {}
+    stats = {}
+    for _ in range(3):
+        for name, serve, tree, mode in variants:
+            if mode == "cold":
+                serve.engine.flush_prefix_cache()
+            dt, n = timed(serve, tree)
+            times[name].append(dt)
+            toks[name] = n
+            stats[name] = (serve.last_stats, serve.last_latency)
+    base = None
+    for name, *_ in variants:
+        secs = float(np.median(times[name]))
+        rate = toks[name] / secs
+        if base is None:
+            base = rate
+        st, lat = stats[name]
+        extra = ""
+        if st and "prefix_hits" in st:
+            extra += (
+                f", hits {st['prefix_hits']}/{NREQ}"
+                f" ({st['prefix_pages_reused']} pages reused)"
+            )
+        if lat and lat.get("refill_frac") is not None:
+            extra += f", refill {lat['refill_frac']:.0%} of engine time"
+        _log(
+            f"[bench] 125M serving, {name}: {rate:,.0f} tok/s "
+            f"({secs:.2f} s, {toks[name]} generated, "
+            f"{rate / base:.2f}x bf16){extra}"
+        )
+
+    # bf16 speculation agreement guard (VERDICT r4 item 10): the verify
+    # chunk evaluates num_draft+1 positions in one bf16 forward whose
+    # logits differ in the last ulps from the plain path's S=1 forwards,
+    # occasionally flipping a greedy argmax (fp32 oracle exact,
+    # test-pinned). A SELF-draft isolates exactly that drift; recording
+    # the agreement rate every round makes verify-chunk numerics
+    # regressions visible. Round-4 observation: 97-99%.
+    spec = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, **common, draft_config=cfg, num_draft=4,
+    )
+    plain_outs = plain(params, prompts)
+    spec_outs = spec(params, prompts, draft_params=params)
+    agree = float(
+        np.mean([
+            np.mean(a[544:][: min(len(a), len(b)) - 544]
+                    == b[544:][: min(len(a), len(b)) - 544])
+            for a, b in zip(plain_outs, spec_outs)
+        ])
+    )
+    _log(
+        f"[bench] 125M serving, bf16 self-draft speculative token "
+        f"agreement vs plain: {agree:.1%} (guard band: round-4 observed "
+        f"97-99%)"
+    )
+
+    # Staggered-arrival latency (VERDICT r4 item 1): requests arrive over
+    # time through the persistent engine's streaming API; TTFT and
+    # per-token latency percentiles come from the engine's own telemetry.
+    eng = plain.engine
+    eng.reset_stats()
+    arrivals = list(prompts[:16])
+    gap = 0.05                       # 20 req/s offered load
+    t0 = _time.perf_counter()
+    nxt = 0
+    while eng.has_work() or nxt < len(arrivals):
+        while (
+            nxt < len(arrivals)
+            and _time.perf_counter() - t0 >= nxt * gap
+        ):
+            eng.add_request(arrivals[nxt])
+            nxt += 1
+        eng.step(params)
+    eng.pop_finished()
+    lat = eng.latency_stats()
+    _log(
+        f"[bench] 125M serving latency (16 staggered arrivals, "
+        f"{1 / gap:.0f} req/s): TTFT p50 {lat['ttft_p50'] * 1e3:.0f} ms / "
+        f"p99 {lat['ttft_p99'] * 1e3:.0f} ms, TPOT p50 "
+        f"{lat['tpot_p50'] * 1e3:.1f} ms, ITL p99 "
+        f"{lat['itl_p99'] * 1e3:.0f} ms, queue wait p50 "
+        f"{lat['queue_wait_p50'] * 1e3:.0f} ms"
+    )
+
+
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.
 
@@ -458,9 +652,17 @@ def main():
     except Exception as e:
         _log(f"[bench] 1.4B decode bench skipped: {type(e).__name__}: {e}")
     try:
+        bench_serving_125m()
+    except Exception as e:
+        _log(f"[bench] serving bench skipped: {type(e).__name__}: {e}")
+    try:
         bench_moe_125m()
     except Exception as e:
         _log(f"[bench] MoE bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_moe_headline()
+    except Exception as e:
+        _log(f"[bench] MoE headline bench skipped: {type(e).__name__}: {e}")
     try:
         bench_reference_configs()
     except Exception as e:

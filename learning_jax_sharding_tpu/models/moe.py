@@ -41,6 +41,73 @@ from learning_jax_sharding_tpu.parallel.logical import (
 )
 
 
+def assign_slots(probs: jax.Array, top_k: int, capacity: int):
+    """THE slot-assignment rule, shared by every dispatch implementation
+    (einsum, scatter, all-to-all) so routing math cannot drift between
+    them: top-k choices, rank-major GShard priority, int32 position
+    cumsum, capacity drop, and surviving-gate renormalization.
+
+    Returns ``(gate_vals, gate_idx, pos, fits, masks)`` for ``probs``
+    of shape (T, E) — T is whatever token GROUP the caller routes over
+    (the global batch for the single-group paths; one shard's tokens for
+    the grouped all-to-all path, GShard's actual formulation)."""
+    t, e = probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)          # (T, k)
+    masks = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)     # (T, k, E)
+    # Rank-major priority: all rank-0 choices claim slots before any
+    # rank-1 choice, matching GShard's dispatch order. Slot counting in
+    # int32: fp32 cumsum would lose exactness past 2^24 slots per expert.
+    flat = masks.transpose(1, 0, 2).reshape(top_k * t, e)      # (k·T, E)
+    pos = jnp.cumsum(flat.astype(jnp.int32), axis=0) - flat.astype(jnp.int32)
+    fits = flat * (pos < capacity)                             # drop overflow
+    pos = pos.reshape(top_k, t, e).transpose(1, 0, 2)          # (T, k, E)
+    fits = fits.reshape(top_k, t, e).transpose(1, 0, 2)        # (T, k, E)
+    if top_k > 1:
+        # Normalize the surviving gate weights per token (GShard).
+        kept_vals = gate_vals * jnp.sum(masks * fits, axis=-1)  # (T, k)
+        denom = jnp.maximum(jnp.sum(kept_vals, axis=-1, keepdims=True), 1e-9)
+        gate_vals = kept_vals / denom
+    else:
+        gate_vals = gate_vals * jnp.sum(masks * fits, axis=-1)
+    return gate_vals, gate_idx, pos, fits, masks
+
+
+def scatter_slot_ids(pos, fits, masks, gate_idx, capacity, num_experts):
+    """Each accepted (token, rank)'s flat slot id ``expert·C + position``
+    (unique — ranks pick distinct experts); dropped entries target the
+    dump slot ``E·C``. Shared by the scatter and all-to-all dispatches."""
+    slot_pos = jnp.sum(pos * masks.astype(jnp.int32), axis=-1)   # (T, k)
+    kept = jnp.sum(masks * fits, axis=-1) > 0                    # (T, k)
+    return jnp.where(
+        kept, gate_idx * capacity + slot_pos, num_experts * capacity
+    ).reshape(-1)                                                # (T·k,)
+
+
+def bucket_tokens(xf, flat_slot, num_experts, capacity, top_k, dtype):
+    """Scatter tokens into the ``(E, C, M)`` slot pool by their flat slot
+    ids (dump row absorbs capacity-dropped entries) — the movement half
+    of the flop-free dispatch, shared by the scatter and all-to-all
+    paths."""
+    t, m = xf.shape
+    token_of = jnp.repeat(jnp.arange(t), top_k)              # (T·k,)
+    pool = jnp.zeros((num_experts * capacity + 1, m), dtype)
+    pool = pool.at[flat_slot].set(xf.astype(dtype)[token_of])
+    return pool[:-1].reshape(num_experts, capacity, m)
+
+
+def combine_slots(expert_out, flat_slot, gate_vals, top_k, dtype):
+    """Gather each (token, rank)'s slot output (dump slot reads zero) and
+    fold the gate weights in one tiny contraction — gate_vals already
+    carries the kept mask and normalization, exactly as the combine
+    einsum's gating. Shared by the scatter and all-to-all paths."""
+    e, c, m = expert_out.shape
+    eflat = jnp.concatenate(
+        [expert_out.reshape(e * c, m), jnp.zeros((1, m), expert_out.dtype)]
+    )
+    per_rank = eflat[flat_slot].reshape(gate_vals.shape[0], top_k, m)
+    return jnp.einsum("tkm,tk->tm", per_rank, gate_vals.astype(dtype))
+
+
 class MoEFeedForward(nn.Module):
     """Top-k routed expert FFN, drop-in for the dense ``FeedForward``.
 
@@ -68,18 +135,25 @@ class MoEFeedForward(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     dispatch: str = "einsum"
+    dispatch_fn: Callable | None = None
     # Token routing implementation — identical math, different cost model:
     # "einsum" builds (T, E, C) one-hot dispatch/combine tensors whose
     #   contractions cost O(E·C·M·T) MXU FLOPs (≈40% of MoE step time at
     #   E=8 top-2, PERF.md round 3) but shard cleanly under EXPERT→model
     #   rules (GSPMD lowers them to the expert all-to-all) — the
-    #   multi-device EP path;
+    #   zero-configuration multi-device EP path;
     # "scatter" computes each (token, rank)'s slot index directly from the
     #   shared cumsum (expert·C + position-in-expert) and moves rows by
     #   .at[].set scatter / gather — O(k·T·M) bytes, no routing FLOPs.
     #   Slot assignment is bit-identical to the einsum path (same cumsum,
     #   same GShard rank-major priority). Single-device oriented:
     #   data-dependent gathers don't partition over EXPERT.
+    # "alltoall" (dispatch_fn = ops.moe_dispatch.make_moe_a2a_fn(mesh)):
+    #   the EXPLICIT expert-parallel path — scatter's flop-free bucketing
+    #   per TOKEN SHARD, then lax.all_to_all over the expert mesh axis
+    #   each way (GShard's grouped formulation: capacity per token group,
+    #   not global — see make_moe_a2a_fn). Deletes the one-hot FLOPs the
+    #   einsum EP path still pays AND partitions over EXPERT.
 
     @nn.compact
     def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
@@ -110,25 +184,53 @@ class MoEFeedForward(nn.Module):
             )
         probs = jax.nn.softmax(logits, axis=-1)                    # (T, E)
 
-        # --- Top-k assignment with capacity --------------------------------
-        gate_vals, gate_idx = jax.lax.top_k(probs, self.top_k)     # (T, k)
-        masks = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)     # (T, k, E)
-        # Rank-major priority: all rank-0 choices claim slots before any
-        # rank-1 choice, matching GShard's dispatch order. Slot counting in
-        # int32: fp32 cumsum would lose exactness past 2^24 slots per expert.
-        flat = masks.transpose(1, 0, 2).reshape(self.top_k * t, e)  # (k·T, E)
-        pos = jnp.cumsum(flat.astype(jnp.int32), axis=0) - flat.astype(jnp.int32)
-        fits = flat * (pos < capacity)                              # drop overflow
-        pos = pos.reshape(self.top_k, t, e).transpose(1, 0, 2)      # (T, k, E)
-        fits = fits.reshape(self.top_k, t, e).transpose(1, 0, 2)    # (T, k, E)
+        # --- Load-balancing aux loss + the expert weights (shared by all
+        # dispatch paths; the all-to-all path routes inside dispatch_fn).
+        w_up = self.param(
+            "up",
+            nn.with_logical_partitioning(self.kernel_init, (EXPERT, EMBED, MLP)),
+            (e, m, self.hidden),
+            self.param_dtype,
+        )
+        w_down = self.param(
+            "down",
+            nn.with_logical_partitioning(self.kernel_init, (EXPERT, MLP, EMBED)),
+            (e, self.hidden, m),
+            self.param_dtype,
+        )
 
-        if self.top_k > 1:
-            # Normalize the surviving gate weights per token (GShard).
-            kept_vals = gate_vals * jnp.sum(masks * fits, axis=-1)  # (T, k)
-            denom = jnp.maximum(jnp.sum(kept_vals, axis=-1, keepdims=True), 1e-9)
-            gate_vals = kept_vals / denom
-        else:
-            gate_vals = gate_vals * jnp.sum(masks * fits, axis=-1)
+        def sow_aux(probs, masks0):
+            load = jnp.mean(masks0, axis=0)                         # (E,)
+            importance = jnp.mean(probs, axis=0)                    # (E,)
+            self.sow(
+                "losses",
+                "load_balancing",
+                self.aux_loss_weight * e * jnp.sum(load * importance),
+                reduce_fn=lambda a, b: a + b,
+                init_fn=lambda: jnp.zeros((), jnp.float32),
+            )
+
+        if self.dispatch == "alltoall":
+            if self.dispatch_fn is None:
+                raise ValueError(
+                    "dispatch='alltoall' needs dispatch_fn — build one with "
+                    "ops.moe_dispatch.make_moe_a2a_fn(mesh)"
+                )
+            sow_aux(
+                probs, jax.nn.one_hot(jnp.argmax(probs, -1), e, dtype=probs.dtype)
+            )
+            out = self.dispatch_fn(
+                x.reshape(t, m), probs, w_up, w_down,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+                dtype=self.dtype,
+            )
+            out = out.reshape(b, s, m)
+            return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
+
+        # --- Top-k assignment with capacity (ONE global group) -------------
+        gate_vals, gate_idx, pos, fits, masks = assign_slots(
+            probs, self.top_k, capacity
+        )
 
         if self.dispatch == "einsum":
             slot = jax.nn.one_hot(
@@ -146,52 +248,30 @@ class MoEFeedForward(nn.Module):
             # target a dump slot past the pool. The expensive part of the
             # einsum path was never the int cumsum above — it is the
             # O(E·C·M·T) dispatch/combine MXU work this branch deletes.
-            slot_pos = jnp.sum(pos * masks.astype(jnp.int32), axis=-1)  # (T,k)
-            kept = jnp.sum(masks * fits, axis=-1) > 0                    # (T,k)
-            flat_slot = jnp.where(
-                kept, gate_idx * capacity + slot_pos, e * capacity
-            ).reshape(-1)                                                # (T·k,)
+            flat_slot = scatter_slot_ids(
+                pos, fits, masks, gate_idx, capacity, e
+            )
         else:
             raise ValueError(
-                f"unknown dispatch {self.dispatch!r}: 'einsum' or 'scatter'"
+                f"unknown dispatch {self.dispatch!r}: 'einsum', 'scatter', "
+                f"or 'alltoall'"
             )
 
         # --- Load-balancing aux loss (Switch eq. 4, on rank-0 choices) -----
-        load = jnp.mean(masks[:, 0], axis=0)                        # (E,)
-        importance = jnp.mean(probs, axis=0)                        # (E,)
-        self.sow(
-            "losses",
-            "load_balancing",
-            self.aux_loss_weight * e * jnp.sum(load * importance),
-            reduce_fn=lambda a, b: a + b,
-            init_fn=lambda: jnp.zeros((), jnp.float32),
-        )
+        sow_aux(probs, masks[:, 0])
 
         # --- Expert computation --------------------------------------------
         xf = x.reshape(t, m)
         if self.dispatch == "scatter":
-            token_of = jnp.repeat(jnp.arange(t), self.top_k)         # (T·k,)
-            pool = jnp.zeros((e * capacity + 1, m), self.dtype)
-            pool = pool.at[flat_slot].set(xf.astype(self.dtype)[token_of])
-            expert_in = pool[:-1].reshape(e, capacity, m)
+            expert_in = bucket_tokens(
+                xf, flat_slot, e, capacity, self.top_k, self.dtype
+            )
         else:
             expert_in = jnp.einsum(
                 "tec,tm->ecm", dispatch.astype(self.dtype), xf.astype(self.dtype)
             )
         expert_in = nn.with_logical_constraint(expert_in, (EXPERT, None, EMBED))
 
-        w_up = self.param(
-            "up",
-            nn.with_logical_partitioning(self.kernel_init, (EXPERT, EMBED, MLP)),
-            (e, m, self.hidden),
-            self.param_dtype,
-        )
-        w_down = self.param(
-            "down",
-            nn.with_logical_partitioning(self.kernel_init, (EXPERT, MLP, EMBED)),
-            (e, self.hidden, m),
-            self.param_dtype,
-        )
         h = jnp.einsum("ecm,emh->ech", expert_in, w_up.astype(self.dtype))
         h = nn.with_logical_constraint(h, (EXPERT, None, MLP))
         h = nn.gelu(h)
@@ -199,19 +279,8 @@ class MoEFeedForward(nn.Module):
         expert_out = nn.with_logical_constraint(expert_out, (EXPERT, None, EMBED))
 
         if self.dispatch == "scatter":
-            # Each (token, rank) gathers its slot's output (dump slot reads
-            # zero) and the gate weights fold in one tiny contraction —
-            # gate_vals already carries the kept mask and normalization,
-            # exactly as the combine einsum's gating.
-            eflat = jnp.concatenate(
-                [
-                    expert_out.reshape(e * capacity, m),
-                    jnp.zeros((1, m), expert_out.dtype),
-                ]
-            )
-            per_rank = eflat[flat_slot].reshape(t, self.top_k, m)
-            out = jnp.einsum(
-                "tkm,tk->tm", per_rank, gate_vals.astype(self.dtype)
+            out = combine_slots(
+                expert_out, flat_slot, gate_vals, self.top_k, self.dtype
             )
         else:
             out = jnp.einsum(

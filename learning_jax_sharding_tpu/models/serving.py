@@ -1,4 +1,4 @@
-"""Continuous batching: slot reuse over the ragged KV cache.
+"""Continuous batching: a PERSISTENT engine serving requests over time.
 
 The last piece of serving realism the rectangular stack could not express
 (after ragged batches, round 3): a REQUEST QUEUE served through a fixed
@@ -7,6 +7,16 @@ with the next queued prompt instead of idling until the whole batch
 drains. The reference has no inference path at all (SURVEY.md §5); this is
 the engine loop that production serving runs.
 
+Round 5 makes the engine PERSISTENT (``ContinuousEngine``): the compiled
+programs, the KV cache, the paged page pool, and the prefix-cache
+registry all live on the engine OBJECT, not inside a ``serve()`` call —
+so a second call re-prefills nothing it already holds (prefix hits span
+calls and sessions), allocates nothing (the cache-creating first refill
+runs once per engine, ever), and requests can be ADMITTED OVER TIME
+(``add_request`` / ``step``) instead of only as a one-shot queue. The
+engine also measures what production engines measure: per-request TTFT,
+per-token latency (TPOT), and inter-token gaps (ITL), with p50/p99.
+
 TPU-shaped design — the host drives, the device stays static:
 
 * two steady-state compiled programs serve any workload — ``refill_step``
@@ -14,7 +24,8 @@ TPU-shaped design — the host drives, the device stays static:
   ragged ``chunk_lengths``, so any mix of fresh prompts, continuing long
   prompts, and idle/decoding rows shares one executable) and
   ``decode_block`` (K tokens per active row, scanned on device) — plus
-  the one-shot cache-creating first refill;
+  the one-shot cache-creating first refill (once per ENGINE, not per
+  call);
 * admission is a pure cache-index RESET (per-row counters zero; stale K/V
   beyond a row's new index is invisible to the causal-at-index masks and
   overwritten as the new request advances) — no cache clearing, no
@@ -44,17 +55,18 @@ TPU-shaped design — the host drives, the device stays static:
 
 Oracles (test-pinned): under GREEDY decoding every request's output is
 bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
-slot reuse, chunk scheduling, and speculation change throughput, never
-results. With ``temperature > 0`` every sampling draw is keyed by
-(REQUEST id, generated position), so a request's sampled stream is
-reproducible across schedules too: the same queue served with any batch
-size, arrival order, or slot assignment yields the same tokens per
-request (given the same ``rng``).
+slot reuse, chunk scheduling, speculation, and engine persistence change
+throughput, never results. With ``temperature > 0`` every sampling draw
+is keyed by (REQUEST id, generated position), so a request's sampled
+stream is reproducible across schedules too: the same queue served with
+any batch size, arrival order, or slot assignment yields the same tokens
+per request (given the same ``rng``).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from typing import Any, Optional
 
@@ -113,35 +125,53 @@ def _reset_rows(
     return jax.tree_util.tree_map_with_path(leaf, cache)
 
 
-def make_continuous_engine(
-    config: TransformerConfig,
-    mesh: Mesh,
-    rules: Rules,
-    *,
-    batch_size: int,
-    max_new_tokens: int,
-    eos_id: Optional[int] = None,
-    refill_chunk: int = 64,
-    decode_block_steps: int = 16,
-    temperature: float = 0.0,
-    top_k: int | None = None,
-    top_p: float | None = None,
-    min_p: float | None = None,
-    vocab_limit: int | None = None,
-    inference_dtype: Any | None = None,
-    dequantize: bool | str = False,
-    draft_config: Optional[TransformerConfig] = None,
-    num_draft: int = 4,
-    paged_pages: Optional[int] = None,
-    page_size: int = 64,
-    prefix_cache: bool = False,
-):
-    """Build ``serve(params, prompts, rng, draft_params) -> list[np.ndarray]``.
+@dataclasses.dataclass
+class _Request:
+    """Host bookkeeping for one request, from arrival to retirement."""
 
-    ``prompts`` is any number of 1-D int32 arrays (the request queue, in
-    arrival order); the result list matches its order, each entry
-    ``[prompt, generated...]`` — generation stops at ``eos_id`` (included
-    in the output) or after ``max_new_tokens``.
+    rid: int
+    prompt: np.ndarray
+    arrival_t: float
+    admit_t: float | None = None
+    first_token_t: float | None = None
+    finish_t: float | None = None
+    tokens: np.ndarray | None = None      # final [prompt, generated...]
+
+
+class ContinuousEngine:
+    """A persistent continuous-batching engine.
+
+    Construction compiles the engine's programs and validates the
+    configuration; the returned object then serves any number of
+    workloads through TWO entry styles:
+
+    * **one-shot**: ``engine.serve(params, prompts, rng=..., draft_params=...)``
+      — drain a whole queue, return outputs in queue order (the original
+      ``make_continuous_engine`` contract, bit-identity oracles intact);
+    * **streaming**: ``engine.add_request(prompt)`` at any time (an
+      arrival process), ``engine.step(params, ...)`` to run ONE scheduler
+      iteration (admission + one refill or decode dispatch), and
+      ``engine.pop_finished()`` to collect completed requests — the shape
+      a serving frontend drives.
+
+    What persists across calls (the round-5 redesign — previously all of
+    this was rebuilt per ``serve()`` call):
+
+    * the compiled programs AND the KV cache — the cache-creating first
+      refill runs once per engine ever (``engine.cache_creations`` counts
+      it, test-pinned at 1 across calls);
+    * the paged page pool and its allocator;
+    * the PREFIX-CACHE registry/refcounts/LRU — a request in a later
+      ``serve()`` call (or streaming session) whose prompt starts with a
+      previously retired prompt's page-aligned prefix is admitted with
+      those pages already mapped: the shared-system-prompt workload this
+      feature exists for. NOTE the registry keys pages by TOKEN BYTES
+      only: it assumes the engine serves ONE fixed set of params. Call
+      ``flush_prefix_cache()`` when swapping checkpoints.
+
+    ``prompts`` entries are 1-D int32 arrays; each result is
+    ``[prompt, generated...]`` — generation stops at ``eos_id`` (included)
+    or after ``max_new_tokens``.
 
     ``batch_size`` fixes the device batch (cache slots); ``refill_chunk``
     fixes the admission chunk length (longer prompts stream through
@@ -168,21 +198,25 @@ def make_continuous_engine(
     ``temperature > 0``: every draw is keyed by (request id, generated
     position) folded into ``rng`` — sampled outputs are reproducible
     across schedules (batch size, arrival order, slot assignment).
+    ``serve()`` numbers requests by QUEUE INDEX per call (the pinned
+    schedule-independence contract); streaming ``add_request`` assigns
+    engine-global monotonic ids.
 
     ``dequantize``: serve QUANTIZED target weights, exactly as
     ``make_generate_fn`` does — ``True`` for an int8/int4 tree from
     ``quantize_tree`` dequantized inside the jitted steps, ``"fused"`` /
     ``"fused_w4a8"`` for an int4 tree streamed through the fused
     dequant-matmul kernels (whole-FF + q/k/v on single-device serving; an
-    injected shard_map matmul under TP). Applies to the TARGET tree only;
-    a speculative draft serves at ``inference_dtype``. Greedy engine
+    injected shard_map matmul under TP). ``draft_dequantize`` applies the
+    same policy (``True`` → in-jit dequant) to the DRAFT tree — pass a
+    quantized draft to ``serve(..., draft_params=...)``. Greedy engine
     outputs are bit-identical to the corresponding
     ``make_generate_fn(dequantize=...)`` single runs (test-pinned).
 
     ``paged_pages``: PAGED KV cache — each layer's K/V live in a physical
     pool of ``paged_pages`` pages of ``page_size`` tokens (page 0 is a
     reserved scratch target), indirected through per-row block tables
-    that THIS host loop owns: pages are allocated on demand as a row's
+    that the host loop owns: pages are allocated on demand as a row's
     index approaches a page boundary and freed the moment the request
     retires, so cache HBM scales with tokens actually in flight instead
     of ``batch_size × max_seq_len`` — and slot count is no longer bounded
@@ -191,769 +225,1273 @@ def make_continuous_engine(
     raises if a dispatch would need more pages than the pool holds.
     ``prefix_cache`` (paged only): PREFIX CACHING — when a request
     retires, the pages fully covered by its prompt are RETAINED (keyed by
-    their page-aligned token prefix) instead of freed; a later request in
-    the same ``serve`` call whose prompt starts with the same tokens is
-    admitted with those pages already in its block table and its counters
-    set to the shared length, so the shared prefix is neither re-stored
-    nor re-prefilled — both the HBM and the prefill compute are saved.
+    their page-aligned token prefix) instead of freed; a later request
+    whose prompt starts with the same tokens is admitted with those pages
+    already in its block table and its counters set to the shared length,
+    so the shared prefix is neither re-stored nor re-prefilled — both the
+    HBM and the prefill compute are saved, ACROSS ``serve()`` calls.
     Sharing is all-or-nothing per page, capped at ``len(prompt) - 1`` (the
     last prompt token always recomputes: its logits seed generation), and
     reference-counted; retained pages with no references are evicted LRU
-    when the allocator runs dry, so the pool never shrinks. Outputs are
+    when the allocator runs dry (chain tails strictly before their roots,
+    across retirements), so the pool never shrinks. Outputs are
     bit-identical to the uncached engine (test-pinned): shared pages hold
-    exactly the bytes the evicted computation wrote. Scope: one ``serve``
-    call (the caches themselves live per call).
+    exactly the bytes the evicted computation wrote.
 
-    After each ``serve`` call, ``serve.last_stats`` reports what the run
-    measured: ``page_high_water`` / ``pages_total`` (paged — the
-    footprint), ``prefix_hits`` / ``prefix_pages_reused`` (prefix
-    caching), and ``spec_accepted`` / ``spec_proposed`` /
-    ``spec_accept_rate`` (speculative — verifier acceptance before
-    EOS/budget truncation, the number to tune ``num_draft`` against);
-    ``None`` when none of the modes are on.
+    After each ``serve`` call (and on demand via ``latency_stats()``):
+
+    * ``last_stats`` — ``page_high_water`` / ``pages_total`` (paged — the
+      LIVE footprint, excluding retained reference-free prefix pages,
+      which are reported separately as ``prefix_pages_retained``),
+      ``prefix_hits`` / ``prefix_pages_reused`` (prefix caching), and
+      ``spec_accepted`` / ``spec_proposed`` / ``spec_accept_rate``
+      (speculative — verifier acceptance before EOS/budget truncation,
+      the number to tune ``num_draft`` against); ``None`` when none of
+      the modes are on.
+    * ``last_latency`` — per-request latency telemetry: ``ttft_p50/p99``
+      (arrival → first generated token visible on the host),
+      ``tpot_p50/p99`` (per-request mean inter-token time after the
+      first), ``itl_p50/p99`` (raw host-visibility gaps — block-granular
+      by design: tokens land ``decode_block_steps`` at a time), and
+      ``queue_wait_p50/p99`` (arrival → slot admission).
     """
-    if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
-        raise ValueError(
-            "batch_size, refill_chunk, decode_block_steps must be >= 1"
-        )
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if refill_chunk > config.max_seq_len:
-        raise ValueError(
-            f"refill_chunk ({refill_chunk}) exceeds max_seq_len "
-            f"({config.max_seq_len})"
-        )
-    speculative = draft_config is not None
-    if speculative:
-        if num_draft < 1:
-            raise ValueError(f"num_draft must be >= 1, got {num_draft}")
-        if draft_config.vocab_size != config.vocab_size:
-            raise ValueError(
-                f"target vocab {config.vocab_size} != draft vocab "
-                f"{draft_config.vocab_size}"
-            )
-    paged = paged_pages is not None
-    if prefix_cache and not paged:
-        raise ValueError(
-            "prefix_cache requires the paged KV cache (paged_pages=N): "
-            "sharing is expressed through block-table entries"
-        )
 
-    def check_paged(name, c):
-        # ONE copy of the paged preconditions, applied to the target and
-        # (when speculative) the draft — their caches page side by side.
-        if resolve_decode_backend(c.decode_attention) != "blocked":
-            raise ValueError(
-                f"paged_pages requires the blocked decode backend for the "
-                f"{name} config (decode_attention='blocked', or 'auto' on "
-                f"TPU)"
-            )
-        if c.max_seq_len % page_size:
-            raise ValueError(
-                f"{name} max_seq_len ({c.max_seq_len}) must be a multiple "
-                f"of page_size ({page_size})"
-            )
-
-    def pagedify(c):
-        return dataclasses.replace(
-            c, decode_paged=True, decode_page_count=paged_pages,
-            decode_block_k=page_size,
-        )
-
-    if paged:
-        if paged_pages < 2:
-            raise ValueError(
-                "paged_pages must be >= 2 (page 0 is the scratch page)"
-            )
-        check_paged("target", config)
-    cfg = derive_decode_config(config, inference_dtype, mesh=mesh, rules=rules)
-    cfg = dataclasses.replace(cfg, decode_ragged=True)
-    cfg, fused = apply_dequantize_policy(cfg, dequantize, mesh, rules)
-    if paged:
-        cfg = pagedify(cfg)
-    model = Transformer(cfg)
-    # The quantization options apply to the TARGET tree only — a draft is
-    # small by design and serves at inference_dtype.
-    apply = make_cached_apply(
-        model, dequantize=bool(dequantize) and not fused,
-        dequant_dtype=cfg.param_dtype,
-    )
-    maybe_cast = make_param_caster(
-        inference_dtype, dequantize=bool(dequantize)
-    )
-    if speculative:
-        if paged:
-            check_paged("draft", draft_config)
-        d_cfg = derive_decode_config(
-            draft_config, inference_dtype, mesh=mesh, rules=rules
-        )
-        d_cfg = dataclasses.replace(d_cfg, decode_ragged=True)
-        if paged:
-            d_cfg = pagedify(d_cfg)
-        d_apply = make_cached_apply(Transformer(d_cfg))
-
-    def _greedy(logits):
-        return greedy_pick(logits, vocab_limit)
-
-    def row_keys(rng, rid, pos):
-        """(B,) keys from (request id, generated position): the stream a
-        request samples from depends only on its own identity and how far
-        it has generated — never on scheduling."""
-
-        def one(r, p):
-            return jax.random.fold_in(jax.random.fold_in(rng, r), p)
-
-        return jax.vmap(one)(rid, pos)
-
-    def spec_keys(rng, rid, pos, tag):
-        """Per-REQUEST rejection streams: ``speculative._pos_key``'s
-        position+tag derivation (THE definition of the three stream roles)
-        under a request-id fold — position-keyed, so a rolled-back
-        position re-derives its draws and a round/block boundary lands
-        nowhere in the stream (schedule independence, test-pinned)."""
-
-        def one(r, p):
-            return _pos_key(jax.random.fold_in(rng, r), p, tag)
-
-        return jax.vmap(one)(rid, pos)
-
-    def to_flogits(logits):
-        """The filtered sampling distribution in logit space — shared with
-        ``sample_rows`` via ``generate.filtered_logits`` (THE definition
-        of the filter order) so the speculative acceptance distribution
-        cannot drift from what plain sampling draws."""
-        return filtered_logits(
-            logits, temperature, top_k, top_p, min_p, vocab_limit
-        )
-
-    def sample_rows(logits, rng, rid, pos):
-        """Per-row sampling with (request, position) keys; greedy ignores
-        the keys entirely (deterministic)."""
-        if temperature == 0.0:
-            return _greedy(logits)
-        return jax.vmap(jax.random.categorical)(
-            row_keys(rng, rid, pos), to_flogits(logits)
-        ).astype(jnp.int32)
-
-    def _refill(params, d_params, cache, chunk, lengths, rid, rng):
-        # Run the chunk through the target (and the draft, whose cache
-        # must mirror the target's valid prefix for verification); the
-        # pick is each row's first generated token — position 0 of its
-        # stream.
-        if speculative:
-            t_cache, d_cache = cache
-            logits, t_cache = apply(params, t_cache, chunk, lengths)
-            _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
-            cache = (t_cache, d_cache)
-        else:
-            logits, cache = apply(params, cache, chunk, lengths)
-        pick = jnp.take_along_axis(
-            logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-        )[:, 0]
-        tok = sample_rows(pick, rng, rid, jnp.zeros_like(rid))
-        return tok, cache
-
-    @jax.jit
-    def refill_step(
-        params, d_params, cache, chunk, lengths, reset_mask, reset_to,
-        rid, rng,
+    def __init__(
+        self,
+        config: TransformerConfig,
+        mesh: Mesh,
+        rules: Rules,
+        *,
+        batch_size: int,
+        max_new_tokens: int,
+        eos_id: Optional[int] = None,
+        refill_chunk: int = 64,
+        decode_block_steps: int = 16,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        min_p: float | None = None,
+        vocab_limit: int | None = None,
+        inference_dtype: Any | None = None,
+        dequantize: bool | str = False,
+        draft_config: Optional[TransformerConfig] = None,
+        draft_dequantize: bool = False,
+        num_draft: int = 4,
+        paged_pages: Optional[int] = None,
+        page_size: int = 64,
+        prefix_cache: bool = False,
     ):
-        # Admission: set the admitted rows' counters (0, or the shared-
-        # prefix length under prefix caching), then run the chunk — every
-        # row's cache advance is its own valid length (0 for rows that
-        # are decoding or idle this call). The cache-None first call
-        # routes to first_refill instead.
+        if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
+            raise ValueError(
+                "batch_size, refill_chunk, decode_block_steps must be >= 1"
+            )
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if refill_chunk > config.max_seq_len:
+            raise ValueError(
+                f"refill_chunk ({refill_chunk}) exceeds max_seq_len "
+                f"({config.max_seq_len})"
+            )
+        speculative = draft_config is not None
         if speculative:
-            cache = tuple(
-                _reset_rows(c, reset_mask, reset_to) for c in cache
+            if num_draft < 1:
+                raise ValueError(f"num_draft must be >= 1, got {num_draft}")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"target vocab {config.vocab_size} != draft vocab "
+                    f"{draft_config.vocab_size}"
+                )
+        if draft_dequantize and not speculative:
+            raise ValueError("draft_dequantize requires draft_config")
+        paged = paged_pages is not None
+        if prefix_cache and not paged:
+            raise ValueError(
+                "prefix_cache requires the paged KV cache (paged_pages=N): "
+                "sharing is expressed through block-table entries"
+            )
+
+        def check_paged(name, c):
+            # ONE copy of the paged preconditions, applied to the target and
+            # (when speculative) the draft — their caches page side by side.
+            if resolve_decode_backend(c.decode_attention) != "blocked":
+                raise ValueError(
+                    f"paged_pages requires the blocked decode backend for the "
+                    f"{name} config (decode_attention='blocked', or 'auto' on "
+                    f"TPU)"
+                )
+            if c.max_seq_len % page_size:
+                raise ValueError(
+                    f"{name} max_seq_len ({c.max_seq_len}) must be a multiple "
+                    f"of page_size ({page_size})"
+                )
+
+        def pagedify(c):
+            return dataclasses.replace(
+                c, decode_paged=True, decode_page_count=paged_pages,
+                decode_block_k=page_size,
+            )
+
+        if paged:
+            if paged_pages < 2:
+                raise ValueError(
+                    "paged_pages must be >= 2 (page 0 is the scratch page)"
+                )
+            check_paged("target", config)
+        cfg = derive_decode_config(
+            config, inference_dtype, mesh=mesh, rules=rules
+        )
+        cfg = dataclasses.replace(cfg, decode_ragged=True)
+        cfg, fused = apply_dequantize_policy(cfg, dequantize, mesh, rules)
+        if paged:
+            cfg = pagedify(cfg)
+        model = Transformer(cfg)
+        apply = make_cached_apply(
+            model, dequantize=bool(dequantize) and not fused,
+            dequant_dtype=cfg.param_dtype,
+        )
+        maybe_cast = make_param_caster(
+            inference_dtype, dequantize=bool(dequantize)
+        )
+        d_cfg = None
+        if speculative:
+            if paged:
+                check_paged("draft", draft_config)
+            d_cfg = derive_decode_config(
+                draft_config, inference_dtype, mesh=mesh, rules=rules
+            )
+            d_cfg = dataclasses.replace(d_cfg, decode_ragged=True)
+            if paged:
+                d_cfg = pagedify(d_cfg)
+            # The draft may be served quantized too (`draft_dequantize` —
+            # in-jit int8/int4 dequant, the non-fused policy: a draft is
+            # small, the fused kernels' launch floor would dominate it).
+            d_apply = make_cached_apply(
+                Transformer(d_cfg), dequantize=draft_dequantize,
+                dequant_dtype=d_cfg.param_dtype,
+            )
+            d_cast = make_param_caster(
+                inference_dtype, dequantize=draft_dequantize
             )
         else:
-            cache = _reset_rows(cache, reset_mask, reset_to)
-        return _refill(params, d_params, cache, chunk, lengths, rid, rng)
+            d_apply = None
+            d_cast = maybe_cast
 
-    # Cache creation needs an apply without a cache; same program shape as
-    # refill_step minus the reset (Flax creates the zeroed caches —
-    # make_cached_apply treats a None cache as the creating call).
-    @jax.jit
-    def first_refill(params, d_params, chunk, lengths, rid, rng):
-        cache = (None, None) if speculative else None
-        return _refill(params, d_params, cache, chunk, lengths, rid, rng)
+        def _greedy(logits):
+            return greedy_pick(logits, vocab_limit)
 
-    @jax.jit
-    def decode_block(params, cache, tok, active, remaining, rid, rng):
-        """``decode_block_steps`` tokens per call, scanned ON DEVICE — the
-        host loop costs one dispatch/readback per BLOCK, not per token
-        (measured on the tunneled chip: per-token host stepping ran 30×
-        slower than the same work scanned). Rows that emit ``eos`` OR
-        exhaust their per-row ``remaining`` budget flip inactive IN-scan —
-        chunk_lengths 0 from then on, so a retired row stops consuming
-        cache mid-block and its index can never pass its admission
-        budget."""
+        def row_keys(rng, rid, pos):
+            """(B,) keys from (request id, generated position): the stream a
+            request samples from depends only on its own identity and how far
+            it has generated — never on scheduling."""
 
-        def body(carry, _):
-            tok, active, remaining, cache = carry
-            logits, cache = apply(params, cache, tok[:, None], active)
-            # This draw's generated position: the row has already emitted
-            # max_new_tokens - remaining tokens.
-            pos = max_new_tokens - remaining
-            nxt = sample_rows(logits[:, -1], rng, rid, pos)
-            nxt = jnp.where(active == 1, nxt, tok)
-            remaining = remaining - active
-            if eos_id is not None:
-                active = active * (nxt != eos_id).astype(jnp.int32)
-            active = active * (remaining > 0).astype(jnp.int32)
-            return (nxt, active, remaining, cache), nxt
+            def one(r, p):
+                return jax.random.fold_in(jax.random.fold_in(rng, r), p)
 
-        (tok, active, remaining, cache), toks = jax.lax.scan(
-            body, (tok, active, remaining, cache), None,
-            length=decode_block_steps,
-        )
-        return toks.T, active, remaining, cache   # (B, K) tokens
+            return jax.vmap(one)(rid, pos)
 
-    @jax.jit
-    def decode_block_spec(
-        params, d_params, t_cache, d_cache, tok, active, pos, remaining,
-        rid, rng,
-    ):
-        """Speculative decode block: ``decode_block_steps`` draft-verify
-        ROUNDS, each emitting 1..num_draft+1 tokens per row with PER-ROW
-        acceptance and rollback (the ragged-cache machinery of
-        ``models/speculative.py::generate_ragged``, driven inside the
-        engine's scan). ``pos`` is each row's current cache index
-        (prompt_len + emitted - 1); EOS and budget truncate a round's
-        per-row emission exactly, so the buffer/counts the block returns
-        are final — the host appends them verbatim.
+        def spec_keys(rng, rid, pos, tag):
+            """Per-REQUEST rejection streams: ``speculative._pos_key``'s
+            position+tag derivation (THE definition of the three stream roles)
+            under a request-id fold — position-keyed, so a rolled-back
+            position re-derives its draws and a round/block boundary lands
+            nowhere in the stream (schedule independence, test-pinned)."""
 
-        ``temperature > 0``: speculative SAMPLING (Leviathan rejection) —
-        the draft proposes from the filtered distribution, acceptance is
-        ``u·q < p`` per position, the slot-m token samples the residual
-        ``norm(max(p − q, 0))`` — with every draw keyed by (request id,
-        generated position, stream tag) via ``spec_keys``, so a request's
-        sampled output is independent of batch composition, round
-        boundaries, and block boundaries (rollback re-derives draws)."""
-        width = decode_block_steps * (num_draft + 1)
-        idx = jnp.arange(num_draft + 1)
+            def one(r, p):
+                return _pos_key(jax.random.fold_in(rng, r), p, tag)
 
-        def body(carry, _):
-            (tok, active, pos, remaining, count, buffer, acc, prop,
-             t_cache, d_cache) = carry
-            # Each row's next GENERATED position (the refill's pick was
-            # position 0 of its stream).
-            gen = max_new_tokens - remaining
+            return jax.vmap(one)(rid, pos)
 
-            # 1. Draft proposes per row (frozen rows ride with length 0).
-            if temperature == 0.0:
-
-                def draft_step(c, j):
-                    prev, dc = c
-                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
-                    nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
-                    return (nxt, dc), nxt
-
-                (last_d, d_cache), drafts = jax.lax.scan(
-                    draft_step, (tok, d_cache), jnp.arange(num_draft)
-                )
-                q_all = None
-            else:
-
-                def draft_step(c, j):
-                    prev, dc = c
-                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
-                    fl = to_flogits(lg[:, -1])
-                    nxt = jax.vmap(jax.random.categorical)(
-                        spec_keys(rng, rid, gen + j, 0), fl
-                    ).astype(jnp.int32)
-                    nxt = jnp.where(active == 1, nxt, prev)
-                    return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
-
-                (last_d, d_cache), (drafts, q_all) = jax.lax.scan(
-                    draft_step, (tok, d_cache), jnp.arange(num_draft)
-                )
-            drafts = drafts.T
-            _, d_cache = d_apply(d_params, d_cache, last_d[:, None], active)
-
-            # 2. One chunked target verify.
-            chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
-            t_logits, t_cache = apply(
-                params, t_cache, chunk, active * (num_draft + 1)
+        def to_flogits(logits):
+            """The filtered sampling distribution in logit space — shared with
+            ``sample_rows`` via ``generate.filtered_logits`` (THE definition
+            of the filter order) so the speculative acceptance distribution
+            cannot drift from what plain sampling draws."""
+            return filtered_logits(
+                logits, temperature, top_k, top_p, min_p, vocab_limit
             )
 
-            # 3. Per-row acceptance; emitted = accepted drafts + the
-            #    bonus/correction (greedy) or residual sample (sampling) —
-            #    the shared cores, models/speculative.py.
+        def sample_rows(logits, rng, rid, pos):
+            """Per-row sampling with (request, position) keys; greedy ignores
+            the keys entirely (deterministic)."""
             if temperature == 0.0:
-                m, emitted, _ = greedy_accept_emit(drafts, _greedy(t_logits))
+                return _greedy(logits)
+            return jax.vmap(jax.random.categorical)(
+                row_keys(rng, rid, pos), to_flogits(logits)
+            ).astype(jnp.int32)
+
+        def _refill(params, d_params, cache, chunk, lengths, rid, rng):
+            # Run the chunk through the target (and the draft, whose cache
+            # must mirror the target's valid prefix for verification); the
+            # pick is each row's first generated token — position 0 of its
+            # stream.
+            if speculative:
+                t_cache, d_cache = cache
+                logits, t_cache = apply(params, t_cache, chunk, lengths)
+                _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
+                cache = (t_cache, d_cache)
             else:
-                q_all = jnp.moveaxis(q_all, 0, 1)        # (B, num_draft, V)
-                p_all = jax.nn.softmax(to_flogits(t_logits), axis=-1)
-                p_at = jnp.take_along_axis(
-                    p_all[:, :num_draft], drafts[..., None], axis=-1
-                )[..., 0]
-                q_at = jnp.take_along_axis(
-                    q_all, drafts[..., None], axis=-1
-                )[..., 0]
-                u = jax.vmap(
-                    lambda j: jax.vmap(jax.random.uniform)(
-                        spec_keys(rng, rid, gen + j, 1)
-                    ),
-                    out_axes=1,
-                )(jnp.arange(num_draft))                 # (B, num_draft)
-                accept = u * q_at < p_at
-                m = jnp.sum(
-                    jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
-                )
-                q_pad = jnp.concatenate(
-                    [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
-                )
-
-                def take_m(x):
-                    return jnp.take_along_axis(
-                        x, m[:, None, None], axis=1
-                    )[:, 0]
-
-                p_m = take_m(p_all)
-                residual = jnp.maximum(p_m - take_m(q_pad), 0.0)
-                mass = jnp.sum(residual, axis=-1, keepdims=True)
-                residual = jnp.where(mass > 0, residual / mass, p_m)
-                token_m = jax.vmap(jax.random.categorical)(
-                    spec_keys(rng, rid, gen + m, 2), jnp.log(residual)
-                ).astype(jnp.int32)
-                emitted = emit_vector(drafts, m, token_m)
-
-            # 4. Truncate each row's emission at EOS and at its budget.
-            raw = 1 + m
-            if eos_id is not None:
-                hit = (emitted == eos_id) & (idx[None, :] < raw[:, None])
-                any_hit = jnp.any(hit, axis=1)
-                first = jnp.argmax(hit, axis=1)
-                n_stop = jnp.where(any_hit, first + 1, raw)
-            else:
-                any_hit = jnp.zeros_like(active, dtype=bool)
-                n_stop = raw
-            n_emit = jnp.minimum(n_stop, remaining) * active
-
-            # 5. Append at each row's own offset; advance the pending
-            #    token to the last emitted one.
-            buffer = row_update_masked(
-                buffer, emitted, count, n_emit, seq_dim=1
-            )
-            new_tok = jnp.take_along_axis(
-                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+                logits, cache = apply(params, cache, chunk, lengths)
+            pick = jnp.take_along_axis(
+                logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
             )[:, 0]
-            tok = jnp.where(active == 1, new_tok, tok)
+            tok = sample_rows(pick, rng, rid, jnp.zeros_like(rid))
+            return tok, cache
 
-            # 6. Per-row rollback: the row's new index is pos + n_emit
-            #    (frozen rows: +0, i.e. their current index — one
-            #    broadcast serves all rows).
-            pos = pos + n_emit
-            t_cache = _rollback(t_cache, pos)
-            d_cache = _rollback(d_cache, pos)
+        @jax.jit
+        def refill_step(
+            params, d_params, cache, chunk, lengths, reset_mask, reset_to,
+            rid, rng,
+        ):
+            # Admission: set the admitted rows' counters (0, or the shared-
+            # prefix length under prefix caching), then run the chunk — every
+            # row's cache advance is its own valid length (0 for rows that
+            # are decoding or idle this call). The cache-None first call
+            # routes to first_refill instead.
+            if speculative:
+                cache = tuple(
+                    _reset_rows(c, reset_mask, reset_to) for c in cache
+                )
+            else:
+                cache = _reset_rows(cache, reset_mask, reset_to)
+            return _refill(params, d_params, cache, chunk, lengths, rid, rng)
 
-            remaining = remaining - n_emit
-            count = count + n_emit
-            # Acceptance telemetry: verifier acceptance per live round
-            # (before EOS/budget truncation — the DRAFT's quality, which
-            # is what the operator tunes num_draft against).
-            acc = acc + m * active
-            prop = prop + active * num_draft
-            stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
-            active = (
-                active
-                * (remaining > 0).astype(jnp.int32)
-                * (1 - stopped_eos.astype(jnp.int32))
-            )
-            return (
-                tok, active, pos, remaining, count, buffer, acc, prop,
-                t_cache, d_cache
-            ), None
+        # Cache creation needs an apply without a cache; same program shape as
+        # refill_step minus the reset (Flax creates the zeroed caches —
+        # make_cached_apply treats a None cache as the creating call).
+        @jax.jit
+        def first_refill(params, d_params, chunk, lengths, rid, rng):
+            cache = (None, None) if speculative else None
+            return _refill(params, d_params, cache, chunk, lengths, rid, rng)
 
-        b = tok.shape[0]
-        buffer = jnp.zeros((b, width), jnp.int32)
-        count = jnp.zeros((b,), jnp.int32)
-        acc = jnp.zeros((b,), jnp.int32)
-        prop = jnp.zeros((b,), jnp.int32)
-        (tok, active, pos, remaining, count, buffer, acc, prop,
-         t_cache, d_cache), _ = (
-            jax.lax.scan(
-                body,
-                (tok, active, pos, remaining, count, buffer, acc, prop,
-                 t_cache, d_cache),
-                None,
+        @jax.jit
+        def decode_block(params, cache, tok, active, remaining, rid, rng):
+            """``decode_block_steps`` tokens per call, scanned ON DEVICE — the
+            host loop costs one dispatch/readback per BLOCK, not per token
+            (measured on the tunneled chip: per-token host stepping ran 30×
+            slower than the same work scanned). Rows that emit ``eos`` OR
+            exhaust their per-row ``remaining`` budget flip inactive IN-scan —
+            chunk_lengths 0 from then on, so a retired row stops consuming
+            cache mid-block and its index can never pass its admission
+            budget."""
+
+            def body(carry, _):
+                tok, active, remaining, cache = carry
+                logits, cache = apply(params, cache, tok[:, None], active)
+                # This draw's generated position: the row has already emitted
+                # max_new_tokens - remaining tokens.
+                pos = max_new_tokens - remaining
+                nxt = sample_rows(logits[:, -1], rng, rid, pos)
+                nxt = jnp.where(active == 1, nxt, tok)
+                remaining = remaining - active
+                if eos_id is not None:
+                    active = active * (nxt != eos_id).astype(jnp.int32)
+                active = active * (remaining > 0).astype(jnp.int32)
+                return (nxt, active, remaining, cache), nxt
+
+            (tok, active, remaining, cache), toks = jax.lax.scan(
+                body, (tok, active, remaining, cache), None,
                 length=decode_block_steps,
             )
-        )
-        return buffer, count, acc, prop, active, remaining, t_cache, d_cache
+            return toks.T, active, remaining, cache   # (B, K) tokens
 
-    def serve(params, prompts, rng=None, draft_params=None):
-        if speculative and draft_params is None:
-            raise ValueError(
-                "draft_config was given: pass draft_params to serve()"
-            )
-        if not speculative and draft_params is not None:
-            raise ValueError("draft_params requires draft_config")
-        rng = jax.random.key(0) if rng is None else rng
-        b = batch_size
-        headroom = num_draft + 1 if speculative else 0
-        prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
-        budget_cfgs = (
-            [("target", cfg), ("draft", d_cfg)] if speculative
-            else [("target", cfg)]
-        )
-        for p in prompts:
-            if p.size < 1:
-                raise ValueError("empty prompt")
-            for name, c in budget_cfgs:
-                # The draft cache must fit the same worst case as the
-                # target's: its index walks in lockstep through prefill,
-                # proposals, and rollback.
-                check_sequence_budget(
-                    p.size + max_new_tokens + headroom, c.max_seq_len,
-                    f"prompt ({p.size}) + max_new_tokens ({max_new_tokens})"
-                    + (f" + draft headroom ({headroom})" if headroom else "")
-                    + f" for {name}",
-                )
-        params = maybe_cast(params)
-        if speculative:
-            draft_params = maybe_cast(draft_params)
-        queue = deque(enumerate(prompts))
-        results: dict[int, list[int]] = {}
+        @jax.jit
+        def decode_block_spec(
+            params, d_params, t_cache, d_cache, tok, active, pos, remaining,
+            rid, rng,
+        ):
+            """Speculative decode block: ``decode_block_steps`` draft-verify
+            ROUNDS, each emitting 1..num_draft+1 tokens per row with PER-ROW
+            acceptance and rollback (the ragged-cache machinery of
+            ``models/speculative.py::generate_ragged``, driven inside the
+            engine's scan). ``pos`` is each row's current cache index
+            (prompt_len + emitted - 1); EOS and budget truncate a round's
+            per-row emission exactly, so the buffer/counts the block returns
+            are final — the host appends them verbatim.
 
-        # Host-side slot state. A slot is: idle (req < 0), refilling
-        # (pending prompt tokens remain), or decoding (active).
-        req = [-1] * b                 # request id per slot
-        plen = [0] * b                 # admitted prompt length per slot
-        pending: list[np.ndarray] = [np.zeros((0,), np.int32)] * b
-        emitted = [0] * b
-        out: list[list[int]] = [[] for _ in range(b)]
-        tok = np.zeros((b,), np.int32)
-        active = np.zeros((b,), bool)
-        cache = None
-        spec_accepted = spec_proposed = 0   # acceptance telemetry
+            ``temperature > 0``: speculative SAMPLING (Leviathan rejection) —
+            the draft proposes from the filtered distribution, acceptance is
+            ``u·q < p`` per position, the slot-m token samples the residual
+            ``norm(max(p − q, 0))`` — with every draw keyed by (request id,
+            generated position, stream tag) via ``spec_keys``, so a request's
+            sampled output is independent of batch composition, round
+            boundaries, and block boundaries (rollback re-derives draws)."""
+            width = decode_block_steps * (num_draft + 1)
+            idx = jnp.arange(num_draft + 1)
 
-        if paged:
-            # Host-owned page allocator: page 0 is scratch; a slot holds a
-            # prefix of logical blocks mapped to arbitrary physical pages.
-            free_pages = list(range(paged_pages - 1, 0, -1))
-            held: list[list[int]] = [[] for _ in range(b)]
-            t_cap = cfg.max_seq_len // page_size
-            table_np = np.zeros((b, t_cap), np.int32)
-            high_water = 0
-            tables_dirty = True
-            # Prefix-cache state: page-aligned token-prefix bytes → the
-            # page holding that prefix's LAST page of K/V; refcounts for
-            # pages shared by live slots; ref-0 registered pages stay
-            # evictable in LRU order (dict preserves insertion order).
-            registry: dict[bytes, int] = {}
-            key_of_page: dict[int, bytes] = {}
-            refcnt: dict[int, int] = {}
-            cached_lru: dict[int, None] = {}
-            shared_count = [0] * b     # leading registry pages per slot
-            prefix_hits = prefix_pages_reused = 0
+            def body(carry, _):
+                (tok, active, pos, remaining, count, buffer, acc, prop,
+                 t_cache, d_cache) = carry
+                # Each row's next GENERATED position (the refill's pick was
+                # position 0 of its stream).
+                gen = max_new_tokens - remaining
 
-            def take_page():
-                if free_pages:
-                    return free_pages.pop()
-                if cached_lru:
-                    # Evict the oldest reference-free cached page — the
-                    # pool must serve live requests before retained ones.
-                    pid = next(iter(cached_lru))
-                    del cached_lru[pid]
-                    del registry[key_of_page.pop(pid)]
-                    del refcnt[pid]
-                    return pid
-                raise RuntimeError(
-                    f"page pool exhausted ({paged_pages - 1} pages "
-                    f"× {page_size} tokens): raise paged_pages or "
-                    "lower concurrency"
-                )
+                # 1. Draft proposes per row (frozen rows ride with length 0).
+                if temperature == 0.0:
 
-            def ensure(slot, tokens_through):
-                # Allocate pages so positions [0, tokens_through) are
-                # mapped before the dispatch that writes them.
-                nonlocal high_water, tables_dirty
-                need = -(-int(tokens_through) // page_size)
-                while len(held[slot]) < need:
-                    p = take_page()
-                    table_np[slot, len(held[slot])] = p
-                    held[slot].append(p)
-                    tables_dirty = True
-                high_water = max(
-                    high_water, (paged_pages - 1) - len(free_pages)
-                )
+                    def draft_step(c, j):
+                        prev, dc = c
+                        lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                        nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
+                        return (nxt, dc), nxt
 
-            def release(slot):
-                nonlocal tables_dirty
-                if prefix_cache:
-                    pages, ns = held[slot], shared_count[slot]
-                    # Private pages: RETAIN the ones fully inside the
-                    # prompt (immutable once written — generation never
-                    # rewrites earlier positions) under their token-prefix
-                    # key; free the rest (generated-region K/V). DEEPEST
-                    # page first into the LRU — admission chains break at
-                    # the first missing page, so eviction must take chain
-                    # tails before roots or the stranded descendants
-                    # retain HBM with zero hit potential.
-                    p_toks = np.asarray(
-                        out[slot][: plen[slot]], np.int32
+                    (last_d, d_cache), drafts = jax.lax.scan(
+                        draft_step, (tok, d_cache), jnp.arange(num_draft)
                     )
-                    full = plen[slot] // page_size
-                    for j in range(len(pages) - 1, ns - 1, -1):
-                        pid = pages[j]
-                        if j < full:
-                            key = p_toks[: (j + 1) * page_size].tobytes()
-                            if key not in registry:
-                                registry[key] = pid
-                                key_of_page[pid] = key
-                                refcnt[pid] = 0
-                                cached_lru[pid] = None
-                                continue
-                        free_pages.append(pid)
-                    for pid in reversed(pages[:ns]):  # drop shared refs,
-                        refcnt[pid] -= 1              # tails first too
-                        if refcnt[pid] == 0:
-                            cached_lru[pid] = None
-                    shared_count[slot] = 0
+                    q_all = None
                 else:
-                    free_pages.extend(held[slot])
-                held[slot] = []
-                table_np[slot, :] = 0
-                tables_dirty = True
 
-            def set_tables(cache):
-                # Push the host tables into every layer's block_table leaf
-                # (target AND draft trees; the draft's table may be
-                # narrower — same prefix, same page ids). Skipped entirely
-                # when no allocation changed since the last push — the
-                # steady-state decode loop mostly doesn't allocate.
-                nonlocal tables_dirty
-                if not tables_dirty:
-                    return cache
-                tables_dirty = False
+                    def draft_step(c, j):
+                        prev, dc = c
+                        lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                        fl = to_flogits(lg[:, -1])
+                        nxt = jax.vmap(jax.random.categorical)(
+                            spec_keys(rng, rid, gen + j, 0), fl
+                        ).astype(jnp.int32)
+                        nxt = jnp.where(active == 1, nxt, prev)
+                        return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
 
-                def leaf(path, x):
-                    if getattr(path[-1], "key", None) == "block_table":
-                        return jnp.asarray(table_np[:, : x.shape[1]])
-                    return x
+                    (last_d, d_cache), (drafts, q_all) = jax.lax.scan(
+                        draft_step, (tok, d_cache), jnp.arange(num_draft)
+                    )
+                drafts = drafts.T
+                _, d_cache = d_apply(
+                    d_params, d_cache, last_d[:, None], active
+                )
 
-                return jax.tree_util.tree_map_with_path(leaf, cache)
+                # 2. One chunked target verify.
+                chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+                t_logits, t_cache = apply(
+                    params, t_cache, chunk, active * (num_draft + 1)
+                )
 
-        def retire(slot):
-            results[req[slot]] = out[slot]
-            req[slot] = -1
-            active[slot] = False
-            if paged:
-                release(slot)
+                # 3. Per-row acceptance; emitted = accepted drafts + the
+                #    bonus/correction (greedy) or residual sample (sampling) —
+                #    the shared cores, models/speculative.py.
+                if temperature == 0.0:
+                    m, emitted, _ = greedy_accept_emit(
+                        drafts, _greedy(t_logits)
+                    )
+                else:
+                    q_all = jnp.moveaxis(q_all, 0, 1)    # (B, num_draft, V)
+                    p_all = jax.nn.softmax(to_flogits(t_logits), axis=-1)
+                    p_at = jnp.take_along_axis(
+                        p_all[:, :num_draft], drafts[..., None], axis=-1
+                    )[..., 0]
+                    q_at = jnp.take_along_axis(
+                        q_all, drafts[..., None], axis=-1
+                    )[..., 0]
+                    u = jax.vmap(
+                        lambda j: jax.vmap(jax.random.uniform)(
+                            spec_keys(rng, rid, gen + j, 1)
+                        ),
+                        out_axes=1,
+                    )(jnp.arange(num_draft))             # (B, num_draft)
+                    accept = u * q_at < p_at
+                    m = jnp.sum(
+                        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+                    )
+                    q_pad = jnp.concatenate(
+                        [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
+                    )
 
-        def consume(slot, tokens):
-            # Append a decode dispatch's tokens for one slot; retire at
-            # EOS or budget — ONE copy of the retirement rule for both
-            # engine modes.
-            for t in tokens:
-                out[slot].append(int(t))
-                emitted[slot] += 1
-                tok[slot] = int(t)
-                if (eos_id is not None and t == eos_id) or (
-                    emitted[slot] >= max_new_tokens
-                ):
-                    retire(slot)
-                    break
+                    def take_m(x):
+                        return jnp.take_along_axis(
+                            x, m[:, None, None], axis=1
+                        )[:, 0]
 
-        def rid_arr():
-            return jnp.asarray(np.maximum(req, 0), jnp.int32)
+                    p_m = take_m(p_all)
+                    residual = jnp.maximum(p_m - take_m(q_pad), 0.0)
+                    mass = jnp.sum(residual, axis=-1, keepdims=True)
+                    residual = jnp.where(mass > 0, residual / mass, p_m)
+                    token_m = jax.vmap(jax.random.categorical)(
+                        spec_keys(rng, rid, gen + m, 2), jnp.log(residual)
+                    ).astype(jnp.int32)
+                    emitted = emit_vector(drafts, m, token_m)
 
-        try:
-            with activate(mesh, rules):
-                while queue or any(r >= 0 for r in req):
-                    # 1. Admit queued requests into idle slots.
-                    reset = np.zeros((b,), bool)
-                    reset_to = np.zeros((b,), np.int32)
-                    for slot in range(b):
-                        if req[slot] < 0 and queue:
-                            rid, prompt = queue.popleft()
-                            req[slot] = rid
-                            plen[slot] = prompt.size
-                            pending[slot] = prompt
-                            emitted[slot] = 0
-                            out[slot] = list(prompt)
-                            reset[slot] = True
-                            if paged and prefix_cache:
-                                # Longest chain of retained pages whose
-                                # token prefix matches; the last prompt
-                                # token always recomputes (its logits
-                                # seed generation).
-                                shared = []
-                                for k in range(
-                                    1, (prompt.size - 1) // page_size + 1
-                                ):
-                                    pid = registry.get(
-                                        prompt[: k * page_size].tobytes()
-                                    )
-                                    if pid is None:
-                                        break
-                                    shared.append(pid)
-                                for j, pid in enumerate(shared):
-                                    refcnt[pid] = refcnt.get(pid, 0) + 1
-                                    cached_lru.pop(pid, None)
-                                    table_np[slot, j] = pid
-                                    held[slot].append(pid)
-                                    tables_dirty = True
-                                shared_count[slot] = len(shared)
-                                if shared:
-                                    s_len = len(shared) * page_size
-                                    pending[slot] = prompt[s_len:]
-                                    reset_to[slot] = s_len
-                                    prefix_hits += 1
-                                    prefix_pages_reused += len(shared)
+                # 4. Truncate each row's emission at EOS and at its budget.
+                raw = 1 + m
+                if eos_id is not None:
+                    hit = (emitted == eos_id) & (idx[None, :] < raw[:, None])
+                    any_hit = jnp.any(hit, axis=1)
+                    first = jnp.argmax(hit, axis=1)
+                    n_stop = jnp.where(any_hit, first + 1, raw)
+                else:
+                    any_hit = jnp.zeros_like(active, dtype=bool)
+                    n_stop = raw
+                n_emit = jnp.minimum(n_stop, remaining) * active
 
-                    # 2. One refill chunk for every slot with pending prompt
-                    #    tokens (fresh or continuing); decoding rows ride along
-                    #    with length 0.
-                    lengths = np.zeros((b,), np.int32)
-                    chunk = np.zeros((b, refill_chunk), np.int32)
-                    for slot in range(b):
-                        n = min(pending[slot].size, refill_chunk)
-                        if n:
-                            chunk[slot, :n] = pending[slot][:n]
-                            lengths[slot] = n
-                    if lengths.any():
-                        if paged:
-                            for slot in range(b):
-                                if lengths[slot]:
-                                    consumed = plen[slot] - pending[slot].size
-                                    ensure(slot, consumed + int(lengths[slot]))
-                            if cache is None:
-                                # Create faithful zero caches with a NO-OP
-                                # refill (every length 0 — no writes, no
-                                # advances), so the real first chunk runs
-                                # through the steady-state path with the
-                                # block tables already installed.
-                                _, cache = first_refill(
-                                    params, draft_params,
-                                    jnp.zeros_like(jnp.asarray(chunk)),
-                                    jnp.zeros((b,), jnp.int32), rid_arr(), rng,
-                                )
-                            cache = set_tables(cache)
-                        if cache is None:
-                            tok_new, cache = first_refill(
-                                params, draft_params, jnp.asarray(chunk),
-                                jnp.asarray(lengths), rid_arr(), rng,
-                            )
-                        else:
-                            tok_new, cache = refill_step(
-                                params, draft_params, cache, jnp.asarray(chunk),
-                                jnp.asarray(lengths), jnp.asarray(reset),
-                                jnp.asarray(reset_to), rid_arr(), rng,
-                            )
-                        tok_new = np.asarray(tok_new)
-                        for slot in range(b):
-                            if lengths[slot]:
-                                pending[slot] = pending[slot][lengths[slot]:]
-                                if pending[slot].size == 0 and req[slot] >= 0:
-                                    # Prompt complete: its first token came from
-                                    # this chunk's last valid position.
-                                    t = int(tok_new[slot])
-                                    out[slot].append(t)
-                                    emitted[slot] = 1
-                                    tok[slot] = t
-                                    if (eos_id is not None and t == eos_id) or (
-                                        max_new_tokens == 1
-                                    ):
-                                        retire(slot)
-                                    else:
-                                        active[slot] = True
-                        continue   # admit/refill until no prompt tokens remain
+                # 5. Append at each row's own offset; advance the pending
+                #    token to the last emitted one.
+                buffer = row_update_masked(
+                    buffer, emitted, count, n_emit, seq_dim=1
+                )
+                new_tok = jnp.take_along_axis(
+                    emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+                )[:, 0]
+                tok = jnp.where(active == 1, new_tok, tok)
 
-                    # 3. One decode BLOCK for the active rows.
-                    if active.any():
-                        remaining = np.asarray(
-                            [max(0, max_new_tokens - e) for e in emitted],
-                            np.int32,
+                # 6. Per-row rollback: the row's new index is pos + n_emit
+                #    (frozen rows: +0, i.e. their current index — one
+                #    broadcast serves all rows).
+                pos = pos + n_emit
+                t_cache = _rollback(t_cache, pos)
+                d_cache = _rollback(d_cache, pos)
+
+                remaining = remaining - n_emit
+                count = count + n_emit
+                # Acceptance telemetry: verifier acceptance per live round
+                # (before EOS/budget truncation — the DRAFT's quality, which
+                # is what the operator tunes num_draft against).
+                acc = acc + m * active
+                prop = prop + active * num_draft
+                stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
+                active = (
+                    active
+                    * (remaining > 0).astype(jnp.int32)
+                    * (1 - stopped_eos.astype(jnp.int32))
+                )
+                return (
+                    tok, active, pos, remaining, count, buffer, acc, prop,
+                    t_cache, d_cache
+                ), None
+
+            b = tok.shape[0]
+            buffer = jnp.zeros((b, width), jnp.int32)
+            count = jnp.zeros((b,), jnp.int32)
+            acc = jnp.zeros((b,), jnp.int32)
+            prop = jnp.zeros((b,), jnp.int32)
+            (tok, active, pos, remaining, count, buffer, acc, prop,
+             t_cache, d_cache), _ = (
+                jax.lax.scan(
+                    body,
+                    (tok, active, pos, remaining, count, buffer, acc, prop,
+                     t_cache, d_cache),
+                    None,
+                    length=decode_block_steps,
+                )
+            )
+            return (
+                buffer, count, acc, prop, active, remaining, t_cache, d_cache
+            )
+
+        # --- engine configuration and compiled programs -------------------
+        self._mesh, self._rules = mesh, rules
+        self._cfg, self._d_cfg = cfg, d_cfg
+        self._b = batch_size
+        self._max_new = max_new_tokens
+        self._eos = eos_id
+        self._refill_chunk = refill_chunk
+        self._block_steps = decode_block_steps
+        self._num_draft = num_draft
+        self._speculative = speculative
+        self._paged = paged
+        self._paged_pages = paged_pages
+        self._page_size = page_size
+        self._prefix = prefix_cache
+        self._maybe_cast = maybe_cast
+        self._d_cast = d_cast
+        self._first_refill_fn = first_refill
+        self._refill_step_fn = refill_step
+        self._decode_block_fn = decode_block
+        self._decode_block_spec_fn = decode_block_spec
+
+        # --- persistent state ---------------------------------------------
+        self.rng = jax.random.key(0)
+        self.cache_creations = 0     # lifetime count of cache-creating calls
+        self.last_stats: dict | None = None
+        self.last_latency: dict | None = None
+        self._cache = None
+        self._queue: deque[_Request] = deque()
+        self._finished: dict[int, _Request] = {}
+        self._next_rid = 0
+        self._cast_src: tuple | None = None
+        self._cast_out: tuple | None = None
+        self._init_slots()
+        if paged:
+            self._init_pool()
+        self.reset_stats()
+
+    # --- state initialisation --------------------------------------------
+
+    def _init_slots(self):
+        b = self._b
+        # A slot is: idle (req < 0), refilling (pending prompt tokens
+        # remain), or decoding (active).
+        self._req = [-1] * b               # request id per slot
+        self._plen = [0] * b               # admitted prompt length per slot
+        self._pending: list[np.ndarray] = [np.zeros((0,), np.int32)] * b
+        self._emitted = [0] * b
+        self._out: list[list[int]] = [[] for _ in range(b)]
+        self._ttimes: list[list[float]] = [[] for _ in range(b)]
+        self._slot_req: list[_Request | None] = [None] * b
+        self._tok = np.zeros((b,), np.int32)
+        self._active = np.zeros((b,), bool)
+        # Admission reset flags live on the ENGINE, not in step() locals:
+        # they are consumed by the first SUCCESSFUL refill dispatch, so a
+        # raise between admission and dispatch (pool exhaustion) cannot
+        # lose a row's counter reset (review finding, round 5).
+        self._needs_reset = np.zeros((b,), bool)
+        self._reset_to = np.zeros((b,), np.int32)
+
+    def _init_pool(self):
+        # Host-owned page allocator: page 0 is scratch; a slot holds a
+        # prefix of logical blocks mapped to arbitrary physical pages.
+        b = self._b
+        self._free_pages = list(range(self._paged_pages - 1, 0, -1))
+        self._held: list[list[int]] = [[] for _ in range(b)]
+        t_cap = self._cfg.max_seq_len // self._page_size
+        self._table_np = np.zeros((b, t_cap), np.int32)
+        self._tables_dirty = True
+        # Prefix-cache state: page-aligned token-prefix bytes → the page
+        # holding that prefix's LAST page of K/V; refcounts for pages
+        # shared by live slots; ref-0 registered pages stay evictable in
+        # LRU order (dict preserves insertion order).
+        self._registry: dict[bytes, int] = {}
+        self._key_of_page: dict[int, bytes] = {}
+        self._refcnt: dict[int, int] = {}
+        self._cached_lru: dict[int, None] = {}
+        self._shared_count = [0] * b   # leading registry pages per slot
+
+    def reset_stats(self):
+        """Zero the per-window counters (``serve()`` calls this at entry;
+        streaming users call it to start a measurement window)."""
+        self._high_water = 0
+        self._preemptions = 0
+        self._prefix_hits = 0
+        self._prefix_pages_reused = 0
+        self._spec_accepted = 0
+        self._spec_proposed = 0
+        self._completed: list[dict] = []
+        self._itl: list[float] = []
+        # Where engine wall time goes (dispatch + readback, host-observed):
+        # the refill share is the "refill pause" decoding rows suffer.
+        self._refill_s = 0.0
+        self._decode_s = 0.0
+
+    def reset(self):
+        """Abandon all in-flight work and return the engine to idle.
+
+        Frees every page (INCLUDING the prefix registry — retained K/V
+        may be mid-write when this is called), clears the queue and
+        slots; keeps the compiled programs and the allocated cache
+        arrays (admission resets their counters)."""
+        self._queue.clear()
+        self._init_slots()
+        if self._paged:
+            self._init_pool()
+
+    def close(self):
+        """Drop the engine's device state (KV cache + page pool) so HBM
+        can be reclaimed between bursts — the persistent engine otherwise
+        pins its caches for the object's lifetime. Requires an IDLE
+        engine (in-flight requests depend on the cache being dropped);
+        completed-but-unpopped results are host-side and survive. The
+        engine stays usable: the next dispatch re-creates the cache
+        (``cache_creations`` increments). The prefix registry is cleared
+        too — its retained K/V lived in the dropped arrays."""
+        if self.has_work():
+            raise RuntimeError(
+                "close() requires an idle engine: in-flight requests "
+                "depend on the cache being dropped"
+            )
+        self._cache = None
+        self._cast_src = self._cast_out = None
+        if self._paged:
+            self._init_pool()
+
+    def flush_prefix_cache(self):
+        """Drop EVERY retained prefix page — call between checkpoints:
+        the registry keys pages by token bytes only, so K/V computed
+        under old params would silently serve new-params requests.
+        Requires an IDLE engine (a live request sharing a registered
+        page, or retiring after the flush, would re-expose or re-register
+        old-params K/V — swap params only between requests)."""
+        if not self._paged:
+            return
+        if self.has_work():
+            raise RuntimeError(
+                "flush_prefix_cache() requires an idle engine: drain "
+                "in-flight work first (params must not change mid-request)"
+            )
+        for pid in list(self._cached_lru):
+            del self._cached_lru[pid]
+            del self._registry[self._key_of_page.pop(pid)]
+            del self._refcnt[pid]
+            self._free_pages.append(pid)
+
+    # --- page allocator ----------------------------------------------------
+
+    def _take_page(self):
+        if self._free_pages:
+            return self._free_pages.pop()
+        if self._cached_lru:
+            # Evict the oldest reference-free cached page — the pool must
+            # serve live requests before retained ones.
+            pid = next(iter(self._cached_lru))
+            del self._cached_lru[pid]
+            del self._registry[self._key_of_page.pop(pid)]
+            del self._refcnt[pid]
+            return pid
+        raise RuntimeError(
+            f"page pool exhausted ({self._paged_pages - 1} pages "
+            f"× {self._page_size} tokens): raise paged_pages or "
+            "lower concurrency"
+        )
+
+    def _update_high_water(self):
+        # LIVE pages only: retained reference-free prefix pages are
+        # reclaimable at will, so they are not footprint — they are
+        # reported separately (``prefix_pages_retained``).
+        live = (
+            (self._paged_pages - 1)
+            - len(self._free_pages)
+            - len(self._cached_lru)
+        )
+        self._high_water = max(self._high_water, live)
+
+    def _ensure(self, slot, tokens_through):
+        # Allocate pages so positions [0, tokens_through) are mapped
+        # before the dispatch that writes them.
+        need = -(-int(tokens_through) // self._page_size)
+        while len(self._held[slot]) < need:
+            p = self._take_page()
+            self._table_np[slot, len(self._held[slot])] = p
+            self._held[slot].append(p)
+            self._tables_dirty = True
+        self._update_high_water()
+
+    def _release(self, slot, register=True):
+        # ``register=False``: the slot is being UN-admitted (backpressure),
+        # so its prompt pages may be only partially written — never
+        # register them; just free privates and drop shared refs.
+        page_size = self._page_size
+        if self._prefix and not register:
+            pages, ns = self._held[slot], self._shared_count[slot]
+            self._free_pages.extend(pages[ns:])
+            for pid in reversed(pages[:ns]):
+                self._refcnt[pid] -= 1
+                if self._refcnt[pid] == 0:
+                    self._cached_lru[pid] = None
+            self._shared_count[slot] = 0
+            self._held[slot] = []
+            self._table_np[slot, :] = 0
+            self._tables_dirty = True
+            return
+        if self._prefix:
+            pages, ns = self._held[slot], self._shared_count[slot]
+            # Private pages: RETAIN the ones fully inside the prompt
+            # (immutable once written — generation never rewrites earlier
+            # positions) under their token-prefix key; free the rest
+            # (generated-region K/V). DEEPEST page first into the LRU —
+            # admission chains break at the first missing page, so
+            # eviction must take chain tails before roots or the stranded
+            # descendants retain HBM with zero hit potential.
+            p_toks = np.asarray(self._out[slot][: self._plen[slot]], np.int32)
+            full = self._plen[slot] // page_size
+            for j in range(len(pages) - 1, ns - 1, -1):
+                pid = pages[j]
+                if j < full:
+                    key = p_toks[: (j + 1) * page_size].tobytes()
+                    if key not in self._registry:
+                        self._registry[key] = pid
+                        self._key_of_page[pid] = key
+                        self._refcnt[pid] = 0
+                        self._cached_lru[pid] = None
+                        continue
+                self._free_pages.append(pid)
+            for pid in reversed(pages[:ns]):   # drop shared refs,
+                self._refcnt[pid] -= 1         # tails first too
+                if self._refcnt[pid] == 0:
+                    self._cached_lru[pid] = None
+            # LRU refresh across RETIREMENTS (advisor r4): a chain root
+            # registered by an earlier retirement would sit OLDER in the
+            # LRU than a tail registered just now, so eviction could take
+            # the root first and strand its descendants as unmatchable.
+            # Touch this prompt's whole chain deepest-first, so every
+            # ancestor ends up newer than its deepest tail.
+            for k in range(full, 0, -1):
+                pid = self._registry.get(p_toks[: k * page_size].tobytes())
+                if pid is not None and pid in self._cached_lru:
+                    del self._cached_lru[pid]
+                    self._cached_lru[pid] = None
+            self._shared_count[slot] = 0
+        else:
+            self._free_pages.extend(self._held[slot])
+        self._held[slot] = []
+        self._table_np[slot, :] = 0
+        self._tables_dirty = True
+
+    def _set_tables(self, cache):
+        # Push the host tables into every layer's block_table leaf
+        # (target AND draft trees; the draft's table may be narrower —
+        # same prefix, same page ids). Skipped entirely when no
+        # allocation changed since the last push — the steady-state
+        # decode loop mostly doesn't allocate.
+        if not self._tables_dirty:
+            return cache
+        self._tables_dirty = False
+        table_np = self._table_np
+
+        def leaf(path, x):
+            if getattr(path[-1], "key", None) == "block_table":
+                # .copy(): the full-width slice is a contiguous view and
+                # jnp.asarray may alias it zero-copy — the host table is
+                # mutated in place by later allocations/releases.
+                return jnp.asarray(table_np[:, : x.shape[1]].copy())
+            return x
+
+        return jax.tree_util.tree_map_with_path(leaf, cache)
+
+    # --- request lifecycle -------------------------------------------------
+
+    def _validate_prompt(self, p: np.ndarray):
+        if p.size < 1:
+            raise ValueError("empty prompt")
+        headroom = self._num_draft + 1 if self._speculative else 0
+        budget_cfgs = (
+            [("target", self._cfg), ("draft", self._d_cfg)]
+            if self._speculative else [("target", self._cfg)]
+        )
+        for name, c in budget_cfgs:
+            # The draft cache must fit the same worst case as the
+            # target's: its index walks in lockstep through prefill,
+            # proposals, and rollback.
+            check_sequence_budget(
+                p.size + self._max_new + headroom, c.max_seq_len,
+                f"prompt ({p.size}) + max_new_tokens ({self._max_new})"
+                + (f" + draft headroom ({headroom})" if headroom else "")
+                + f" for {name}",
+            )
+
+    def _check_draft_args(self, draft_params):
+        if self._speculative and draft_params is None:
+            raise ValueError(
+                "draft_config was given: pass draft_params to serve()/step()"
+            )
+        if not self._speculative and draft_params is not None:
+            raise ValueError("draft_params requires draft_config")
+
+    def _cast_params(self, params, draft_params):
+        # The eager inference cast runs once per (params, draft_params)
+        # OBJECT pair, not once per step — the cached copies are keyed by
+        # identity and hold a reference, so the same tree passed across
+        # steps (and across serve() calls) is cast exactly once.
+        if self._cast_src is not None and (
+            self._cast_src[0] is params and self._cast_src[1] is draft_params
+        ):
+            return self._cast_out
+        out = (
+            self._maybe_cast(params),
+            self._d_cast(draft_params) if draft_params is not None else None,
+        )
+        self._cast_src = (params, draft_params)
+        self._cast_out = out
+        return out
+
+    def add_request(self, prompt, *, rid: int | None = None) -> int:
+        """Enqueue one request (the arrival process). Returns its id —
+        the key ``pop_finished()`` will report it under, and (at
+        ``temperature > 0``) the identity its sampling streams are keyed
+        by. Admission happens inside a later ``step()``."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        self._validate_prompt(p)
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            # An explicit id must be unique among everything live NOW
+            # (silent result overwrite in _finished otherwise) and must
+            # not collide with later auto-assigned ones.
+            if (
+                rid in self._finished
+                or rid in self._req
+                or any(r.rid == rid for r in self._queue)
+            ):
+                raise ValueError(f"request id {rid} already in use")
+            self._next_rid = max(self._next_rid, rid + 1)
+        self._queue.append(
+            _Request(rid=rid, prompt=p, arrival_t=time.perf_counter())
+        )
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(r >= 0 for r in self._req)
+
+    def pop_finished(self) -> dict[int, np.ndarray]:
+        """Collect every request completed since the last pop:
+        ``{rid: [prompt, generated...]}``."""
+        fin = {rid: r.tokens for rid, r in self._finished.items()}
+        self._finished = {}
+        return fin
+
+    def _retire(self, slot, now, retired):
+        r = self._slot_req[slot]
+        r.tokens = np.asarray(self._out[slot], np.int32)
+        r.finish_t = now
+        n = self._emitted[slot]
+        times = self._ttimes[slot]
+        self._itl.extend(
+            b - a for a, b in zip(times, times[1:])
+        )
+        self._completed.append(
+            dict(
+                rid=r.rid,
+                prompt_len=int(r.prompt.size),
+                generated=n,
+                queue_wait=r.admit_t - r.arrival_t,
+                ttft=(
+                    r.first_token_t - r.arrival_t
+                    if r.first_token_t is not None else None
+                ),
+                e2e=now - r.arrival_t,
+                tpot=(
+                    (now - r.first_token_t) / (n - 1) if n > 1 else None
+                ),
+            )
+        )
+        self._finished[r.rid] = r
+        retired.append(r.rid)
+        self._slot_req[slot] = None
+        self._req[slot] = -1
+        self._active[slot] = False
+        if self._paged:
+            self._release(slot)
+
+    def _consume(self, slot, tokens, now, retired):
+        # Append a decode dispatch's tokens for one slot; retire at
+        # EOS or budget — ONE copy of the retirement rule for both
+        # engine modes.
+        for t in tokens:
+            self._out[slot].append(int(t))
+            self._emitted[slot] += 1
+            self._tok[slot] = int(t)
+            self._ttimes[slot].append(now)
+            if (self._eos is not None and t == self._eos) or (
+                self._emitted[slot] >= self._max_new
+            ):
+                self._retire(slot, now, retired)
+                break
+
+    def _rid_arr(self):
+        return jnp.asarray(np.maximum(self._req, 0), jnp.int32)
+
+    # --- the scheduler -----------------------------------------------------
+
+    def _unadmit(self, slot):
+        """Backpressure/preemption: push an in-flight request back to the
+        queue head and free its slot — taken when the page pool cannot
+        cover its next dispatch but OTHER slots still hold pages that
+        will free as they retire. The request restarts from scratch on a
+        later admission (RECOMPUTE preemption): any consumed chunks and
+        emitted tokens are discarded and re-derived — EXACTLY, because
+        greedy decoding is deterministic and every sampling draw is
+        keyed by (request id, generated position), not by schedule. So
+        preemption, like every other scheduling decision, cannot change
+        results (test-pinned)."""
+        r = self._slot_req[slot]
+        self._queue.appendleft(r)
+        if self._paged:
+            self._release(slot, register=False)
+        self._slot_req[slot] = None
+        self._req[slot] = -1
+        self._active[slot] = False
+        self._pending[slot] = np.zeros((0,), np.int32)
+        self._needs_reset[slot] = False
+        self._reset_to[slot] = 0
+
+    def _admit(self):
+        b = self._b
+        now = time.perf_counter()
+        for slot in range(b):
+            if self._req[slot] < 0 and self._queue:
+                r = self._queue.popleft()
+                # A preempted request keeps its first admission time (and
+                # counts its prefix hit once — re-admission re-maps the
+                # same pages, not new savings).
+                first_admission = r.admit_t is None
+                if first_admission:
+                    r.admit_t = now
+                prompt = r.prompt
+                self._slot_req[slot] = r
+                self._req[slot] = r.rid
+                self._plen[slot] = prompt.size
+                self._pending[slot] = prompt
+                self._emitted[slot] = 0
+                self._out[slot] = list(prompt)
+                self._ttimes[slot] = []
+                self._needs_reset[slot] = True
+                self._reset_to[slot] = 0
+                if self._paged and self._prefix:
+                    # Longest chain of retained pages whose token prefix
+                    # matches; the last prompt token always recomputes
+                    # (its logits seed generation).
+                    shared = []
+                    for k in range(
+                        1, (prompt.size - 1) // self._page_size + 1
+                    ):
+                        pid = self._registry.get(
+                            prompt[: k * self._page_size].tobytes()
                         )
-                        if paged:
-                            # Cover every position this block can write: K new
-                            # tokens per row (plain), or K rounds of up to
-                            # num_draft+1 plus the verify chunk's headroom
-                            # (speculative) — capped by the row's remaining
-                            # budget either way.
-                            for slot in range(b):
-                                if not active[slot]:
-                                    continue
-                                pos_s = plen[slot] + emitted[slot] - 1
-                                if speculative:
-                                    span = (
-                                        min(
-                                            int(remaining[slot]),
-                                            decode_block_steps * (num_draft + 1),
-                                        )
-                                        + num_draft + 1
-                                    )
-                                else:
-                                    span = min(
-                                        int(remaining[slot]), decode_block_steps
-                                    )
-                                ensure(slot, pos_s + span)
-                            cache = set_tables(cache)
-                        if speculative:
-                            # Each row's current cache index: prompt + emitted
-                            # - 1 (its pending token is not yet in the cache).
-                            pos = np.asarray(
-                                [max(0, p + e - 1) for p, e in zip(plen, emitted)],
-                                np.int32,
-                            )
-                            t_cache, d_cache = cache
-                            buffer, counts, acc, prop, _, _, t_cache, d_cache = (
-                                decode_block_spec(
-                                    params, draft_params, t_cache, d_cache,
-                                    jnp.asarray(tok),
-                                    jnp.asarray(active.astype(np.int32)),
-                                    jnp.asarray(pos), jnp.asarray(remaining),
-                                    rid_arr(), rng,
-                                )
-                            )
-                            cache = (t_cache, d_cache)
-                            buffer = np.asarray(buffer)
-                            counts = np.asarray(counts)
-                            spec_accepted += int(np.asarray(acc).sum())
-                            spec_proposed += int(np.asarray(prop).sum())
-                            for slot in range(b):
-                                if active[slot]:
-                                    consume(slot, buffer[slot, : counts[slot]].tolist())
-                        else:
-                            toks, _, _, cache = decode_block(
-                                params, cache, jnp.asarray(tok),
-                                jnp.asarray(active.astype(np.int32)),
-                                jnp.asarray(remaining), rid_arr(), rng,
-                            )
-                            toks = np.asarray(toks)
-                            for slot in range(b):
-                                if active[slot]:
-                                    consume(slot, toks[slot].tolist())
+                        if pid is None:
+                            break
+                        shared.append(pid)
+                    for j, pid in enumerate(shared):
+                        self._refcnt[pid] = self._refcnt.get(pid, 0) + 1
+                        self._cached_lru.pop(pid, None)
+                        self._table_np[slot, j] = pid
+                        self._held[slot].append(pid)
+                        self._tables_dirty = True
+                    self._shared_count[slot] = len(shared)
+                    if shared:
+                        s_len = len(shared) * self._page_size
+                        self._pending[slot] = prompt[s_len:]
+                        self._reset_to[slot] = s_len
+                        if first_admission:
+                            self._prefix_hits += 1
+                            self._prefix_pages_reused += len(shared)
 
+    def _refill_dispatch(self, params, d_params, retired):
+        # One refill chunk for every slot with pending prompt tokens
+        # (fresh or continuing); decoding rows ride along with length 0.
+        b = self._b
+        lengths = np.zeros((b,), np.int32)
+        chunk = np.zeros((b, self._refill_chunk), np.int32)
+        for slot in range(b):
+            n = min(self._pending[slot].size, self._refill_chunk)
+            if n:
+                chunk[slot, :n] = self._pending[slot][:n]
+                lengths[slot] = n
+        if not lengths.any():
+            return False
+        if self._paged:
+            for slot in range(b):
+                if lengths[slot]:
+                    consumed = self._plen[slot] - self._pending[slot].size
+                    try:
+                        self._ensure(slot, consumed + int(lengths[slot]))
+                    except RuntimeError:
+                        # Backpressure instead of a wedge: if any OTHER
+                        # slot is mid-flight, its retirement will free
+                        # pages — requeue this request and serve the
+                        # rest. Raise only when this request is alone
+                        # (it can never fit).
+                        if not any(
+                            self._req[s] >= 0
+                            for s in range(b) if s != slot
+                        ):
+                            raise
+                        self._unadmit(slot)
+                        self._preemptions += 1
+                        lengths[slot] = 0
+                        chunk[slot, :] = 0
+            if not lengths.any():
+                return False
+            if self._cache is None:
+                # Create faithful zero caches with a NO-OP refill (every
+                # length 0 — no writes, no advances), so the real first
+                # chunk runs through the steady-state path with the
+                # block tables already installed.
+                _, self._cache = self._first_refill_fn(
+                    params, d_params,
+                    jnp.zeros_like(jnp.asarray(chunk)),
+                    jnp.zeros((b,), jnp.int32), self._rid_arr(), self.rng,
+                )
+                self.cache_creations += 1
+            self._cache = self._set_tables(self._cache)
+        if self._cache is None:
+            tok_new, self._cache = self._first_refill_fn(
+                params, d_params, jnp.asarray(chunk),
+                jnp.asarray(lengths), self._rid_arr(), self.rng,
+            )
+            self.cache_creations += 1
+        else:
+            # COPIES, not the live arrays: jnp.asarray of a numpy array
+            # can be zero-copy (the jax.Array aliases the host buffer),
+            # and the flags are cleared in place below while the
+            # dispatch may still be executing asynchronously — an
+            # aliased clear would erase the admission resets mid-flight
+            # (observed as flaky stale-counter corruption on CPU).
+            tok_new, self._cache = self._refill_step_fn(
+                params, d_params, self._cache, jnp.asarray(chunk),
+                jnp.asarray(lengths),
+                jnp.asarray(self._needs_reset.copy()),
+                jnp.asarray(self._reset_to.copy()),
+                self._rid_arr(), self.rng,
+            )
+        # The dispatch has its own copy of the admission resets, so
+        # consume the flags (every flagged row had pending tokens and
+        # therefore rode this chunk).
+        self._needs_reset[:] = False
+        self._reset_to[:] = 0
+        tok_new = np.asarray(tok_new)
+        now = time.perf_counter()
+        for slot in range(b):
+            if lengths[slot]:
+                self._pending[slot] = self._pending[slot][lengths[slot]:]
+                if self._pending[slot].size == 0 and self._req[slot] >= 0:
+                    # Prompt complete: its first token came from this
+                    # chunk's last valid position.
+                    t = int(tok_new[slot])
+                    self._out[slot].append(t)
+                    self._emitted[slot] = 1
+                    self._tok[slot] = t
+                    self._slot_req[slot].first_token_t = now
+                    self._ttimes[slot].append(now)
+                    if (self._eos is not None and t == self._eos) or (
+                        self._max_new == 1
+                    ):
+                        self._retire(slot, now, retired)
+                    else:
+                        self._active[slot] = True
+        return True
+
+    def _decode_dispatch(self, params, d_params, retired):
+        # One decode BLOCK for the active rows. Returns whether a
+        # dispatch actually ran (idle polling must not accrue time).
+        if not self._active.any():
+            return False
+        b = self._b
+        remaining = np.asarray(
+            [max(0, self._max_new - e) for e in self._emitted], np.int32
+        )
+        if self._paged:
+            # Cover every position this block can write: K new tokens per
+            # row (plain), or K rounds of up to num_draft+1 plus the
+            # verify chunk's headroom (speculative) — capped by the row's
+            # remaining budget either way.
+            for slot in range(b):
+                if not self._active[slot]:
+                    continue
+                pos_s = self._plen[slot] + self._emitted[slot] - 1
+                if self._speculative:
+                    span = (
+                        min(
+                            int(remaining[slot]),
+                            self._block_steps * (self._num_draft + 1),
+                        )
+                        + self._num_draft + 1
+                    )
+                else:
+                    span = min(int(remaining[slot]), self._block_steps)
+                try:
+                    self._ensure(slot, pos_s + span)
+                except RuntimeError:
+                    # Decode-time RECOMPUTE preemption (exact — see
+                    # _unadmit): requeue this row unless it is the only
+                    # request left holding pages (then it can never fit).
+                    if not any(
+                        self._req[s] >= 0 for s in range(b) if s != slot
+                    ):
+                        raise
+                    self._unadmit(slot)
+                    self._preemptions += 1
+            if not self._active.any():
+                return False
+            self._cache = self._set_tables(self._cache)
+        if self._speculative:
+            # Each row's current cache index: prompt + emitted - 1 (its
+            # pending token is not yet in the cache).
+            pos = np.asarray(
+                [
+                    max(0, p + e - 1)
+                    for p, e in zip(self._plen, self._emitted)
+                ],
+                np.int32,
+            )
+            t_cache, d_cache = self._cache
+            buffer, counts, acc, prop, _, _, t_cache, d_cache = (
+                self._decode_block_spec_fn(
+                    params, d_params, t_cache, d_cache,
+                    jnp.asarray(self._tok),
+                    jnp.asarray(self._active.astype(np.int32)),
+                    jnp.asarray(pos), jnp.asarray(remaining),
+                    self._rid_arr(), self.rng,
+                )
+            )
+            self._cache = (t_cache, d_cache)
+            buffer = np.asarray(buffer)
+            counts = np.asarray(counts)
+            now = time.perf_counter()
+            self._spec_accepted += int(np.asarray(acc).sum())
+            self._spec_proposed += int(np.asarray(prop).sum())
+            was_active = self._active.copy()
+            for slot in range(b):
+                if was_active[slot]:
+                    self._consume(
+                        slot, buffer[slot, : counts[slot]].tolist(), now,
+                        retired,
+                    )
+        else:
+            toks, _, _, self._cache = self._decode_block_fn(
+                params, self._cache, jnp.asarray(self._tok),
+                jnp.asarray(self._active.astype(np.int32)),
+                jnp.asarray(remaining), self._rid_arr(), self.rng,
+            )
+            toks = np.asarray(toks)
+            now = time.perf_counter()
+            was_active = self._active.copy()
+            for slot in range(b):
+                if was_active[slot]:
+                    self._consume(slot, toks[slot].tolist(), now, retired)
+        return True
+
+    def step(self, params, draft_params=None) -> list[int]:
+        """ONE scheduler iteration: admit queued requests into idle
+        slots, then run exactly one dispatch — a refill chunk if any slot
+        has pending prompt tokens, else a decode block if any row is
+        active, else nothing. Returns the ids of requests that finished
+        during this step (their outputs await ``pop_finished()``)."""
+        self._check_draft_args(draft_params)
+        params, d_params = self._cast_params(params, draft_params)
+        retired: list[int] = []
+        with activate(self._mesh, self._rules):
+            self._admit()
+            t0 = time.perf_counter()
+            if self._refill_dispatch(params, d_params, retired):
+                self._refill_s += time.perf_counter() - t0
+            elif self._decode_dispatch(params, d_params, retired):
+                # Only DISPATCHED time accrues: an idle poll (streaming
+                # drivers spin step() between arrivals) must not drown
+                # the refill/decode split.
+                self._decode_s += time.perf_counter() - t0
+        return retired
+
+    # --- stats -------------------------------------------------------------
+
+    def latency_stats(self) -> dict | None:
+        """Latency percentiles over the requests completed in the current
+        stats window (see class docstring for the field meanings)."""
+        comp = self._completed
+        if not comp:
+            return None
+
+        def pcts(values, name):
+            a = np.asarray([v for v in values if v is not None], np.float64)
+            if not a.size:
+                return {}
+            return {
+                f"{name}_p50": float(np.percentile(a, 50)),
+                f"{name}_p99": float(np.percentile(a, 99)),
+            }
+
+        out = {"requests": len(comp)}
+        out.update(pcts([c["queue_wait"] for c in comp], "queue_wait"))
+        out.update(pcts([c["ttft"] for c in comp], "ttft"))
+        out.update(pcts([c["tpot"] for c in comp], "tpot"))
+        out.update(pcts(self._itl, "itl"))
+        out.update(pcts([c["e2e"] for c in comp], "e2e"))
+        busy = self._refill_s + self._decode_s
+        out.update(
+            refill_s=self._refill_s, decode_s=self._decode_s,
+            refill_frac=(self._refill_s / busy) if busy else None,
+        )
+        return out
+
+    def _snapshot_stats(self):
+        # Mode stats keep the pre-persistence contract exactly (None when
+        # no mode is on — test-pinned); latency telemetry rides separately.
+        stats = {}
+        if self._paged:
+            stats.update(
+                page_high_water=self._high_water,
+                pages_total=self._paged_pages - 1,
+                page_size=self._page_size,
+                preemptions=self._preemptions,
+            )
+            if self._prefix:
+                stats.update(
+                    prefix_hits=self._prefix_hits,
+                    prefix_pages_reused=self._prefix_pages_reused,
+                    prefix_pages_retained=len(self._cached_lru),
+                )
+        if self._speculative:
+            stats.update(
+                spec_accepted=self._spec_accepted,
+                spec_proposed=self._spec_proposed,
+                spec_accept_rate=(
+                    self._spec_accepted / self._spec_proposed
+                    if self._spec_proposed else None
+                ),
+            )
+        self.last_stats = stats or None
+        self.last_latency = self.latency_stats()
+
+    # --- one-shot entry ----------------------------------------------------
+
+    def serve(self, params, prompts, rng=None, draft_params=None):
+        """Drain a whole queue: outputs in queue order, requests numbered
+        by queue index (the sampling-stream identity). Requires an idle
+        engine (streaming work must finish first); persistent state —
+        cache, pool, prefix registry — carries over BETWEEN calls."""
+        self._check_draft_args(draft_params)
+        if self.has_work():
+            raise RuntimeError(
+                "serve() requires an idle engine: drain streaming work "
+                "(step() until not has_work()) first"
+            )
+        prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompts]
+        # Validate EVERYTHING before touching any state: a bad prompt
+        # must raise without costing the engine its persistent registry
+        # (the failure path below resets the pool).
+        for p in prompts:
+            self._validate_prompt(p)
+        self.rng = jax.random.key(0) if rng is None else rng
+        self.reset_stats()
+        # The per-call rid namespace (0..n-1) must not collide with
+        # un-popped streaming results: stash them, restore after — a
+        # failed call's partial outputs are dropped with its state.
+        stash = self._finished
+        self._finished = {}
+        ok = False
+        try:
+            for i, p in enumerate(prompts):
+                self.add_request(p, rid=i)
+            while self.has_work():
+                self.step(params, draft_params)
+            ok = True
         finally:
             # Stats must reflect THIS call even when it raises — pool
             # exhaustion is exactly when the measured footprint matters.
-            stats = {}
-            if paged:
-                stats.update(
-                    page_high_water=high_water,
-                    pages_total=paged_pages - 1,
-                    page_size=page_size,
-                )
-                if prefix_cache:
-                    stats.update(
-                        prefix_hits=prefix_hits,
-                        prefix_pages_reused=prefix_pages_reused,
-                    )
-            if speculative:
-                stats.update(
-                    spec_accepted=spec_accepted,
-                    spec_proposed=spec_proposed,
-                    spec_accept_rate=(
-                        spec_accepted / spec_proposed if spec_proposed else None
-                    ),
-                )
-            serve.last_stats = stats or None
-        return [np.asarray(results[i], np.int32) for i in range(len(prompts))]
+            self._snapshot_stats()
+            if not ok:
+                # Leave the engine reusable: drop the wedged in-flight
+                # state (and the registry — partial writes may alias it).
+                self.reset()
+                self._finished = stash
+        results = [
+            np.asarray(self._finished.pop(i).tokens, np.int32)
+            for i in range(len(prompts))
+        ]
+        self._finished = stash
+        return results
 
+
+def make_continuous_engine(
+    config: TransformerConfig, mesh: Mesh, rules: Rules, **kwargs
+):
+    """Build a persistent :class:`ContinuousEngine` and return its
+    one-shot entry ``serve(params, prompts, rng, draft_params) ->
+    list[np.ndarray]`` (the original engine API — every oracle pinned on
+    it holds unchanged). The wrapped engine is reachable at
+    ``serve.engine`` for streaming admission and telemetry; after each
+    call ``serve.last_stats`` / ``serve.last_latency`` mirror the
+    engine's. Because the engine persists, repeated calls share the KV
+    cache, page pool, and prefix registry — see
+    :class:`ContinuousEngine` for the full contract."""
+    engine = ContinuousEngine(config, mesh, rules, **kwargs)
+
+    def serve(params, prompts, rng=None, draft_params=None):
+        try:
+            return engine.serve(
+                params, prompts, rng=rng, draft_params=draft_params
+            )
+        finally:
+            serve.last_stats = engine.last_stats
+            serve.last_latency = engine.last_latency
+
+    serve.engine = engine
     serve.last_stats = None
+    serve.last_latency = None
     return serve

@@ -244,7 +244,10 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_dispatch: str = "einsum"  # "einsum" (EP-shardable) | "scatter"
-                                  # (scatter/gather, single-device; moe.py)
+                                  # (scatter/gather, single-device) |
+                                  # "alltoall" (explicit EP exchange; needs
+                                  # moe_dispatch_fn — moe.py/moe_dispatch.py)
+    moe_dispatch_fn: Optional[Callable] = None
     decode: bool = False          # KV-cached autoregressive attention
     max_decode_len: int = 0
     kv_cache_dtype: Optional[Any] = None  # decode-cache storage: None =
@@ -327,6 +330,7 @@ class TransformerBlock(nn.Module):
                 top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
                 dispatch=self.moe_dispatch,
+                dispatch_fn=self.moe_dispatch_fn,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name="moe",
@@ -388,7 +392,10 @@ class TransformerConfig:
     moe_dispatch: str = "einsum"     # routing implementation (models/moe.py):
                                      # "einsum" shards under EP rules;
                                      # "scatter" deletes the O(E*C*M*T) routing
-                                     # FLOPs via scatter/gather (1-device)
+                                     # FLOPs via scatter/gather (1-device);
+                                     # "alltoall" explicit EP exchange (set
+                                     # moe_dispatch_fn = make_moe_a2a_fn(mesh))
+    moe_dispatch_fn: Optional[Callable] = None
     norm: str = "layernorm"          # "layernorm" | "rmsnorm"
     fused_norm: bool = False         # block boundaries (residual add + norm)
                                      # through the Pallas fused kernel
@@ -644,6 +651,7 @@ class Transformer(nn.Module):
             moe_top_k=cfg.moe_top_k,
             moe_capacity_factor=cfg.moe_capacity_factor,
             moe_dispatch=cfg.moe_dispatch,
+            moe_dispatch_fn=cfg.moe_dispatch_fn,
             decode=cfg.decode,
             max_decode_len=cfg.max_seq_len if cfg.decode else 0,
             kv_cache_dtype=cfg.kv_cache_dtype,

@@ -1,0 +1,145 @@
+"""Explicit all-to-all expert-parallel MoE dispatch.
+
+The third dispatch implementation for ``models.moe.MoEFeedForward``
+(VERDICT r4 item 4), closing the gap the first two leave open:
+
+* the **einsum** path shards over EXPERT through GSPMD but pays the
+  O(E·C·M·T) one-hot dispatch/combine contractions (~40% of MoE step
+  time at E=8 top-2, PERF.md round 3);
+* the **scatter** path deletes those FLOPs (measured −8..−12% step time,
+  round 4) but its data-dependent gathers cannot partition over EXPERT —
+  single-device only.
+
+This module composes both properties the way production MoE actually
+partitions (GShard §3.2, DeepSpeed-MoE): tokens are bucketed PER SHARD
+by the flop-free scatter (``models.moe.assign_slots`` /
+``scatter_slot_ids`` — THE shared slot-assignment rule, so routing math
+cannot drift between paths), then ONE ``lax.all_to_all`` over the expert
+mesh axis trades token shards for expert shards, the local experts run
+their FF, and one all-to-all brings the outputs home for a local
+gather-combine.
+
+Topology: EP=DP — experts shard over the SAME mesh axis as the batch
+(``parallel.logical.RULES_DP_EP_A2A``), because the exchange swaps token
+shards for expert shards along one axis. Capacity is PER TOKEN GROUP
+(each shard's T/D tokens), which is GShard's actual formulation — the
+single-group einsum/scatter paths are the degenerate D=1 case, and the
+parity oracle (tests) compares against the einsum path run group-wise.
+
+On a TPU torus both all-to-alls ride ICI; collective counts are pinned
+from compiled HLO in ``tests/test_moe.py``.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_a2a_ff(
+    x: jax.Array,
+    probs: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    mesh: Mesh,
+    ep_axis: str,
+    top_k: int,
+    capacity_factor: float,
+    dtype,
+) -> jax.Array:
+    """Routed expert FF over ``x (T, M)`` / ``probs (T, E)`` sharded on
+    ``ep_axis`` (dim 0) and expert weights ``(E, M, H)`` / ``(E, H, M)``
+    sharded on the same axis (dim 0). Returns ``(T, M)`` sharded like
+    ``x``. Requires ``E % D == 0`` and ``T % D == 0`` for the
+    ``D = mesh.shape[ep_axis]`` exchange."""
+    from learning_jax_sharding_tpu.models.moe import (
+        assign_slots,
+        bucket_tokens,
+        combine_slots,
+        scatter_slot_ids,
+    )
+
+    d = mesh.shape[ep_axis]
+    t, m = x.shape
+    e = probs.shape[-1]
+    if e % d:
+        raise ValueError(
+            f"all-to-all dispatch needs num_experts ({e}) divisible by the "
+            f"'{ep_axis}' axis size ({d})"
+        )
+    if t % d:
+        raise ValueError(
+            f"all-to-all dispatch needs tokens ({t}) divisible by the "
+            f"'{ep_axis}' axis size ({d})"
+        )
+
+    def local(x_l, probs_l, w_up_l, w_down_l):
+        t_l = x_l.shape[0]
+        # Per-GROUP capacity (this shard's tokens) — GShard's grouped
+        # formulation; the single-device paths are the D=1 special case.
+        capacity = min(
+            t_l, max(1, math.ceil(top_k * t_l * capacity_factor / e))
+        )
+        gate_vals, gate_idx, pos, fits, masks = assign_slots(
+            probs_l, top_k, capacity
+        )
+        flat_slot = scatter_slot_ids(pos, fits, masks, gate_idx, capacity, e)
+
+        # Flop-free bucketing: (E, C, M) slots for ALL experts, from this
+        # shard's tokens (models.moe.bucket_tokens — the shared movement
+        # code, so the paths cannot drift).
+        buckets = bucket_tokens(x_l, flat_slot, e, capacity, top_k, dtype)
+
+        # Exchange: send each peer its experts' buckets, receive every
+        # peer's buckets for OUR experts → (E/D, D·C, M).
+        recv = lax.all_to_all(
+            buckets, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+        h = jnp.einsum("ecm,emh->ech", recv, w_up_l.astype(dtype))
+        out_slots = jnp.einsum(
+            "ech,ehm->ecm", jax.nn.gelu(h), w_down_l.astype(dtype)
+        )
+        # Bring every token's slots home: (E/D, D·C, M) → (E, C, M).
+        back = lax.all_to_all(
+            out_slots, ep_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+        return combine_slots(back, flat_slot, gate_vals, top_k, dtype)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(ep_axis, None),
+            P(ep_axis, None),
+            P(ep_axis, None, None),
+            P(ep_axis, None, None),
+        ),
+        out_specs=P(ep_axis, None),
+    )(x, probs, w_up, w_down)
+
+
+def make_moe_a2a_fn(mesh: Mesh, rules=None, ep_axis: str | None = None):
+    """A ``dispatch_fn`` for ``MoEFeedForward(dispatch="alltoall")``.
+
+    The expert axis defaults to whatever mesh axis the rules map
+    ``EXPERT`` to (``RULES_DP_EP_A2A`` → ``"data"``); pass ``ep_axis``
+    to override. Mirrors ``make_ring_attn_fn`` / ``make_ulysses_attn_fn``
+    construction: resolve the topology once, inject via config."""
+    if ep_axis is None:
+        from learning_jax_sharding_tpu.parallel.logical import EXPERT
+
+        mapping = dict(rules or ())
+        ep_axis = mapping.get(EXPERT, "data")
+
+    def fn(x, probs, w_up, w_down, *, top_k, capacity_factor, dtype):
+        return moe_a2a_ff(
+            x, probs, w_up, w_down, mesh=mesh, ep_axis=ep_axis,
+            top_k=top_k, capacity_factor=capacity_factor, dtype=dtype,
+        )
+
+    return fn

@@ -95,6 +95,22 @@ RULES_DP_TP_EP: Rules = (
     (VOCAB, "model"),
 )
 
+#: Explicit expert parallelism for the ALL-TO-ALL MoE dispatch
+#: (``ops.moe_dispatch.make_moe_a2a_fn``): experts shard over the SAME
+#: axis as the batch — each data-parallel worker owns E/D experts and the
+#: dispatch exchanges token shards ↔ expert shards with one
+#: ``lax.all_to_all`` each way (the DeepSpeed-MoE / GShard EP=DP
+#: topology). Attention stays tensor-parallel over 'model'; MLP is NOT
+#: mapped (expert FF width stays whole per device — TP-within-expert
+#: would need a second exchange).
+RULES_DP_EP_A2A: Rules = (
+    (BATCH, "data"),
+    (EXPERT, "data"),
+    (HEADS, "model"),
+    (HIDDEN, "model"),
+    (VOCAB, "model"),
+)
+
 #: Serving layout for the PAGED KV cache: tensor parallelism only. The
 #: batch stays replicated because any row's block table may point at any
 #: physical page — a batch shard would need its own page pool and

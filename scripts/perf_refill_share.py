@@ -1,0 +1,63 @@
+"""Close VERDICT r4 item 9: how much engine wall time do refill
+dispatches cost, per workload class?
+
+The engine now splits its dispatched wall time into refill vs decode
+(`engine.latency_stats()['refill_frac']` — idle polling excluded), so
+the "refill pause" is a number every run reports. This script records
+it for the STANDARD decode-heavy queue (the `perf_serving2.py` shape:
+64-token prompts, +128 out, 32 requests through 8 slots) to complement
+the prefill-heavy numbers already on record (81% on the
+shared-system-prompt bench queue, 79% at S=4096 long-prompt serving).
+
+Run from /root/repo:  python - < scripts/perf_refill_share.py
+"""
+import dataclasses
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+cfg = dataclasses.replace(
+    CONFIG_125M, max_seq_len=512, decode_attention="blocked"
+)
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+rng = np.random.default_rng(0)
+model = Transformer(cfg)
+probe = np.zeros((8, 64), np.int32)
+params = nn.meta.unbox(
+    jax.jit(lambda r, t: model.init({"params": r}, t))(
+        jax.random.key(0), probe
+    )["params"]
+)
+NREQ, NEW, PLEN = 32, 128, 64
+prompts = [
+    rng.integers(1, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
+    for _ in range(NREQ)
+]
+serve = make_continuous_engine(
+    cfg, mesh, RULES_DP_TP, batch_size=8, max_new_tokens=NEW,
+    refill_chunk=64, inference_dtype=jnp.bfloat16,
+)
+serve(params, prompts[:9])            # warm executables
+t0 = time.perf_counter()
+outs = serve(params, prompts)
+dt = time.perf_counter() - t0
+lat = serve.last_latency
+toks = sum(len(o) - PLEN for o in outs)
+print(
+    f"[refill-share] standard decode-heavy queue ({NREQ} x {PLEN}-tok "
+    f"prompts, +{NEW} out, 8 slots): {toks / dt:,.0f} tok/s, refill "
+    f"{lat['refill_s']:.2f} s / decode {lat['decode_s']:.2f} s -> refill "
+    f"= {lat['refill_frac']:.1%} of dispatched engine time",
+    flush=True,
+)

@@ -1,0 +1,139 @@
+"""Partial-acceptance speculative decoding at the 125M-CLASS shape —
+the second half of VERDICT r4 item 3.
+
+``perf_spec_partial.py`` measured the acceptance curve with a TINY
+target (4Lx256), where even 38% acceptance loses money because the draft
+costs ~40% of the target per forward. But round 4's "profitable from
+acceptance ~0.4" interpolation was made at the 125M-target shape, where
+the 2-layer draft costs ~1/6 of the target — the cost ratio is the other
+axis of the curve. This script trains a 125M-class target and two drafts
+on the same non-memorizable stdlib-source corpus (held-out prompts, so
+acceptance is generalization agreement, not recall) and runs the engine
+ladder at the shape the claim was made at.
+
+Run from /root/repo:  python - < scripts/perf_spec_partial2.py
+"""
+import sysconfig
+import tempfile
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.data import MemmapTokenDataset, write_token_file
+from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+)
+from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+
+stdlib = Path(sysconfig.get_paths()["stdlib"])
+texts, total = [], 0
+for f in sorted(stdlib.glob("*.py")):
+    try:
+        t = f.read_text(errors="ignore")
+    except OSError:
+        continue
+    texts.append(t)
+    total += len(t)
+    if total > 1_600_000:
+        break
+held_out = texts[-4:]
+train_text = "\n".join(texts[:-4])
+
+VOCAB = 512
+tok = BPETokenizer.train(train_text[:300_000], vocab_size=VOCAB)
+tokens = tok.encode_to_array(train_text)
+ho_tokens = tok.encode_to_array("\n".join(held_out))
+print(f"[spec-p2] {len(tokens):,} BPE train tokens, "
+      f"{len(ho_tokens):,} held-out", flush=True)
+
+mk = dict(vocab_size=VOCAB, rope=True, max_seq_len=512)
+TARGET = TransformerConfig(
+    num_layers=12, features=768, num_heads=12, head_dim=64, hidden=3072,
+    attn_fn=make_flash_attn_fn(), **mk,
+)
+DRAFTS = {
+    # The round-4 floor-draft shape: ~1/6 of the target per forward.
+    "2Lx768": TransformerConfig(
+        num_layers=2, features=768, num_heads=12, head_dim=64,
+        hidden=3072, **mk,
+    ),
+    # A cheaper draft: ~1/40 of the target.
+    "2Lx256": TransformerConfig(
+        num_layers=2, features=256, num_heads=4, head_dim=64,
+        hidden=1024, **mk,
+    ),
+}
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+
+with tempfile.TemporaryDirectory() as tmp:
+    data = MemmapTokenDataset(
+        write_token_file(Path(tmp) / "c.bin", tokens), seq_len=128
+    )
+
+    def train(cfg, steps, label, lr=3e-4):
+        t0 = time.perf_counter()
+        state, hist = fit(
+            Transformer(cfg), data, mesh, RULES_DP_TP,
+            TrainLoopConfig(steps=steps, global_batch_size=32,
+                            learning_rate=lr, log_every=steps),
+        )
+        print(f"[spec-p2] {label}: {steps} steps in "
+              f"{time.perf_counter() - t0:.0f}s, loss "
+              f"{hist[-1]['loss']:.3f}", flush=True)
+        return state.params
+
+    t_params = train(TARGET, 3000, "target 12Lx768 (125M-class)")
+    pairs = [
+        (tag, cfg, train(cfg, 3000, f"draft {tag}"))
+        for tag, cfg in DRAFTS.items()
+    ]
+
+rng = np.random.default_rng(0)
+NREQ, NEW, ND = 24, 64, 4
+prompts = [
+    ho_tokens[int(s) : int(s) + int(n)].astype(np.int32)
+    for s, n in zip(rng.integers(0, len(ho_tokens) - 40, size=NREQ),
+                    rng.integers(12, 33, size=NREQ))
+]
+# Serving configs must not carry the train-side flash attn_fn.
+import dataclasses
+
+t_serve = dataclasses.replace(TARGET, attn_fn=None)
+common = dict(batch_size=8, max_new_tokens=NEW, refill_chunk=32,
+              inference_dtype=jnp.bfloat16)
+
+
+def run(label, serve, tree, kw):
+    serve(tree, prompts[:9], **kw)
+    t0 = time.perf_counter()
+    outs = serve(tree, prompts, **kw)
+    dt = time.perf_counter() - t0
+    toks = sum(len(o) - p.size for o, p in zip(outs, prompts))
+    st = serve.last_stats or {}
+    acc = st.get("spec_accept_rate")
+    extra = f", acceptance {acc:.0%}" if acc is not None else ""
+    print(f"[spec-p2] {label}: {toks / dt:,.0f} tok/s ({dt:.2f} s){extra}",
+          flush=True)
+    return toks / dt
+
+
+plain = make_continuous_engine(t_serve, mesh, RULES_DP_TP, **common)
+base = run("plain 125M-class engine", plain, t_params, {})
+for tag, dcfg, dp in pairs:
+    d_serve = dataclasses.replace(dcfg, attn_fn=None)
+    eng = make_continuous_engine(
+        t_serve, mesh, RULES_DP_TP, draft_config=d_serve, num_draft=ND,
+        **common,
+    )
+    rate = run(f"speculative, draft {tag}", eng, t_params,
+               {"draft_params": dp})
+    print(f"[spec-p2]   -> {rate / base:.2f}x plain", flush=True)

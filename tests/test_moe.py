@@ -260,3 +260,192 @@ class TestSortDispatch:
         np.testing.assert_allclose(
             np.asarray(os_), np.asarray(oe), rtol=2e-5, atol=2e-5
         )
+
+
+class TestAllToAllDispatch:
+    """dispatch="alltoall" (ops/moe_dispatch.py): the EXPLICIT expert-
+    parallel path — per-shard scatter bucketing + one lax.all_to_all each
+    way over the expert mesh axis. Capacity is per TOKEN GROUP (GShard's
+    grouped formulation), so the oracle is the einsum path run GROUP-WISE
+    with the same params: outputs and grads must match, and the compiled
+    HLO must contain the two all-to-alls."""
+
+    E, K = 4, 2
+
+    def _modules(self, mesh):
+        from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+        from learning_jax_sharding_tpu.ops.moe_dispatch import make_moe_a2a_fn
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_EP_A2A
+
+        kw = dict(
+            features=32, hidden=64, num_experts=self.E, top_k=self.K,
+            dtype=jnp.float32,
+        )
+        a2a = MoEFeedForward(
+            dispatch="alltoall",
+            dispatch_fn=make_moe_a2a_fn(mesh, RULES_DP_EP_A2A), **kw,
+        )
+        ein = MoEFeedForward(dispatch="einsum", **kw)
+        return a2a, ein
+
+    def _grouped_ref(self, ein, params, x, d):
+        # The einsum path applied per token GROUP (one group per expert-
+        # axis shard): same params, per-group capacity — the semantics
+        # the all-to-all exchange implements.
+        outs = [
+            ein.apply({"params": params}, xg, mutable=("losses",))[0]
+            for xg in jnp.split(x, d, axis=0)
+        ]
+        return jnp.concatenate(outs, axis=0)
+
+    @pytest.mark.parametrize("cap", [1.25, 0.5])
+    def test_matches_grouped_einsum(self, mesh22, cap):
+        import dataclasses as dc
+
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_EP_A2A
+        from learning_jax_sharding_tpu.parallel.logical import activate
+
+        a2a, ein = self._modules(mesh22)
+        a2a = dc.replace(a2a, capacity_factor=cap)
+        ein = dc.replace(ein, capacity_factor=cap)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(4, 16, 32)), jnp.float32)
+        params = ein.init({"params": jax.random.key(0)}, x)["params"]
+        d = mesh22.shape["data"]
+
+        with activate(mesh22, RULES_DP_EP_A2A):
+            got = jax.jit(
+                lambda p, x: a2a.apply(
+                    {"params": p}, x, mutable=("losses",)
+                )[0]
+            )(params, x)
+        ref = self._grouped_ref(ein, params, x, d)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_grads_match_grouped_einsum(self, mesh22):
+        from learning_jax_sharding_tpu.parallel.logical import (
+            RULES_DP_EP_A2A,
+            activate,
+        )
+
+        a2a, ein = self._modules(mesh22)
+        rng = np.random.default_rng(4)
+        x = jnp.asarray(rng.normal(size=(4, 16, 32)), jnp.float32)
+        params = ein.init({"params": jax.random.key(0)}, x)["params"]
+        d = mesh22.shape["data"]
+
+        def loss_a2a(p):
+            out = a2a.apply({"params": p}, x, mutable=("losses",))[0]
+            return jnp.sum(jnp.sin(out))
+
+        def loss_ref(p):
+            return jnp.sum(jnp.sin(self._grouped_ref(ein, p, x, d)))
+
+        with activate(mesh22, RULES_DP_EP_A2A):
+            ga = jax.jit(jax.grad(loss_a2a))(params)
+        gr = jax.grad(loss_ref)(params)
+        for (kp, a), (_, e) in zip(
+            jax.tree_util.tree_leaves_with_path(ga),
+            jax.tree_util.tree_leaves_with_path(gr),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(e), rtol=2e-4, atol=2e-4,
+                err_msg=str(kp),
+            )
+
+    def test_hlo_has_all_to_alls(self, mesh22):
+        from learning_jax_sharding_tpu.parallel.hlo import collective_counts
+        from learning_jax_sharding_tpu.parallel.logical import (
+            RULES_DP_EP_A2A,
+            activate,
+        )
+
+        a2a, ein = self._modules(mesh22)
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(4, 16, 32)), jnp.float32)
+        params = ein.init({"params": jax.random.key(0)}, x)["params"]
+        with activate(mesh22, RULES_DP_EP_A2A):
+            counts = collective_counts(
+                jax.jit(
+                    lambda p, x: a2a.apply(
+                        {"params": p}, x, mutable=("losses",)
+                    )[0]
+                ),
+                params, x,
+            )
+        # One exchange out, one back.
+        assert counts.get("all-to-all", 0) >= 2, counts
+
+    def test_divisibility_validation(self, mesh22):
+        import dataclasses as dc
+
+        from learning_jax_sharding_tpu.parallel.logical import (
+            RULES_DP_EP_A2A,
+            activate,
+        )
+
+        a2a, ein = self._modules(mesh22)
+        a2a = dc.replace(a2a, num_experts=3)
+        x = jnp.zeros((4, 16, 32), jnp.float32)
+        with activate(mesh22, RULES_DP_EP_A2A):
+            with pytest.raises(ValueError, match="divisible"):
+                jax.jit(
+                    lambda x: a2a.init({"params": jax.random.key(0)}, x)
+                )(x)
+
+    def test_requires_dispatch_fn(self):
+        from learning_jax_sharding_tpu.models.moe import MoEFeedForward
+
+        mod = MoEFeedForward(
+            features=32, hidden=64, num_experts=4, dispatch="alltoall",
+        )
+        with pytest.raises(ValueError, match="dispatch_fn"):
+            mod.init(
+                {"params": jax.random.key(0)}, jnp.zeros((2, 4, 32))
+            )
+
+    def test_transformer_trains_a2a(self, mesh22):
+        """End to end: a tiny MoE transformer train step under
+        RULES_DP_EP_A2A with the all-to-all dispatch — compiles, runs,
+        loss finite, expert grads nonzero."""
+        import dataclasses as dc
+
+        from learning_jax_sharding_tpu.ops.moe_dispatch import make_moe_a2a_fn
+        from learning_jax_sharding_tpu.parallel.logical import RULES_DP_EP_A2A
+        from learning_jax_sharding_tpu.training.pipeline import (
+            make_train_step,
+            sharded_train_state,
+        )
+
+        cfg = dc.replace(
+            CONFIG_TINY_MOE, dtype=jnp.float32, num_experts=4,
+            moe_dispatch="alltoall",
+            moe_dispatch_fn=make_moe_a2a_fn(mesh22, RULES_DP_EP_A2A),
+        )
+        rng = np.random.default_rng(6)
+        tokens = rng.integers(0, cfg.vocab_size, size=(4, 17)).astype(np.int32)
+        batch = {"inputs": tokens[:, :-1], "targets": tokens[:, 1:]}
+        from learning_jax_sharding_tpu.parallel import mesh_sharding, put
+
+        sh = mesh_sharding(mesh22, "data", None)
+        batch = {k: put(v, sh) for k, v in batch.items()}
+        state, state_sh = sharded_train_state(
+            Transformer(cfg), optax.sgd(1e-2), batch["inputs"],
+            {"params": jax.random.key(0)}, mesh22, RULES_DP_EP_A2A,
+        )
+        step = make_train_step(
+            state_sh, {k: v.sharding for k, v in batch.items()}, mesh22,
+            RULES_DP_EP_A2A, loss_fn=next_token_loss,
+            aux_loss_collection="losses",
+        )
+        up0 = np.asarray(
+            jax.tree_util.tree_leaves(state.params["block_0"]["moe"]["up"])[0]
+        )
+        state2, loss = step(state, batch)   # donates state
+        assert np.isfinite(float(loss))
+        up1 = np.asarray(
+            jax.tree_util.tree_leaves(state2.params["block_0"]["moe"]["up"])[0]
+        )
+        assert not np.array_equal(up0, up1)
