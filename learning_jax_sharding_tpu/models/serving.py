@@ -202,6 +202,25 @@ class ContinuousEngine:
     schedule-independence contract); streaming ``add_request`` assigns
     engine-global monotonic ids.
 
+    ``decode_chain``: dispatch up to this many decode blocks (and refill
+    chunks) BACK-TO-BACK, carrying tok/active/remaining device-to-device
+    and syncing the host once per chain. Rows freeze on device at
+    EOS/budget exactly as within one block, so chaining cannot change
+    results (test-pinned). Measured on the tunneled chip
+    (``scripts/perf_block_ladder.py``): each jitted CALL costs ~120 ms
+    in the dispatch itself, so the first-order decode lever is
+    ``decode_block_steps`` (tokens per compiled program — 823 → 2,637
+    tok/s from K=16 to K=128 on the standard queue; size K ≈
+    max_new_tokens so rows retire at block boundaries); chaining stacks
+    a further gain on decode (K=64 chain=2 > K=64) and is the MAIN
+    lever for REFILL, whose chunk contents are host-known (long-prompt
+    prefill 13.0k → 20.2k tok/s at S=4096). The cost of both is
+    scheduling granularity: retirement/admission coarsen by up to a
+    chain/block, and token-visibility telemetry (ITL) becomes
+    chain-granular — size to the workload (throughput queues high,
+    latency-sensitive arrivals low; ``decode_chain`` is a public
+    attribute, tunable per phase at runtime).
+
     ``dequantize``: serve QUANTIZED target weights, exactly as
     ``make_generate_fn`` does — ``True`` for an int8/int4 tree from
     ``quantize_tree`` dequantized inside the jitted steps, ``"fused"`` /
@@ -267,6 +286,7 @@ class ContinuousEngine:
         eos_id: Optional[int] = None,
         refill_chunk: int = 64,
         decode_block_steps: int = 16,
+        decode_chain: int = 1,
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
@@ -285,6 +305,8 @@ class ContinuousEngine:
             raise ValueError(
                 "batch_size, refill_chunk, decode_block_steps must be >= 1"
             )
+        if decode_chain < 1:
+            raise ValueError(f"decode_chain must be >= 1, got {decode_chain}")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -672,8 +694,12 @@ class ContinuousEngine:
                     length=decode_block_steps,
                 )
             )
+            # tok and pos ride the return so CHAINED dispatches can carry
+            # them device-to-device (decode_chain — no host sync between
+            # chained blocks).
             return (
-                buffer, count, acc, prop, active, remaining, t_cache, d_cache
+                buffer, count, acc, prop, tok, pos, active, remaining,
+                t_cache, d_cache,
             )
 
         # --- engine configuration and compiled programs -------------------
@@ -684,6 +710,10 @@ class ContinuousEngine:
         self._eos = eos_id
         self._refill_chunk = refill_chunk
         self._block_steps = decode_block_steps
+        # Public and runtime-tunable: a frontend can raise it for
+        # throughput phases and drop it to 1 for latency-sensitive
+        # arrival bursts (read at each dispatch).
+        self.decode_chain = decode_chain
         self._num_draft = num_draft
         self._speculative = speculative
         self._paged = paged
@@ -1155,112 +1185,162 @@ class ContinuousEngine:
     def _refill_dispatch(self, params, d_params, retired):
         # One refill chunk for every slot with pending prompt tokens
         # (fresh or continuing); decoding rows ride along with length 0.
+        # With ``decode_chain > 1`` up to that many CHUNKS are dispatched
+        # back-to-back with a single host sync at the end — chunk
+        # contents are host-known (the pending prompt), so nothing in a
+        # later chunk depends on an earlier chunk's readback; a long
+        # prompt pays one round trip per CHAIN instead of per chunk.
         b = self._b
-        lengths = np.zeros((b,), np.int32)
-        chunk = np.zeros((b, self._refill_chunk), np.int32)
-        for slot in range(b):
-            n = min(self._pending[slot].size, self._refill_chunk)
-            if n:
-                chunk[slot, :n] = self._pending[slot][:n]
-                lengths[slot] = n
-        if not lengths.any():
-            return False
-        if self._paged:
+        segs = []            # (lengths, tok_new_device) per chained chunk
+        for _ in range(self.decode_chain):
+            lengths = np.zeros((b,), np.int32)
+            chunk = np.zeros((b, self._refill_chunk), np.int32)
             for slot in range(b):
-                if lengths[slot]:
-                    consumed = self._plen[slot] - self._pending[slot].size
-                    try:
-                        self._ensure(slot, consumed + int(lengths[slot]))
-                    except RuntimeError:
-                        # Backpressure instead of a wedge: if any OTHER
-                        # slot is mid-flight, its retirement will free
-                        # pages — requeue this request and serve the
-                        # rest. Raise only when this request is alone
-                        # (it can never fit).
-                        if not any(
-                            self._req[s] >= 0
-                            for s in range(b) if s != slot
-                        ):
-                            raise
-                        self._unadmit(slot)
-                        self._preemptions += 1
-                        lengths[slot] = 0
-                        chunk[slot, :] = 0
+                n = min(self._pending[slot].size, self._refill_chunk)
+                if n:
+                    chunk[slot, :n] = self._pending[slot][:n]
+                    lengths[slot] = n
             if not lengths.any():
-                return False
+                break
+            if self._paged:
+                for slot in range(b):
+                    if lengths[slot]:
+                        consumed = (
+                            self._plen[slot] - self._pending[slot].size
+                        )
+                        try:
+                            self._ensure(
+                                slot, consumed + int(lengths[slot])
+                            )
+                        except RuntimeError:
+                            # Backpressure instead of a wedge: if any
+                            # OTHER slot is mid-flight, its retirement
+                            # will free pages — requeue this request and
+                            # serve the rest. Raise only when this
+                            # request is alone (it can never fit).
+                            if not any(
+                                self._req[s] >= 0
+                                for s in range(b) if s != slot
+                            ):
+                                raise
+                            self._unadmit(slot)
+                            self._preemptions += 1
+                            lengths[slot] = 0
+                            chunk[slot, :] = 0
+                if not lengths.any():
+                    break
+                if self._cache is None:
+                    # Create faithful zero caches with a NO-OP refill
+                    # (every length 0 — no writes, no advances), so the
+                    # real first chunk runs through the steady-state path
+                    # with the block tables already installed.
+                    _, self._cache = self._first_refill_fn(
+                        params, d_params,
+                        jnp.zeros_like(jnp.asarray(chunk)),
+                        jnp.zeros((b,), jnp.int32), self._rid_arr(),
+                        self.rng,
+                    )
+                    self.cache_creations += 1
+                self._cache = self._set_tables(self._cache)
             if self._cache is None:
-                # Create faithful zero caches with a NO-OP refill (every
-                # length 0 — no writes, no advances), so the real first
-                # chunk runs through the steady-state path with the
-                # block tables already installed.
-                _, self._cache = self._first_refill_fn(
-                    params, d_params,
-                    jnp.zeros_like(jnp.asarray(chunk)),
-                    jnp.zeros((b,), jnp.int32), self._rid_arr(), self.rng,
+                tok_new, self._cache = self._first_refill_fn(
+                    params, d_params, jnp.asarray(chunk),
+                    jnp.asarray(lengths), self._rid_arr(), self.rng,
                 )
                 self.cache_creations += 1
-            self._cache = self._set_tables(self._cache)
-        if self._cache is None:
-            tok_new, self._cache = self._first_refill_fn(
-                params, d_params, jnp.asarray(chunk),
-                jnp.asarray(lengths), self._rid_arr(), self.rng,
-            )
-            self.cache_creations += 1
-        else:
-            # COPIES, not the live arrays: jnp.asarray of a numpy array
-            # can be zero-copy (the jax.Array aliases the host buffer),
-            # and the flags are cleared in place below while the
-            # dispatch may still be executing asynchronously — an
-            # aliased clear would erase the admission resets mid-flight
-            # (observed as flaky stale-counter corruption on CPU).
-            tok_new, self._cache = self._refill_step_fn(
-                params, d_params, self._cache, jnp.asarray(chunk),
-                jnp.asarray(lengths),
-                jnp.asarray(self._needs_reset.copy()),
-                jnp.asarray(self._reset_to.copy()),
-                self._rid_arr(), self.rng,
-            )
-        # The dispatch has its own copy of the admission resets, so
-        # consume the flags (every flagged row had pending tokens and
-        # therefore rode this chunk).
-        self._needs_reset[:] = False
-        self._reset_to[:] = 0
-        tok_new = np.asarray(tok_new)
-        now = time.perf_counter()
-        for slot in range(b):
-            if lengths[slot]:
-                self._pending[slot] = self._pending[slot][lengths[slot]:]
-                if self._pending[slot].size == 0 and self._req[slot] >= 0:
-                    # Prompt complete: its first token came from this
-                    # chunk's last valid position.
-                    t = int(tok_new[slot])
-                    self._out[slot].append(t)
-                    self._emitted[slot] = 1
-                    self._tok[slot] = t
-                    self._slot_req[slot].first_token_t = now
-                    self._ttimes[slot].append(now)
-                    if (self._eos is not None and t == self._eos) or (
-                        self._max_new == 1
+            else:
+                # COPIES, not the live arrays: jnp.asarray of a numpy
+                # array can be zero-copy (the jax.Array aliases the host
+                # buffer), and the flags are cleared in place below while
+                # the dispatch may still be executing asynchronously — an
+                # aliased clear would erase the admission resets
+                # mid-flight (observed as flaky stale-counter corruption
+                # on CPU).
+                tok_new, self._cache = self._refill_step_fn(
+                    params, d_params, self._cache, jnp.asarray(chunk),
+                    jnp.asarray(lengths),
+                    jnp.asarray(self._needs_reset.copy()),
+                    jnp.asarray(self._reset_to.copy()),
+                    self._rid_arr(), self.rng,
+                )
+            # The dispatch has its own copy of the admission resets, so
+            # consume the flags (every flagged row had pending tokens and
+            # therefore rode this chunk).
+            self._needs_reset[:] = False
+            self._reset_to[:] = 0
+            # Advance the host-side pending views NOW (later chunks in
+            # the chain read them); completions are processed after the
+            # single sync, per segment, in order.
+            seg_completes = []
+            for slot in range(b):
+                if lengths[slot]:
+                    self._pending[slot] = (
+                        self._pending[slot][lengths[slot]:]
+                    )
+                    if (
+                        self._pending[slot].size == 0
+                        and self._req[slot] >= 0
                     ):
-                        self._retire(slot, now, retired)
-                    else:
-                        self._active[slot] = True
+                        seg_completes.append(slot)
+            segs.append((tok_new, seg_completes))
+        if not segs:
+            return False
+        for tok_new, seg_completes in segs:
+            tok_new = np.asarray(tok_new)   # each segment's own sync
+            now = time.perf_counter()       # its host-visibility time
+            for slot in seg_completes:
+                # Prompt complete: its first token came from this
+                # chunk's last valid position.
+                t = int(tok_new[slot])
+                self._out[slot].append(t)
+                self._emitted[slot] = 1
+                self._tok[slot] = t
+                self._slot_req[slot].first_token_t = now
+                self._ttimes[slot].append(now)
+                if (self._eos is not None and t == self._eos) or (
+                    self._max_new == 1
+                ):
+                    self._retire(slot, now, retired)
+                else:
+                    self._active[slot] = True
         return True
 
     def _decode_dispatch(self, params, d_params, retired):
-        # One decode BLOCK for the active rows. Returns whether a
-        # dispatch actually ran (idle polling must not accrue time).
+        # Up to ``decode_chain`` decode BLOCKS dispatched back-to-back —
+        # the carries (tok/active/remaining[/pos]) flow device-to-device
+        # and the host syncs ONCE at the end. Rows freeze in-scan at
+        # EOS/budget exactly as within one block, so chaining cannot
+        # change results (test-pinned). Scheduling tradeoff, not
+        # correctness: a slot retiring mid-chain idles until the chain's
+        # one sync, so admission (and queued-request TTFT) coarsens by
+        # up to chain-1 blocks — decode_chain is an explicit opt-in
+        # (default 1). NOTE the measured first-order decode lever on the
+        # tunneled chip is decode_block_steps (dispatch cost ~120 ms is
+        # paid per CALL; see perf_block_ladder.py) — chaining stacks a
+        # further gain and is the main lever for refill. Returns whether
+        # a dispatch actually ran (idle polling must not accrue time).
         if not self._active.any():
             return False
         b = self._b
         remaining = np.asarray(
             [max(0, self._max_new - e) for e in self._emitted], np.int32
         )
+        # Never dispatch blocks that CANNOT emit: the host knows every
+        # row's remaining budget, so the chain is capped at the blocks
+        # the longest-running active row can still use — with
+        # K = max_new_tokens an entire wave retires in block 1 and an
+        # uncapped chain would run chain-1 fully-frozen (but fully
+        # priced) no-op blocks.
+        worst = int(remaining[self._active].max())
+        per_block = self._block_steps * (
+            (self._num_draft + 1) if self._speculative else 1
+        )
+        chain = min(self.decode_chain, -(-worst // per_block))
         if self._paged:
-            # Cover every position this block can write: K new tokens per
-            # row (plain), or K rounds of up to num_draft+1 plus the
-            # verify chunk's headroom (speculative) — capped by the row's
-            # remaining budget either way.
+            # Cover every position this chain can write: chain·K new
+            # tokens per row (plain), or chain·K rounds of up to
+            # num_draft+1 plus the verify chunk's headroom (speculative)
+            # — capped by the row's remaining budget either way.
             for slot in range(b):
                 if not self._active[slot]:
                     continue
@@ -1269,12 +1349,15 @@ class ContinuousEngine:
                     span = (
                         min(
                             int(remaining[slot]),
-                            self._block_steps * (self._num_draft + 1),
+                            chain * self._block_steps
+                            * (self._num_draft + 1),
                         )
                         + self._num_draft + 1
                     )
                 else:
-                    span = min(int(remaining[slot]), self._block_steps)
+                    span = min(
+                        int(remaining[slot]), chain * self._block_steps
+                    )
                 try:
                     self._ensure(slot, pos_s + span)
                 except RuntimeError:
@@ -1290,51 +1373,79 @@ class ContinuousEngine:
             if not self._active.any():
                 return False
             self._cache = self._set_tables(self._cache)
+            # Re-cap the chain from the SURVIVING rows: if backpressure
+            # just un-admitted the longest-running row, the chain sized
+            # to it would dispatch fully-frozen no-op blocks.
+            worst = int(remaining[self._active].max())
+            chain = min(self.decode_chain, -(-worst // per_block))
+        tok_d = jnp.asarray(self._tok)
+        active_d = jnp.asarray(self._active.astype(np.int32))
+        remaining_d = jnp.asarray(remaining)
+        rid = self._rid_arr()
         if self._speculative:
             # Each row's current cache index: prompt + emitted - 1 (its
             # pending token is not yet in the cache).
-            pos = np.asarray(
-                [
-                    max(0, p + e - 1)
-                    for p, e in zip(self._plen, self._emitted)
-                ],
-                np.int32,
-            )
-            t_cache, d_cache = self._cache
-            buffer, counts, acc, prop, _, _, t_cache, d_cache = (
-                self._decode_block_spec_fn(
-                    params, d_params, t_cache, d_cache,
-                    jnp.asarray(self._tok),
-                    jnp.asarray(self._active.astype(np.int32)),
-                    jnp.asarray(pos), jnp.asarray(remaining),
-                    self._rid_arr(), self.rng,
+            pos_d = jnp.asarray(
+                np.asarray(
+                    [
+                        max(0, p + e - 1)
+                        for p, e in zip(self._plen, self._emitted)
+                    ],
+                    np.int32,
                 )
             )
-            self._cache = (t_cache, d_cache)
-            buffer = np.asarray(buffer)
-            counts = np.asarray(counts)
-            now = time.perf_counter()
-            self._spec_accepted += int(np.asarray(acc).sum())
-            self._spec_proposed += int(np.asarray(prop).sum())
-            was_active = self._active.copy()
-            for slot in range(b):
-                if was_active[slot]:
-                    self._consume(
-                        slot, buffer[slot, : counts[slot]].tolist(), now,
-                        retired,
+            t_cache, d_cache = self._cache
+            segs = []
+            for _ in range(chain):
+                (buffer, counts, acc, prop, tok_d, pos_d, active_d,
+                 remaining_d, t_cache, d_cache) = (
+                    self._decode_block_spec_fn(
+                        params, d_params, t_cache, d_cache, tok_d,
+                        active_d, pos_d, remaining_d, rid, self.rng,
                     )
-        else:
-            toks, _, _, self._cache = self._decode_block_fn(
-                params, self._cache, jnp.asarray(self._tok),
-                jnp.asarray(self._active.astype(np.int32)),
-                jnp.asarray(remaining), self._rid_arr(), self.rng,
-            )
-            toks = np.asarray(toks)
+                )
+                segs.append((buffer, counts, acc, prop))
+            self._cache = (t_cache, d_cache)
+            # ONE sync for the whole chain.
+            segs = [
+                tuple(np.asarray(x) for x in seg) for seg in segs
+            ]
             now = time.perf_counter()
             was_active = self._active.copy()
-            for slot in range(b):
-                if was_active[slot]:
-                    self._consume(slot, toks[slot].tolist(), now, retired)
+            for buffer, counts, acc, prop in segs:
+                self._spec_accepted += int(acc.sum())
+                self._spec_proposed += int(prop.sum())
+                for slot in range(b):
+                    # Consume segments chronologically; a slot retired in
+                    # an earlier segment (req < 0) emits nothing real in
+                    # later ones — its lane froze on device.
+                    if was_active[slot] and self._req[slot] >= 0:
+                        self._consume(
+                            slot, buffer[slot, : counts[slot]].tolist(),
+                            now, retired,
+                        )
+        else:
+            segs = []
+            for _ in range(chain):
+                toks, active_d, remaining_d, self._cache = (
+                    self._decode_block_fn(
+                        params, self._cache, tok_d, active_d,
+                        remaining_d, rid, self.rng,
+                    )
+                )
+                # Next block's pending token: each row's last emitted
+                # (frozen rows repeat their token — correct carry).
+                tok_d = toks[:, -1]
+                segs.append(toks)
+            segs = [np.asarray(t) for t in segs]   # ONE sync
+            now = time.perf_counter()
+            was_active = self._active.copy()
+            for toks in segs:
+                for slot in range(b):
+                    if was_active[slot] and self._req[slot] >= 0:
+                        self._consume(
+                            slot, toks[slot].tolist(), now, retired
+                        )
         return True
 
     def step(self, params, draft_params=None) -> list[int]:

@@ -55,47 +55,46 @@ prompts = [
     for _ in range(NREQ)
 ]
 
-PAGE = 64
-pages_per_req = -(-(S + NEW) // PAGE)
-PAGES = SLOTS * pages_per_req + 1 + 4
-eng = ContinuousEngine(
-    cfg, mesh, RULES_DP_TP, batch_size=SLOTS, max_new_tokens=NEW,
-    refill_chunk=512, inference_dtype=jnp.bfloat16,
-    paged_pages=PAGES, page_size=PAGE,
-)
-# Warm the executables on a short queue (compiles excluded).
-eng.serve(params, [p[:600] for p in prompts[:SLOTS]])
+# decode_chain=8 (round 5): each prompt's 8 refill chunks ride ONE host
+# sync instead of eight — the tunnel's ~110 ms/dispatch round trip
+# dominated the first (unchained) measurement. Page-size ladder: the
+# paged kernel's k-grid steps at page granularity, so page 64 walks
+# 128 grid steps per q-tile at L=8192 where page 256 walks 32 — the
+# long-context page-size tradeoff (vs prefix-sharing granularity).
+for PAGE in (64, 256):
+    pages_per_req = -(-(S + NEW) // PAGE)
+    PAGES = SLOTS * pages_per_req + 1 + 4
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=SLOTS, max_new_tokens=NEW,
+        refill_chunk=512, inference_dtype=jnp.bfloat16,
+        paged_pages=PAGES, page_size=PAGE, decode_chain=8,
+    )
+    # Warm the executables on a short queue (compiles excluded).
+    eng.serve(params, [p[:600] for p in prompts[:SLOTS]])
 
-eng.reset_stats()
-t0 = time.perf_counter()
-outs = eng.serve(params, prompts)
-dt = time.perf_counter() - t0
-lat = eng.last_latency
-st = eng.last_stats
-prefill_toks = NREQ * S
-gen_toks = sum(len(o) - S for o in outs)
-assert all(len(o) == S + NEW for o in outs)
-print(
-    f"[longserve] {NREQ} x S={S} prompts, {SLOTS} slots, +{NEW} out: "
-    f"{dt:.2f} s wall, {prefill_toks:,} prompt tokens + {gen_toks} generated",
-    flush=True,
-)
-print(
-    f"[longserve] prefill throughput (prompt tokens / refill seconds): "
-    f"{prefill_toks / lat['refill_s']:,.0f} tok/s "
-    f"(refill {lat['refill_frac']:.0%} of engine time)",
-    flush=True,
-)
-print(
-    f"[longserve] TTFT p50 {lat['ttft_p50']:.2f} s / p99 "
-    f"{lat['ttft_p99']:.2f} s (includes second-wave queue wait: "
-    f"{NREQ} requests through {SLOTS} slots), TPOT p50 "
-    f"{lat['tpot_p50'] * 1e3:.1f} ms",
-    flush=True,
-)
-print(
-    f"[longserve] page high-water {st['page_high_water']}/{st['pages_total']}"
-    f" pages ({st['page_high_water'] * PAGE:,} token-slots of KV live; "
-    f"pool sized {PAGES})",
-    flush=True,
-)
+    eng.reset_stats()
+    t0 = time.perf_counter()
+    outs = eng.serve(params, prompts)
+    dt = time.perf_counter() - t0
+    lat = eng.last_latency
+    st = eng.last_stats
+    prefill_toks = NREQ * S
+    gen_toks = sum(len(o) - S for o in outs)
+    assert all(len(o) == S + NEW for o in outs)
+    print(
+        f"[longserve] page={PAGE}: {NREQ} x S={S} prompts, {SLOTS} slots, "
+        f"+{NEW} out: {dt:.2f} s wall, {prefill_toks:,} prompt tokens + "
+        f"{gen_toks} generated",
+        flush=True,
+    )
+    print(
+        f"[longserve] page={PAGE}: prefill throughput "
+        f"{prefill_toks / lat['refill_s']:,.0f} tok/s "
+        f"(refill {lat['refill_frac']:.0%} of engine time); TTFT p50 "
+        f"{lat['ttft_p50']:.2f} s / p99 {lat['ttft_p99']:.2f} s; TPOT p50 "
+        f"{lat['tpot_p50'] * 1e3:.1f} ms; high-water "
+        f"{st['page_high_water']}/{st['pages_total']} pages "
+        f"({st['page_high_water'] * PAGE:,} token-slots)",
+        flush=True,
+    )
+    eng.close()

@@ -44,9 +44,12 @@ prompts = [
     rng.integers(1, cfg.vocab_size, size=(PLEN,)).astype(np.int32)
     for _ in range(NREQ)
 ]
+# decode_block_steps=128 = max_new (rows retire at block boundaries) —
+# the dispatch-granularity sizing rule from perf_block_ladder.py.
 serve = make_continuous_engine(
     cfg, mesh, RULES_DP_TP, batch_size=8, max_new_tokens=NEW,
     refill_chunk=64, inference_dtype=jnp.bfloat16,
+    decode_block_steps=128,
 )
 serve(params, prompts[:9])            # warm executables
 t0 = time.perf_counter()
@@ -56,8 +59,9 @@ lat = serve.last_latency
 toks = sum(len(o) - PLEN for o in outs)
 print(
     f"[refill-share] standard decode-heavy queue ({NREQ} x {PLEN}-tok "
-    f"prompts, +{NEW} out, 8 slots): {toks / dt:,.0f} tok/s, refill "
-    f"{lat['refill_s']:.2f} s / decode {lat['decode_s']:.2f} s -> refill "
-    f"= {lat['refill_frac']:.1%} of dispatched engine time",
+    f"prompts, +{NEW} out, 8 slots, K=128): "
+    f"{toks / dt:,.0f} tok/s, refill {lat['refill_s']:.2f} s / decode "
+    f"{lat['decode_s']:.2f} s -> refill = {lat['refill_frac']:.1%} of "
+    f"dispatched engine time",
     flush=True,
 )

@@ -1065,3 +1065,52 @@ class TestPersistentEngine:
         got = paged.serve(params, [long_p.copy()])
         np.testing.assert_array_equal(got[0], ref[0])
         assert paged.last_stats["page_high_water"] >= 44 // self.PAGE
+
+    @pytest.mark.parametrize("temp", [0.0, 1.0])
+    def test_decode_chain_bit_identical(self, setup, mesh22, temp):
+        """decode_chain > 1 (device-carried block chaining, one host
+        sync per chain) cannot change results: greedy AND sampled, with
+        EOS retirement mid-chain, vs the chain=1 engine."""
+        from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+
+        cfg, params, prompts = setup
+        kw = dict(
+            batch_size=2, max_new_tokens=NEW, refill_chunk=4,
+            decode_block_steps=2, temperature=temp,
+            top_k=16 if temp else None,
+        )
+        plain_ref = _rect_reference(cfg, mesh22, params, prompts[0])
+        eos = int(plain_ref[len(prompts[0]) + 1]) if temp == 0.0 else None
+        one = ContinuousEngine(cfg, mesh22, RULES_DP_TP, eos_id=eos, **kw)
+        chained = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, eos_id=eos, decode_chain=3, **kw
+        )
+        key = jax.random.key(9)
+        a = one.serve(params, prompts, rng=key)
+        b = chained.serve(params, prompts, rng=key)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(y, x)
+
+    def test_decode_chain_speculative_paged(self, setup, mesh22):
+        """Chained SPECULATIVE blocks over the paged pool — the whole
+        carry set (tok/pos/active/remaining + both caches) rides the
+        chain; outputs stay bit-identical to the unchained engine."""
+        from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+
+        cfg, params, prompts = setup
+        bcfg = dataclasses.replace(cfg, decode_attention="blocked")
+        dcfg = dataclasses.replace(DRAFT_CFG, decode_attention="blocked")
+        kw = dict(
+            batch_size=2, max_new_tokens=NEW, refill_chunk=4,
+            decode_block_steps=2, draft_config=dcfg, num_draft=2,
+            paged_pages=9, page_size=self.PAGE,
+        )
+        dp = _draft_params()
+        one = ContinuousEngine(bcfg, mesh22, RULES_TP_SERVING, **kw)
+        chained = ContinuousEngine(
+            bcfg, mesh22, RULES_TP_SERVING, decode_chain=4, **kw
+        )
+        a = one.serve(params, prompts[:4], draft_params=dp)
+        b = chained.serve(params, prompts[:4], draft_params=dp)
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(y, x)
