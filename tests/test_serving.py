@@ -1079,15 +1079,25 @@ class TestPersistentEngine:
             decode_block_steps=2, temperature=temp,
             top_k=16 if temp else None,
         )
-        plain_ref = _rect_reference(cfg, mesh22, params, prompts[0])
-        eos = int(plain_ref[len(prompts[0]) + 1]) if temp == 0.0 else None
+        key_probe = jax.random.key(9)
+        if temp == 0.0:
+            plain_ref = _rect_reference(cfg, mesh22, params, prompts[0])
+            eos = int(plain_ref[len(prompts[0]) + 1])
+        else:
+            # Derive an eos the SAMPLED streams actually emit, so EOS
+            # retirement mid-chain is exercised at temperature > 0 too.
+            probe = ContinuousEngine(cfg, mesh22, RULES_DP_TP, **kw)
+            outs = probe.serve(params, prompts, rng=key_probe)
+            gen = np.concatenate(
+                [o[len(p):] for o, p in zip(outs, prompts)]
+            )
+            eos = int(np.bincount(gen).argmax())
         one = ContinuousEngine(cfg, mesh22, RULES_DP_TP, eos_id=eos, **kw)
         chained = ContinuousEngine(
             cfg, mesh22, RULES_DP_TP, eos_id=eos, decode_chain=3, **kw
         )
-        key = jax.random.key(9)
-        a = one.serve(params, prompts, rng=key)
-        b = chained.serve(params, prompts, rng=key)
+        a = one.serve(params, prompts, rng=key_probe)
+        b = chained.serve(params, prompts, rng=key_probe)
         for x, y in zip(a, b):
             np.testing.assert_array_equal(y, x)
 
