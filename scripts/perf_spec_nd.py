@@ -1,0 +1,91 @@
+"""num_draft ladder at partial acceptance — the last speculative knob.
+
+Extends ``perf_spec_partial2.py``: with the 125M-class trained pair and
+TUNED dispatch granularity (K=64, chain=4; plain engine 1,694 tok/s),
+sweep ``num_draft``. Measured (2026-08-01, PERF.md round 5):
+
+    nd=1: acceptance 53%, 0.36x plain
+    nd=2: acceptance 41%, 0.38x plain
+    nd=4: acceptance 27%, 0.38x plain
+
+Acceptance-per-proposal rises exactly as theory predicts as nd falls —
+and the speedup does not move: the round cost is floor-bound per draft
+TOKEN-STEP on this chip, so no num_draft rescues partial acceptance.
+Speculation profits only near full acceptance; the lever is draft
+QUALITY.
+
+Run from /root/repo:  python - < scripts/perf_spec_nd.py
+"""
+import sysconfig, tempfile, time, dataclasses
+from pathlib import Path
+import jax, jax.numpy as jnp, numpy as np
+from learning_jax_sharding_tpu.data import MemmapTokenDataset, write_token_file
+from learning_jax_sharding_tpu.data.tokenizer import BPETokenizer
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import Transformer, TransformerConfig
+from learning_jax_sharding_tpu.ops.flash_attention import make_flash_attn_fn
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+
+stdlib = Path(sysconfig.get_paths()["stdlib"])
+texts, total = [], 0
+for f in sorted(stdlib.glob("*.py")):
+    try: t = f.read_text(errors="ignore")
+    except OSError: continue
+    texts.append(t); total += len(t)
+    if total > 1_600_000: break
+held_out = texts[-4:]
+train_text = "\n".join(texts[:-4])
+tok = BPETokenizer.train(train_text[:300_000], vocab_size=512)
+tokens = tok.encode_to_array(train_text)
+ho = tok.encode_to_array("\n".join(held_out))
+
+mk = dict(vocab_size=512, rope=True, max_seq_len=512)
+TARGET = TransformerConfig(num_layers=12, features=768, num_heads=12, head_dim=64,
+                           hidden=3072, attn_fn=make_flash_attn_fn(), **mk)
+DRAFT = TransformerConfig(num_layers=2, features=256, num_heads=4, head_dim=64,
+                          hidden=1024, **mk)
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+with tempfile.TemporaryDirectory() as tmp:
+    data = MemmapTokenDataset(write_token_file(Path(tmp) / "c.bin", tokens), seq_len=128)
+    def train(cfg, steps, label):
+        t0 = time.perf_counter()
+        state, hist = fit(Transformer(cfg), data, mesh, RULES_DP_TP,
+                          TrainLoopConfig(steps=steps, global_batch_size=32,
+                                          learning_rate=3e-4, log_every=steps))
+        print(f"[nd] {label}: loss {hist[-1]['loss']:.3f} ({time.perf_counter()-t0:.0f}s)", flush=True)
+        return state.params
+    t_params = train(TARGET, 3000, "target 12Lx768")
+    d_params = train(DRAFT, 3000, "draft 2Lx256")
+
+rng = np.random.default_rng(0)
+NREQ, NEW = 24, 64
+prompts = [ho[int(s):int(s)+int(n)].astype(np.int32)
+           for s, n in zip(rng.integers(0, len(ho)-40, size=NREQ),
+                           rng.integers(12, 33, size=NREQ))]
+t_serve = dataclasses.replace(TARGET, attn_fn=None)
+d_serve = dataclasses.replace(DRAFT, attn_fn=None)
+# Tuned dispatch granularity (round 5): K = max_new, chained refills.
+common = dict(batch_size=8, max_new_tokens=NEW, refill_chunk=32,
+              inference_dtype=jnp.bfloat16, decode_block_steps=NEW, decode_chain=4)
+
+def run(label, serve, kw):
+    serve(t_params, prompts[:9], **kw)
+    t0 = time.perf_counter()
+    outs = serve(t_params, prompts, **kw)
+    dt = time.perf_counter() - t0
+    toks = sum(len(o) - p.size for o, p in zip(outs, prompts))
+    st = serve.last_stats or {}
+    acc = st.get("spec_accept_rate")
+    extra = f", acceptance {acc:.0%}" if acc is not None else ""
+    print(f"[nd] {label}: {toks/dt:,.0f} tok/s ({dt:.2f} s){extra}", flush=True)
+    return toks / dt
+
+plain = make_continuous_engine(t_serve, mesh, RULES_DP_TP, **common)
+base = run("plain engine (K=64, chain=4)", plain, {})
+for nd in (1, 2, 4):
+    eng = make_continuous_engine(t_serve, mesh, RULES_DP_TP,
+                                 draft_config=d_serve, num_draft=nd, **common)
+    r = run(f"speculative nd={nd}", eng, {"draft_params": d_params})
+    print(f"[nd]   -> {r/base:.2f}x plain", flush=True)
