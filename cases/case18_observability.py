@@ -1,0 +1,227 @@
+"""Case 18 — unified telemetry: one layer answers the three questions.
+
+The reference's entire observability story is ``visualize_array_sharding``
+plus one flawed timing loop (SURVEY.md §5; `case6_attention.py:234-238`
+times async dispatch with no sync). This driver runs the serving engine
+under the round-6 telemetry subsystem and shows that ONE layer answers,
+per request and per step:
+
+1. WHERE DID THE TIME GO — the engine's tracer records a per-request
+   span timeline (arrival → admit → first token → finish) and
+   per-dispatch refill/decode spans, exported as Perfetto-loadable
+   Chrome trace JSON (plus JSONL); spans bridge into
+   ``jax.profiler.TraceAnnotation`` so an XProf capture shows the same
+   phases against device ops.
+2. WHAT IS THE ENGINE DOING — the metrics registry (counters, gauges,
+   fixed-bucket histograms) carries queue depth, page-pool live/high
+   water, acceptance counters, latency histograms; exported as
+   Prometheus text exposition and a JSON snapshot. ``last_stats`` /
+   ``last_latency`` are window deltas over the SAME registry.
+3. DID XLA DO WHAT WE THINK — compile_watch counts compiles and compile
+   seconds (process-wide via jax.monitoring, per-program via the
+   executable cache), and the engine's ``collective_inventory()`` reads
+   the per-dispatch collective ops straight off its compiled HLO.
+
+Artifacts (written to ``sys.argv[1]`` or ``./case18_out``; open
+trace.json in https://ui.perfetto.dev):
+
+* ``trace.json``   — Chrome trace events (Perfetto)
+* ``events.jsonl`` — the same events, one JSON object per line
+* ``metrics.prom`` — Prometheus text exposition
+* ``report.json``  — run report: TTFT/TPOT percentiles, page-pool
+  high-water, compile counts/seconds, per-step collective counts
+* ``xprof/``       — a jax.profiler capture of the traced steps
+
+Run: ``python cases/case18_observability.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses
+import json
+import pathlib
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.hlo import COLLECTIVE_OPS
+from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
+from learning_jax_sharding_tpu.telemetry import CompileWatch
+from learning_jax_sharding_tpu.utils.profiling import trace
+
+outdir = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "case18_out")
+outdir.mkdir(parents=True, exist_ok=True)
+
+mesh = build_mesh((2, 4), ("data", "model"))
+cfg = dataclasses.replace(
+    CONFIG_TINY, dtype=jnp.float32, decode_attention="blocked"
+)
+model = Transformer(cfg)
+params = nn.meta.unbox(
+    jax.jit(lambda r, t: model.init({"params": r}, t))(
+        jax.random.key(3), np.zeros((2, 8), np.int32)
+    )["params"]
+)
+rng = np.random.default_rng(18)
+NEW = 6
+system = rng.integers(1, cfg.vocab_size, size=(20,)).astype(np.int32)
+prompts = [system] + [
+    rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+    for n in (3, 9, 12)
+] + [system.copy()]
+
+watch = CompileWatch()
+engine = ContinuousEngine(
+    cfg, mesh, RULES_TP_SERVING, batch_size=2, max_new_tokens=NEW,
+    refill_chunk=4, paged_pages=12, page_size=16, prefix_cache=True,
+)
+
+# --- serve the queue under the watch, with an XProf capture ------------
+with watch:
+    # Streaming admission: the first two requests arrive up front, the
+    # rest while the engine is mid-flight — a real arrival process, with
+    # a jax.profiler capture around the traced steps so the engine's
+    # TraceAnnotations land in XProf next to the device ops.
+    rids, results, late = [], {}, list(prompts[2:])
+    with trace(outdir / "xprof"):
+        for p in prompts[:2]:
+            rids.append(engine.add_request(p))
+        steps = 0
+        while engine.has_work() or late:
+            engine.step(params)
+            results.update(engine.pop_finished())
+            steps += 1
+            if late and steps >= 2:
+                rids.append(engine.add_request(late.pop(0)))
+lat = engine.latency_stats()      # the streaming session's window
+compiles_after_stream = engine.compile_counts()
+assert len(results) == len(prompts)
+
+# A second windowed serve() call: last_stats/last_latency must be the
+# registry-derived window, and the repeated system prompt must hit the
+# prefix registry populated by the streaming session above.
+out2 = engine.serve(params, [system.copy()])
+assert engine.last_stats["prefix_hits"] == 1, engine.last_stats
+np.testing.assert_array_equal(out2[0], results[rids[0]])
+print(
+    "PASS: streaming + one-shot serving under telemetry — prefix hit "
+    "across sessions, outputs bit-identical"
+)
+
+# --- pillar 1: the trace ------------------------------------------------
+engine.tracer.dump_chrome_trace(outdir / "trace.json")
+engine.tracer.dump_jsonl(outdir / "events.jsonl")
+events = engine.tracer.events
+names = {e["name"] for e in events}
+for needed in (
+    "request.arrival", "request.admit", "request.first_token",
+    "request", "engine.refill", "engine.decode",
+):
+    assert needed in names, (needed, sorted(names))
+begins = [e for e in events if e["ph"] == "b" and e["name"] == "request"]
+ends = [e for e in events if e["ph"] == "e" and e["name"] == "request"]
+assert {e["id"] for e in begins} == {e["id"] for e in ends}
+xplane = list((outdir / "xprof").rglob("*.xplane.pb"))
+assert xplane, "no XProf capture landed"
+print(
+    f"PASS: {len(events)} trace events (complete/instant/async), "
+    f"{len(begins)} request timelines, XProf capture at "
+    f"{xplane[0].parent.name}/"
+)
+
+# --- pillar 2: the registry ---------------------------------------------
+engine.registry.dump_prometheus(outdir / "metrics.prom")
+prom = (outdir / "metrics.prom").read_text()
+for needed in (
+    "# TYPE engine_requests_finished_total counter",
+    "# TYPE engine_pages_live gauge",
+    "# TYPE engine_ttft_seconds histogram",
+    "engine_ttft_seconds_bucket{le=\"+Inf\"}",
+):
+    assert needed in prom, needed
+snap = engine.registry.snapshot()
+assert snap["engine_requests_finished_total"] == len(prompts) + 1
+assert snap["engine_pages_live__high_water"] >= 1
+print(
+    "PASS: Prometheus exposition + JSON snapshot — "
+    f"{int(snap['engine_requests_finished_total'])} requests, "
+    f"{int(snap['engine_tokens_generated_total'])} tokens, page "
+    f"high-water {int(snap['engine_pages_live__high_water'])}"
+)
+
+# --- pillar 3: compile accounting + collective inventory ----------------
+compiles = engine.compile_counts()
+# Warmup is ≤2 executables per program (the 2nd call re-specializes to
+# the steady-state cache shardings); the pinned claim is that the whole
+# SECOND serving session compiled NOTHING — a mid-serve recompile is
+# the failure this probe exists to catch.
+assert compiles == compiles_after_stream, (compiles_after_stream, compiles)
+assert all(v is not None and v <= 2 for v in compiles.values()), compiles
+inventory = engine.collective_inventory()
+assert "decode_block" in inventory and "refill_step" in inventory
+for counts in inventory.values():
+    assert set(counts) == set(COLLECTIVE_OPS)
+# TP serving on the (2,4) mesh: the decode step must put collectives on
+# the wire (GSPMD chooses which — the inventory makes it checkable).
+assert sum(inventory["decode_block"].values()) > 0, inventory
+cw = watch.report()
+print(
+    f"PASS: compile accounting — steady state after warmup "
+    f"{compiles}, {cw['backend_compiles']} backend compiles / "
+    f"{cw['backend_compile_seconds']:.1f} s under the watch; decode "
+    f"collectives per step: "
+    + ", ".join(f"{k}={v}" for k, v in inventory["decode_block"].items()
+                if v)
+)
+
+# --- the run report ------------------------------------------------------
+report = {
+    "requests": lat["requests"] + 1,
+    "ttft_p50": lat["ttft_p50"],
+    "ttft_p99": lat["ttft_p99"],
+    "tpot_p50": lat.get("tpot_p50"),
+    "tpot_p99": lat.get("tpot_p99"),
+    "queue_wait_p50": lat["queue_wait_p50"],
+    "refill_frac": lat["refill_frac"],
+    "page_pool": {
+        "high_water": int(snap["engine_pages_live__high_water"]),
+        "total": engine.last_stats["pages_total"],
+        "prefix_hits_last_window": engine.last_stats["prefix_hits"],
+    },
+    "compile": {
+        "per_program_compiles": compiles,
+        "backend_compiles": cw["backend_compiles"],
+        "backend_compile_seconds": cw["backend_compile_seconds"],
+        "monitoring_available": cw["monitoring_available"],
+    },
+    "collectives_per_step": inventory,
+    "registry": snap,
+}
+with open(outdir / "report.json", "w") as f:
+    json.dump(report, f, indent=2, sort_keys=True)
+for k in ("ttft_p50", "ttft_p99", "tpot_p50"):
+    assert report[k] is not None and report[k] > 0, (k, report[k])
+print(
+    f"PASS: run report — TTFT p50 {report['ttft_p50'] * 1e3:.0f} ms / "
+    f"p99 {report['ttft_p99'] * 1e3:.0f} ms, TPOT p50 "
+    f"{report['tpot_p50'] * 1e3:.1f} ms, refill "
+    f"{report['refill_frac']:.0%} of dispatched time"
+)
+
+print(
+    f"PASS: case18 — telemetry artifacts in {outdir}/ (open trace.json "
+    "in ui.perfetto.dev; point Prometheus at metrics.prom; xprof/ in "
+    "TensorBoard)"
+)

@@ -12,6 +12,9 @@ A brand-new framework with the capabilities of ``entrpn/learning-jax-sharding``
   feed-forward, composed transformer blocks).
 * ``training/`` — the sharded-init / train_step / apply pipeline: parameters
   are born sharded, steps are single SPMD executables.
+* ``telemetry/`` — unified observability: structured spans (Perfetto/XProf),
+  metrics registry (JSON + Prometheus exposition), compile/collective
+  accounting.
 * ``utils/`` — correct benchmarking (warmup + sync + MFU), profiling,
   checkpointing.
 

@@ -99,6 +99,9 @@ from learning_jax_sharding_tpu.models.transformer import (
     TransformerConfig,
 )
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+from learning_jax_sharding_tpu.telemetry import MetricsRegistry, Tracer
+from learning_jax_sharding_tpu.telemetry.compile_watch import cache_size
+from learning_jax_sharding_tpu.utils.profiling import annotate
 
 
 def _reset_rows(
@@ -273,6 +276,22 @@ class ContinuousEngine:
       first), ``itl_p50/p99`` (raw host-visibility gaps — block-granular
       by design: tokens land ``decode_block_steps`` at a time), and
       ``queue_wait_p50/p99`` (arrival → slot admission).
+
+    TELEMETRY (round 6): the engine meters into a
+    :class:`~learning_jax_sharding_tpu.telemetry.MetricsRegistry`
+    (``engine.registry`` — counters/gauges/histograms with Prometheus
+    text exposition; engine-local unless one is passed in, and passing a
+    shared one makes the counters fleet totals while ``last_stats``
+    windows then span every engine metering into it) and traces
+    into a :class:`~learning_jax_sharding_tpu.telemetry.Tracer`
+    (``engine.tracer`` — a per-request span timeline arrival → admit →
+    first token → finish plus per-dispatch refill/decode spans,
+    exportable as Perfetto-loadable Chrome trace JSON). ``last_stats``
+    and ``last_latency`` are re-derived from the registry (window deltas
+    over cumulative counters), so their shapes and values keep the
+    pinned contract. ``compile_counts()`` reports per-program compile
+    counts and ``collective_inventory()`` the per-dispatch collective
+    ops from the compiled HLO.
     """
 
     def __init__(
@@ -300,6 +319,8 @@ class ContinuousEngine:
         paged_pages: Optional[int] = None,
         page_size: int = 64,
         prefix_cache: bool = False,
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[Tracer] = None,
     ):
         if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
             raise ValueError(
@@ -738,12 +759,94 @@ class ContinuousEngine:
         self._next_rid = 0
         self._cast_src: tuple | None = None
         self._cast_out: tuple | None = None
+        # Most recent dispatch arguments (closures over the engine's
+        # live state — cleared when the served params change, see
+        # _cast_params) — collective_inventory() re-lowers the compiled
+        # programs with them to read per-step collective counts off the
+        # HLO. NOTE abstract ShapeDtypeStruct capture does not work
+        # here: AOT lowering treats a struct's sharding as a hard
+        # constraint, and host-committed inputs that live dispatch
+        # happily transfers then refuse to lower against the mesh.
+        self._last_first_refill_args = None
+        self._last_refill_args = None
+        self._last_decode_args = None
+        self._init_telemetry(registry, tracer)
         self._init_slots()
         if paged:
             self._init_pool()
         self.reset_stats()
 
     # --- state initialisation --------------------------------------------
+
+    def _init_telemetry(self, registry, tracer):
+        # Engine-local by default: each engine is its own measurement
+        # window and trace timeline. A shared registry AGGREGATES: the
+        # cumulative engine_* counters then carry every engine's
+        # activity, so a scraper sees fleet totals — but window-derived
+        # per-call stats (last_stats/last_latency) would include the
+        # other engines' increments too. Keep the default (engine-local)
+        # when per-engine stats matter; share only for fleet export.
+        self.registry = (
+            registry if registry is not None else MetricsRegistry()
+        )
+        self.tracer = tracer if tracer is not None else Tracer()
+        r = self.registry
+        self._c_requests = r.counter(
+            "engine_requests_total", "requests enqueued")
+        self._c_finished = r.counter(
+            "engine_requests_finished_total", "requests retired")
+        self._c_tokens = r.counter(
+            "engine_tokens_generated_total", "generated tokens emitted")
+        self._c_preempt = r.counter(
+            "engine_preemptions_total",
+            "recompute preemptions under page-pool pressure")
+        self._c_pfx_hits = r.counter(
+            "engine_prefix_hits_total",
+            "admissions that reused retained prefix pages")
+        self._c_pfx_pages = r.counter(
+            "engine_prefix_pages_reused_total",
+            "prefix pages mapped on admission")
+        self._c_spec_acc = r.counter(
+            "engine_spec_accepted_total",
+            "draft tokens accepted by the verifier")
+        self._c_spec_prop = r.counter(
+            "engine_spec_proposed_total", "draft tokens proposed")
+        self._c_refill_s = r.counter(
+            "engine_refill_seconds_total",
+            "host-observed refill dispatch+sync seconds")
+        self._c_decode_s = r.counter(
+            "engine_decode_seconds_total",
+            "host-observed decode dispatch+sync seconds")
+        self._c_refill_n = r.counter(
+            "engine_refill_dispatches_total", "refill dispatches")
+        self._c_decode_n = r.counter(
+            "engine_decode_dispatches_total", "decode dispatches")
+        self._c_creations = r.counter(
+            "engine_cache_creations_total", "cache-creating first refills")
+        self._g_queue = r.gauge(
+            "engine_queue_depth", "requests waiting for a slot")
+        self._g_active = r.gauge(
+            "engine_active_slots", "slots actively decoding")
+        self._g_pages = r.gauge(
+            "engine_pages_live", "live (non-retained) pages held")
+        self._g_retained = r.gauge(
+            "engine_prefix_pages_retained",
+            "reference-free retained prefix pages")
+        self._h_ttft = r.histogram(
+            "engine_ttft_seconds", "arrival to first visible token")
+        self._h_tpot = r.histogram(
+            "engine_tpot_seconds", "per-request mean inter-token seconds")
+        self._h_itl = r.histogram(
+            "engine_itl_seconds", "raw host-visibility gaps")
+        self._h_wait = r.histogram(
+            "engine_queue_wait_seconds", "arrival to slot admission")
+        self._h_e2e = r.histogram(
+            "engine_e2e_seconds", "arrival to retirement")
+
+    def _win_delta(self, counter):
+        # The stats window (reset_stats → snapshot) over a cumulative
+        # counter: value minus its base at the last reset.
+        return counter.value - self._win_base.get(counter.name, 0.0)
 
     def _init_slots(self):
         b = self._b
@@ -774,31 +877,39 @@ class ContinuousEngine:
         t_cap = self._cfg.max_seq_len // self._page_size
         self._table_np = np.zeros((b, t_cap), np.int32)
         self._tables_dirty = True
-        # Prefix-cache state: page-aligned token-prefix bytes → the page
-        # holding that prefix's LAST page of K/V; refcounts for pages
+        # Prefix-cache state (the metrics registry is the separate,
+        # public ``self.registry``): page-aligned token-prefix bytes →
+        # the page holding that prefix's LAST page of K/V; refcounts for pages
         # shared by live slots; ref-0 registered pages stay evictable in
         # LRU order (dict preserves insertion order).
-        self._registry: dict[bytes, int] = {}
+        self._prefix_registry: dict[bytes, int] = {}
         self._key_of_page: dict[int, bytes] = {}
         self._refcnt: dict[int, int] = {}
         self._cached_lru: dict[int, None] = {}
         self._shared_count = [0] * b   # leading registry pages per slot
+        self._g_pages.set(0)
+        self._g_retained.set(0)
 
     def reset_stats(self):
-        """Zero the per-window counters (``serve()`` calls this at entry;
-        streaming users call it to start a measurement window)."""
-        self._high_water = 0
-        self._preemptions = 0
-        self._prefix_hits = 0
-        self._prefix_pages_reused = 0
-        self._spec_accepted = 0
-        self._spec_proposed = 0
+        """Start a stats window (``serve()`` calls this at entry;
+        streaming users call it to start a measurement window). The
+        registry's counters are CUMULATIVE (Prometheus semantics) and
+        are never zeroed — the window is a base snapshot, and
+        ``last_stats``/``latency_stats`` report deltas against it, so
+        per-call stats keep their pinned meaning while a scraper sees
+        monotone series."""
         self._completed: list[dict] = []
         self._itl: list[float] = []
-        # Where engine wall time goes (dispatch + readback, host-observed):
-        # the refill share is the "refill pause" decoding rows suffer.
-        self._refill_s = 0.0
-        self._decode_s = 0.0
+        self._win_base = {
+            c.name: c.value
+            for c in (
+                self._c_preempt, self._c_pfx_hits, self._c_pfx_pages,
+                self._c_spec_acc, self._c_spec_prop, self._c_refill_s,
+                self._c_decode_s,
+            )
+        }
+        # Window high-water for the page-pool gauge (live value rides on).
+        self._g_pages.reset_high_water()
 
     def reset(self):
         """Abandon all in-flight work and return the engine to idle.
@@ -828,6 +939,8 @@ class ContinuousEngine:
             )
         self._cache = None
         self._cast_src = self._cast_out = None
+        self._last_first_refill_args = None
+        self._last_refill_args = self._last_decode_args = None
         if self._paged:
             self._init_pool()
 
@@ -847,9 +960,12 @@ class ContinuousEngine:
             )
         for pid in list(self._cached_lru):
             del self._cached_lru[pid]
-            del self._registry[self._key_of_page.pop(pid)]
+            del self._prefix_registry[self._key_of_page.pop(pid)]
             del self._refcnt[pid]
             self._free_pages.append(pid)
+        # Refresh the export gauges: retained pages just went to zero and
+        # a scraper must not keep seeing the flushed K/V.
+        self._update_high_water()
 
     # --- page allocator ----------------------------------------------------
 
@@ -861,7 +977,7 @@ class ContinuousEngine:
             # serve live requests before retained ones.
             pid = next(iter(self._cached_lru))
             del self._cached_lru[pid]
-            del self._registry[self._key_of_page.pop(pid)]
+            del self._prefix_registry[self._key_of_page.pop(pid)]
             del self._refcnt[pid]
             return pid
         raise RuntimeError(
@@ -870,16 +986,21 @@ class ContinuousEngine:
             "lower concurrency"
         )
 
-    def _update_high_water(self):
+    def _live_pages(self) -> int:
         # LIVE pages only: retained reference-free prefix pages are
         # reclaimable at will, so they are not footprint — they are
         # reported separately (``prefix_pages_retained``).
-        live = (
+        return (
             (self._paged_pages - 1)
             - len(self._free_pages)
             - len(self._cached_lru)
         )
-        self._high_water = max(self._high_water, live)
+
+    def _update_high_water(self):
+        # The gauge carries both the live value (export) and the window
+        # maximum (``last_stats["page_high_water"]``).
+        self._g_pages.set(self._live_pages())
+        self._g_retained.set(len(self._cached_lru))
 
     def _ensure(self, slot, tokens_through):
         # Allocate pages so positions [0, tokens_through) are mapped
@@ -908,6 +1029,7 @@ class ContinuousEngine:
             self._held[slot] = []
             self._table_np[slot, :] = 0
             self._tables_dirty = True
+            self._update_high_water()
             return
         if self._prefix:
             pages, ns = self._held[slot], self._shared_count[slot]
@@ -924,8 +1046,8 @@ class ContinuousEngine:
                 pid = pages[j]
                 if j < full:
                     key = p_toks[: (j + 1) * page_size].tobytes()
-                    if key not in self._registry:
-                        self._registry[key] = pid
+                    if key not in self._prefix_registry:
+                        self._prefix_registry[key] = pid
                         self._key_of_page[pid] = key
                         self._refcnt[pid] = 0
                         self._cached_lru[pid] = None
@@ -942,7 +1064,7 @@ class ContinuousEngine:
             # Touch this prompt's whole chain deepest-first, so every
             # ancestor ends up newer than its deepest tail.
             for k in range(full, 0, -1):
-                pid = self._registry.get(p_toks[: k * page_size].tobytes())
+                pid = self._prefix_registry.get(p_toks[: k * page_size].tobytes())
                 if pid is not None and pid in self._cached_lru:
                     del self._cached_lru[pid]
                     self._cached_lru[pid] = None
@@ -952,6 +1074,7 @@ class ContinuousEngine:
         self._held[slot] = []
         self._table_np[slot, :] = 0
         self._tables_dirty = True
+        self._update_high_water()
 
     def _set_tables(self, cache):
         # Push the host tables into every layer's block_table leaf
@@ -1018,6 +1141,12 @@ class ContinuousEngine:
         )
         self._cast_src = (params, draft_params)
         self._cast_out = out
+        # The stored dispatch-args closures reference the PREVIOUS cast
+        # trees — stale for collective_inventory(), and keeping them
+        # would hold both parameter trees in HBM across a checkpoint
+        # swap. Drop them; the next dispatch re-captures.
+        self._last_first_refill_args = None
+        self._last_refill_args = self._last_decode_args = None
         return out
 
     def add_request(self, prompt, *, rid: int | None = None) -> int:
@@ -1044,6 +1173,11 @@ class ContinuousEngine:
         self._queue.append(
             _Request(rid=rid, prompt=p, arrival_t=time.perf_counter())
         )
+        self._c_requests.inc()
+        self._g_queue.set(len(self._queue))
+        self.tracer.instant(
+            "request.arrival", rid=rid, prompt_len=int(p.size)
+        )
         return rid
 
     def has_work(self) -> bool:
@@ -1062,25 +1196,36 @@ class ContinuousEngine:
         r.finish_t = now
         n = self._emitted[slot]
         times = self._ttimes[slot]
-        self._itl.extend(
-            b - a for a, b in zip(times, times[1:])
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        self._itl.extend(gaps)
+        for g in gaps:
+            self._h_itl.observe(g)
+        rec = dict(
+            rid=r.rid,
+            prompt_len=int(r.prompt.size),
+            generated=n,
+            queue_wait=r.admit_t - r.arrival_t,
+            ttft=(
+                r.first_token_t - r.arrival_t
+                if r.first_token_t is not None else None
+            ),
+            e2e=now - r.arrival_t,
+            tpot=(
+                (now - r.first_token_t) / (n - 1) if n > 1 else None
+            ),
         )
-        self._completed.append(
-            dict(
-                rid=r.rid,
-                prompt_len=int(r.prompt.size),
-                generated=n,
-                queue_wait=r.admit_t - r.arrival_t,
-                ttft=(
-                    r.first_token_t - r.arrival_t
-                    if r.first_token_t is not None else None
-                ),
-                e2e=now - r.arrival_t,
-                tpot=(
-                    (now - r.first_token_t) / (n - 1) if n > 1 else None
-                ),
-            )
-        )
+        self._completed.append(rec)
+        # Histograms carry the same observations for export; the exact
+        # percentiles in latency_stats() stay sample-based (pinned).
+        self._c_finished.inc()
+        self._c_tokens.inc(n)
+        self._h_wait.observe(rec["queue_wait"])
+        self._h_e2e.observe(rec["e2e"])
+        if rec["ttft"] is not None:
+            self._h_ttft.observe(rec["ttft"])
+        if rec["tpot"] is not None:
+            self._h_tpot.observe(rec["tpot"])
+        self.tracer.async_end("request", r.rid, generated=n)
         self._finished[r.rid] = r
         retired.append(r.rid)
         self._slot_req[slot] = None
@@ -1122,6 +1267,7 @@ class ContinuousEngine:
         results (test-pinned)."""
         r = self._slot_req[slot]
         self._queue.appendleft(r)
+        self.tracer.instant("request.preempted", rid=r.rid, slot=slot)
         if self._paged:
             self._release(slot, register=False)
         self._slot_req[slot] = None
@@ -1143,6 +1289,13 @@ class ContinuousEngine:
                 first_admission = r.admit_t is None
                 if first_admission:
                     r.admit_t = now
+                    self.tracer.async_begin(
+                        "request", r.rid,
+                        prompt_len=int(r.prompt.size), slot=slot,
+                    )
+                self.tracer.instant(
+                    "request.admit", rid=r.rid, slot=slot
+                )
                 prompt = r.prompt
                 self._slot_req[slot] = r
                 self._req[slot] = r.rid
@@ -1161,7 +1314,7 @@ class ContinuousEngine:
                     for k in range(
                         1, (prompt.size - 1) // self._page_size + 1
                     ):
-                        pid = self._registry.get(
+                        pid = self._prefix_registry.get(
                             prompt[: k * self._page_size].tobytes()
                         )
                         if pid is None:
@@ -1179,8 +1332,10 @@ class ContinuousEngine:
                         self._pending[slot] = prompt[s_len:]
                         self._reset_to[slot] = s_len
                         if first_admission:
-                            self._prefix_hits += 1
-                            self._prefix_pages_reused += len(shared)
+                            self._c_pfx_hits.inc()
+                            self._c_pfx_pages.inc(len(shared))
+                        self._update_high_water()
+        self._g_queue.set(len(self._queue))
 
     def _refill_dispatch(self, params, d_params, retired):
         # One refill chunk for every slot with pending prompt tokens
@@ -1224,7 +1379,7 @@ class ContinuousEngine:
                             ):
                                 raise
                             self._unadmit(slot)
-                            self._preemptions += 1
+                            self._c_preempt.inc()
                             lengths[slot] = 0
                             chunk[slot, :] = 0
                 if not lengths.any():
@@ -1234,20 +1389,27 @@ class ContinuousEngine:
                     # (every length 0 — no writes, no advances), so the
                     # real first chunk runs through the steady-state path
                     # with the block tables already installed.
-                    _, self._cache = self._first_refill_fn(
+                    first_args = (
                         params, d_params,
                         jnp.zeros_like(jnp.asarray(chunk)),
                         jnp.zeros((b,), jnp.int32), self._rid_arr(),
                         self.rng,
                     )
+                    _, self._cache = self._first_refill_fn(*first_args)
                     self.cache_creations += 1
+                    self._c_creations.inc()
+                    self._last_first_refill_args = lambda: first_args
                 self._cache = self._set_tables(self._cache)
             if self._cache is None:
-                tok_new, self._cache = self._first_refill_fn(
+                first_args = (
                     params, d_params, jnp.asarray(chunk),
                     jnp.asarray(lengths), self._rid_arr(), self.rng,
                 )
+                with annotate("engine.first_refill"):
+                    tok_new, self._cache = self._first_refill_fn(*first_args)
                 self.cache_creations += 1
+                self._c_creations.inc()
+                self._last_first_refill_args = lambda: first_args
             else:
                 # COPIES, not the live arrays: jnp.asarray of a numpy
                 # array can be zero-copy (the jax.Array aliases the host
@@ -1256,12 +1418,19 @@ class ContinuousEngine:
                 # aliased clear would erase the admission resets
                 # mid-flight (observed as flaky stale-counter corruption
                 # on CPU).
-                tok_new, self._cache = self._refill_step_fn(
-                    params, d_params, self._cache, jnp.asarray(chunk),
-                    jnp.asarray(lengths),
-                    jnp.asarray(self._needs_reset.copy()),
-                    jnp.asarray(self._reset_to.copy()),
-                    self._rid_arr(), self.rng,
+                chunk_d = jnp.asarray(chunk)
+                lengths_d = jnp.asarray(lengths)
+                reset_d = jnp.asarray(self._needs_reset.copy())
+                reset_to_d = jnp.asarray(self._reset_to.copy())
+                rid_d = self._rid_arr()
+                with annotate("engine.refill_step"):
+                    tok_new, self._cache = self._refill_step_fn(
+                        params, d_params, self._cache, chunk_d, lengths_d,
+                        reset_d, reset_to_d, rid_d, self.rng,
+                    )
+                self._last_refill_args = lambda: (
+                    params, d_params, self._cache, chunk_d, lengths_d,
+                    reset_d, reset_to_d, rid_d, self.rng,
                 )
             # The dispatch has its own copy of the admission resets, so
             # consume the flags (every flagged row had pending tokens and
@@ -1297,6 +1466,9 @@ class ContinuousEngine:
                 self._tok[slot] = t
                 self._slot_req[slot].first_token_t = now
                 self._ttimes[slot].append(now)
+                self.tracer.instant(
+                    "request.first_token", rid=self._req[slot]
+                )
                 if (self._eos is not None and t == self._eos) or (
                     self._max_new == 1
                 ):
@@ -1369,7 +1541,7 @@ class ContinuousEngine:
                     ):
                         raise
                     self._unadmit(slot)
-                    self._preemptions += 1
+                    self._c_preempt.inc()
             if not self._active.any():
                 return False
             self._cache = self._set_tables(self._cache)
@@ -1397,15 +1569,20 @@ class ContinuousEngine:
             t_cache, d_cache = self._cache
             segs = []
             for _ in range(chain):
-                (buffer, counts, acc, prop, tok_d, pos_d, active_d,
-                 remaining_d, t_cache, d_cache) = (
-                    self._decode_block_spec_fn(
-                        params, d_params, t_cache, d_cache, tok_d,
-                        active_d, pos_d, remaining_d, rid, self.rng,
+                with annotate("engine.decode_block_spec"):
+                    (buffer, counts, acc, prop, tok_d, pos_d, active_d,
+                     remaining_d, t_cache, d_cache) = (
+                        self._decode_block_spec_fn(
+                            params, d_params, t_cache, d_cache, tok_d,
+                            active_d, pos_d, remaining_d, rid, self.rng,
+                        )
                     )
-                )
                 segs.append((buffer, counts, acc, prop))
             self._cache = (t_cache, d_cache)
+            self._last_decode_args = lambda: (
+                params, d_params, self._cache[0], self._cache[1], tok_d,
+                active_d, pos_d, remaining_d, rid, self.rng,
+            )
             # ONE sync for the whole chain.
             segs = [
                 tuple(np.asarray(x) for x in seg) for seg in segs
@@ -1413,8 +1590,8 @@ class ContinuousEngine:
             now = time.perf_counter()
             was_active = self._active.copy()
             for buffer, counts, acc, prop in segs:
-                self._spec_accepted += int(acc.sum())
-                self._spec_proposed += int(prop.sum())
+                self._c_spec_acc.inc(int(acc.sum()))
+                self._c_spec_prop.inc(int(prop.sum()))
                 for slot in range(b):
                     # Consume segments chronologically; a slot retired in
                     # an earlier segment (req < 0) emits nothing real in
@@ -1427,16 +1604,21 @@ class ContinuousEngine:
         else:
             segs = []
             for _ in range(chain):
-                toks, active_d, remaining_d, self._cache = (
-                    self._decode_block_fn(
-                        params, self._cache, tok_d, active_d,
-                        remaining_d, rid, self.rng,
+                with annotate("engine.decode_block"):
+                    toks, active_d, remaining_d, self._cache = (
+                        self._decode_block_fn(
+                            params, self._cache, tok_d, active_d,
+                            remaining_d, rid, self.rng,
+                        )
                     )
-                )
                 # Next block's pending token: each row's last emitted
                 # (frozen rows repeat their token — correct carry).
                 tok_d = toks[:, -1]
                 segs.append(toks)
+            self._last_decode_args = lambda: (
+                params, self._cache, tok_d, active_d, remaining_d, rid,
+                self.rng,
+            )
             segs = [np.asarray(t) for t in segs]   # ONE sync
             now = time.perf_counter()
             was_active = self._active.copy()
@@ -1461,12 +1643,24 @@ class ContinuousEngine:
             self._admit()
             t0 = time.perf_counter()
             if self._refill_dispatch(params, d_params, retired):
-                self._refill_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self._c_refill_s.inc(dt)
+                self._c_refill_n.inc()
+                self.tracer.complete(
+                    "engine.refill", t0, dt, retired=len(retired)
+                )
             elif self._decode_dispatch(params, d_params, retired):
                 # Only DISPATCHED time accrues: an idle poll (streaming
                 # drivers spin step() between arrivals) must not drown
                 # the refill/decode split.
-                self._decode_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self._c_decode_s.inc(dt)
+                self._c_decode_n.inc()
+                self.tracer.complete(
+                    "engine.decode", t0, dt, retired=len(retired)
+                )
+        self._g_active.set(int(self._active.sum()))
+        self._g_queue.set(len(self._queue))
         return retired
 
     # --- stats -------------------------------------------------------------
@@ -1493,41 +1687,96 @@ class ContinuousEngine:
         out.update(pcts([c["tpot"] for c in comp], "tpot"))
         out.update(pcts(self._itl, "itl"))
         out.update(pcts([c["e2e"] for c in comp], "e2e"))
-        busy = self._refill_s + self._decode_s
+        refill_s = self._win_delta(self._c_refill_s)
+        decode_s = self._win_delta(self._c_decode_s)
+        busy = refill_s + decode_s
         out.update(
-            refill_s=self._refill_s, decode_s=self._decode_s,
-            refill_frac=(self._refill_s / busy) if busy else None,
+            refill_s=refill_s, decode_s=decode_s,
+            refill_frac=(refill_s / busy) if busy else None,
         )
         return out
 
     def _snapshot_stats(self):
         # Mode stats keep the pre-persistence contract exactly (None when
-        # no mode is on — test-pinned); latency telemetry rides separately.
+        # no mode is on — test-pinned); the VALUES are window deltas over
+        # the cumulative registry counters, so last_stats is re-derived
+        # from the same metrics a Prometheus scrape would see.
         stats = {}
         if self._paged:
             stats.update(
-                page_high_water=self._high_water,
+                page_high_water=int(self._g_pages.high_water),
                 pages_total=self._paged_pages - 1,
                 page_size=self._page_size,
-                preemptions=self._preemptions,
+                preemptions=int(self._win_delta(self._c_preempt)),
             )
             if self._prefix:
                 stats.update(
-                    prefix_hits=self._prefix_hits,
-                    prefix_pages_reused=self._prefix_pages_reused,
+                    prefix_hits=int(self._win_delta(self._c_pfx_hits)),
+                    prefix_pages_reused=int(
+                        self._win_delta(self._c_pfx_pages)
+                    ),
                     prefix_pages_retained=len(self._cached_lru),
                 )
         if self._speculative:
+            acc = self._win_delta(self._c_spec_acc)
+            prop = self._win_delta(self._c_spec_prop)
             stats.update(
-                spec_accepted=self._spec_accepted,
-                spec_proposed=self._spec_proposed,
-                spec_accept_rate=(
-                    self._spec_accepted / self._spec_proposed
-                    if self._spec_proposed else None
-                ),
+                spec_accepted=int(acc),
+                spec_proposed=int(prop),
+                spec_accept_rate=(acc / prop) if prop else None,
             )
         self.last_stats = stats or None
         self.last_latency = self.latency_stats()
+
+    def compile_counts(self) -> dict[str, int | None]:
+        """Executable-cache size per compiled engine program — each is
+        that program's lifetime compile count (one executable per
+        distinct shape/static combination), the "did serving recompile
+        mid-flight?" probe. The steady-state engine holds these at 1."""
+        fns = {
+            "first_refill": self._first_refill_fn,
+            "refill_step": self._refill_step_fn,
+        }
+        if self._speculative:
+            fns["decode_block_spec"] = self._decode_block_spec_fn
+        else:
+            fns["decode_block"] = self._decode_block_fn
+        return {k: cache_size(f) for k, f in fns.items()}
+
+    def collective_inventory(self) -> dict[str, dict[str, int]]:
+        """Per-dispatch collective counts read off the engine's OWN
+        compiled programs — ``parallel.hlo.collective_counts`` over each
+        program re-lowered AOT with its most recent dispatch arguments
+        (costs a compile: a diagnostic for "what does one step put on
+        the wire", not a hot-path call). Keys appear only for
+        programs that have dispatched at least once on this engine
+        (``first_refill`` included, so single-chunk prefills are not
+        silently missing)."""
+        from learning_jax_sharding_tpu.telemetry.compile_watch import (
+            executable_report,
+        )
+
+        out: dict[str, dict[str, int]] = {}
+        with activate(self._mesh, self._rules):
+            if self._last_first_refill_args is not None:
+                out["first_refill"] = executable_report(
+                    self._first_refill_fn, *self._last_first_refill_args()
+                )["collectives"]
+            if self._last_refill_args is not None:
+                out["refill_step"] = executable_report(
+                    self._refill_step_fn, *self._last_refill_args()
+                )["collectives"]
+            if self._last_decode_args is not None:
+                if self._speculative:
+                    fn, name = (
+                        self._decode_block_spec_fn, "decode_block_spec"
+                    )
+                else:
+                    fn, name = self._decode_block_fn, "decode_block"
+                out[name] = executable_report(
+                    fn, *self._last_decode_args()
+                )["collectives"]
+        return out
 
     # --- one-shot entry ----------------------------------------------------
 
@@ -1559,8 +1808,9 @@ class ContinuousEngine:
         try:
             for i, p in enumerate(prompts):
                 self.add_request(p, rid=i)
-            while self.has_work():
-                self.step(params, draft_params)
+            with self.tracer.span("engine.serve", requests=len(prompts)):
+                while self.has_work():
+                    self.step(params, draft_params)
             ok = True
         finally:
             # Stats must reflect THIS call even when it raises — pool

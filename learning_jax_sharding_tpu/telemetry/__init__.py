@@ -1,0 +1,42 @@
+"""Unified telemetry (observability layer): spans, metrics, compile watch.
+
+Three pillars, one import point:
+
+* :mod:`~.telemetry.spans` — nested structured spans with explicit
+  device-sync points, bridged into ``jax.profiler.TraceAnnotation``
+  (XProf) and exported as Chrome trace-event JSON (Perfetto) + JSONL;
+* :mod:`~.telemetry.registry` — counters / gauges / fixed-bucket
+  histograms with JSON snapshot and Prometheus text exposition;
+* :mod:`~.telemetry.compile_watch` — recompilation + compile-time
+  accounting, per-executable FLOPs/bytes, and the per-step collective
+  inventory.
+
+Consumers: ``models.serving.ContinuousEngine`` (per-request span
+timeline, queue/page-pool gauges, acceptance counters — its
+``last_stats``/``last_latency`` are re-derived from the registry),
+``training.loop.fit`` + ``utils.metrics.MetricsLogger`` (same registry),
+``bench.py`` (compile-vs-steady-state phase breakdown), and
+``cases/case18_observability.py`` (the end-to-end driver that dumps all
+three artifact kinds).
+"""
+
+from learning_jax_sharding_tpu.telemetry.compile_watch import (  # noqa: F401
+    CompileWatch,
+    WatchedFunction,
+    cache_size,
+    executable_report,
+    watched,
+)
+from learning_jax_sharding_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from learning_jax_sharding_tpu.telemetry.spans import (  # noqa: F401
+    Tracer,
+    default_tracer,
+    device_sync,
+)

@@ -1,0 +1,262 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+One process-wide place for every numeric the stack emits — the serving
+engine's admission/page-pool/acceptance counters, the training loop's
+step timings, compile accounting — exported two ways:
+
+* :meth:`MetricsRegistry.snapshot` — a JSON-able dict (the
+  ``BENCH_r{N}.json`` / run-report style);
+* :meth:`MetricsRegistry.prometheus_text` — Prometheus text exposition
+  (version 0.0.4), so a scrape endpoint is one ``http.server`` handler
+  away.
+
+Deliberately dependency-free and small: three metric kinds, get-or-create
+by name, thread-safe. Percentile-grade latency numbers stay sample-based
+where exactness is pinned (``ContinuousEngine.latency_stats``); the
+histograms here carry the same observations in fixed buckets for export,
+where bucket resolution is the accepted trade.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import threading
+from typing import Sequence
+
+#: Default histogram upper bounds (seconds-flavoured, Prometheus-style).
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Counter:
+    """Monotonically increasing value. ``inc`` with a negative amount
+    raises — a counter that goes down is a gauge."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease (inc {amount})"
+            )
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value, plus a high-water mark (max value seen since
+    the last :meth:`reset_high_water`) — the page-pool/queue-depth shape
+    of measurement, where the peak inside a window matters as much as the
+    current value."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+        self._high_water = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+            if value > self._high_water:
+                self._high_water = value
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+            if self._value > self._high_water:
+                self._high_water = self._value
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    @property
+    def high_water(self) -> float:
+        return self._high_water
+
+    def reset_high_water(self) -> None:
+        with self._lock:
+            self._high_water = self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram: cumulative bucket counts (Prometheus
+    ``le`` semantics), sum, and count. Buckets are chosen at creation and
+    never resize — snapshots are O(buckets), observation is O(log
+    buckets)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self, name: str, help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"histogram {name} needs at least one bucket")
+        self.name = name
+        self.help = help
+        self.buckets = bs
+        self._counts = [0] * (len(bs) + 1)   # [+Inf] overflow last
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] ending with (+inf, count)."""
+        out, running = [], 0
+        for ub, c in zip(self.buckets, self._counts):
+            running += c
+            out.append((ub, running))
+        out.append((math.inf, self._count))
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric store. Re-requesting a name returns the same
+    object; requesting it as a different kind (or a histogram with
+    different buckets) raises — silent double-registration is how two
+    subsystems end up fighting over one counter."""
+
+    def __init__(self):
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+                return m
+        if not isinstance(m, cls):
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested as {cls.kind}"
+            )
+        if kwargs.get("buckets") is not None and tuple(
+            sorted(float(b) for b in kwargs["buckets"])
+        ) != m.buckets:
+            raise ValueError(
+                f"histogram {name!r} already registered with different "
+                f"buckets"
+            )
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def metrics(self) -> list:
+        return list(self._metrics.values())
+
+    # --- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able dump: counters/gauges as numbers, gauges' high-water
+        alongside, histograms as {buckets, counts, sum, count}."""
+        out: dict = {}
+        for m in self.metrics():
+            if m.kind == "counter":
+                out[m.name] = m.value
+            elif m.kind == "gauge":
+                out[m.name] = m.value
+                out[m.name + "__high_water"] = m.high_water
+            else:
+                out[m.name] = {
+                    "buckets": list(m.buckets),
+                    "counts": [c for _, c in m.cumulative()],
+                    "sum": m.sum,
+                    "count": m.count,
+                }
+        return out
+
+    def dump_json(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.snapshot(), f, indent=2, sort_keys=True)
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+
+        def fmt(v: float) -> str:
+            if v == math.inf:
+                return "+Inf"
+            if float(v).is_integer():
+                return str(int(v))
+            return repr(float(v))
+
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            if m.kind in ("counter", "gauge"):
+                lines.append(f"{m.name} {fmt(m.value)}")
+            else:
+                for ub, c in m.cumulative():
+                    lines.append(
+                        f'{m.name}_bucket{{le="{fmt(ub)}"}} {c}'
+                    )
+                lines.append(f"{m.name}_sum {fmt(m.sum)}")
+                lines.append(f"{m.name}_count {m.count}")
+        return "\n".join(lines) + "\n"
+
+    def dump_prometheus(self, path) -> None:
+        with open(path, "w") as f:
+            f.write(self.prometheus_text())
+
+
+_DEFAULT = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry — subsystems that are not handed one
+    explicitly meter here."""
+    return _DEFAULT

@@ -1,0 +1,215 @@
+"""Structured spans: nested wall-clock timing that lands in three places.
+
+A :class:`Tracer` records host-side events (spans, instants, per-request
+async intervals) and exports them as
+
+* **Chrome trace-event JSON** (:meth:`Tracer.chrome_trace`) — load the
+  file straight into Perfetto (https://ui.perfetto.dev) or
+  ``chrome://tracing``;
+* **JSONL** (:meth:`Tracer.dump_jsonl`) — one event per line for
+  machine consumption, the ``BENCH_r{N}.json`` style;
+* **XProf/TensorBoard**, live: every :meth:`Tracer.span` also enters a
+  ``jax.profiler.TraceAnnotation``, so when a ``utils.profiling.trace``
+  capture is active the framework phases appear on the profiler's host
+  timeline next to the device ops they dispatched.
+
+Honesty under async dispatch is explicit: a span around a jitted call
+measures DISPATCH unless it contains a sync point (the reference's
+timing flaw, `case6_attention.py:234-238`). :meth:`Tracer.sync` is that
+sync point — it forces a one-element host readback of its argument
+(``jax.block_until_ready`` alone is not trustworthy behind remote-device
+transports, see ``utils/bench.py::_sync``) and records an instant event
+marking where in the timeline the device was known to be done.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+import jax
+
+
+def device_sync(out: Any) -> None:
+    """Force completion of ``out`` by reading one element back to host —
+    THE honest sync point. Delegates to ``utils.bench._sync`` so the
+    repo has exactly one definition of what "synced" means (a fix to the
+    tunneled-transport behavior documented there reaches every span)."""
+    from learning_jax_sharding_tpu.utils.bench import _sync
+
+    if not jax.tree_util.tree_leaves(out):
+        return
+    _sync(out)
+
+
+class Tracer:
+    """Collects trace events; cheap enough to leave on.
+
+    Events are Chrome trace-event dicts (``ph`` phases used: ``X``
+    complete, ``i`` instant, ``b``/``e`` async begin/end). Timestamps are
+    microseconds since tracer construction; the buffer is a bounded RING
+    (``max_events``): past the cap the OLDEST events are dropped (with a
+    count), because the trace someone exports after an incident needs
+    the most recent window, not the run's first minutes.
+    """
+
+    def __init__(self, *, enabled: bool = True, max_events: int = 200_000):
+        import collections
+
+        self.enabled = enabled
+        self.dropped = 0
+        self._events: "collections.deque[dict]" = collections.deque(
+            maxlen=max_events
+        )
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._max_events = max_events
+
+    # --- time/emission -----------------------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _emit(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self.dropped += 1   # the append below evicts the oldest
+            self._events.append(ev)
+
+    def _base(self, name: str, ph: str, **extra) -> dict:
+        ev = {
+            "name": name,
+            "ph": ph,
+            "ts": self._now_us(),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        ev.update(extra)
+        return ev
+
+    def _stack(self) -> list[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # --- recording API -----------------------------------------------------
+
+    @contextlib.contextmanager
+    def span(self, name: str, **args) -> Iterator[None]:
+        """Nested complete event + XProf bridge. ``args`` become the
+        event's ``args`` dict (JSON-able values only)."""
+        if not self.enabled:
+            yield
+            return
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(name)
+        start = self._now_us()
+        try:
+            with jax.profiler.TraceAnnotation(name):
+                yield
+        finally:
+            stack.pop()
+            end = self._now_us()
+            ev = self._base(name, "X", dur=end - start)
+            ev["ts"] = start
+            if parent is not None:
+                args = dict(args, parent=parent)
+            if args:
+                ev["args"] = args
+            self._emit(ev)
+
+    def complete(
+        self, name: str, start_perf: float, duration_s: float, **args
+    ) -> None:
+        """Record a complete event retrospectively from host timestamps
+        (``time.perf_counter()`` start + seconds) — for call sites that
+        only know after the fact whether a dispatch actually ran."""
+        if not self.enabled:
+            return
+        ev = self._base(name, "X", dur=duration_s * 1e6)
+        ev["ts"] = (start_perf - self._t0) * 1e6
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def instant(self, name: str, **args) -> None:
+        if not self.enabled:
+            return
+        ev = self._base(name, "i", s="t")
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def async_begin(self, name: str, id: int, **args) -> None:
+        """Open an async interval (e.g. one request's admit→finish
+        lifetime) — Perfetto renders ``b``/``e`` pairs keyed by
+        (category, id) as horizontal tracks independent of call nesting."""
+        if not self.enabled:
+            return
+        ev = self._base(name, "b", id=int(id), cat=name)
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def async_end(self, name: str, id: int, **args) -> None:
+        if not self.enabled:
+            return
+        ev = self._base(name, "e", id=int(id), cat=name)
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def sync(self, out: Any, name: str = "device_sync") -> None:
+        """Honest sync point: host-readback ``out``, then mark the
+        instant the device was known done (see module docstring)."""
+        if not self.enabled:
+            device_sync(out)
+            return
+        t0 = time.perf_counter()
+        device_sync(out)
+        self.complete(name, t0, time.perf_counter() - t0)
+
+    # --- export ------------------------------------------------------------
+
+    @property
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def chrome_trace(self) -> dict:
+        """Perfetto/chrome://tracing-loadable trace object."""
+        return {
+            "traceEvents": self.events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_events": self.dropped},
+        }
+
+    def dump_chrome_trace(self, path) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+    def dump_jsonl(self, path) -> None:
+        with open(path, "w") as f:
+            for ev in self.events:
+                f.write(json.dumps(ev) + "\n")
+
+
+_DEFAULT = Tracer()
+
+
+def default_tracer() -> Tracer:
+    """The process-wide tracer — subsystems not handed one trace here."""
+    return _DEFAULT

@@ -128,6 +128,8 @@ def fit(
     optimizer: optax.GradientTransformation | None = None,
     loss_fn: Callable[..., jax.Array] = next_token_loss,
     step_kwargs: dict[str, Any] | None = None,
+    registry: Any | None = None,
+    tracer: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Train ``model`` on ``dataset`` for ``cfg.steps`` steps.
 
@@ -146,36 +148,51 @@ def fit(
         step_kwargs: extra kwargs for :func:`training.pipeline.make_train_step`
             (e.g. ``aux_loss_collection="losses"`` for MoE models,
             ``apply_kwargs={"return_hidden": True}`` for the fused CE loss).
+        registry: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.MetricsRegistry`
+            — per-step metrics are mirrored into it as ``train_*``
+            series (same registry the serving engine meters into, one
+            export surface for the whole stack).
+        tracer: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.Tracer` — the
+            run's phases (setup, restore, cost analysis, each train
+            step) become nested spans, Perfetto-exportable and visible
+            in XProf when a profiler capture is active.
     """
-    optimizer = default_optimizer(cfg) if optimizer is None else optimizer
-    loader = ShardedBatchLoader(
-        dataset, mesh, cfg.global_batch_size, spec=("data",)
-    )
-    sample = loader.batch_at(0)
+    from learning_jax_sharding_tpu.telemetry import Tracer
 
-    state, state_sh = sharded_train_state(
-        model, optimizer, sample["inputs"],
-        {"params": jax.random.key(cfg.seed)}, mesh, rules,
-    )
-    step_fn = make_train_step(
-        state_sh, {k: v.sharding for k, v in sample.items()}, mesh, rules,
-        loss_fn=loss_fn, **(step_kwargs or {}),
-    )
+    tr = tracer if tracer is not None else Tracer(enabled=False)
+    optimizer = default_optimizer(cfg) if optimizer is None else optimizer
+    with tr.span("fit.setup"):
+        loader = ShardedBatchLoader(
+            dataset, mesh, cfg.global_batch_size, spec=("data",)
+        )
+        sample = loader.batch_at(0)
+
+        state, state_sh = sharded_train_state(
+            model, optimizer, sample["inputs"],
+            {"params": jax.random.key(cfg.seed)}, mesh, rules,
+        )
+        step_fn = make_train_step(
+            state_sh, {k: v.sharding for k, v in sample.items()}, mesh,
+            rules, loss_fn=loss_fn, **(step_kwargs or {}),
+        )
 
     ckpt = None
     start_step = 0
     if cfg.checkpoint_dir is not None:
-        ckpt = CheckpointManager(
-            cfg.checkpoint_dir,
-            max_to_keep=cfg.max_checkpoints,
-            save_interval_steps=cfg.checkpoint_every,
-        )
-        restored = ckpt.restore_latest(like=state)
-        if restored is not None:
-            state = restored
-            start_step = int(state.step)
+        with tr.span("fit.restore"):
+            ckpt = CheckpointManager(
+                cfg.checkpoint_dir,
+                max_to_keep=cfg.max_checkpoints,
+                save_interval_steps=cfg.checkpoint_every,
+            )
+            restored = ckpt.restore_latest(like=state)
+            if restored is not None:
+                state = restored
+                start_step = int(state.step)
 
-    with activate(mesh, rules):
+    with tr.span("fit.cost_analysis"), activate(mesh, rules):
         flops = compiled_flops(step_fn.jitted, state, sample)
     tokens_per_step = int(
         sample["inputs"].shape[0] * sample["inputs"].shape[1]
@@ -187,6 +204,7 @@ def fit(
         tokens_per_step=tokens_per_step,
         n_devices=mesh.size,
         log_every=cfg.log_every,
+        registry=registry,
     )
     batches = None
     if cfg.prefetch > 0:
@@ -194,8 +212,12 @@ def fit(
     try:
         for i in range(start_step, cfg.steps):
             batch = next(batches) if batches is not None else loader.batch_at(i)
-            state, loss = step_fn(state, batch)
-            metrics.log(i + 1, loss=loss)
+            with tr.span("train_step", step=i + 1):
+                state, loss = step_fn(state, batch)
+                # metrics.log's float(loss) is the step's honest sync
+                # point — inside the span, so the span measures the
+                # step, not its dispatch.
+                metrics.log(i + 1, loss=loss)
             if ckpt is not None:
                 ckpt.save(i + 1, state)
         if ckpt is not None:

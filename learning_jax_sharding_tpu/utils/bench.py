@@ -77,6 +77,8 @@ def compiled_flops(fn: Callable, *args, **kwargs) -> float | None:
     analysis — no hand-derived formulas to drift out of sync with the model."""
     jitted = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
     analysis = jitted.lower(*args, **kwargs).compile().cost_analysis()
+    if isinstance(analysis, (list, tuple)):   # older jax: one dict/device
+        analysis = analysis[0] if analysis else None
     if not analysis:
         return None
     flops = analysis.get("flops")

@@ -44,6 +44,12 @@ class MetricsLogger:
         n_devices: chips sharing the work (default: all devices in the
             global ``jax.devices()`` list — the right divisor for
             whole-program FLOPs on multi-host meshes too).
+        registry: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.MetricsRegistry`
+            — every record is mirrored as ``train_*`` metrics (steps
+            counter, loss/rate gauges, step-time histogram), so training
+            rides the same export surface (JSON snapshot / Prometheus
+            text) as the serving engine.
     """
 
     def __init__(
@@ -55,6 +61,7 @@ class MetricsLogger:
         tokens_per_step: int | None = None,
         n_devices: int | None = None,
         log_every: int = 1,
+        registry: Any | None = None,
     ):
         self._file: IO | None = None
         if path is not None:
@@ -69,6 +76,19 @@ class MetricsLogger:
         self._last_t: float | None = None
         self._last_step: int | None = None
         self.history: list[dict[str, Any]] = []
+        self._registry = registry
+        if registry is not None:
+            self._m_steps = registry.counter(
+                "train_steps_total", "train steps logged")
+            self._m_loss = registry.gauge("train_loss", "latest loss")
+            self._m_sps = registry.gauge(
+                "train_seconds_per_step", "steady-state step seconds")
+            self._m_tps = registry.gauge(
+                "train_tokens_per_second", "token throughput")
+            self._m_mfu = registry.gauge(
+                "train_mfu", "model FLOPs utilization [0,1]")
+            self._m_step_hist = registry.histogram(
+                "train_step_seconds", "per-step wall time")
 
     def log(self, step: int, loss: Any = None, **scalars: Any) -> dict[str, Any] | None:
         """Record one step. Returns the record, or None when skipped by
@@ -80,6 +100,7 @@ class MetricsLogger:
         now = time.perf_counter()
         if step % self._log_every:
             self._last_t, self._last_step = now, int(step)
+            self._mirror(rec)
             return None
 
         if self._last_t is not None and step > self._last_step:
@@ -94,6 +115,7 @@ class MetricsLogger:
         self._last_t, self._last_step = now, int(step)
 
         rec.update({k: float(v) for k, v in scalars.items()})
+        self._mirror(rec)
         self.history.append(rec)
         if self._file is not None:
             self._file.write(json.dumps(rec) + "\n")
@@ -111,6 +133,22 @@ class MetricsLogger:
             parts += [f"{k} {rec[k]:.4g}" for k in scalars]
             print("  ".join(parts), file=self._stream, flush=True)
         return rec
+
+    def _mirror(self, rec: dict[str, Any]) -> None:
+        # Mirror a (possibly partial — skipped steps carry step+loss
+        # only) record into the shared registry.
+        if self._registry is None:
+            return
+        self._m_steps.inc()
+        if "loss" in rec:
+            self._m_loss.set(rec["loss"])
+        if "seconds_per_step" in rec:
+            self._m_sps.set(rec["seconds_per_step"])
+            self._m_step_hist.observe(rec["seconds_per_step"])
+        if "tokens_per_second" in rec:
+            self._m_tps.set(rec["tokens_per_second"])
+        if "mfu" in rec:
+            self._m_mfu.set(rec["mfu"])
 
     def close(self) -> None:
         if self._file is not None:

@@ -32,10 +32,18 @@ def trace(logdir: str | os.PathLike, *, host_tracer_level: int = 2) -> Iterator[
     at ``host_tracer_level``, and all :func:`annotate` spans.
     """
     os.makedirs(os.fspath(logdir), exist_ok=True)
-    options = jax.profiler.ProfileOptions()
-    options.host_tracer_level = host_tracer_level
-    with jax.profiler.trace(os.fspath(logdir), profiler_options=options):
-        yield
+    # ProfileOptions landed in newer jax; this runtime (0.4.x) captures
+    # host activity by default — gate rather than pin the version.
+    if hasattr(jax.profiler, "ProfileOptions"):
+        options = jax.profiler.ProfileOptions()
+        options.host_tracer_level = host_tracer_level
+        with jax.profiler.trace(
+            os.fspath(logdir), profiler_options=options
+        ):
+            yield
+    else:
+        with jax.profiler.trace(os.fspath(logdir)):
+            yield
 
 
 def annotate(name: str) -> jax.profiler.TraceAnnotation:
@@ -68,6 +76,16 @@ def checking(*, nans: bool = True, checks: bool = True) -> Iterator[None]:
         jax.clear_caches()
         yield
     finally:
-        jax.config.update("jax_debug_nans", prev_nans)
-        jax.config.update("jax_enable_checks", prev_checks)
-        jax.clear_caches()
+        # The block typically exits by RAISING (that is the tool's point:
+        # FloatingPointError from a nan trap, or an invariant failure
+        # mid-compile), so the restore path must itself be exception-safe:
+        # drop the check-laden executables FIRST, then restore each flag
+        # under its own finally — a failure in any one step must not
+        # leave check-mode caches or flags live in production dispatch.
+        try:
+            jax.clear_caches()
+        finally:
+            try:
+                jax.config.update("jax_debug_nans", prev_nans)
+            finally:
+                jax.config.update("jax_enable_checks", prev_checks)

@@ -1,0 +1,108 @@
+"""parallel/hlo.py instruction-regex edge cases, pinned on HLO text.
+
+``_INSTR_RE`` is the foundation the collective assertions (and now the
+telemetry layer's per-step collective inventory) stand on. Its corner
+cases are text-level, so they are pinned on realistic HLO snippets:
+async ``-start``/``-done`` pairs count once, tuple-typed results match,
+and op substrings inside fusion/computation NAMES are never counted.
+"""
+
+import pytest
+
+from learning_jax_sharding_tpu.parallel.hlo import (
+    COLLECTIVE_OPS,
+    collective_counts,
+)
+
+
+class TestInstrRegexEdgeCases:
+    def test_async_start_done_pair_counts_once(self):
+        hlo = """
+ENTRY %main {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %ag-start = (f32[4,8]{1,0}, f32[4,16]{1,0}) all-gather-start(f32[4,8]{1,0} %p0), replica_groups={{0,1}}, dimensions={1}
+  %ag-done = f32[4,16]{1,0} all-gather-done((f32[4,8]{1,0}, f32[4,16]{1,0}) %ag-start)
+}
+"""
+        counts = collective_counts(hlo)
+        assert counts["all-gather"] == 1
+        assert sum(counts.values()) == 1
+
+    def test_async_all_reduce_pair_counts_once(self):
+        hlo = """
+  %ar-start = f32[64]{0} all-reduce-start(f32[64]{0} %x), to_apply=%add
+  %ar-done = f32[64]{0} all-reduce-done(f32[64]{0} %ar-start)
+"""
+        assert collective_counts(hlo)["all-reduce"] == 1
+
+    def test_tuple_typed_result_matches(self):
+        # A sync collective whose RESULT is a tuple (spaces inside the
+        # type) must still match the `= <type> <op>(` form.
+        hlo = """
+  %rs = (bf16[8,4]{1,0}, bf16[8,4]{1,0}) reduce-scatter(bf16[16,4]{1,0} %a, bf16[16,4]{1,0} %b), dimensions={0}, to_apply=%add
+"""
+        assert collective_counts(hlo)["reduce-scatter"] == 1
+
+    def test_op_names_inside_fusion_names_not_counted(self):
+        # "all-reduce" appears in the fusion NAME, the computation NAME,
+        # and an operand name — none of those are instructions.
+        hlo = """
+%fused_all-reduce.clone (param_0: f32[4]) -> f32[4] {
+  %param_0 = f32[4]{0} parameter(0)
+  ROOT %m = f32[4]{0} multiply(f32[4]{0} %param_0, f32[4]{0} %param_0)
+}
+
+ENTRY %all-reduce_main {
+  %x = f32[4]{0} parameter(0)
+  %fusion.all-reduce.1 = f32[4]{0} fusion(f32[4]{0} %x), kind=kLoop, calls=%fused_all-reduce.clone
+  ROOT %out = f32[4]{0} add(f32[4]{0} %fusion.all-reduce.1, f32[4]{0} %x)
+}
+"""
+        counts = collective_counts(hlo)
+        assert sum(counts.values()) == 0, counts
+
+    def test_real_instruction_next_to_decoy_names(self):
+        hlo = """
+  %fusion.all-gather.7 = f32[8]{0} fusion(f32[8]{0} %p), kind=kLoop, calls=%c
+  %real = f32[16]{0} all-gather(f32[8]{0} %fusion.all-gather.7), replica_groups={{0,1}}, dimensions={0}
+"""
+        counts = collective_counts(hlo)
+        assert counts["all-gather"] == 1
+        assert sum(counts.values()) == 1
+
+    def test_every_op_kind_keyed_even_when_absent(self):
+        counts = collective_counts("ENTRY %e { ROOT %r = f32[] constant(0) }")
+        assert set(counts) == set(COLLECTIVE_OPS)
+        assert all(v == 0 for v in counts.values())
+
+    def test_collective_permute_and_all_to_all(self):
+        hlo = """
+  %cp = u32[2]{0} collective-permute(u32[2]{0} %x), source_target_pairs={{0,1},{1,0}}
+  %a2a-start = (f32[4]{0}, f32[4]{0}) all-to-all-start(f32[4]{0} %y), replica_groups={{0,1}}
+  %a2a-done = f32[4]{0} all-to-all-done((f32[4]{0}, f32[4]{0}) %a2a-start)
+"""
+        counts = collective_counts(hlo)
+        assert counts["collective-permute"] == 1
+        assert counts["all-to-all"] == 1
+
+    def test_compiled_function_counts_match_text_counts(self, mesh24, rng):
+        """The regex against REAL compiler output: a psum matmul's
+        optimized HLO must contain exactly the all-reduce the explicit
+        collective promises (sync or async-pair spelled)."""
+        from functools import partial
+
+        from learning_jax_sharding_tpu.parallel.collectives import (
+            psum_matmul,
+        )
+        from learning_jax_sharding_tpu.parallel.hlo import compiled_hlo
+        from tests.conftest import matmul_operands
+
+        a, b = matmul_operands(rng)
+        text = compiled_hlo(partial(psum_matmul, mesh=mesh24, axis="y"), a, b)
+        counts = collective_counts(text)
+        assert counts["all-reduce"] >= 1
+        # -done must never double an async pair: the done-op count is
+        # bounded by (in fact equal to) the start/sync count.
+        dones = text.count("all-reduce-done(")
+        starts = text.count("all-reduce-start(")
+        assert counts["all-reduce"] >= dones == starts

@@ -69,6 +69,39 @@ class TestProfiling:
                 jnp.divide(jnp.zeros(()), jnp.zeros(()))  # 0/0 → NaN
         assert jax.config.jax_debug_nans == prev
 
+    def test_checking_no_leak_after_raise_mid_dispatch(self):
+        """Regression (round 6): a check-laden executable compiled INSIDE
+        the context — on the very dispatch that raises — must not serve
+        post-context calls. The restore path runs while unwinding that
+        exception, so it must clear caches before (and regardless of)
+        the flag restores; afterwards the same jitted fn must produce its
+        NaN silently."""
+        f = jax.jit(lambda x: x / x)
+        prev_nans = jax.config.jax_debug_nans
+        prev_checks = jax.config.jax_enable_checks
+        with pytest.raises(FloatingPointError):
+            with checking():
+                f(jnp.zeros(()))      # compiles under checks, raises
+        assert jax.config.jax_debug_nans == prev_nans
+        assert jax.config.jax_enable_checks == prev_checks
+        out = np.asarray(f(jnp.zeros(())))   # re-dispatch: NO trap
+        assert np.isnan(out)
+
+    def test_checking_restores_when_block_raises_mid_compile(self):
+        """An error raised while TRACING inside the block (before any
+        executable exists) must restore both flags too."""
+        prev_nans = jax.config.jax_debug_nans
+        prev_checks = jax.config.jax_enable_checks
+        with pytest.raises(TypeError):
+            with checking():
+                jax.jit(lambda x: jnp.reshape(x, (3,)))(jnp.zeros((4,)))
+        assert jax.config.jax_debug_nans == prev_nans
+        assert jax.config.jax_enable_checks == prev_checks
+        # And a fresh compile afterwards is check-free.
+        assert np.isnan(
+            np.asarray(jax.jit(lambda x: x / x)(jnp.zeros(())))
+        )
+
 
 class TestBenchUtils:
     def test_time_fn_measures_per_iteration_cost(self):

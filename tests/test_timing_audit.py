@@ -1,0 +1,62 @@
+"""Async-dispatch timing audit over cases/ (round-6 satellite).
+
+The reference times a jitted loop with neither warmup nor a sync point
+(`/root/reference/case6_attention.py:234-238`), so it measures dispatch,
+not execution. Audit result for our cases/: every timing site routes
+through ``utils.bench.measure``/``time_fn`` (warmup + host-readback
+sync) or syncs via a host readback (``float(loss)``, ``np.asarray``);
+no case touches a raw wall clock. This test is the tripwire that keeps
+it that way: a case that starts timing with ``time.perf_counter`` /
+``time.time`` must also contain an explicit honest-sync idiom, and no
+case may ever time without one.
+"""
+
+import pathlib
+import re
+
+CASES = pathlib.Path(__file__).resolve().parents[1] / "cases"
+
+RAW_CLOCKS = re.compile(
+    r"time\.perf_counter\(|time\.time\(|time\.monotonic\(|timeit\."
+)
+#: The honest sync idioms: the bench harness (which owns warmup+sync),
+#: an explicit readback, the tracer's sync point, or an engine call
+#: (step/serve read results back to host before returning). ``float(``
+#: is deliberately absent — ``float(dt)`` on the elapsed time itself
+#: would satisfy a naive list while syncing nothing.
+SYNC_IDIOMS = re.compile(
+    r"measure\(|time_fn\(|block_until_ready|np\.asarray\(|"
+    r"\.sync\(|device_sync\(|latency_stats\(|\.step\(|serve\("
+)
+#: A sync idiom must appear THIS close (in lines) to each raw clock
+#: read — file-level matching would be vacuous, since nearly every case
+#: calls np.asarray/float somewhere for unrelated reasons.
+WINDOW = 10
+
+
+def test_cases_never_time_raw_dispatch():
+    assert CASES.is_dir()
+    offenders = []
+    for path in sorted(CASES.glob("*.py")):
+        lines = path.read_text().splitlines()
+        for i, line in enumerate(lines):
+            if not RAW_CLOCKS.search(line):
+                continue
+            lo, hi = max(0, i - WINDOW), i + WINDOW + 1
+            if not any(SYNC_IDIOMS.search(l) for l in lines[lo:hi]):
+                offenders.append(f"{path.name}:{i + 1}")
+    assert not offenders, (
+        f"raw wall-clock reads with no sync point within ±{WINDOW} lines: "
+        f"{offenders} — use utils.bench.measure/time_fn (warmup + "
+        "host-readback sync) or read a result back before stopping the "
+        "clock (the reference's flaw, case6_attention.py:234-238)"
+    )
+
+
+def test_case6_uses_the_corrected_harness():
+    """The case rebuilt FROM the flawed reference loop must use the
+    corrected harness explicitly (pinned so a refactor cannot silently
+    regress it to a bare loop)."""
+    text = (CASES / "case6_attention.py").read_text()
+    assert "measure(" in text
+    assert not RAW_CLOCKS.search(text)
