@@ -96,6 +96,11 @@ def bench_attention(dtype, label):
     rep = executable_report(single, params, x)
     flops_single = rep["flops"]
     collectives = rep["collectives"]
+    from learning_jax_sharding_tpu.telemetry import axis_collective_volume
+
+    axis_volume = axis_collective_volume(
+        rep["collective_instructions"], mesh
+    )
     chained = jax.jit(partial(_chained_apply, model, n=CHAIN))
     result = measure(
         chained, params, x,
@@ -112,6 +117,7 @@ def bench_attention(dtype, label):
         "tflops": tflops,
         "seconds_per_forward": per_iter,
         "collectives": collectives,
+        "axis_volume": axis_volume,
     }
 
 
@@ -647,6 +653,44 @@ def _device_ready(timeout_s: float = 600.0) -> bool:
     return ok.is_set()
 
 
+def _diagnosis_block(headline_axis_volume):
+    """The round-7 diagnosis summary for the JSON line: predicted HBM for
+    the 125M bench configuration vs the chip's LIVE watermark (devview —
+    guarded: backends without memory stats report plan-only), plus the
+    headline executable's per-mesh-axis collective bytes. Machine-readable
+    per round, so drifts in either become bench_compare-visible facts."""
+    import dataclasses as _dc
+
+    from learning_jax_sharding_tpu.ops.flash_attention import (
+        make_flash_attn_fn,
+    )
+    from learning_jax_sharding_tpu.telemetry import memory_report
+    from learning_jax_sharding_tpu.utils.memory import memory_plan
+
+    cfg = _dc.replace(CONFIG_125M, attn_fn=make_flash_attn_fn())
+    plan = memory_plan(cfg, 8, 1024, donate_state=False)
+    mem = memory_report(plan)
+    block = {
+        "memory_predicted_bytes": plan.total,
+        "memory_actual_available": mem["actual_available"],
+        "memory_actual_peak_bytes": mem.get("actual_peak_bytes"),
+        "memory_predicted_over_actual": mem.get("predicted_over_actual"),
+        "memory_hbm_bytes": mem.get("hbm_bytes"),
+        "headline_collective_bytes_per_axis": headline_axis_volume,
+    }
+    actual = block["memory_actual_peak_bytes"]
+    _log(
+        f"[bench] diagnosis: 125M step predicted "
+        f"{plan.total / 1e9:.2f} GB"
+        + (
+            f", device peak {actual / 1e9:.2f} GB "
+            f"(predicted/actual {block['memory_predicted_over_actual']:.2f})"
+            if actual else ", no live memory stats (plan-only)"
+        )
+    )
+    return block
+
+
 def _phase_telemetry(watch, before, label):
     """Delta of a CompileWatch report across one phase → a log line plus
     the dict that lands in the JSON telemetry block: compile seconds are
@@ -718,6 +762,11 @@ def main():
 
     watch.stop()
     run_report = watch.report()
+    try:
+        diagnosis = _diagnosis_block(ours["axis_volume"])
+    except Exception as e:  # context only — never break the headline line
+        _log(f"[bench] diagnosis block skipped: {type(e).__name__}: {e}")
+        diagnosis = None
     ours_tf, base_tf = ours["tflops"], baseline["tflops"]
     vs_baseline = (ours_tf / base_tf) if (ours_tf and base_tf) else None
     print(json.dumps({
@@ -746,6 +795,9 @@ def main():
             "run_trace_seconds": round(run_report["trace_seconds"], 3),
             "monitoring_available": run_report["monitoring_available"],
         },
+        # Round-7 diagnosis: predicted-vs-actual memory + per-axis
+        # collective bytes (telemetry.devview).
+        "diagnosis": diagnosis,
     }), flush=True)
 
 

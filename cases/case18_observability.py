@@ -22,8 +22,9 @@ per request and per step:
    executable cache), and the engine's ``collective_inventory()`` reads
    the per-dispatch collective ops straight off its compiled HLO.
 
-Artifacts (written to ``sys.argv[1]`` or ``./case18_out``; open
-trace.json in https://ui.perfetto.dev):
+Artifacts (written to ``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case18``,
+else a fresh temp dir — never the CWD; open trace.json in
+https://ui.perfetto.dev):
 
 * ``trace.json``   — Chrome trace events (Perfetto)
 * ``events.jsonl`` — the same events, one JSON object per line
@@ -58,10 +59,12 @@ from learning_jax_sharding_tpu.models.transformer import (
 from learning_jax_sharding_tpu.parallel import build_mesh
 from learning_jax_sharding_tpu.parallel.hlo import COLLECTIVE_OPS
 from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
-from learning_jax_sharding_tpu.telemetry import CompileWatch
+from learning_jax_sharding_tpu.telemetry import CompileWatch, artifact_dir
 from learning_jax_sharding_tpu.utils.profiling import trace
 
-outdir = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "case18_out")
+outdir = (
+    pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else artifact_dir("case18")
+)
 outdir.mkdir(parents=True, exist_ok=True)
 
 mesh = build_mesh((2, 4), ("data", "model"))

@@ -1,0 +1,233 @@
+"""Case 19 — runtime diagnosis: the telemetry layer turns numbers into WHY.
+
+Case 18 showed the stack measuring itself (spans, registry, compile
+accounting). This driver induces three production incidents on the
+8-device emulated mesh and shows stage 2 DIAGNOSING each one:
+
+1. INDUCED NaN — a training run whose step-4 batch poisons the loss
+   (0/0). The :class:`telemetry.Watchdog` probes loss + global grad-norm
+   on device (async — no extra sync), names the failing step, the
+   escalation re-runs the offending batch under
+   ``utils.profiling.checking()`` to localize the first NaN-producing
+   primitive, and the :class:`telemetry.FlightRecorder` dumps a
+   post-mortem bundle (events + registry + trace + device memory stats).
+2. INDUCED IMBALANCE — a parameter tree with one tensor accidentally
+   committed to a single device. :func:`telemetry.shard_imbalance` reads
+   exact per-device bytes off every leaf's sharding and flags the stray
+   by path.
+3. SLO BREACH — a :class:`telemetry.SLOMonitor` attached to a
+   :class:`ContinuousEngine` run, with one impossible TTFT target (every
+   request breaches: burn rate screams) and one loose target (healthy),
+   streaming percentiles riding the same window.
+
+Plus the devview memory report (predicted ``MemoryPlan`` vs live device
+stats — PLAN-ONLY here: emulated CPU devices return no memory stats, the
+guarded degradation tier-1 pins) and per-mesh-axis collective byte
+attribution for the engine's decode step.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case19``, else a
+temp dir): ``report.json`` + the post-mortem bundle under ``postmortem/``.
+
+Run: ``python cases/case19_diagnosis.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses
+import json
+import pathlib
+import sys
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learning_jax_sharding_tpu.data.datasets import SyntheticLMDataset
+from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+    next_token_loss,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import (
+    RULES_DP_TP,
+    RULES_TP_SERVING,
+)
+from learning_jax_sharding_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    NonFiniteError,
+    SLOMonitor,
+    SLOTarget,
+    Tracer,
+    Watchdog,
+    artifact_dir,
+    axis_collective_volume,
+    memory_report,
+    shard_imbalance,
+)
+from learning_jax_sharding_tpu.training.loop import TrainLoopConfig, fit
+from learning_jax_sharding_tpu.utils.memory import memory_plan
+
+outdir = (
+    pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else artifact_dir("case19")
+)
+outdir.mkdir(parents=True, exist_ok=True)
+report: dict = {}
+
+mesh = build_mesh((2, 4), ("data", "model"))
+cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+
+# --- incident 1: induced NaN → watchdog names the step, bundle dumps ----
+POISON_INDEX = 4            # batch index 4 → train step 5 (1-based logging)
+SENTINEL = cfg.vocab_size   # out-of-vocab marker (embedding lookup clamps)
+
+
+class PoisonedDataset(SyntheticLMDataset):
+    """Synthetic stream whose batch ``POISON_INDEX`` carries the sentinel."""
+
+    def batch(self, index, rows=None, batch_size=8):
+        b = super().batch(index, rows=rows, batch_size=batch_size)
+        if index == POISON_INDEX:
+            b["inputs"] = b["inputs"].copy()
+            b["inputs"][0, 0] = SENTINEL
+        return b
+
+
+def trip_loss(y, batch):
+    # 0/0 exactly when the sentinel is present: NaN from DATA, the shape
+    # of incident the escalation localizes exactly.
+    bad = jnp.any(batch["inputs"] >= SENTINEL).astype(jnp.float32)
+    return next_token_loss(y, batch) + bad * 0.0 / (1.0 - bad)
+
+
+recorder = FlightRecorder()
+registry = MetricsRegistry()
+tracer = Tracer()
+watchdog = Watchdog(registry=registry, recorder=recorder, lag=2)
+dataset = PoisonedDataset(vocab_size=cfg.vocab_size, seq_len=32, seed=19)
+train_cfg = TrainLoopConfig(steps=8, global_batch_size=8, prefetch=0)
+
+bundle = None
+try:
+    fit(
+        Transformer(cfg), dataset, mesh, RULES_DP_TP, train_cfg,
+        loss_fn=trip_loss, registry=registry, tracer=tracer,
+        watchdog=watchdog, recorder=recorder,
+    )
+    raise AssertionError("poisoned run was supposed to trip the watchdog")
+except NonFiniteError as e:
+    assert e.step == POISON_INDEX + 1, (e.step, POISON_INDEX + 1)
+    assert watchdog.first_bad_step == POISON_INDEX + 1
+    assert e.localized and "nan" in e.localized.lower(), e.localized
+    bundle = e.bundle
+assert bundle is not None and bundle.is_dir()
+events = json.loads((bundle / "events.json").read_text())["events"]
+kinds = {ev["kind"] for ev in events}
+assert "nonfinite" in kinds and "nan_localized" in kinds, kinds
+assert "train_step" in kinds
+assert (bundle / "registry.json").exists()
+assert (bundle / "memory.json").exists()
+assert (bundle / "error.txt").exists()
+assert registry.get("watchdog_nonfinite_total").value >= 1
+report["induced_nan"] = {
+    "flagged_step": watchdog.first_bad_step,
+    "bundle": str(bundle),
+    "event_kinds": sorted(kinds),
+}
+print(
+    f"PASS: induced NaN at step {POISON_INDEX + 1} — watchdog flagged step "
+    f"{watchdog.first_bad_step}, escalation localized the primitive, "
+    f"post-mortem bundle at {bundle}/"
+)
+
+# --- incident 2: induced shard imbalance --------------------------------
+even = jax.device_put(
+    np.ones((64, 128), np.float32), NamedSharding(mesh, P("data", "model"))
+)
+stray = jax.device_put(np.ones((512, 64), np.float32), jax.devices()[0])
+audit = shard_imbalance({"layers": {"even": even, "stray_head": stray}})
+assert audit["imbalanced"], audit
+flagged = [f["path"] for f in audit["flagged"]]
+assert any("stray_head" in p for p in flagged), flagged
+assert not any("'even'" in p for p in flagged), flagged
+report["imbalance"] = {
+    "skew": audit["skew"],
+    "flagged": flagged,
+    "per_device_bytes": audit["per_device_bytes"],
+}
+print(
+    f"PASS: shard-imbalance audit — skew {audit['skew']:.2f}x, flagged "
+    + ", ".join(flagged)
+)
+
+# --- incident 3: SLO breach on a ContinuousEngine run -------------------
+scfg = dataclasses.replace(cfg, decode_attention="blocked")
+model = Transformer(scfg)
+params = nn.meta.unbox(
+    jax.jit(lambda r, t: model.init({"params": r}, t))(
+        jax.random.key(3), np.zeros((2, 8), np.int32)
+    )["params"]
+)
+slo = SLOMonitor(
+    [
+        SLOTarget("ttft", 1e-9, objective=0.9, name="ttft_impossible"),
+        SLOTarget("ttft", 1e3, objective=0.9, name="ttft_loose"),
+    ]
+)
+engine = ContinuousEngine(
+    scfg, mesh, RULES_TP_SERVING, batch_size=2, max_new_tokens=4,
+    refill_chunk=4, slo=slo, recorder=recorder,
+)
+rng = np.random.default_rng(19)
+prompts = [
+    rng.integers(1, scfg.vocab_size, size=(n,)).astype(np.int32)
+    for n in (3, 9, 5)
+]
+engine.serve(params, prompts)
+snap = slo.snapshot()
+assert slo.burn_rate("ttft_impossible") > 1.0, snap["targets"]
+assert "ttft_impossible" in slo.breached()
+assert "ttft_loose" not in slo.breached()
+assert snap["metrics"]["ttft"]["p50"] > 0
+assert snap["metrics"]["queue_wait"]["count"] == len(prompts)
+prom = engine.registry.prometheus_text()
+assert "slo_ttft_impossible_breaches_total" in prom
+assert "slo_ttft_impossible_burn_rate" in prom
+report["slo"] = snap
+print(
+    f"PASS: SLO monitor — ttft p50 {snap['metrics']['ttft']['p50'] * 1e3:.0f} "
+    f"ms, impossible-target burn rate "
+    f"{snap['targets']['ttft_impossible']['burn_rate']:.1f} (breached), "
+    f"loose target healthy"
+)
+
+# --- devview: predicted-vs-actual memory + per-axis collective bytes ----
+plan = memory_plan(cfg, 8, 32)
+mem = memory_report(plan)
+assert mem["predicted"]["total"] > 0
+# Emulated CPU devices report no memory stats: the guarded plan-only path.
+assert mem["actual_available"] is False
+axis_vol = engine.collective_axis_volume()
+decode = axis_vol["decode_block"]
+moved = {k: v for k, v in decode.items() if v["bytes"]}
+assert sum(v["bytes"] for v in decode.values()) > 0, decode
+report["memory_report"] = mem
+report["collective_axis_volume"] = axis_vol
+print(
+    "PASS: devview — memory report degraded to plan-only "
+    f"(predicted total {mem['predicted']['total'] / 1e6:.1f} MB), decode "
+    "collective bytes per axis: "
+    + ", ".join(f"{k}={v['bytes']}" for k, v in moved.items())
+)
+
+with open(outdir / "report.json", "w") as f:
+    json.dump(report, f, indent=2, sort_keys=True, default=str)
+print(f"PASS: case19 — diagnosis report at {outdir}/report.json, "
+      f"post-mortem bundle at {bundle}/")

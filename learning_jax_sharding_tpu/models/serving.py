@@ -292,6 +292,17 @@ class ContinuousEngine:
     pinned contract. ``compile_counts()`` reports per-program compile
     counts and ``collective_inventory()`` the per-dispatch collective
     ops from the compiled HLO.
+
+    DIAGNOSIS (round 7): the engine feeds a flight recorder
+    (``engine.recorder`` — process-wide default ring; arrival/admission/
+    preemption/retirement/cache-creation events plus every tracer span
+    closure when attached) whose ``dump_diagnostics()`` writes a
+    post-mortem bundle; an optional ``slo=``
+    :class:`~learning_jax_sharding_tpu.telemetry.SLOMonitor` receives
+    TTFT/TPOT/ITL/queue-wait/e2e per retirement (streaming percentiles +
+    burn-rate targets, exported through the engine registry); and
+    ``collective_axis_volume()`` attributes each program's collective
+    bytes to the mesh axes that carry them.
     """
 
     def __init__(
@@ -321,6 +332,8 @@ class ContinuousEngine:
         prefix_cache: bool = False,
         registry: Optional[MetricsRegistry] = None,
         tracer: Optional[Tracer] = None,
+        slo: Any | None = None,
+        recorder: Any | None = None,
     ):
         if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
             raise ValueError(
@@ -770,7 +783,7 @@ class ContinuousEngine:
         self._last_first_refill_args = None
         self._last_refill_args = None
         self._last_decode_args = None
-        self._init_telemetry(registry, tracer)
+        self._init_telemetry(registry, tracer, slo, recorder)
         self._init_slots()
         if paged:
             self._init_pool()
@@ -778,7 +791,7 @@ class ContinuousEngine:
 
     # --- state initialisation --------------------------------------------
 
-    def _init_telemetry(self, registry, tracer):
+    def _init_telemetry(self, registry, tracer, slo=None, recorder=None):
         # Engine-local by default: each engine is its own measurement
         # window and trace timeline. A shared registry AGGREGATES: the
         # cumulative engine_* counters then carry every engine's
@@ -790,6 +803,28 @@ class ContinuousEngine:
             registry if registry is not None else MetricsRegistry()
         )
         self.tracer = tracer if tracer is not None else Tracer()
+        # The flight recorder defaults to the PROCESS ring (post-mortems
+        # want the whole process's recent history in one place); the SLO
+        # monitor, if handed in unbound, exports through this engine's
+        # registry/recorder.
+        from learning_jax_sharding_tpu.telemetry import (
+            default_flight_recorder,
+        )
+
+        self.recorder = (
+            recorder if recorder is not None else default_flight_recorder()
+        )
+        # Span closures ride the ring next to the lifecycle events (the
+        # dispatch timeline a post-mortem needs). With several engines on
+        # one recorder, the last attachment wins the recorder's default
+        # tracer for dump(); dump_diagnostics always passes its own.
+        self.recorder.attach_tracer(self.tracer)
+        self.slo = slo
+        if slo is not None:
+            if slo.registry is None:
+                slo.registry = self.registry
+            if slo.recorder is None:
+                slo.recorder = self.recorder
         r = self.registry
         self._c_requests = r.counter(
             "engine_requests_total", "requests enqueued")
@@ -1178,6 +1213,10 @@ class ContinuousEngine:
         self.tracer.instant(
             "request.arrival", rid=rid, prompt_len=int(p.size)
         )
+        self.recorder.record(
+            "engine.arrival", rid=rid, prompt_len=int(p.size),
+            queue_depth=len(self._queue),
+        )
         return rid
 
     def has_work(self) -> bool:
@@ -1226,6 +1265,19 @@ class ContinuousEngine:
         if rec["tpot"] is not None:
             self._h_tpot.observe(rec["tpot"])
         self.tracer.async_end("request", r.rid, generated=n)
+        self.recorder.record(
+            "engine.retire", rid=r.rid, slot=slot, generated=n,
+            ttft=rec["ttft"], e2e=rec["e2e"],
+        )
+        if self.slo is not None:
+            self.slo.observe("queue_wait", rec["queue_wait"])
+            self.slo.observe("e2e", rec["e2e"])
+            if rec["ttft"] is not None:
+                self.slo.observe("ttft", rec["ttft"])
+            if rec["tpot"] is not None:
+                self.slo.observe("tpot", rec["tpot"])
+            for g in gaps:
+                self.slo.observe("itl", g)
         self._finished[r.rid] = r
         retired.append(r.rid)
         self._slot_req[slot] = None
@@ -1268,6 +1320,7 @@ class ContinuousEngine:
         r = self._slot_req[slot]
         self._queue.appendleft(r)
         self.tracer.instant("request.preempted", rid=r.rid, slot=slot)
+        self.recorder.record("engine.preempt", rid=r.rid, slot=slot)
         if self._paged:
             self._release(slot, register=False)
         self._slot_req[slot] = None
@@ -1295,6 +1348,11 @@ class ContinuousEngine:
                     )
                 self.tracer.instant(
                     "request.admit", rid=r.rid, slot=slot
+                )
+                self.recorder.record(
+                    "engine.admit", rid=r.rid, slot=slot,
+                    prompt_len=int(r.prompt.size),
+                    readmission=not first_admission,
                 )
                 prompt = r.prompt
                 self._slot_req[slot] = r
@@ -1398,6 +1456,9 @@ class ContinuousEngine:
                     _, self._cache = self._first_refill_fn(*first_args)
                     self.cache_creations += 1
                     self._c_creations.inc()
+                    self.recorder.record(
+                        "engine.cache_create", n=self.cache_creations
+                    )
                     self._last_first_refill_args = lambda: first_args
                 self._cache = self._set_tables(self._cache)
             if self._cache is None:
@@ -1409,6 +1470,9 @@ class ContinuousEngine:
                     tok_new, self._cache = self._first_refill_fn(*first_args)
                 self.cache_creations += 1
                 self._c_creations.inc()
+                self.recorder.record(
+                    "engine.cache_create", n=self.cache_creations
+                )
                 self._last_first_refill_args = lambda: first_args
             else:
                 # COPIES, not the live arrays: jnp.asarray of a numpy
@@ -1743,12 +1807,10 @@ class ContinuousEngine:
             fns["decode_block"] = self._decode_block_fn
         return {k: cache_size(f) for k, f in fns.items()}
 
-    def collective_inventory(self) -> dict[str, dict[str, int]]:
-        """Per-dispatch collective counts read off the engine's OWN
-        compiled programs — ``parallel.hlo.collective_counts`` over each
-        program re-lowered AOT with its most recent dispatch arguments
-        (costs a compile: a diagnostic for "what does one step put on
-        the wire", not a hot-path call). Keys appear only for
+    def _program_reports(self) -> dict[str, dict]:
+        """Full ``executable_report`` per engine program, re-lowered AOT
+        with its most recent dispatch arguments (costs a compile per
+        program — diagnostics, not hot path). Keys appear only for
         programs that have dispatched at least once on this engine
         (``first_refill`` included, so single-chunk prefills are not
         silently missing)."""
@@ -1756,16 +1818,16 @@ class ContinuousEngine:
             executable_report,
         )
 
-        out: dict[str, dict[str, int]] = {}
+        out: dict[str, dict] = {}
         with activate(self._mesh, self._rules):
             if self._last_first_refill_args is not None:
                 out["first_refill"] = executable_report(
                     self._first_refill_fn, *self._last_first_refill_args()
-                )["collectives"]
+                )
             if self._last_refill_args is not None:
                 out["refill_step"] = executable_report(
                     self._refill_step_fn, *self._last_refill_args()
-                )["collectives"]
+                )
             if self._last_decode_args is not None:
                 if self._speculative:
                     fn, name = (
@@ -1775,8 +1837,43 @@ class ContinuousEngine:
                     fn, name = self._decode_block_fn, "decode_block"
                 out[name] = executable_report(
                     fn, *self._last_decode_args()
-                )["collectives"]
+                )
         return out
+
+    def collective_inventory(self) -> dict[str, dict[str, int]]:
+        """Per-dispatch collective counts read off the engine's OWN
+        compiled programs — ``parallel.hlo.collective_counts`` over each
+        program (see :meth:`_program_reports` for cost and coverage)."""
+        return {
+            name: rep["collectives"]
+            for name, rep in self._program_reports().items()
+        }
+
+    def collective_axis_volume(self) -> dict[str, dict]:
+        """Per-MESH-AXIS collective byte volume for each engine program:
+        what one refill/decode dispatch puts on the wire, attributed to
+        the mesh axis whose device groups carry it
+        (``telemetry.devview.axis_collective_volume``). Same AOT-relower
+        cost and coverage as :meth:`collective_inventory`."""
+        from learning_jax_sharding_tpu.telemetry.devview import (
+            axis_collective_volume,
+        )
+
+        return {
+            name: axis_collective_volume(
+                rep["collective_instructions"], self._mesh
+            )
+            for name, rep in self._program_reports().items()
+        }
+
+    def dump_diagnostics(self, outdir=None):
+        """Write the engine's post-mortem bundle (flight-recorder events +
+        registry snapshot + Chrome trace + device memory stats) and return
+        its directory — the on-demand form of what
+        ``recorder.capture()`` dumps on exception."""
+        return self.recorder.dump(
+            outdir, registry=self.registry, tracer=self.tracer
+        )
 
     # --- one-shot entry ----------------------------------------------------
 

@@ -10,6 +10,7 @@ optimized HLO.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Callable
 
@@ -27,10 +28,80 @@ COLLECTIVE_OPS = (
 # `%s = (f32[...], f32[...]) all-gather-start(...)` whose tuple-typed result
 # contains spaces. Matching on `= <type> <op>(` avoids counting occurrences
 # inside fusion/computation names; `-done` ops are deliberately excluded so an
-# async pair counts once.
+# async pair counts once. Group 1 is the result type (byte volumes for
+# telemetry.devview's per-axis attribution), group 2 the op — ONE regex
+# serves both collective_counts and collective_instructions, so the anchor
+# cannot drift between them.
 _INSTR_RE = re.compile(
-    r"=\s+(?:\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+    r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
 )
+
+# One typed-shape token inside a result type: `bf16[8,128]` / `f32[]` /
+# `pred[4]`; the optional `{layout}` suffix is not captured.
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+# Explicit replica groups `{{0,1},{2,3}}`, or XLA's compact iota form
+# `[2,4]<=[8]` / `[4,2]<=[2,4]T(1,0)`.
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{[0-9,{} ]*\}\}|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
+)
+
+
+def _dtype_bits(token: str) -> int:
+    """Bit width of an HLO dtype token (`bf16` → 16, `f8e4m3fn` → 8,
+    `pred` → 8: bool buffers are byte-backed)."""
+    m = re.match(r"^[a-z]+?([0-9]+)", token)
+    return int(m.group(1)) if m else 8
+
+
+def _parse_replica_groups(text: str) -> list[list[int]] | None:
+    """Materialize a replica_groups attribute into explicit id lists."""
+    if text.startswith("{{"):
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.findall(r"\{([0-9, ]*)\}", text[1:-1])
+        ]
+    m = re.match(
+        r"\[([0-9]+),([0-9]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?", text
+    )
+    if m is None:  # pragma: no cover - format drift
+        return None
+    g, s = int(m.group(1)), int(m.group(2))
+    dims = [int(x) for x in m.group(3).split(",")]
+    import numpy as np
+
+    ids = np.arange(math.prod(dims)).reshape(dims)
+    if m.group(4):
+        ids = ids.transpose([int(x) for x in m.group(4).split(",")])
+    return ids.reshape(g, s).tolist()
+
+
+def collective_instructions(hlo_text: str) -> list[dict]:
+    """Per-instruction collective records from optimized HLO text.
+
+    Each record is ``{"op", "bytes", "replica_groups"}``: ``bytes`` is the
+    LARGEST typed operand/result buffer in the instruction's result type (for
+    async ``-start`` pairs the tuple holds operand AND result, so the max is
+    the post-collective buffer — the honest wire-volume proxy for a grown
+    all-gather); ``replica_groups`` is a list of partition-id lists (ids are
+    positions in the mesh's flattened device order under SPMD partitioning),
+    or None when XLA printed none. ``-done`` halves are excluded, so an async
+    pair contributes once — same convention as :func:`collective_counts`.
+    """
+    out = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.search(line)
+        if m is None:
+            continue
+        type_str, op = m.group(1), m.group(2)
+        nbytes = 0
+        for dt, dims in _SHAPE_RE.findall(type_str):
+            numel = math.prod(int(d) for d in dims.split(",") if d)
+            nbytes = max(nbytes, (numel * _dtype_bits(dt) + 7) // 8)
+        gm = _GROUPS_RE.search(line)
+        groups = _parse_replica_groups(gm.group(1)) if gm else None
+        out.append({"op": op, "bytes": nbytes, "replica_groups": groups})
+    return out
 
 
 def compiled_hlo(fn: Callable, *args, **kwargs) -> str:
@@ -54,7 +125,7 @@ def collective_counts(hlo_or_fn, *args, **kwargs) -> dict[str, int]:
     text = hlo_or_fn if isinstance(hlo_or_fn, str) else compiled_hlo(hlo_or_fn, *args, **kwargs)
     counts = {op: 0 for op in COLLECTIVE_OPS}
     for m in _INSTR_RE.finditer(text):
-        counts[m.group(1)] += 1
+        counts[m.group(2)] += 1
     return counts
 
 

@@ -151,6 +151,81 @@ def host_local_batch(
     )
 
 
+def allgather_registry_snapshots(registry: Any) -> dict:
+    """Merge every host's metrics-registry snapshot into one report.
+
+    Each host JSON-serializes its ``registry.snapshot()``; the byte
+    payloads are allgathered (length-padded — snapshots differ per host)
+    and every host returns the same merged view:
+
+    * ``"hosts"`` — the per-host snapshots, indexed by process rank;
+    * ``"merged"`` — one fleet dict: plain numbers SUMMED (counters
+      become fleet totals; gauges sum too — per-host queue depths add up
+      to the fleet's), ``*__high_water`` keys take the MAX, histogram
+      dicts merge bucket-wise (buckets must match — they come from the
+      same code).
+
+    Every host must call this collectively (the usual SPMD contract);
+    single-process runs skip the collective entirely, so the helper is
+    free in tests and on the one-chip TPU.
+    """
+    import json
+
+    snap = registry.snapshot()
+    n = jax.process_count()
+    if n == 1:
+        per_host = [snap]
+    else:  # pragma: no cover - exercised only on real multi-host slices
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(
+            json.dumps(snap).encode("utf-8"), dtype=np.uint8
+        )
+        lengths = multihost_utils.process_allgather(
+            np.array([payload.size], np.int64)
+        ).reshape(-1)
+        padded = np.zeros((int(lengths.max()),), np.uint8)
+        padded[: payload.size] = payload
+        gathered = multihost_utils.process_allgather(padded)
+        per_host = [
+            json.loads(bytes(gathered[i, : int(lengths[i])]).decode("utf-8"))
+            for i in range(n)
+        ]
+    return {
+        "process_count": n,
+        "hosts": per_host,
+        "merged": merge_registry_snapshots(per_host),
+    }
+
+
+def merge_registry_snapshots(per_host: Sequence[dict]) -> dict:
+    """The fleet-merge rule for registry snapshots (see
+    :func:`allgather_registry_snapshots` for the semantics)."""
+    merged: dict = {}
+    for host_snap in per_host:
+        for k, v in host_snap.items():
+            if k not in merged:
+                merged[k] = (
+                    {
+                        "buckets": list(v["buckets"]),
+                        "counts": list(v["counts"]),
+                        "sum": v["sum"],
+                        "count": v["count"],
+                    }
+                    if isinstance(v, dict) else v
+                )
+            elif isinstance(v, dict):
+                m = merged[k]
+                m["counts"] = [a + b for a, b in zip(m["counts"], v["counts"])]
+                m["sum"] += v["sum"]
+                m["count"] += v["count"]
+            elif k.endswith("__high_water"):
+                merged[k] = max(merged[k], v)
+            else:
+                merged[k] += v
+    return merged
+
+
 def sharded_batches(
     it: Iterator[Any],
     mesh: Mesh,

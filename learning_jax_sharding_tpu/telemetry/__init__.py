@@ -1,6 +1,6 @@
-"""Unified telemetry (observability layer): spans, metrics, compile watch.
+"""Unified telemetry (observability layer): measurement + diagnosis.
 
-Three pillars, one import point:
+Stage 1 (PR 1) — measurement, three pillars:
 
 * :mod:`~.telemetry.spans` — nested structured spans with explicit
   device-sync points, bridged into ``jax.profiler.TraceAnnotation``
@@ -11,13 +11,27 @@ Three pillars, one import point:
   accounting, per-executable FLOPs/bytes, and the per-step collective
   inventory.
 
+Stage 2 (PR 2) — diagnosis, four more:
+
+* :mod:`~.telemetry.flight_recorder` — bounded ring of structured events
+  (admissions, evictions, train steps, compiles, span closures) with a
+  post-mortem ``dump()`` bundle on exception or demand;
+* :mod:`~.telemetry.watchdog` — full-speed health probes: async on-device
+  ``isfinite`` of loss/grad-norm, loss-spike EMA, a hang-flagging
+  heartbeat thread, and NaN escalation via ``utils.profiling.checking``;
+* :mod:`~.telemetry.devview` — per-device HBM watermarks vs the static
+  ``MemoryPlan``, shard-imbalance audit, and per-mesh-axis collective
+  byte attribution;
+* :mod:`~.telemetry.slo` — streaming TTFT/TPOT/ITL/queue-wait percentile
+  estimators and SLO targets with burn-rate counters, exported through
+  the registry/Prometheus path.
+
 Consumers: ``models.serving.ContinuousEngine`` (per-request span
-timeline, queue/page-pool gauges, acceptance counters — its
-``last_stats``/``last_latency`` are re-derived from the registry),
-``training.loop.fit`` + ``utils.metrics.MetricsLogger`` (same registry),
-``bench.py`` (compile-vs-steady-state phase breakdown), and
-``cases/case18_observability.py`` (the end-to-end driver that dumps all
-three artifact kinds).
+timeline, queue/page-pool gauges, SLO feed, flight-recorder lifecycle
+events), ``training.loop.fit`` + ``utils.metrics.MetricsLogger`` (same
+registry, watchdog probes), ``bench.py`` (compile-vs-steady-state phase
+breakdown + the diagnosis block), and ``cases/case18_observability.py``
+/ ``cases/case19_diagnosis.py`` (the end-to-end drivers).
 """
 
 from learning_jax_sharding_tpu.telemetry.compile_watch import (  # noqa: F401
@@ -27,6 +41,17 @@ from learning_jax_sharding_tpu.telemetry.compile_watch import (  # noqa: F401
     executable_report,
     watched,
 )
+from learning_jax_sharding_tpu.telemetry.devview import (  # noqa: F401
+    axis_collective_volume,
+    device_memory_stats,
+    memory_report,
+    shard_imbalance,
+)
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    artifact_dir,
+    default_flight_recorder,
+)
 from learning_jax_sharding_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,
@@ -35,8 +60,19 @@ from learning_jax_sharding_tpu.telemetry.registry import (  # noqa: F401
     MetricsRegistry,
     default_registry,
 )
+from learning_jax_sharding_tpu.telemetry.slo import (  # noqa: F401
+    SLOMonitor,
+    SLOTarget,
+    StreamingPercentile,
+)
 from learning_jax_sharding_tpu.telemetry.spans import (  # noqa: F401
     Tracer,
     default_tracer,
     device_sync,
+)
+from learning_jax_sharding_tpu.telemetry.watchdog import (  # noqa: F401
+    Heartbeat,
+    NonFiniteError,
+    Watchdog,
+    localize_nan,
 )

@@ -34,7 +34,10 @@ from typing import Any, Callable
 
 import jax
 
-from learning_jax_sharding_tpu.parallel.hlo import collective_counts
+from learning_jax_sharding_tpu.parallel.hlo import (
+    collective_counts,
+    collective_instructions,
+)
 
 try:  # the monitoring module is private API — gate, don't pin
     from jax._src import monitoring as _monitoring
@@ -68,13 +71,16 @@ class CompileWatch:
     (``compile_events_total``/``compile_seconds_total`` per kind).
     """
 
-    def __init__(self, registry: Any | None = None):
+    def __init__(
+        self, registry: Any | None = None, *, recorder: Any | None = None
+    ):
         self.monitoring_available = _MON_OK
         self._lock = threading.Lock()
         self._counts: dict[str, int] = {}
         self._seconds: dict[str, float] = {}
         self._active = 0
         self._registry = registry
+        self._recorder = recorder
 
     def _on_duration(self, name: str, secs: float, **kw) -> None:
         kind = EVENT_KINDS.get(name)
@@ -85,6 +91,8 @@ class CompileWatch:
         with self._lock:
             self._counts[kind] = self._counts.get(kind, 0) + 1
             self._seconds[kind] = self._seconds.get(kind, 0.0) + secs
+        if self._recorder is not None:
+            self._recorder.record("compile", compile_kind=kind, seconds=secs)
         if self._registry is not None:
             self._registry.counter(
                 f"compile_{kind}_total",
@@ -210,7 +218,10 @@ def executable_report(fn: Callable, *args, **kwargs) -> dict:
     * ``memory``: argument/output/temp/code bytes from
       ``memory_analysis()``;
     * ``collectives``: per-op-kind instruction counts from the optimized
-      HLO (``parallel.hlo.collective_counts`` — async pairs count once).
+      HLO (``parallel.hlo.collective_counts`` — async pairs count once);
+    * ``collective_instructions``: per-instruction records (op, bytes,
+      replica groups) — feed ``telemetry.devview.axis_collective_volume``
+      with the program's mesh to attribute bytes per mesh axis.
 
     ``args`` should carry their real shardings so the partitioner makes
     the same collective choices the runtime would.
@@ -232,6 +243,7 @@ def executable_report(fn: Callable, *args, **kwargs) -> dict:
         }
     except Exception:  # backends without memory stats
         memory = {}
+    text = compiled.as_text()
     return {
         "flops": float(flops) if flops and flops > 0 else None,
         "bytes_accessed": (
@@ -239,5 +251,6 @@ def executable_report(fn: Callable, *args, **kwargs) -> dict:
             if bytes_accessed and bytes_accessed > 0 else None
         ),
         "memory": memory,
-        "collectives": collective_counts(compiled.as_text()),
+        "collectives": collective_counts(text),
+        "collective_instructions": collective_instructions(text),
     }

@@ -70,6 +70,10 @@ class Tracer:
         self._t0 = time.perf_counter()
         self._pid = os.getpid()
         self._max_events = max_events
+        # Optional event sink (``FlightRecorder.attach_tracer`` sets it):
+        # called with each emitted event dict, outside the ring lock. A
+        # raising sink must not take the traced code down with it.
+        self.on_event = None
 
     # --- time/emission -----------------------------------------------------
 
@@ -81,6 +85,12 @@ class Tracer:
             if len(self._events) >= self._max_events:
                 self.dropped += 1   # the append below evicts the oldest
             self._events.append(ev)
+        cb = self.on_event
+        if cb is not None:
+            try:
+                cb(ev)
+            except Exception:
+                pass
 
     def _base(self, name: str, ph: str, **extra) -> dict:
         ev = {

@@ -14,6 +14,7 @@ the uninterrupted run would have.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, Optional
 
@@ -130,6 +131,9 @@ def fit(
     step_kwargs: dict[str, Any] | None = None,
     registry: Any | None = None,
     tracer: Any | None = None,
+    watchdog: Any | None = None,
+    heartbeat: Any | None = None,
+    recorder: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Train ``model`` on ``dataset`` for ``cfg.steps`` steps.
 
@@ -158,10 +162,59 @@ def fit(
             run's phases (setup, restore, cost analysis, each train
             step) become nested spans, Perfetto-exportable and visible
             in XProf when a profiler capture is active.
+        watchdog: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.Watchdog` —
+            full-speed numeric health: the step additionally returns the
+            on-device global grad-norm (``with_grad_norm`` — no extra
+            sync), each step is probed asynchronously, and a non-finite
+            loss/grad-norm ESCALATES: the offending step's batch is
+            re-run under ``utils.profiling.checking()`` to localize the
+            first NaN-producing primitive, the flight recorder dumps a
+            post-mortem bundle, and
+            :class:`~learning_jax_sharding_tpu.telemetry.NonFiniteError`
+            is raised naming the step.
+        heartbeat: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.Heartbeat` —
+            each step's dispatch+sync runs under an armed deadline, so a
+            wedged device/transport is flagged from the monitor thread
+            instead of stalling silently.
+        recorder: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.FlightRecorder`
+            (default: the process-wide ring) — ``fit`` records per-step
+            events and the escalation trail into it.
     """
-    from learning_jax_sharding_tpu.telemetry import Tracer
+    from learning_jax_sharding_tpu.telemetry import (
+        CompileWatch,
+        Tracer,
+        default_flight_recorder,
+    )
+    from learning_jax_sharding_tpu.telemetry.watchdog import (
+        NonFiniteError,
+        localize_nan,
+    )
 
     tr = tracer if tracer is not None else Tracer(enabled=False)
+    rec = recorder if recorder is not None else default_flight_recorder()
+    if tracer is not None:
+        # Span closures (setup/restore/train_step, with durations) ride
+        # the ring next to the step records — same feed the engine gives.
+        rec.attach_tracer(tr)
+    if watchdog is not None:
+        # Late-bind fit's registry/recorder into an unbound watchdog —
+        # same courtesy the engine extends to an unbound SLOMonitor, so
+        # fit(watchdog=Watchdog(), registry=reg, recorder=fr) meters and
+        # records without constructor plumbing.
+        watchdog.bind(registry=registry, recorder=rec)
+    if heartbeat is not None:
+        heartbeat.bind(registry=registry, recorder=rec)
+    # Compile events ride the ring (and the registry, when given) for the
+    # training loop's lifetime — a mid-run recompile is exactly the kind
+    # of event a post-mortem needs in its timeline. Started (with the
+    # owned heartbeat thread) immediately before the try whose finally
+    # stops them: a setup-phase raise must not leak the process-wide
+    # monitoring listener or a polling daemon thread.
+    compile_watch = CompileWatch(registry=registry, recorder=rec)
+    hb_owned = heartbeat is not None and not heartbeat.running
     optimizer = default_optimizer(cfg) if optimizer is None else optimizer
     with tr.span("fit.setup"):
         loader = ShardedBatchLoader(
@@ -173,9 +226,14 @@ def fit(
             model, optimizer, sample["inputs"],
             {"params": jax.random.key(cfg.seed)}, mesh, rules,
         )
+        extra = dict(step_kwargs or {})
+        if watchdog is not None:
+            # The watchdog needs the grad-norm on device; the step
+            # computes it inside the backward's epilogue (no extra sync).
+            extra.setdefault("with_grad_norm", True)
         step_fn = make_train_step(
             state_sh, {k: v.sharding for k, v in sample.items()}, mesh,
-            rules, loss_fn=loss_fn, **(step_kwargs or {}),
+            rules, loss_fn=loss_fn, **extra,
         )
 
     ckpt = None
@@ -206,25 +264,78 @@ def fit(
         log_every=cfg.log_every,
         registry=registry,
     )
+    def escalate():
+        # A probe came back non-finite. Localize: re-run the flagged
+        # step's batch (still held in the recent-batch window) under
+        # scoped NaN trapping, which names the first bad primitive —
+        # against the CURRENT state, so data-induced NaNs localize
+        # exactly while state-drift ones may come back clean (recorded
+        # either way). Then dump the post-mortem bundle and raise.
+        bad = watchdog.first_bad_step
+        batch = recent.get(bad)
+        localized = None
+        if batch is not None:
+            localized = localize_nan(lambda: step_fn(state, batch))
+        rec.record(
+            "nan_localized", step=bad, what=watchdog.bad_what,
+            message=localized,
+        )
+        err = NonFiniteError(bad, watchdog.bad_what or "loss")
+        bundle = rec.dump(registry=registry, tracer=tr, error=err)
+        raise NonFiniteError(
+            bad, watchdog.bad_what or "loss", localized=localized,
+            bundle=bundle,
+        )
+
     batches = None
     if cfg.prefetch > 0:
         batches = loader.prefetched(cfg.prefetch, start=start_step)
+    recent: dict[int, Any] = {}
+    compile_watch.start()
+    if hb_owned:
+        heartbeat.start()
     try:
         for i in range(start_step, cfg.steps):
             batch = next(batches) if batches is not None else loader.batch_at(i)
-            with tr.span("train_step", step=i + 1):
+            if watchdog is not None:
+                # Keep the async-probe window's batches for escalation.
+                recent[i + 1] = batch
+                for old in [s for s in recent if s <= i + 1 - (watchdog.lag + 2)]:
+                    del recent[old]
+            hb = (
+                heartbeat.expect(f"train_step {i + 1}")
+                if heartbeat is not None else contextlib.nullcontext()
+            )
+            with tr.span("train_step", step=i + 1), hb:
                 state, loss = step_fn(state, batch)
+                loss, gnorm = (
+                    (loss["loss"], loss.get("grad_norm"))
+                    if isinstance(loss, dict) else (loss, None)
+                )
                 # metrics.log's float(loss) is the step's honest sync
-                # point — inside the span, so the span measures the
-                # step, not its dispatch.
+                # point — inside the span (and the heartbeat's armed
+                # window), so the span measures the step, not its
+                # dispatch — and a wedged sync is flagged.
                 metrics.log(i + 1, loss=loss)
+            rec.record("train_step", step=i + 1, loss=float(loss))
+            if watchdog is not None:
+                watchdog.probe(i + 1, loss, gnorm)
+                if watchdog.tripped:
+                    escalate()
             if ckpt is not None:
                 ckpt.save(i + 1, state)
+        if watchdog is not None:
+            watchdog.flush()
+            if watchdog.tripped:
+                escalate()
         if ckpt is not None:
             if ckpt.latest_step() != cfg.steps:
                 ckpt.save(cfg.steps, state, force=True)
             ckpt.wait()
     finally:
+        compile_watch.stop()
+        if hb_owned:
+            heartbeat.stop()
         if batches is not None:
             batches.close()
         metrics.close()

@@ -154,6 +154,7 @@ def make_train_step(
     apply_kwargs: dict[str, Any] | None = None,
     grad_accum_steps: int = 1,
     steps_per_call: int = 1,
+    with_grad_norm: bool = False,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -189,6 +190,12 @@ def make_train_step(
     etc.). A sum-style loss (including ``default_loss``) ends up scaled by
     ``1/grad_accum_steps`` relative to the unaccumulated step — use a mean
     loss when accumulating.
+
+    ``with_grad_norm``: return ``(state, {"loss": ..., "grad_norm": ...})``
+    instead of ``(state, loss)`` — the global gradient norm computed INSIDE
+    the step (``optax.global_norm``, a reduction XLA fuses into the
+    backward's epilogue: no extra pass, no extra sync), so a health
+    watchdog (``telemetry.watchdog``) can check both numbers on device.
 
     ``steps_per_call``: run this many FULL optimizer steps per jitted call
     (a ``lax.scan``); the batch then carries a leading ``(steps_per_call,)``
@@ -261,6 +268,9 @@ def make_train_step(
             (loss_sum, grad_sum), _ = jax.lax.scan(body, init, (accum_idx, micro))
             loss = loss_sum / grad_accum_steps
             grads = jax.tree.map(lambda g: g / grad_accum_steps, grad_sum)
+        if with_grad_norm:
+            out = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+            return state.apply_gradients(grads=grads), out
         return state.apply_gradients(grads=grads), loss
 
     scalar_sh = NamedSharding(mesh, jax.sharding.PartitionSpec())

@@ -31,6 +31,18 @@ HBM_BYTES: dict[str, float] = {
 }
 
 
+def device_hbm_bytes(device: Any | None = None) -> float | None:
+    """Spec HBM capacity for ``device`` (default: first local device), or
+    None when unknown (emulated CPU). The static fallback for backends that
+    report no ``bytes_limit`` — ``telemetry.devview.memory_report`` prefers
+    the live limit when the runtime provides one."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    return HBM_BYTES.get(getattr(device, "device_kind", None))
+
+
 @dataclasses.dataclass(frozen=True)
 class MemoryPlan:
     """Byte estimates for one train step (single chip unless divided)."""

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Regression gate over the bench trajectory: diff BENCH_r*.json rounds.
+
+The driver appends one ``BENCH_r{N}.json`` per round — the headline JSON
+line under ``"parsed"`` plus the full stderr context under ``"tail"``. This
+script makes the trajectory MACHINE-CHECKABLE instead of eyeballed: it
+extracts every named metric from the two most recent rounds (or any two
+given explicitly), prints the per-metric % delta, and exits non-zero when
+any metric regressed past the threshold in its OWN bad direction (tok/s,
+TFLOP/s, MFU, MBU, agreement: lower is worse; ms/step, ms/token-step,
+latency ms, seconds: higher is worse).
+
+Usage:
+    python scripts/bench_compare.py [--threshold 0.10] [--repo DIR] [--json]
+    python scripts/bench_compare.py old.json new.json [--threshold 0.10]
+
+Exit codes: 0 clean, 1 regression past threshold, 2 not enough rounds.
+
+Metrics that appear in only one round (benches come and go) are reported
+as added/removed, never failed — the gate compares what is comparable.
+The tunneled chip drifts ±30% across windows (PERF.md methodology), so
+the default threshold is deliberately loose; tighten per-invocation when
+comparing same-session runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import sys
+
+#: (regex over one `[bench] name: ...` line tail, metric suffix,
+#:  higher_is_better). Applied per line; the metric key is the bench line's
+#: name plus the suffix, so every line's numbers stay distinct.
+_PATTERNS: list[tuple[re.Pattern, str, bool]] = [
+    (re.compile(r"([\d,.]+)\s*tok/s"), "tok_s", True),
+    (re.compile(r"([\d.]+)\s*TFLOP/s/chip"), "tflops", True),
+    (re.compile(r"(?<![-\w])MFU=([\d.]+)%"), "mfu_pct", True),
+    (re.compile(r"activated-MFU=([\d.]+)%"), "act_mfu_pct", True),
+    (re.compile(r"MBU=([\d.]+)%"), "mbu_pct", True),
+    (re.compile(r"([\d.]+)\s*ms/step"), "ms_per_step", False),
+    (re.compile(r"([\d.]+)\s*ms/token-step"), "ms_per_token", False),
+    (re.compile(r"([\d.]+)\s*us/forward"), "us_per_forward", False),
+    (re.compile(r"TTFT p50 ([\d.]+)\s*ms"), "ttft_p50_ms", False),
+    (re.compile(r"p99 ([\d.]+)\s*ms"), "p99_ms", False),
+    (re.compile(r"agreement vs plain: ([\d.]+)%"), "agreement_pct", True),
+]
+
+_NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")
+
+
+def _round_of(path: pathlib.Path) -> int:
+    m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+    return int(m.group(1)) if m else -1
+
+
+def extract_metrics(doc: dict) -> dict[str, tuple[float, bool]]:
+    """``{metric: (value, higher_is_better)}`` from one round's record."""
+    out: dict[str, tuple[float, bool]] = {}
+    parsed = doc.get("parsed") or {}
+    if isinstance(parsed.get("value"), (int, float)):
+        out["headline:" + str(parsed.get("metric", "value"))] = (
+            float(parsed["value"]), True,
+        )
+    if isinstance(parsed.get("vs_baseline"), (int, float)):
+        out["headline:vs_baseline"] = (float(parsed["vs_baseline"]), True)
+    for line in (doc.get("tail") or "").splitlines():
+        nm = _NAME_RE.search(line)
+        if nm is None:
+            continue
+        name = re.sub(r"\s+", "_", nm.group(1).strip())
+        for pat, suffix, higher in _PATTERNS:
+            m = pat.search(line)
+            if m is None:
+                continue
+            key = f"{name}:{suffix}"
+            if key in out:   # first occurrence wins (ladder lines repeat)
+                continue
+            out[key] = (float(m.group(1).replace(",", "")), higher)
+    return out
+
+
+def compare(
+    old: dict, new: dict, threshold: float
+) -> tuple[list[dict], list[str], list[str]]:
+    """Per-metric deltas plus added/removed names. A REGRESSION is a move
+    past ``threshold`` in the metric's own bad direction."""
+    om, nm = extract_metrics(old), extract_metrics(new)
+    rows: list[dict] = []
+    for key in sorted(om.keys() & nm.keys()):
+        (ov, higher), (nv, _) = om[key], nm[key]
+        delta = (nv - ov) / abs(ov) if ov else 0.0
+        worse = -delta if higher else delta
+        rows.append(
+            {
+                "metric": key,
+                "old": ov,
+                "new": nv,
+                "delta_pct": 100.0 * delta,
+                "higher_is_better": higher,
+                "regressed": worse > threshold,
+            }
+        )
+    added = sorted(nm.keys() - om.keys())
+    removed = sorted(om.keys() - nm.keys())
+    return rows, added, removed
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*", help="two BENCH json files (old new);"
+                    " default: the two most recent BENCH_r*.json in --repo")
+    ap.add_argument("--repo", default=".", help="directory holding BENCH_r*.json")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="regression threshold as a fraction (default 0.10)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    if args.files:
+        if len(args.files) != 2:
+            ap.error("pass exactly two files (old new), or none")
+        paths = [pathlib.Path(f) for f in args.files]
+    else:
+        found = sorted(
+            pathlib.Path(args.repo).glob("BENCH_r*.json"), key=_round_of
+        )
+        if len(found) < 2:
+            print(f"need >= 2 BENCH_r*.json in {args.repo}, "
+                  f"found {len(found)}", file=sys.stderr)
+            return 2
+        paths = found[-2:]
+
+    docs = [json.loads(p.read_text()) for p in paths]
+    rows, added, removed = compare(docs[0], docs[1], args.threshold)
+    regressed = [r for r in rows if r["regressed"]]
+    if args.json:
+        print(json.dumps(
+            {
+                "old": str(paths[0]), "new": str(paths[1]),
+                "threshold": args.threshold, "metrics": rows,
+                "added": added, "removed": removed,
+                "regressions": [r["metric"] for r in regressed],
+            },
+            indent=2,
+        ))
+    else:
+        print(f"bench_compare: {paths[0].name} -> {paths[1].name} "
+              f"(threshold {args.threshold:.0%})")
+        for r in rows:
+            arrow = "v" if r["delta_pct"] < 0 else "^"
+            flag = "  REGRESSED" if r["regressed"] else ""
+            print(f"  {r['metric']:60s} {r['old']:>12.3f} -> "
+                  f"{r['new']:>12.3f}  {arrow}{abs(r['delta_pct']):6.1f}%"
+                  f"{flag}")
+        for k in added:
+            print(f"  + {k} (new)")
+        for k in removed:
+            print(f"  - {k} (gone)")
+        n = len(regressed)
+        print(f"bench_compare: {len(rows)} compared, {n} regression(s)")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""The bench regression gate: metric extraction, directions, exit codes.
+
+``scripts/bench_compare.py`` is the machine check on the BENCH_r*.json
+trajectory; these tests pin what makes it trustworthy — metrics regress
+in their OWN bad direction (tok/s down = bad, ms/step up = bad), metrics
+present in only one round never fail the gate, and the exit codes are
+the contract CI scripts on.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_compare",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "bench_compare.py",
+)
+bench_compare = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_compare)
+
+
+def _doc(tail_lines, value=100.0, vs_baseline=1.05):
+    return {
+        "parsed": {
+            "metric": "case6_attention_tflops_per_chip",
+            "value": value, "vs_baseline": vs_baseline,
+        },
+        "tail": "\n".join(tail_lines),
+    }
+
+
+OLD = _doc([
+    "[bench] 125M decode, bf16 (b=8): 10,000 tok/s, 0.58 ms/token-step, MBU=80.0%",
+    "[bench] 125M transformer train step: 66.0 ms/step, MFU=49.0%",
+    "[bench] gone-next-round: 5.0 ms/step",
+])
+
+
+class TestExtraction:
+    def test_metrics_and_directions(self):
+        m = bench_compare.extract_metrics(OLD)
+        assert m["headline:case6_attention_tflops_per_chip"] == (100.0, True)
+        assert m["headline:vs_baseline"] == (1.05, True)
+        assert m["125M_decode,_bf16_(b=8):tok_s"] == (10000.0, True)
+        assert m["125M_decode,_bf16_(b=8):ms_per_token"] == (0.58, False)
+        assert m["125M_decode,_bf16_(b=8):mbu_pct"] == (80.0, True)
+        assert m["125M_transformer_train_step:ms_per_step"] == (66.0, False)
+        assert m["125M_transformer_train_step:mfu_pct"] == (49.0, True)
+
+    def test_activated_mfu_does_not_shadow_mfu(self):
+        m = bench_compare.extract_metrics(
+            _doc(["[bench] moe step: 70.0 ms/step, activated-MFU=33.0%"])
+        )
+        assert m["moe_step:act_mfu_pct"] == (33.0, True)
+        assert "moe_step:mfu_pct" not in m
+
+
+class TestCompare:
+    def test_regressions_follow_direction(self):
+        new = _doc(
+            [
+                # tok/s fell 20% (bad), ms/token fell (good), MBU up (good)
+                "[bench] 125M decode, bf16 (b=8): 8,000 tok/s, 0.40 ms/token-step, MBU=85.0%",
+                # ms/step rose 30% (bad)
+                "[bench] 125M transformer train step: 86.0 ms/step, MFU=49.0%",
+                "[bench] brand-new-line: 1.0 ms/step",
+            ],
+            value=101.0,
+        )
+        rows, added, removed = bench_compare.compare(OLD, new, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["125M_decode,_bf16_(b=8):tok_s"]["regressed"]
+        assert not by["125M_decode,_bf16_(b=8):ms_per_token"]["regressed"]
+        assert not by["125M_decode,_bf16_(b=8):mbu_pct"]["regressed"]
+        assert by["125M_transformer_train_step:ms_per_step"]["regressed"]
+        assert not by["headline:case6_attention_tflops_per_chip"]["regressed"]
+        assert "brand-new-line:ms_per_step" in added
+        assert "gone-next-round:ms_per_step" in removed
+
+    def test_within_threshold_is_clean(self):
+        new = _doc(
+            ["[bench] 125M decode, bf16 (b=8): 9,500 tok/s, "
+             "0.60 ms/token-step, MBU=79.0%",
+             "[bench] 125M transformer train step: 68.0 ms/step, MFU=48.0%"],
+            value=99.0,
+        )
+        rows, _, _ = bench_compare.compare(OLD, new, 0.10)
+        assert not any(r["regressed"] for r in rows)
+
+
+class TestMain:
+    def _write(self, tmp_path, n, doc):
+        p = tmp_path / f"BENCH_r{n:02d}.json"
+        p.write_text(json.dumps(doc))
+        return p
+
+    def test_exit_codes(self, tmp_path, capsys):
+        self._write(tmp_path, 1, OLD)
+        self._write(
+            tmp_path, 2,
+            _doc(["[bench] 125M decode, bf16 (b=8): 9,900 tok/s"]),
+        )
+        assert bench_compare.main(["--repo", str(tmp_path)]) == 0
+        # A regressed round: tok/s down 50%.
+        self._write(
+            tmp_path, 3,
+            _doc(["[bench] 125M decode, bf16 (b=8): 5,000 tok/s"]),
+        )
+        assert bench_compare.main(["--repo", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out
+        # Explicit files override discovery; loose threshold passes.
+        assert bench_compare.main(
+            [str(tmp_path / "BENCH_r02.json"),
+             str(tmp_path / "BENCH_r03.json"), "--threshold", "0.6"]
+        ) == 0
+
+    def test_too_few_rounds(self, tmp_path):
+        self._write(tmp_path, 1, OLD)
+        assert bench_compare.main(["--repo", str(tmp_path)]) == 2
+
+    def test_picks_two_most_recent_by_round(self, tmp_path, capsys):
+        # r02/r10 ordering must be numeric, not lexicographic.
+        self._write(tmp_path, 2, OLD)
+        self._write(tmp_path, 9, OLD)
+        self._write(
+            tmp_path, 10,
+            _doc(["[bench] 125M decode, bf16 (b=8): 5,000 tok/s"]),
+        )
+        assert bench_compare.main(["--repo", str(tmp_path)]) == 1
+        assert "BENCH_r09.json -> BENCH_r10.json" in capsys.readouterr().out
+
+    def test_json_output(self, tmp_path, capsys):
+        self._write(tmp_path, 1, OLD)
+        self._write(tmp_path, 2, OLD)
+        assert bench_compare.main(["--repo", str(tmp_path), "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["regressions"] == []
+        assert doc["metrics"]

@@ -1,0 +1,153 @@
+"""MemoryPlan regime arithmetic — the closed forms, not just orderings.
+
+``tests/test_memory_tokenizer.py`` pins the planner against the OBSERVED
+v5e fit/OOM boundary (calibration); this module pins the ARITHMETIC: the
+exact byte formulas per attention regime (dense / remat / flash), the
+fused vs unfused loss head, the ``fits()`` headroom boundary, and the
+shard divisors — so a planner refactor cannot silently change a term
+while staying on the right side of the calibration points.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import pytest
+
+from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY
+from learning_jax_sharding_tpu.utils.memory import (
+    HBM_BYTES,
+    device_hbm_bytes,
+    memory_plan,
+)
+
+# A config where every term is hand-computable. fp32 activations AND
+# params (itemsize 4 each); no GQA (num_kv_heads None → num_heads).
+CFG = dataclasses.replace(
+    CONFIG_TINY, dtype=jnp.float32, max_seq_len=256
+)
+B, S = 4, 256
+
+
+def _flash(cfg):
+    # Any non-None attn_fn marks the flash regime; the planner never calls it.
+    return dataclasses.replace(cfg, attn_fn=lambda *a, **k: None)
+
+
+class TestRegimeArithmetic:
+    def test_dense_scores_closed_form(self):
+        plan = memory_plan(CFG, B, S)
+        # Saved softmax probabilities: B × heads × S² × itemsize.
+        assert plan.detail["per_layer_scores"] == B * CFG.num_heads * S * S * 4
+
+    def test_per_layer_residuals_closed_form(self):
+        plan = memory_plan(CFG, B, S)
+        nh = CFG.num_heads * CFG.head_dim
+        expected = B * S * 4 * (
+            4 * CFG.features        # block in, 2×LN out, attn out
+            + nh + 2 * nh           # q, k, v (no GQA here)
+            + 2 * CFG.hidden        # FF up pre/post-GELU
+        )
+        assert plan.detail["per_layer_residuals"] == expected
+        assert plan.saved_activations == CFG.num_layers * (
+            expected + plan.detail["per_layer_scores"]
+        )
+
+    def test_remat_and_flash_drop_scores_identically(self):
+        dense = memory_plan(CFG, B, S)
+        remat = memory_plan(
+            dataclasses.replace(CFG, remat_attention=True), B, S
+        )
+        flash = memory_plan(_flash(CFG), B, S)
+        assert remat.detail["per_layer_scores"] == 0
+        assert flash.detail["per_layer_scores"] == 0
+        # Identical except the score term (same residuals, same head).
+        assert remat.saved_activations == flash.saved_activations
+        assert dense.saved_activations - remat.saved_activations == (
+            CFG.num_layers * dense.detail["per_layer_scores"]
+        )
+        assert remat.total == flash.total < dense.total
+
+    def test_state_terms_closed_form(self):
+        plan = memory_plan(CFG, B, S)   # donated, adamw (2 slots)
+        p_bytes = CFG.param_count * 4
+        assert plan.params == p_bytes
+        assert plan.grads == p_bytes
+        assert plan.optimizer_state == 2 * p_bytes
+        kept = memory_plan(CFG, B, S, donate_state=False)
+        assert kept.params == 2 * p_bytes
+        assert kept.optimizer_state == 4 * p_bytes
+        assert kept.grads == p_bytes      # grads never double
+        one_slot = memory_plan(CFG, B, S, optimizer_slots=1)
+        assert one_slot.optimizer_state == p_bytes
+
+
+class TestLossHead:
+    def test_unfused_head_closed_form(self):
+        plan = memory_plan(CFG, B, S, unfused_loss=True)
+        # Full (B,S,V) logits in act dtype + the fp32 softmax upcast.
+        assert plan.loss_head == B * S * CFG.vocab_size * (4 + 4)
+
+    def test_fused_head_is_chunk_over_seq(self):
+        unfused = memory_plan(CFG, B, S, unfused_loss=True)
+        fused = memory_plan(CFG, B, S)
+        chunk = min(S, 128)
+        assert fused.loss_head == pytest.approx(
+            unfused.loss_head * chunk / S
+        )
+
+    def test_short_sequences_fuse_to_parity(self):
+        # chunk = min(seq, 128): at S <= 128 fusing saves nothing.
+        short = dataclasses.replace(CFG, max_seq_len=64)
+        assert memory_plan(short, B, 64).loss_head == (
+            memory_plan(short, B, 64, unfused_loss=True).loss_head
+        )
+
+
+class TestShardDivisors:
+    def test_model_shards_divide_state_and_hidden(self):
+        one = memory_plan(CFG, B, S)
+        tp2 = memory_plan(CFG, B, S, n_model_shards=2)
+        assert tp2.params == one.params / 2
+        assert tp2.grads == one.grads / 2
+        assert tp2.optimizer_state == one.optimizer_state / 2
+        assert tp2.loss_head == one.loss_head / 2
+        assert tp2.detail["per_layer_scores"] == (
+            one.detail["per_layer_scores"] / 2
+        )
+
+    def test_data_shards_divide_activations_not_state(self):
+        one = memory_plan(CFG, B, S)
+        dp4 = memory_plan(CFG, B, S, n_data_shards=4)
+        assert dp4.params == one.params
+        assert dp4.saved_activations == one.saved_activations / 4
+        assert dp4.loss_head == one.loss_head / 4
+        assert dp4.detail["batch_per_shard"] == B / 4
+
+
+class TestFits:
+    def test_headroom_boundary(self):
+        plan = memory_plan(CFG, B, S)
+        # fits ⇔ total <= headroom × capacity, default headroom 0.8.
+        assert plan.fits(plan.total / 0.8 * 1.001)
+        assert not plan.fits(plan.total / 0.8 * 0.999)
+        assert plan.fits(plan.total, headroom=1.0)
+        assert not plan.fits(plan.total * 0.999, headroom=1.0)
+
+    def test_total_is_the_sum_of_parts(self):
+        plan = memory_plan(CFG, B, S)
+        assert plan.total == (
+            plan.params + plan.grads + plan.optimizer_state
+            + plan.saved_activations + plan.loss_head
+        )
+
+
+class TestDeviceHBM:
+    def test_known_and_unknown_kinds(self):
+        class Dev:
+            def __init__(self, kind):
+                self.device_kind = kind
+
+        assert device_hbm_bytes(Dev("TPU v5 lite")) == HBM_BYTES["TPU v5 lite"]
+        assert device_hbm_bytes(Dev("cpu")) is None
+        # Default argument path: the emulated CPU devices here are unknown.
+        assert device_hbm_bytes() is None
