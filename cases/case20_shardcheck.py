@@ -1,0 +1,202 @@
+"""Case 20 — shardcheck: static analysis catches what PR 1/2 could only
+watch happen.
+
+The observability PRs (cases 18/19) MEASURE and DIAGNOSE the runtime;
+this driver shows the static layer catching the same failure classes
+BEFORE a step runs, on the 8-device emulated mesh:
+
+1. SEEDED MISSED DONATION — the framework's own train step built with
+   ``donate_state=False``: the donation pass reads the executable's
+   input/output aliases, flags every state leaf as
+   ``donation-missed``, and prices the regression with the
+   ``utils.memory`` planner (the 2× params+moments HBM a real run would
+   silently pay). The default (donating) step audits clean.
+2. SEEDED WEIGHT GATHER — a column-parallel matmul goldened at zero
+   collectives, then recompiled with the weight row-sharded (the classic
+   wrong ``in_sharding``): GSPMD inserts communication and the contract
+   diff names it (``added-collective``), instead of the bytes quietly
+   riding every future step.
+3. CLEAN-REPO BASELINE — all three passes over the repo as checked in:
+   every entry-point contract (``analysis/golden/*.json``) holds, the
+   donation audit of the shipped train/ZeRO-1 steps is clean, and the
+   AST lint gates at zero new findings under ``analysis/baseline.json``.
+
+All findings are also reported into a flight recorder + registry
+(``analysis.findings.report_findings``), so static verdicts ride the
+same diagnosis surfaces as case 19's runtime incidents.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case20``, else a
+temp dir): ``report.json``.
+
+Run: ``python cases/case20_shardcheck.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import json
+import pathlib
+import sys
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learning_jax_sharding_tpu.analysis import (
+    check_against_golden,
+    check_train_step_donation,
+    contract_of,
+    report_findings,
+    run_ast_pass,
+    run_contract_pass,
+    run_jaxpr_pass,
+)
+from learning_jax_sharding_tpu.analysis.entrypoints import (
+    _mesh24,
+    _train_state_and_step,
+)
+from learning_jax_sharding_tpu.parallel.logical import activate
+from learning_jax_sharding_tpu.telemetry import MetricsRegistry
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+    FlightRecorder,
+    artifact_dir,
+)
+from learning_jax_sharding_tpu.training.pipeline import make_train_step
+
+outdir = (
+    pathlib.Path(sys.argv[1]) if len(sys.argv) > 1 else artifact_dir("case20")
+)
+outdir.mkdir(parents=True, exist_ok=True)
+recorder = FlightRecorder()
+registry = MetricsRegistry()
+report: dict = {}
+
+mesh = _mesh24()
+
+# --- seed 1: the deliberately missed donation ---------------------------
+print("== seed 1: train step with donate_state=False ==")
+cfg, state, batch, good_step, rules = _train_state_and_step(mesh)
+bad_step = make_train_step(
+    jax.tree.map(lambda x: x.sharding, state),
+    {k: v.sharding for k, v in batch.items()},
+    mesh, rules, donate_state=False,
+)
+with activate(mesh, rules):
+    bad = check_train_step_donation(bad_step, state, batch, cfg=cfg)
+    good = check_train_step_donation(good_step, state, batch, cfg=cfg)
+
+missed = [f for f in bad["findings"] if f.rule == "donation-missed"]
+assert missed, "undonated train step was not flagged"
+assert not good["findings"], (
+    f"the donating step must audit clean, got {good['findings']}"
+)
+print(f"   caught: {len(missed)} state leaves eligible-but-not-donated, "
+      f"planner prices the miss at "
+      f"{bad['missed_donation_bytes'] / 1e6:.1f} MB")
+print(f"   e.g. {missed[0]}")
+report_findings(missed, recorder=recorder, registry=registry)
+report["seed_missed_donation"] = {
+    "flagged_leaves": len(missed),
+    "planner_bytes_at_stake": bad["missed_donation_bytes"],
+    "donating_step_clean": not good["findings"],
+}
+
+# --- seed 2: forced weight gather via a wrong in_sharding ---------------
+print("== seed 2: weight resharded against its golden contract ==")
+
+
+def mm(x, w):
+    return x @ w
+
+
+x = np.ones((16, 64), np.float32)
+w = np.ones((64, 128), np.float32)
+out_sh = NamedSharding(mesh, P(None, "model"))
+f = jax.jit(mm, out_shardings=out_sh)
+x_rep = jax.device_put(x, NamedSharding(mesh, P()))
+w_col = jax.device_put(w, NamedSharding(mesh, P(None, "model")))
+golden = contract_of("case20_mm", f, x_rep, w_col, mesh=mesh)
+assert golden.collectives == {}, golden.collectives  # column-parallel
+golden_dir = outdir / "golden"
+golden_dir.mkdir(exist_ok=True)
+(golden_dir / "case20_mm.json").write_text(golden.to_json())
+
+w_row = jax.device_put(w, NamedSharding(mesh, P("model", None)))
+drifted = contract_of("case20_mm", f, x_rep, w_row, mesh=mesh)
+drift = check_against_golden(golden_dir, drifted)
+assert drift, "wrong weight sharding compiled to the same collectives"
+assert all(fi.rule == "added-collective" for fi in drift)
+print(f"   caught: {[str(fi) for fi in drift]}")
+report_findings(drift, recorder=recorder, registry=registry)
+report["seed_wrong_sharding"] = {
+    "violations": [fi.to_dict() for fi in drift],
+}
+
+# --- clean-repo baseline: all three passes ------------------------------
+print("== clean repo: contracts + jaxpr/donation + ast ==")
+from learning_jax_sharding_tpu.analysis.entrypoints import (
+    build_entry_programs,
+)
+
+# One shared program list: the jaxpr pass reuses the contract pass's
+# cached AOT compiles instead of re-paying them (the CLI does the same).
+programs = build_entry_programs()
+contract_findings = run_contract_pass(programs=programs)
+jaxpr_findings = run_jaxpr_pass(programs=programs)
+ast_findings = run_ast_pass(pathlib.Path(__file__).resolve().parents[1])
+for name, fs in (
+    ("contracts", contract_findings),
+    ("jaxpr", jaxpr_findings),
+    ("ast", ast_findings),
+):
+    for fi in fs:
+        print(f"   UNEXPECTED {fi}")
+    assert not fs, f"clean-repo {name} pass found {len(fs)} finding(s)"
+print("   contracts hold for all golden entry points; donation audit "
+      "clean; AST lint at zero under baseline")
+
+# The jaxpr budgets must be TIGHT, not just sufficient: a ceiling looser
+# than reality silently absorbs that many NEW dead equations forever.
+# (tests/test_repo_lint.py pins the same property for the AST budgets;
+# this is the compile-side counterpart, checked here because this case
+# already paid the compiles.)
+from learning_jax_sharding_tpu.analysis import BASELINE_PATH
+
+budgets = json.loads(BASELINE_PATH.read_text()).get("jaxpr_budgets", {})
+for prog in programs:
+    if prog.jaxpr is None:
+        continue
+    counts: dict = {}
+    for fi in prog.jaxpr():
+        counts[fi.rule] = counts.get(fi.rule, 0) + 1
+    allowed = {
+        k: v for k, v in budgets.get(prog.name, {}).items()
+        if not k.startswith("_")
+    }
+    assert counts == allowed, (
+        f"jaxpr budget for {prog.name} is stale/loose: "
+        f"actual {counts} vs budget {allowed} — tighten baseline.json"
+    )
+print("   jaxpr budgets are tight (actual counts == ceilings)")
+report["clean_repo"] = {
+    "contracts": 0, "jaxpr": 0, "ast": 0, "jaxpr_budgets_tight": True,
+}
+
+# --- verdicts land in the diagnosis surfaces ----------------------------
+events = recorder.events("shardcheck_finding")
+assert len(events) == len(missed) + len(drift)
+assert any(
+    k.startswith("shardcheck_") for k in registry.snapshot()
+)
+report["telemetry_wiring"] = {
+    "recorder_events": len(events),
+    "registry_series": sorted(
+        k for k in registry.snapshot() if k.startswith("shardcheck_")
+    ),
+}
+
+(outdir / "report.json").write_text(json.dumps(report, indent=2))
+print(f"case20 artifacts: {outdir}")
+print("case20: all seeded violations caught; clean repo passes. OK")

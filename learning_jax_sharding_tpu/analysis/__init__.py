@@ -1,0 +1,161 @@
+"""Static sharding analysis: catch distributed-cost regressions pre-run.
+
+Three levels, one finding type, one CLI (``scripts/shardcheck.py``):
+
+1. **HLO contracts** (:mod:`.contracts`) — golden per-entry-point
+   multisets of ``(collective op, mesh axis, byte bound)`` over compiled
+   programs; drift (a new all-gather, a collective inside a while body,
+   an oversized replicated constant) fails before a step runs.
+2. **jaxpr / executable lint** (:mod:`.jaxpr_lint`, :mod:`.donation`) —
+   silent f32 promotions in bf16 graphs, dead equations, and donations
+   requested-but-dropped or eligible-but-never-requested, cross-checked
+   against ``utils.memory.memory_plan``.
+3. **AST source lint** (:mod:`.source_lint`) — jit-in-loop, non-hashable
+   static args, closure-captured device arrays, raw unsynced clocks;
+   pre-existing findings ride ``analysis/baseline.json``.
+
+Static verdicts land in the PR-2 flight recorder / registry
+(:func:`~.findings.report_findings`), so a post-mortem bundle shows what
+the static layer already knew.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from learning_jax_sharding_tpu.analysis.contracts import (
+    Contract,
+    ShardingContractError,
+    check_against_golden,
+    check_contract,
+    contract_of,
+    enforce_contract,
+)
+from learning_jax_sharding_tpu.analysis.donation import (
+    check_train_step_donation,
+    donation_report,
+    missed_donation_bytes,
+)
+from learning_jax_sharding_tpu.analysis.findings import (
+    Finding,
+    report_findings,
+)
+from learning_jax_sharding_tpu.analysis.jaxpr_lint import lint_fn, lint_jaxpr
+from learning_jax_sharding_tpu.analysis.source_lint import (
+    apply_baseline,
+    lint_source,
+    lint_tree,
+    load_baseline,
+)
+
+#: Checked-in goldens / baseline, relative to the repo root.
+GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
+
+
+def run_contract_pass(
+    golden_dir: str | pathlib.Path = GOLDEN_DIR,
+    *,
+    names: list[str] | None = None,
+    update: bool = False,
+    programs: list | None = None,
+) -> list[Finding]:
+    """Compile every registered entry point (``analysis.entrypoints``)
+    and diff its collective contract against the goldens. With
+    ``update=True``, (re)write the goldens instead and return [].
+    ``programs`` shares one ``build_entry_programs`` result across
+    passes (their per-program caches hold the built state/step, so the
+    jaxpr pass then reuses this pass's compiles instead of re-paying
+    them)."""
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_entry_programs,
+    )
+
+    golden_dir = pathlib.Path(golden_dir)
+    findings: list[Finding] = []
+    for prog in (programs if programs is not None
+                 else build_entry_programs(names)):
+        observed = contract_of(prog.name, prog.hlo(), mesh=prog.mesh)
+        if update:
+            golden_dir.mkdir(parents=True, exist_ok=True)
+            (golden_dir / f"{prog.name}.json").write_text(observed.to_json())
+        else:
+            findings.extend(check_against_golden(golden_dir, observed))
+    return findings
+
+
+def run_jaxpr_pass(
+    *,
+    names: list[str] | None = None,
+    baseline: str | pathlib.Path | None = BASELINE_PATH,
+    programs: list | None = None,
+) -> list[Finding]:
+    """Jaxpr + donation lint over the train-shaped entry points (serving
+    programs manage buffers through the engine's slot pool, not
+    donation). The jaxpr rules (f32 promotions, f32 dots in bf16 graphs,
+    dead equations) gate through per-program budgets in the baseline
+    file's ``jaxpr_budgets`` section — the framework's own traces carry
+    a known population of trivially-DCE'd flax/optax internals (recorded
+    as a ceiling, so NEW dead compute still fails), while the precision
+    rules run at zero budget."""
+    import json
+
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_entry_programs,
+    )
+
+    budgets: dict = {}
+    if baseline is not None:
+        p = pathlib.Path(baseline)
+        if p.exists() and p.read_text().strip():
+            budgets = json.loads(p.read_text()).get("jaxpr_budgets", {})
+    findings: list[Finding] = []
+    for prog in (programs if programs is not None
+                 else build_entry_programs(names)):
+        if prog.donation is not None:
+            findings.extend(prog.donation()["findings"])
+        if prog.jaxpr is not None:
+            used: dict[str, int] = {}
+            allowed = budgets.get(prog.name, {})
+            for f in prog.jaxpr():
+                used[f.rule] = used.get(f.rule, 0) + 1
+                if used[f.rule] > int(allowed.get(f.rule, 0)):
+                    findings.append(f)
+    return findings
+
+
+def run_ast_pass(
+    root: str | pathlib.Path,
+    *,
+    baseline: str | pathlib.Path | None = BASELINE_PATH,
+) -> list[Finding]:
+    """Repo-wide source lint under the baseline budget."""
+    findings = lint_tree(root)
+    budget = load_baseline(baseline) if baseline else {}
+    return apply_baseline(findings, budget)
+
+
+__all__ = [
+    "BASELINE_PATH",
+    "Contract",
+    "Finding",
+    "GOLDEN_DIR",
+    "ShardingContractError",
+    "enforce_contract",
+    "apply_baseline",
+    "check_against_golden",
+    "check_contract",
+    "check_train_step_donation",
+    "contract_of",
+    "donation_report",
+    "lint_fn",
+    "lint_jaxpr",
+    "lint_source",
+    "lint_tree",
+    "load_baseline",
+    "missed_donation_bytes",
+    "report_findings",
+    "run_ast_pass",
+    "run_contract_pass",
+    "run_jaxpr_pass",
+]

@@ -1,0 +1,294 @@
+"""SPMD collective contracts: golden multisets over compiled HLO.
+
+``parallel.hlo`` made GSPMD's collective choices countable; this module
+makes them ENFORCEABLE. A :class:`Contract` is the declarative record of
+what one jitted entry point is allowed to put on the wire: a multiset of
+``(collective op, mesh-axis label, count)`` with a per-group byte-volume
+bound, plus two structural caps — collectives inside ``while`` loops
+(per-iteration cost: an accidental weight all-gather in a decode loop
+multiplies its bytes by the trip count) and the largest replicated
+constant (every HLO constant is materialized on ALL devices under SPMD).
+
+Goldens live in ``analysis/golden/*.json`` and regenerate via
+``python scripts/shardcheck.py --update-golden``; :func:`check_contract`
+diffs a freshly compiled program against its golden and emits
+:class:`~.findings.Finding` records for every drift class — the exact
+failure shapes arXiv 2211.05322 / 2004.13336 show dominate distributed
+cost, caught before a single step runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any
+
+from learning_jax_sharding_tpu.analysis.findings import Finding
+from learning_jax_sharding_tpu.parallel.hlo import (
+    collective_instructions,
+    compiled_hlo,
+    constant_instructions,
+)
+
+#: Constants below this are noise (iota seeds, scalar tables); only larger
+#: ones are tracked/bounded. 64 KiB replicated × 8 devices = 512 KiB — the
+#: scale where "baked a tensor into the program" starts to matter.
+CONST_TRACK_BYTES = 64 * 1024
+
+#: Headroom multiplier on golden byte bounds: layout padding and fusion
+#: drift move buffer sizes a little between compiler versions; a REAL
+#: regression (gathering a weight instead of an activation) moves them
+#: by the sharding factor, far past this.
+DEFAULT_BYTE_SLACK = 1.25
+
+
+def _axis_label(groups: Any, by_groups: dict) -> str:
+    """Mesh-axis-subset label for one instruction's replica groups —
+    ``"data"``, ``"model"``, ``"data+model"``, ``"unattributed"``, or
+    ``"none"`` for degenerate all-singleton groups (no traffic, but the
+    instruction still counts toward the contract). Delegates to
+    ``telemetry.devview.axis_label_of_groups`` — ONE matcher, so
+    contract keys can never disagree with devview's byte attribution."""
+    from learning_jax_sharding_tpu.telemetry.devview import (
+        axis_label_of_groups,
+    )
+
+    label = axis_label_of_groups(groups, by_groups)
+    return "none" if label is None else label
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """Golden collective inventory for one jitted entry point.
+
+    ``collectives`` maps ``"op@axis"`` → ``{"count", "max_bytes"}``;
+    ``while_collectives`` caps how many collectives may run inside while
+    bodies; ``max_constant_bytes`` bounds the largest tracked replicated
+    constant (0 when none reached :data:`CONST_TRACK_BYTES`).
+    """
+
+    name: str
+    mesh_shape: list[int]
+    mesh_axes: list[str]
+    collectives: dict[str, dict]
+    while_collectives: int
+    max_constant_bytes: int
+
+    def to_json(self) -> str:
+        doc = {
+            "_comment": (
+                "Golden SPMD collective contract — regenerate with "
+                "`python scripts/shardcheck.py --update-golden` after an "
+                "INTENDED sharding change; never hand-edit counts."
+            ),
+            **dataclasses.asdict(self),
+        }
+        return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+    @classmethod
+    def from_json(cls, text: str) -> "Contract":
+        doc = json.loads(text)
+        doc.pop("_comment", None)
+        return cls(**doc)
+
+    @classmethod
+    def load(cls, path: str | pathlib.Path) -> "Contract":
+        return cls.from_json(pathlib.Path(path).read_text())
+
+
+def contract_of(name: str, hlo_or_fn: Any, *args, mesh: Any, **kwargs) -> Contract:
+    """Extract the contract a program ACTUALLY honors.
+
+    ``hlo_or_fn`` is optimized HLO text, or a (jitted or plain) function
+    compiled on ``args`` — which must already carry their real shardings,
+    so the partitioner makes the same collective choices the runtime
+    would (``parallel.hlo.compiled_hlo``'s convention).
+    """
+    from learning_jax_sharding_tpu.telemetry.devview import _axis_group_sets
+
+    text = (
+        hlo_or_fn if isinstance(hlo_or_fn, str)
+        else compiled_hlo(hlo_or_fn, *args, **kwargs)
+    )
+    by_groups = _axis_group_sets(mesh)
+    groups: dict[str, dict] = {}
+    n_while = 0
+    for ins in collective_instructions(text):
+        key = f"{ins['op']}@{_axis_label(ins['replica_groups'], by_groups)}"
+        g = groups.setdefault(key, {"count": 0, "max_bytes": 0})
+        g["count"] += 1
+        g["max_bytes"] = max(g["max_bytes"], int(ins["bytes"]))
+        if ins.get("in_while"):
+            n_while += 1
+    consts = constant_instructions(text, min_bytes=CONST_TRACK_BYTES)
+    return Contract(
+        name=name,
+        mesh_shape=[int(mesh.shape[a]) for a in mesh.axis_names],
+        mesh_axes=list(mesh.axis_names),
+        collectives=groups,
+        while_collectives=n_while,
+        max_constant_bytes=max((c["bytes"] for c in consts), default=0),
+    )
+
+
+def check_contract(
+    golden: Contract,
+    observed: Contract,
+    *,
+    byte_slack: float = DEFAULT_BYTE_SLACK,
+) -> list[Finding]:
+    """Diff ``observed`` against ``golden``; empty list == contract holds.
+
+    Violation classes (each its own stable rule id, for suppressions and
+    registry series):
+
+    * ``added-collective``   — an (op, axis) group grew or appeared: GSPMD
+      inserted communication the contract never admitted;
+    * ``missing-collective`` — a group shrank or vanished: either a real
+      win (regenerate the golden) or a sharding silently degenerated to
+      replication (no comms because every device now does all the work);
+    * ``oversized-collective`` — counts match but a buffer outgrew the
+      golden bound × ``byte_slack``: same ops, more wire bytes;
+    * ``while-loop-collective`` — more collectives inside while bodies
+      than the golden admits;
+    * ``oversized-constant`` — a replicated constant past both the golden
+      max and the tracking floor.
+    """
+    if golden.mesh_axes != observed.mesh_axes or golden.mesh_shape != observed.mesh_shape:
+        return [Finding(
+            "contracts", "mesh-mismatch", golden.name,
+            f"golden mesh {golden.mesh_shape}×{golden.mesh_axes} != observed "
+            f"{observed.mesh_shape}×{observed.mesh_axes}: the contract was "
+            "recorded on a different topology — regenerate the golden",
+        )]
+    out: list[Finding] = []
+    for key in sorted(set(golden.collectives) | set(observed.collectives)):
+        g = golden.collectives.get(key, {"count": 0, "max_bytes": 0})
+        o = observed.collectives.get(key, {"count": 0, "max_bytes": 0})
+        if o["count"] > g["count"]:
+            out.append(Finding(
+                "contracts", "added-collective", f"{golden.name}:{key}",
+                f"{o['count']} × {key} compiled, contract admits "
+                f"{g['count']} — GSPMD inserted communication the golden "
+                f"never recorded (largest buffer {o['max_bytes']} B)",
+                data={"golden": g, "observed": o},
+            ))
+        elif o["count"] < g["count"]:
+            out.append(Finding(
+                "contracts", "missing-collective", f"{golden.name}:{key}",
+                f"{o['count']} × {key} compiled, contract expects "
+                f"{g['count']} — a win to re-golden, or a sharding "
+                "degenerated to replication (no comms, all-redundant "
+                "compute)",
+                data={"golden": g, "observed": o},
+            ))
+        elif o["max_bytes"] > g["max_bytes"] * byte_slack:
+            out.append(Finding(
+                "contracts", "oversized-collective", f"{golden.name}:{key}",
+                f"largest {key} buffer {o['max_bytes']} B exceeds golden "
+                f"{g['max_bytes']} B × {byte_slack} slack — same op count, "
+                "more wire volume per dispatch",
+                data={"golden": g, "observed": o},
+            ))
+    if observed.while_collectives > golden.while_collectives:
+        out.append(Finding(
+            "contracts", "while-loop-collective", golden.name,
+            f"{observed.while_collectives} collective(s) inside while "
+            f"bodies, contract admits {golden.while_collectives} — "
+            "per-iteration communication multiplies by the trip count",
+            data={"golden": golden.while_collectives,
+                  "observed": observed.while_collectives},
+        ))
+    if observed.max_constant_bytes > max(
+        golden.max_constant_bytes * byte_slack, CONST_TRACK_BYTES
+    ):
+        out.append(Finding(
+            "contracts", "oversized-constant", golden.name,
+            f"largest replicated constant {observed.max_constant_bytes} B "
+            f"exceeds golden {golden.max_constant_bytes} B — under SPMD "
+            "every device materializes it",
+            data={"golden": golden.max_constant_bytes,
+                  "observed": observed.max_constant_bytes},
+        ))
+    return out
+
+
+class ShardingContractError(AssertionError):
+    """A compiled program violated its SPMD collective contract.
+
+    Raised by the ENFORCING entry points (``training.loop.fit(contract=)``,
+    ``enforce_contract``) — the checking APIs return findings instead.
+    Carries them as ``.findings``.
+    """
+
+    def __init__(self, findings: list[Finding]):
+        self.findings = findings
+        super().__init__(
+            f"{len(findings)} SPMD contract violation(s):\n"
+            + "\n".join(str(f) for f in findings)
+        )
+
+
+def enforce_contract(
+    golden: str | pathlib.Path | Contract,
+    hlo_or_fn: Any,
+    *args,
+    mesh: Any,
+    name: str | None = None,
+    byte_slack: float = DEFAULT_BYTE_SLACK,
+    recorder: Any | None = None,
+    registry: Any | None = None,
+    **kwargs,
+) -> Contract:
+    """Compile-and-check, loudly: raise :class:`ShardingContractError` on
+    any drift from ``golden`` (a :class:`Contract`, a golden file, or a
+    golden DIRECTORY — then ``name`` picks ``<dir>/<name>.json``).
+    Findings land in the recorder/registry first (when given), so the
+    bundle shows what tripped even though the process is about to die.
+    Returns the observed contract on success.
+    """
+    if isinstance(golden, Contract):
+        gold = golden
+    else:
+        path = pathlib.Path(golden)
+        if path.is_dir():
+            if name is None:
+                raise ValueError("a golden DIRECTORY needs name=")
+            path = path / f"{name}.json"
+        gold = Contract.load(path)
+    observed = contract_of(
+        name or gold.name, hlo_or_fn, *args, mesh=mesh, **kwargs
+    )
+    findings = check_contract(gold, observed, byte_slack=byte_slack)
+    if findings:
+        from learning_jax_sharding_tpu.analysis.findings import (
+            report_findings,
+        )
+
+        report_findings(findings, recorder=recorder, registry=registry)
+        raise ShardingContractError(findings)
+    return observed
+
+
+def check_against_golden(
+    golden_dir: str | pathlib.Path,
+    observed: Contract,
+    *,
+    byte_slack: float = DEFAULT_BYTE_SLACK,
+) -> list[Finding]:
+    """Check one observed contract against ``golden_dir/<name>.json``.
+
+    A missing golden is itself a finding (``no-golden``): an entry point
+    compiled under contract enforcement without a checked-in contract is
+    unreviewed communication.
+    """
+    path = pathlib.Path(golden_dir) / f"{observed.name}.json"
+    if not path.exists():
+        return [Finding(
+            "contracts", "no-golden", observed.name,
+            f"no golden contract at {path} — run "
+            "`python scripts/shardcheck.py --update-golden` and review "
+            "the recorded collectives",
+        )]
+    return check_contract(Contract.load(path), observed, byte_slack=byte_slack)

@@ -1,0 +1,350 @@
+"""The jitted entry points shardcheck holds under contract.
+
+One place that knows how to BUILD each hot program the repo ships —
+train step, ZeRO-1 update, serving prefill/decode, MoE all-to-all
+dispatch, ring/Ulysses attention — small enough to compile on the
+8-device emulated mesh in seconds, shaped exactly like the production
+path (same builders: ``make_train_step``, ``ContinuousEngine``,
+``moe_a2a_ff``, ``ops.ring_attention``/``ulysses``), so the golden
+contracts in ``analysis/golden/`` pin the real partitioning decisions.
+
+Every entry point resolves to one or more :class:`EntryProgram` records
+(name, mesh, optimized-HLO supplier, optional donation-audit hook).
+``scripts/shardcheck.py --update-golden`` regenerates the goldens from
+these; the checking path compiles the same programs and diffs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from learning_jax_sharding_tpu.parallel.hlo import compiled_hlo
+
+
+@dataclasses.dataclass
+class EntryProgram:
+    """One contract-checkable compiled program.
+
+    ``hlo`` is a thunk (compiles are paid lazily, once); ``donation``
+    optionally audits the program's buffer donations
+    (``analysis.donation.donation_report``-shaped dict); ``jaxpr``
+    optionally lints the program's trace
+    (``analysis.jaxpr_lint.lint_jaxpr`` findings, where-prefixed with
+    the entry-point name so per-program budgets can key on it).
+    """
+
+    name: str
+    mesh: Any
+    hlo: Callable[[], str]
+    donation: Callable[[], dict] | None = None
+    jaxpr: Callable[[], list] | None = None
+
+
+def _mesh24():
+    from learning_jax_sharding_tpu.parallel import build_mesh
+
+    return build_mesh((2, 4), ("data", "model"))
+
+
+def _tiny_cfg():
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from learning_jax_sharding_tpu.models.transformer import CONFIG_TINY
+
+    return dc.replace(CONFIG_TINY, dtype=jnp.float32)
+
+
+def _train_state_and_step(mesh, *, zero1_axis=None, with_grad_norm=False):
+    import jax
+
+    from learning_jax_sharding_tpu.data.datasets import SyntheticLMDataset
+    from learning_jax_sharding_tpu.data.loader import ShardedBatchLoader
+    from learning_jax_sharding_tpu.models.transformer import (
+        Transformer,
+        next_token_loss,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.training.loop import (
+        TrainLoopConfig,
+        default_optimizer,
+    )
+    from learning_jax_sharding_tpu.training.pipeline import (
+        make_train_step,
+        sharded_train_state,
+    )
+
+    cfg = _tiny_cfg()
+    dataset = SyntheticLMDataset(vocab_size=cfg.vocab_size, seq_len=32, seed=0)
+    loader = ShardedBatchLoader(dataset, mesh, 8, spec=("data",))
+    batch = loader.batch_at(0)
+    opt = default_optimizer(TrainLoopConfig(steps=4, global_batch_size=8))
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), opt, batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+        zero1_axis=zero1_axis,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=next_token_loss,
+        with_grad_norm=with_grad_norm,
+    )
+    return cfg, state, batch, step, RULES_DP_TP
+
+
+def _train_like(
+    name: str, *, zero1_axis=None, with_grad_norm=False, audit=True
+) -> EntryProgram:
+    import dataclasses as dc
+
+    from learning_jax_sharding_tpu.analysis.donation import (
+        check_train_step_donation,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import activate
+
+    mesh = _mesh24()
+    built: dict = {}
+
+    def ensure():
+        if not built:
+            built["v"] = _train_state_and_step(
+                mesh, zero1_axis=zero1_axis, with_grad_norm=with_grad_norm
+            )
+        return built["v"]
+
+    def ensure_compiled():
+        # ONE AOT lower+compile serves the contract pass (HLO text) AND
+        # the donation pass (alias header + args_info) — the single
+        # largest line of the CI budget, paid once per entry point.
+        if "text" not in built:
+            cfg, state, batch, step, rules = ensure()
+            with activate(mesh, rules):
+                built["lowered"] = step.jitted.lower(state, batch)
+                built["text"] = built["lowered"].compile().as_text()
+        return built["lowered"], built["text"]
+
+    def hlo():
+        return ensure_compiled()[1]
+
+    def donation():
+        cfg, state, batch, step, rules = ensure()
+        lowered, text = ensure_compiled()
+        with activate(mesh, rules):
+            return check_train_step_donation(
+                step, state, batch, cfg=cfg, precompiled=(lowered, text),
+            )
+
+    def jaxpr():
+        from learning_jax_sharding_tpu.analysis.jaxpr_lint import lint_jaxpr
+
+        cfg, state, batch, step, rules = ensure()
+        with activate(mesh, rules):
+            findings = lint_jaxpr(step.jitted, state, batch)
+        # Prefix with the entry-point name so baseline.json's per-program
+        # jaxpr budgets (and the reader) know which trace this is.
+        return [
+            dc.replace(f, where=f"{name}:{f.where}") for f in findings
+        ]
+
+    if not audit:
+        # Contract-golden-only variants (e.g. train_step_gn): skip the
+        # donation/jaxpr hooks so the jaxpr pass doesn't pay a duplicate
+        # compile for a program that differs only in its epilogue.
+        return EntryProgram(name, mesh, hlo)
+    return EntryProgram(name, mesh, hlo, donation, jaxpr)
+
+
+def _sharded_serving_params(model, mesh, rules):
+    """Params BORN SHARDED under the serving rules (the sharded-init
+    pipeline, same as a trained state would arrive) — relowering with
+    replicated params would record a vacuous no-collectives contract."""
+    import flax.linen as nn
+    import jax
+
+    from learning_jax_sharding_tpu.parallel.logical import (
+        activate,
+        tree_shardings,
+    )
+
+    probe = np.zeros((2, 8), np.int32)
+
+    def init(r, t):
+        return model.init({"params": r}, t)
+
+    with activate(mesh, rules):
+        abstract = jax.eval_shape(init, jax.random.key(0), probe)
+        shardings = tree_shardings(abstract, mesh, rules)
+        return jax.jit(
+            lambda r, t: nn.meta.unbox(init(r, t)),
+            out_shardings=shardings,
+        )(jax.random.key(0), probe)["params"]
+
+
+def _engine_programs(*, speculative: bool) -> list[EntryProgram]:
+    """Prefill + decode via a real (tiny) ContinuousEngine: one short
+    serve populates the dispatch-arg caches, then each program relowers
+    AOT (``ContinuousEngine.program_hlo``) under the engine's own golden
+    names (``contract_name`` — ``spec_``-prefixed for the speculative
+    family, whose refill also prefills the draft cache). first_refill is
+    covered too — single-chunk prefills must not be silently
+    contract-free."""
+    import dataclasses as dc
+
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.models.transformer import Transformer
+    from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
+
+    mesh = _mesh24()
+    built: dict = {}
+
+    def ensure():
+        if built:
+            return built["hlo"]
+        cfg = _tiny_cfg()
+        params = _sharded_serving_params(
+            Transformer(cfg), mesh, RULES_TP_SERVING
+        )
+        kwargs: dict = {}
+        d_params = None
+        if speculative:
+            d_cfg = dc.replace(cfg, num_layers=1)
+            d_params = _sharded_serving_params(
+                Transformer(d_cfg), mesh, RULES_TP_SERVING
+            )
+            kwargs = dict(draft_config=d_cfg, num_draft=2)
+        eng = ContinuousEngine(
+            cfg, mesh, RULES_TP_SERVING,
+            batch_size=2, max_new_tokens=8, refill_chunk=16,
+            decode_block_steps=4, **kwargs,
+        )
+        rng = np.random.default_rng(0)
+        prompts = [
+            rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+            for n in (20, 5)
+        ]
+        eng.serve(params, prompts, draft_params=d_params)
+        built["hlo"] = {
+            eng.contract_name(k): v for k, v in eng.program_hlo().items()
+        }
+        return built["hlo"]
+
+    names = (
+        ("spec_first_prefill", "spec_prefill", "spec_decode_step")
+        if speculative else ("first_prefill", "prefill", "decode_step")
+    )
+    return [
+        EntryProgram(name, mesh, lambda name=name: ensure()[name])
+        for name in names
+    ]
+
+
+def _serving_programs() -> list[EntryProgram]:
+    return [
+        *_engine_programs(speculative=False),
+        *_engine_programs(speculative=True),
+    ]
+
+
+def _moe_dispatch() -> EntryProgram:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from learning_jax_sharding_tpu.ops.moe_dispatch import moe_a2a_ff
+
+    mesh = _mesh24()
+
+    def hlo():
+        e, t, m, h = 4, 16, 32, 64
+        rng = np.random.default_rng(0)
+        sh = NamedSharding(mesh, P("data", None))
+        wsh = NamedSharding(mesh, P("data", None, None))
+        x = jax.device_put(
+            rng.standard_normal((t, m)).astype(np.float32), sh
+        )
+        probs = jax.device_put(
+            jax.nn.softmax(
+                jnp.asarray(rng.standard_normal((t, e)), jnp.float32)
+            ), sh,
+        )
+        w_up = jax.device_put(
+            rng.standard_normal((e, m, h)).astype(np.float32), wsh
+        )
+        w_down = jax.device_put(
+            rng.standard_normal((e, h, m)).astype(np.float32), wsh
+        )
+
+        def fn(x, probs, w_up, w_down):
+            return moe_a2a_ff(
+                x, probs, w_up, w_down, mesh=mesh, ep_axis="data",
+                top_k=2, capacity_factor=1.25, dtype=jnp.float32,
+            )
+
+        return compiled_hlo(fn, x, probs, w_up, w_down)
+
+    return EntryProgram("moe_dispatch", mesh, hlo)
+
+
+def _seq_attention(name: str) -> EntryProgram:
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = _mesh24()
+
+    def hlo():
+        from learning_jax_sharding_tpu.ops.ring_attention import (
+            ring_attention,
+        )
+        from learning_jax_sharding_tpu.ops.ulysses import ulysses_attention
+
+        b, s, n, h = 2, 32, 4, 16
+        rng = np.random.default_rng(0)
+        sh = NamedSharding(mesh, P("data", "model", None, None))
+        q, k, v = (
+            jax.device_put(
+                rng.standard_normal((b, s, n, h)).astype(np.float32), sh
+            )
+            for _ in range(3)
+        )
+        op = ring_attention if name == "ring_attention" else ulysses_attention
+
+        def fn(q, k, v):
+            return op(
+                q, k, v, mesh=mesh, axis="model", causal=True,
+                batch_axis="data",
+            )
+
+        return compiled_hlo(fn, q, k, v)
+
+    return EntryProgram(name, mesh, hlo)
+
+
+def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
+    """All contract-checkable programs (or the named subset), lazily
+    compiled. Must run under the 8-device emulated mesh (the CLI forces
+    it; tests inherit conftest's)."""
+    programs: list[EntryProgram] = [
+        _train_like("train_step"),
+        # The watchdog regime: fit(watchdog=...) forces with_grad_norm,
+        # whose global-norm epilogue adds collectives — its own golden,
+        # or fit(contract=..., watchdog=...) could never launch.
+        _train_like("train_step_gn", with_grad_norm=True, audit=False),
+        _train_like("zero1_update", zero1_axis="data"),
+        *_serving_programs(),
+        _moe_dispatch(),
+        _seq_attention("ring_attention"),
+        _seq_attention("ulysses_attention"),
+    ]
+    if names:
+        unknown = set(names) - {p.name for p in programs}
+        if unknown:
+            raise ValueError(
+                f"unknown entry point(s) {sorted(unknown)}; "
+                f"known: {sorted(p.name for p in programs)}"
+            )
+        programs = [p for p in programs if p.name in names]
+    return programs

@@ -1,0 +1,71 @@
+"""The shared finding record every shardcheck pass emits.
+
+One shape for all three levels (HLO contracts, jaxpr/executable lint,
+AST source lint) so the CLI, the tests, the baseline-suppression file,
+and the PR-2 diagnosis bundle all consume the same thing. A finding is
+deliberately JSON-plain: the flight recorder's producer contract
+(``FlightRecorder.record`` never filters) and the baseline file both
+require it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One static-analysis verdict.
+
+    ``check``: which pass produced it (``"contracts"``, ``"jaxpr"``,
+    ``"donation"``, ``"ast"``); ``rule``: the stable rule id suppressions
+    key on (``"added-collective"``, ``"jit-in-loop"``, …); ``where``: the
+    subject — ``file:line`` for source findings, the entry-point /
+    computation name for compiled ones; ``message``: the human sentence
+    naming what is wrong and why it costs.
+    """
+
+    check: str
+    rule: str
+    where: str
+    message: str
+    data: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "check": self.check,
+            "rule": self.rule,
+            "where": self.where,
+            "message": self.message,
+            **({"data": self.data} if self.data else {}),
+        }
+
+    def __str__(self) -> str:
+        return f"[{self.check}/{self.rule}] {self.where}: {self.message}"
+
+
+def report_findings(
+    findings: list[Finding],
+    *,
+    recorder: Any | None = None,
+    registry: Any | None = None,
+) -> None:
+    """Land static verdicts in the SAME diagnosis surfaces the runtime
+    uses (PR 1/2): one ``shardcheck_finding`` flight-recorder event per
+    finding (so a post-mortem bundle shows what static analysis already
+    knew), and per-rule ``shardcheck_findings_total`` counters in the
+    registry (so a scrape sees static drift next to runtime SLOs).
+    """
+    if recorder is not None:
+        for f in findings:
+            recorder.record("shardcheck_finding", **f.to_dict())
+    if registry is not None:
+        for f in findings:
+            # The registry is label-free (PR 1's deliberate smallness):
+            # encode pass/rule into the series name, the same convention
+            # the engine uses for its per-program compile counters.
+            registry.counter(
+                f"shardcheck_{f.check}_{f.rule.replace('-', '_')}_total",
+                help="static shardcheck findings for one pass/rule",
+            ).inc()

@@ -1,0 +1,357 @@
+"""Repo-wide AST lint for the JAX-specific footguns tests can't see.
+
+``tests/test_timing_audit.py`` proved the shape works: a textual
+tripwire (raw clock ⇒ nearby sync) kept every ``cases/`` timing loop
+honest across five rounds of refactors. This module generalizes that
+tripwire into reusable rules over the WHOLE repo, AST-based where
+structure matters:
+
+* ``jit-in-loop``        — ``jax.jit(...)`` (or ``partial(jax.jit, ...)``)
+  called inside a ``for``/``while`` body: a fresh wrapper per iteration
+  defeats the compile cache, so every pass through the loop recompiles —
+  the recompile hazard PR 1's ``CompileWatch`` detects at runtime, caught
+  here at review time.
+* ``nonhashable-static`` — a function jitted with
+  ``static_argnames``/``static_argnums`` whose named parameter defaults
+  to a mutable literal (list/dict/set): the first call with the default
+  raises ``unhashable type`` — or worse, callers pass fresh literals and
+  every call recompiles.
+* ``captured-device-array`` — a jit-decorated function reading a
+  module-level name bound to a ``jnp.``/``device_put`` result: the array
+  is baked into the trace as a constant (bloating the executable and
+  pinning device memory) instead of being passed as an argument.
+* ``raw-clock``          — a raw wall-clock read (``time.time`` /
+  ``perf_counter`` call) with no honest sync idiom within ±10 lines:
+  times dispatch, not execution (the reference's original flaw,
+  case6_attention.py:234-238).
+
+Findings carry ``file:line`` and a stable rule id; pre-existing hits are
+carried in ``analysis/baseline.json`` — a (file, rule) → count budget —
+so the repo gates on NEW findings without a flag-day cleanup.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from typing import Iterable
+
+from learning_jax_sharding_tpu.analysis.findings import Finding
+
+#: Same idioms the timing-audit test pins, kept textually in sync with
+#: tests/test_timing_audit.py (that test remains the cases/-specific
+#: tripwire; this rule is the repo-wide generalization).
+RAW_CLOCKS = re.compile(
+    r"time\.perf_counter\(|time\.time\(|time\.monotonic\(|timeit\."
+)
+SYNC_IDIOMS = re.compile(
+    r"measure\(|time_fn\(|block_until_ready|np\.asarray\(|"
+    r"\.sync\(|device_sync\(|latency_stats\(|\.step\(|serve\("
+)
+SYNC_WINDOW = 10
+
+_SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "build", "dist"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """`jax.jit` / `partial` / `np.asarray` — the dotted name of a call
+    target, best effort ('' for subscripts/lambdas)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = _dotted(node.func)
+    if name in ("jax.jit", "jit", "pjit", "jax.pjit"):
+        return True
+    # functools.partial(jax.jit, ...) — the decorator spelling.
+    if name.endswith("partial") and node.args:
+        return _dotted(node.args[0]) in ("jax.jit", "jit", "pjit", "jax.pjit")
+    return False
+
+
+def _static_names(call: ast.Call) -> set[str]:
+    """Parameter names a jit call pins static via ``static_argnames``."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+    return out
+
+
+_DEVICE_MAKERS = re.compile(
+    r"^(jnp|jax\.numpy)\.|^jax\.device_put$|^jax\.random\.|device_put$"
+)
+
+
+def _flat_targets(t: ast.AST):
+    """Names bound by one assignment target (handles Tuple/List/Starred)."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _flat_targets(e)
+    elif isinstance(t, ast.Starred):
+        yield from _flat_targets(t.value)
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Every name BOUND anywhere inside ``fn``'s body: assignments
+    (plain/aug/annotated, tuple unpacking), ``for`` targets, ``with ...
+    as``, comprehension targets, ``except ... as``, imports, nested
+    def/class names. A module-level device-array name shadowed by any of
+    these is a local, not a capture — missing a binding form here turns
+    correct code into a CI-gating false positive."""
+    out: set[str] = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                out.update(_flat_targets(t))
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            out.update(_flat_targets(n.target))
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            out.update(_flat_targets(n.target))
+        elif isinstance(n, (ast.With, ast.AsyncWith)):
+            for item in n.items:
+                if item.optional_vars is not None:
+                    out.update(_flat_targets(item.optional_vars))
+        elif isinstance(n, ast.comprehension):
+            out.update(_flat_targets(n.target))
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            out.add(n.name)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            out.update(
+                (a.asname or a.name.split(".")[0]) for a in n.names
+            )
+        elif isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ) and n is not fn:
+            out.add(n.name)
+        elif isinstance(n, ast.NamedExpr):
+            out.update(_flat_targets(n.target))
+    return out
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, lines: list[str]):
+        self.path = path
+        self.lines = lines
+        self.findings: list[Finding] = []
+        self.loop_depth = 0
+        self.func_depth = 0
+        # Names bound at MODULE scope to device-array-producing calls —
+        # function-local `x = jnp...` bindings must not poison the set
+        # (a jitted function elsewhere reading an unrelated global `x`
+        # would false-positive and gate CI).
+        self.device_names: set[str] = set()
+
+    # --- loops: jit construction inside is a per-iteration recompile ---
+    def _loop(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_Call(self, node: ast.Call):
+        if _is_jit_call(node) and self.loop_depth > 0:
+            self.findings.append(Finding(
+                "ast", "jit-in-loop", f"{self.path}:{node.lineno}",
+                "jax.jit called inside a loop body — each iteration "
+                "builds a fresh wrapper with its own compile cache, so "
+                "every pass recompiles; hoist the jit out of the loop",
+            ))
+        self.generic_visit(node)
+
+    # --- module-scope device arrays + jitted functions that read them ---
+    def visit_Assign(self, node: ast.Assign):
+        if (
+            self.loop_depth == 0
+            and self.func_depth == 0
+            and isinstance(node.value, ast.Call)
+        ):
+            maker = _dotted(node.value.func)
+            if _DEVICE_MAKERS.search(maker):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.device_names.add(t.id)
+        self.generic_visit(node)
+
+    def _check_function(self, node):
+        jit_decos = [
+            d for d in node.decorator_list
+            if (isinstance(d, ast.Call) and _is_jit_call(d))
+            or _dotted(d) in ("jax.jit", "jit")
+        ]
+        if jit_decos:
+            self._check_static_defaults(node, jit_decos)
+            self._check_captures(node)
+        self.func_depth += 1
+        self.generic_visit(node)
+        self.func_depth -= 1
+
+    visit_FunctionDef = visit_AsyncFunctionDef = _check_function
+
+    def _check_static_defaults(self, node, jit_decos):
+        static: set[str] = set()
+        for d in jit_decos:
+            if isinstance(d, ast.Call):
+                static |= _static_names(d)
+        if not static:
+            return
+        args = node.args
+        pos = args.posonlyargs + args.args
+        defaults = [None] * (len(pos) - len(args.defaults)) + list(args.defaults)
+        pairs = list(zip(pos, defaults)) + list(
+            zip(args.kwonlyargs, args.kw_defaults)
+        )
+        for arg, default in pairs:
+            if arg.arg in static and isinstance(
+                default, (ast.List, ast.Dict, ast.Set)
+            ):
+                self.findings.append(Finding(
+                    "ast", "nonhashable-static",
+                    f"{self.path}:{default.lineno}",
+                    f"static arg {arg.arg!r} of jitted "
+                    f"`{node.name}` defaults to a mutable literal — "
+                    "static args key the compile cache by hash; a "
+                    "list/dict default raises `unhashable type` on "
+                    "first use (use a tuple/frozen value)",
+                ))
+
+    def _check_captures(self, node):
+        params = {
+            a.arg for a in (
+                node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+            )
+        }
+        local = _bound_names(node)
+        seen: set[str] = set()
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in self.device_names
+                and n.id not in params
+                and n.id not in local
+                and n.id not in seen
+            ):
+                seen.add(n.id)
+                self.findings.append(Finding(
+                    "ast", "captured-device-array",
+                    f"{self.path}:{n.lineno}",
+                    f"jitted `{node.name}` closes over module-level "
+                    f"device array `{n.id}` — it is baked into the "
+                    "executable as a constant (replicated on every "
+                    "device, invisible to donation); pass it as an "
+                    "argument instead",
+                ))
+
+
+def _raw_clock_findings(path: str, lines: list[str]) -> list[Finding]:
+    out: list[Finding] = []
+    for i, line in enumerate(lines):
+        if not RAW_CLOCKS.search(line):
+            continue
+        lo, hi = max(0, i - SYNC_WINDOW), i + SYNC_WINDOW + 1
+        if not any(SYNC_IDIOMS.search(l) for l in lines[lo:hi]):
+            out.append(Finding(
+                "ast", "raw-clock", f"{path}:{i + 1}",
+                "raw wall-clock read with no sync idiom within "
+                f"±{SYNC_WINDOW} lines — times dispatch, not execution; "
+                "use utils.bench.measure/time_fn or read a result back "
+                "before stopping the clock",
+            ))
+    return out
+
+
+def lint_source(path: str | pathlib.Path, text: str | None = None) -> list[Finding]:
+    """Lint ONE Python source file; ``path`` is the label findings carry
+    (pass repo-relative paths so the baseline file stays portable)."""
+    p = pathlib.Path(path)
+    if text is None:
+        text = p.read_text()
+    lines = text.splitlines()
+    out = _raw_clock_findings(str(path), lines)
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return out + [Finding(
+            "ast", "syntax-error", f"{path}:{e.lineno or 0}", str(e.msg),
+        )]
+    v = _Visitor(str(path), lines)
+    v.visit(tree)
+    return out + v.findings
+
+
+def lint_tree(
+    root: str | pathlib.Path,
+    *,
+    include: Iterable[str] = ("learning_jax_sharding_tpu", "cases", "scripts", "bench.py"),
+) -> list[Finding]:
+    """Lint every ``.py`` under ``root``'s source surfaces (not tests/ —
+    tests legitimately construct pathological jits on purpose). Paths in
+    findings are repo-relative, stable for the baseline file."""
+    root = pathlib.Path(root)
+    files: list[pathlib.Path] = []
+    for entry in include:
+        p = root / entry
+        if p.is_file():
+            files.append(p)
+        elif p.is_dir():
+            files.extend(
+                f for f in sorted(p.rglob("*.py"))
+                if not any(part in _SKIP_DIRS for part in f.parts)
+            )
+    out: list[Finding] = []
+    for f in files:
+        out.extend(lint_source(f.relative_to(root).as_posix(), f.read_text()))
+    return out
+
+
+# --- baseline suppression -------------------------------------------------
+
+
+def load_baseline(path: str | pathlib.Path) -> dict[tuple[str, str], int]:
+    """``{(file, rule): allowed_count}`` from ``analysis/baseline.json``.
+    A missing file is an empty baseline (everything gates)."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        return {}
+    text = p.read_text()
+    if not text.strip():   # empty file / /dev/null: everything gates
+        return {}
+    doc = json.loads(text)
+    return {
+        (s["file"], s["rule"]): int(s.get("count", 1))
+        for s in doc.get("suppressions", [])
+    }
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: dict[tuple[str, str], int]
+) -> list[Finding]:
+    """Findings NOT covered by the baseline budget. Budgets are per
+    (file, rule) counts — line numbers drift with every edit, counts
+    only change when a finding is added or fixed. The baseline is a
+    ceiling: a count below budget passes here, and
+    ``tests/test_repo_lint.py`` separately fails on stale/loose budgets
+    so the slack cannot silently accumulate."""
+    used: dict[tuple[str, str], int] = {}
+    out: list[Finding] = []
+    for f in findings:
+        key = (f.where.rsplit(":", 1)[0], f.rule)
+        used[key] = used.get(key, 0) + 1
+        if used[key] > baseline.get(key, 0):
+            out.append(f)
+    return out

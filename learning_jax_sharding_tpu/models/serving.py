@@ -1807,38 +1807,46 @@ class ContinuousEngine:
             fns["decode_block"] = self._decode_block_fn
         return {k: cache_size(f) for k, f in fns.items()}
 
+    def _dispatched_programs(self):
+        """``(program_name, jitted_fn, args)`` for every engine program
+        that has dispatched at least once — THE one list of relowerable
+        programs, shared by the runtime reports and the static contract
+        pass so a new program cannot be visible to one and invisible to
+        the other. ``first_refill`` is included so single-chunk prefills
+        are not silently missing."""
+        out = []
+        if self._last_first_refill_args is not None:
+            out.append((
+                "first_refill", self._first_refill_fn,
+                self._last_first_refill_args(),
+            ))
+        if self._last_refill_args is not None:
+            out.append((
+                "refill_step", self._refill_step_fn,
+                self._last_refill_args(),
+            ))
+        if self._last_decode_args is not None:
+            if self._speculative:
+                fn, name = self._decode_block_spec_fn, "decode_block_spec"
+            else:
+                fn, name = self._decode_block_fn, "decode_block"
+            out.append((name, fn, self._last_decode_args()))
+        return out
+
     def _program_reports(self) -> dict[str, dict]:
-        """Full ``executable_report`` per engine program, re-lowered AOT
-        with its most recent dispatch arguments (costs a compile per
-        program — diagnostics, not hot path). Keys appear only for
-        programs that have dispatched at least once on this engine
-        (``first_refill`` included, so single-chunk prefills are not
-        silently missing)."""
+        """Full ``executable_report`` per dispatched engine program,
+        re-lowered AOT with its most recent dispatch arguments (costs a
+        compile per program — diagnostics, not hot path; coverage per
+        :meth:`_dispatched_programs`)."""
         from learning_jax_sharding_tpu.telemetry.compile_watch import (
             executable_report,
         )
 
-        out: dict[str, dict] = {}
         with activate(self._mesh, self._rules):
-            if self._last_first_refill_args is not None:
-                out["first_refill"] = executable_report(
-                    self._first_refill_fn, *self._last_first_refill_args()
-                )
-            if self._last_refill_args is not None:
-                out["refill_step"] = executable_report(
-                    self._refill_step_fn, *self._last_refill_args()
-                )
-            if self._last_decode_args is not None:
-                if self._speculative:
-                    fn, name = (
-                        self._decode_block_spec_fn, "decode_block_spec"
-                    )
-                else:
-                    fn, name = self._decode_block_fn, "decode_block"
-                out[name] = executable_report(
-                    fn, *self._last_decode_args()
-                )
-        return out
+            return {
+                name: executable_report(fn, *args)
+                for name, fn, args in self._dispatched_programs()
+            }
 
     def collective_inventory(self) -> dict[str, dict[str, int]]:
         """Per-dispatch collective counts read off the engine's OWN
@@ -1848,6 +1856,62 @@ class ContinuousEngine:
             name: rep["collectives"]
             for name, rep in self._program_reports().items()
         }
+
+    def program_hlo(self) -> dict[str, str]:
+        """Optimized HLO text per dispatched engine program — the static
+        contract pass's view of the serving path (``analysis.contracts``).
+        Same AOT-relower cost and coverage as :meth:`_program_reports`
+        (both map over :meth:`_dispatched_programs`)."""
+        from learning_jax_sharding_tpu.parallel.hlo import compiled_hlo
+
+        with activate(self._mesh, self._rules):
+            return {
+                name: compiled_hlo(fn, *args)
+                for name, fn, args in self._dispatched_programs()
+            }
+
+    #: Engine program → golden contract name (``analysis/golden/<name>.json``)
+    #: — the names ``analysis.entrypoints`` generates under. A SPECULATIVE
+    #: engine's programs get a ``spec_`` prefix on top (its refill also
+    #: prefills the draft cache — a different program family with its own
+    #: goldens): spec_first_prefill / spec_prefill / spec_decode_step.
+    CONTRACT_NAMES = {
+        "first_refill": "first_prefill",
+        "refill_step": "prefill",
+        "decode_block": "decode_step",
+        "decode_block_spec": "decode_step",
+    }
+
+    def contract_name(self, program: str) -> str:
+        base = self.CONTRACT_NAMES.get(program, program)
+        return f"spec_{base}" if self._speculative else base
+
+    def check_contracts(self, golden_dir):
+        """Check every dispatched engine program against its golden SPMD
+        contract in ``golden_dir`` (:meth:`contract_name` maps programs
+        to golden files) and return the findings — the serving-side
+        enforcement hook for ``scripts/shardcheck.py``. Findings also
+        land in this engine's flight recorder and registry, so a contract
+        drift shows up in the same diagnosis bundle as the runtime events
+        it explains."""
+        from learning_jax_sharding_tpu.analysis.contracts import (
+            check_against_golden,
+            contract_of,
+        )
+        from learning_jax_sharding_tpu.analysis.findings import (
+            report_findings,
+        )
+
+        findings = []
+        for prog, text in self.program_hlo().items():
+            observed = contract_of(
+                self.contract_name(prog), text, mesh=self._mesh
+            )
+            findings.extend(check_against_golden(golden_dir, observed))
+        report_findings(
+            findings, recorder=self.recorder, registry=self.registry
+        )
+        return findings
 
     def collective_axis_volume(self) -> dict[str, dict]:
         """Per-MESH-AXIS collective byte volume for each engine program:

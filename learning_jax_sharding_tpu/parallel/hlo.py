@@ -46,6 +46,101 @@ _GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[0-9,{} ]*\}\}|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
 )
 
+# A computation header: `%name (params...) -> result {` — optionally prefixed
+# by `ENTRY`. Params may nest parens (tuple-typed args), so the param match is
+# greedy to the last `)` before `->`. The `^` anchor excludes instruction
+# lines (XLA indents bodies by two spaces); the body runs to the first `}` at
+# column 0.
+_COMP_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+
+# Instruction-level references to other computations: while bodies/conditions,
+# fusion bodies, calls, conditional branches (indexed `branch_computations=`
+# AND the pred form's `true_computation=`/`false_computation=` — XLA prints
+# two-branch conditionals with the latter). `to_apply` is deliberately NOT
+# an edge — it names the scalar reduction of a reduce/all-reduce, which can
+# never contain a collective, and following it would misfile the reducer.
+_CALL_EDGE_RE = re.compile(
+    r"(?:body|condition|calls|true_computation|false_computation)"
+    r"=%?([\w.\-]+)"
+    r"|branch_computations=\{([^}]*)\}"
+)
+
+_WHILE_BODY_RE = re.compile(r"=\s+(?:\([^)]*\)|\S+)\s+while\(")
+
+
+def _scoped_lines(hlo_text: str):
+    """Yield ``(computation_name, line)`` for every line of ``hlo_text``
+    — THE one computation-tracking state machine (header match, closing
+    ``}`` at column 0), shared by every scanner in this module so they
+    can never disagree about which computation a line belongs to.
+    ``computation_name`` is None outside any computation (module header
+    lines, or headerless instruction snippets as the tests feed).
+    Header and closing-brace lines themselves are not yielded.
+    """
+    name: str | None = None
+    for line in hlo_text.splitlines():
+        if name is None:
+            m = _COMP_HEADER_RE.match(line)
+            if m is not None and line.rstrip().endswith("{"):
+                name = m.group(1)
+                continue
+        elif line.startswith("}"):
+            name = None
+            continue
+        yield name, line
+
+
+def hlo_computations(hlo_text: str) -> dict[str, str]:
+    """Split optimized HLO text into ``{computation_name: body_text}``.
+
+    Names are stripped of the leading ``%``. Text before the first header
+    (the ``HloModule`` line and attributes) is dropped.
+    """
+    bodies: dict[str, list[str]] = {}
+    for comp, line in _scoped_lines(hlo_text):
+        if comp is not None:
+            bodies.setdefault(comp, []).append(line)
+    return {name: "\n".join(body) for name, body in bodies.items()}
+
+
+def while_scoped_computations(hlo_text: str) -> set[str]:
+    """Names of computations that execute INSIDE a ``while`` loop.
+
+    Seeds from every ``while(...)`` instruction's ``body=`` / ``condition=``
+    attributes, then closes transitively over ``calls=`` / nested ``body=`` /
+    ``branch_computations`` edges — a collective anywhere in that closure
+    runs once per loop iteration, the exact shape of silent cost the static
+    contract pass exists to flag (an all-gather of the weights inside a
+    decode loop multiplies its wire bytes by the trip count).
+    """
+    comps = hlo_computations(hlo_text)
+    edges: dict[str, set[str]] = {}
+    seeds: set[str] = set()
+    for cname, body in comps.items():
+        refs: set[str] = set()
+        for line in body.splitlines():
+            for m in _CALL_EDGE_RE.finditer(line):
+                if m.group(1):
+                    refs.add(m.group(1))
+                else:
+                    refs.update(
+                        t.strip().lstrip("%")
+                        for t in m.group(2).split(",") if t.strip()
+                    )
+            if _WHILE_BODY_RE.search(line):
+                for wm in re.finditer(r"(?:body|condition)=%?([\w.\-]+)", line):
+                    seeds.add(wm.group(1))
+        edges[cname] = refs
+    scoped: set[str] = set()
+    frontier = list(seeds)
+    while frontier:
+        cur = frontier.pop()
+        if cur in scoped:
+            continue
+        scoped.add(cur)
+        frontier.extend(edges.get(cur, ()))
+    return scoped
+
 
 def _dtype_bits(token: str) -> int:
     """Bit width of an HLO dtype token (`bf16` → 16, `f8e4m3fn` → 8,
@@ -79,17 +174,24 @@ def _parse_replica_groups(text: str) -> list[list[int]] | None:
 def collective_instructions(hlo_text: str) -> list[dict]:
     """Per-instruction collective records from optimized HLO text.
 
-    Each record is ``{"op", "bytes", "replica_groups"}``: ``bytes`` is the
-    LARGEST typed operand/result buffer in the instruction's result type (for
-    async ``-start`` pairs the tuple holds operand AND result, so the max is
-    the post-collective buffer — the honest wire-volume proxy for a grown
-    all-gather); ``replica_groups`` is a list of partition-id lists (ids are
-    positions in the mesh's flattened device order under SPMD partitioning),
-    or None when XLA printed none. ``-done`` halves are excluded, so an async
-    pair contributes once — same convention as :func:`collective_counts`.
+    Each record is ``{"op", "bytes", "replica_groups", "computation",
+    "in_while"}``: ``bytes`` is the LARGEST typed operand/result buffer in
+    the instruction's result type (for async ``-start`` pairs the tuple
+    holds operand AND result, so the max is the post-collective buffer —
+    the honest wire-volume proxy for a grown all-gather);
+    ``replica_groups`` is a list of partition-id lists (ids are positions
+    in the mesh's flattened device order under SPMD partitioning), or None
+    when XLA printed none; ``computation`` is the enclosing computation's
+    name (None for headerless snippets); ``in_while`` marks instructions
+    whose computation executes inside a ``while`` loop
+    (:func:`while_scoped_computations` — per-iteration cost, the
+    contract pass's highest-signal flag). ``-done`` halves are excluded,
+    so an async pair contributes once — same convention as
+    :func:`collective_counts`.
     """
+    scoped = while_scoped_computations(hlo_text)
     out = []
-    for line in hlo_text.splitlines():
+    for comp, line in _scoped_lines(hlo_text):
         m = _INSTR_RE.search(line)
         if m is None:
             continue
@@ -100,7 +202,36 @@ def collective_instructions(hlo_text: str) -> list[dict]:
             nbytes = max(nbytes, (numel * _dtype_bits(dt) + 7) // 8)
         gm = _GROUPS_RE.search(line)
         groups = _parse_replica_groups(gm.group(1)) if gm else None
-        out.append({"op": op, "bytes": nbytes, "replica_groups": groups})
+        out.append({
+            "op": op, "bytes": nbytes, "replica_groups": groups,
+            "computation": comp, "in_while": comp in scoped,
+        })
+    return out
+
+
+_CONST_RE = re.compile(r"=\s+(\([^)]*\)|\S+)\s+constant\(")
+
+
+def constant_instructions(hlo_text: str, *, min_bytes: int = 0) -> list[dict]:
+    """``{"bytes", "computation"}`` for every ``constant(...)`` instruction
+    whose buffer is at least ``min_bytes``.
+
+    Under SPMD partitioning every device runs the same program, so every
+    HLO constant is materialized REPLICATED on all devices — a large one
+    (a weight baked in as a literal, a huge iota table) silently costs
+    ``n_devices ×`` its bytes. The contract pass bounds the largest.
+    """
+    out = []
+    for comp, line in _scoped_lines(hlo_text):
+        m = _CONST_RE.search(line)
+        if m is None:
+            continue
+        nbytes = 0
+        for dt, dims in _SHAPE_RE.findall(m.group(1)):
+            numel = math.prod(int(d) for d in dims.split(",") if d)
+            nbytes = max(nbytes, (numel * _dtype_bits(dt) + 7) // 8)
+        if nbytes >= min_bytes:
+            out.append({"bytes": nbytes, "computation": comp})
     return out
 
 

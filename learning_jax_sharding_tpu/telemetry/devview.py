@@ -226,6 +226,29 @@ def _axis_group_sets(mesh: Any) -> dict[str, frozenset]:
     return out
 
 
+def axis_label_of_groups(groups: Any, by_groups: dict) -> str | None:
+    """THE replica-groups → mesh-axis-subset matcher, shared by this
+    module's byte attribution and ``analysis.contracts``' contract keys
+    (so the two can never disagree about which axis carried an op).
+
+    Returns a key of ``by_groups`` (:func:`_axis_group_sets`) on an exact
+    group-set match, ``"unattributed"`` when nothing matches or XLA
+    printed no groups, and ``None`` for degenerate all-singleton groups
+    (no traffic — callers decide whether to skip or bucket those).
+    """
+    if not groups:
+        return "unattributed"
+    gset = frozenset(
+        frozenset(int(x) for x in g) for g in groups if len(g) > 1
+    )
+    if not gset:
+        return None
+    for cand, expected in by_groups.items():
+        if gset == expected:
+            return cand
+    return "unattributed"
+
+
 def axis_collective_volume(hlo_or_instrs: Any, mesh: Any) -> dict:
     """Attribute collective byte volume to mesh axes.
 
@@ -248,18 +271,9 @@ def axis_collective_volume(hlo_or_instrs: Any, mesh: Any) -> dict:
     }
     out["unattributed"] = {"ops": 0, "bytes": 0}
     for ins in instrs:
-        groups = ins.get("replica_groups")
-        label = "unattributed"
-        if groups:
-            gset = frozenset(
-                frozenset(int(x) for x in g) for g in groups if len(g) > 1
-            )
-            if not gset:
-                continue   # degenerate single-member groups: no traffic
-            for cand, expected in by_groups.items():
-                if gset == expected:
-                    label = cand
-                    break
+        label = axis_label_of_groups(ins.get("replica_groups"), by_groups)
+        if label is None:
+            continue   # degenerate single-member groups: no traffic
         out[label]["ops"] += 1
         out[label]["bytes"] += int(ins.get("bytes", 0))
     return out

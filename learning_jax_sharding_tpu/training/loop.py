@@ -134,6 +134,7 @@ def fit(
     watchdog: Any | None = None,
     heartbeat: Any | None = None,
     recorder: Any | None = None,
+    contract: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Train ``model`` on ``dataset`` for ``cfg.steps`` steps.
 
@@ -182,6 +183,18 @@ def fit(
             :class:`~learning_jax_sharding_tpu.telemetry.FlightRecorder`
             (default: the process-wide ring) — ``fit`` records per-step
             events and the escalation trail into it.
+        contract: optional SPMD collective contract
+            (:class:`~learning_jax_sharding_tpu.analysis.Contract`, a
+            golden ``.json`` path, or a golden directory — then the
+            ``"train_step"`` golden is used, or ``"train_step_gn"``
+            when a watchdog forces the grad-norm epilogue into the
+            step). The compiled step is
+            checked BEFORE step 1 and any drift (a new collective, an
+            oversized buffer, comms inside a while body) raises
+            :class:`~learning_jax_sharding_tpu.analysis.contracts.ShardingContractError`
+            — an accidental weight all-gather should cost one failed
+            launch, not a week of a slow hot loop. The findings land in
+            the flight recorder/registry first.
     """
     from learning_jax_sharding_tpu.telemetry import (
         CompileWatch,
@@ -235,6 +248,33 @@ def fit(
             state_sh, {k: v.sharding for k, v in sample.items()}, mesh,
             rules, loss_fn=loss_fn, **extra,
         )
+        if contract is not None:
+            # Fail-fast static gate. Costs ONE extra AOT compile of the
+            # step at launch (the .lower().compile() here does not seed
+            # the jit dispatch cache on this jax) — the price of failing
+            # a bad sharding before step 1 instead of shipping it.
+            from learning_jax_sharding_tpu.analysis.contracts import (
+                enforce_contract,
+            )
+
+            # Under activate(): the goldens are generated with the mesh
+            # and logical rules ambient (analysis/entrypoints.py), and a
+            # model whose with_logical_constraint calls resolve to no-ops
+            # here could compile different collectives than its golden —
+            # a spurious launch failure.
+            # A watchdog forces the grad-norm epilogue into the step
+            # (extra reductions), which has its OWN golden — checking
+            # that program against the plain train_step contract would
+            # fail every healthy watchdog run at launch.
+            golden_name = (
+                "train_step_gn" if extra.get("with_grad_norm")
+                else "train_step"
+            )
+            with tr.span("fit.contract_check"), activate(mesh, rules):
+                enforce_contract(
+                    contract, step_fn.jitted, state, sample, mesh=mesh,
+                    name=golden_name, recorder=rec, registry=registry,
+                )
 
     ckpt = None
     start_step = 0

@@ -179,19 +179,73 @@ def make_lora_train_step(
         adapters = optax.apply_updates(ls.adapters, updates)
         return LoraState(adapters, opt_state, ls.step + 1, ls.alpha), loss
 
-    jitted = jax.jit(
-        step,
-        in_shardings=(base_shardings, None, x_sharding),
-        out_shardings=(None, NamedSharding(mesh, PartitionSpec())),
-        donate_argnums=(1,),
-    )
+    # The donated LoraState's OUTPUT shardings must be pinned to its input
+    # shardings. Left unspecified (the original spelling), GSPMD was free
+    # to choose different output placements for the adapter/moment leaves,
+    # and the donation then aliased per-device buffers of DIFFERENT sizes
+    # — "Expected aliased input ... and output ... to have the same size"
+    # at dispatch (the `analysis.donation` pass surfaces the same
+    # executable-level aliases statically). Those shardings only exist on
+    # a concrete state, so the step binds to the FIRST LoraState it sees
+    # (``bind(ls)`` explicitly, or the first dispatch): NamedSharding
+    # leaves are pinned through in AND out, scalar/uncommitted leaves stay
+    # unconstrained. Bind with the state you will train with — a later
+    # state with different placements belongs to a new step.
+    return _LoraTrainStep(step, base_shardings, x_sharding, mesh, rules)
 
-    def run(base: Any, ls: LoraState, batch: Any):
-        with activate(mesh, rules):
+
+class _LoraTrainStep:
+    """Callable LoRA train step; see :func:`make_lora_train_step`.
+
+    ``.jitted`` (the lowering/HLO-inspection surface every step builder
+    exposes) is available after :meth:`bind` or the first dispatch, and
+    raises a descriptive error before — NOT AttributeError, so generic
+    ``getattr(step, "jitted", step)`` consumers (e.g. the donation audit)
+    fail loudly instead of silently re-jitting the unbound wrapper
+    without donation."""
+
+    def __init__(self, step, base_shardings, x_sharding, mesh, rules):
+        self._step = step
+        self._base_shardings = base_shardings
+        self._x_sharding = x_sharding
+        self._mesh = mesh
+        self._rules = rules
+        self._jit = None
+
+    def bind(self, ls: LoraState):
+        """Build (once) the jit pinned to ``ls``'s placements; returns it."""
+        if self._jit is None:
+            ls_sh = jax.tree.map(
+                lambda x: x.sharding
+                if isinstance(getattr(x, "sharding", None), NamedSharding)
+                else None,
+                ls,
+            )
+            self._jit = jax.jit(
+                self._step,
+                in_shardings=(self._base_shardings, ls_sh, self._x_sharding),
+                out_shardings=(
+                    ls_sh, NamedSharding(self._mesh, PartitionSpec()),
+                ),
+                donate_argnums=(1,),
+            )
+        return self._jit
+
+    @property
+    def jitted(self):
+        if self._jit is None:
+            raise RuntimeError(
+                "LoRA train step is unbound: call step.bind(lora_state) "
+                "(or dispatch once) before lowering/HLO inspection — the "
+                "jit pins the LoraState's shardings, which only exist on "
+                "a concrete state"
+            )
+        return self._jit
+
+    def __call__(self, base: Any, ls: LoraState, batch: Any):
+        jitted = self.bind(ls)
+        with activate(self._mesh, self._rules):
             return jitted(base, ls, batch)
-
-    run.jitted = jitted
-    return run
 
 
 def lora_train_state(

@@ -14,7 +14,17 @@ Usage:
     python scripts/bench_compare.py [--threshold 0.10] [--repo DIR] [--json]
     python scripts/bench_compare.py old.json new.json [--threshold 0.10]
 
-Exit codes: 0 clean, 1 regression past threshold, 2 not enough rounds.
+The gate also cross-checks the NEW round's collective inventory (the
+bench JSON line's ``telemetry.headline_collectives``) against the golden
+SPMD contract ``analysis/golden/bench_headline.json`` (shardcheck's
+declarative layer): a bench round whose headline executable suddenly
+contains collectives the contract doesn't admit fails exactly like a
+metric regression — communication drift IS a perf regression, it just
+shows up in HLO before it shows up in tok/s. Rounds without a telemetry
+block (pre-PR-1 rounds) skip the check with a note.
+
+Exit codes: 0 clean, 1 regression past threshold or collective-inventory
+drift, 2 not enough rounds.
 
 Metrics that appear in only one round (benches come and go) are reported
 as added/removed, never failed — the gate compares what is comparable.
@@ -82,6 +92,50 @@ def extract_metrics(doc: dict) -> dict[str, tuple[float, bool]]:
     return out
 
 
+def extract_collective_inventory(doc: dict) -> dict[str, int] | None:
+    """The round's ``telemetry.headline_collectives`` per-op counts, from
+    the bench's JSON line (``parsed`` when the driver kept it whole, else
+    re-parsed out of the ``tail`` text). None when the round predates the
+    telemetry block."""
+    tel = (doc.get("parsed") or {}).get("telemetry")
+    if isinstance(tel, dict) and "headline_collectives" in tel:
+        return {k: int(v) for k, v in tel["headline_collectives"].items()}
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and '"telemetry"' in line):
+            continue
+        try:
+            tel = json.loads(line).get("telemetry") or {}
+        except json.JSONDecodeError:
+            continue
+        if "headline_collectives" in tel:
+            return {k: int(v) for k, v in tel["headline_collectives"].items()}
+    return None
+
+
+def check_collective_contract(
+    inventory: dict[str, int], golden_path: pathlib.Path
+) -> list[str]:
+    """Diff per-op collective counts against a golden contract file
+    (plain JSON read — the shardcheck golden's ``collectives`` section
+    keyed ``op@axis``, summed per op here because the bench inventory is
+    axis-blind). Returns human-readable drift lines; empty == clean."""
+    golden = json.loads(golden_path.read_text())
+    allowed: dict[str, int] = {}
+    for key, grp in (golden.get("collectives") or {}).items():
+        op = key.split("@", 1)[0]
+        allowed[op] = allowed.get(op, 0) + int(grp["count"])
+    drift = []
+    for op in sorted(set(inventory) | set(allowed)):
+        got, want = inventory.get(op, 0), allowed.get(op, 0)
+        if got != want:
+            drift.append(
+                f"collective inventory drift vs {golden_path.name}: "
+                f"{got} x {op} in the bench round, contract admits {want}"
+            )
+    return drift
+
+
 def compare(
     old: dict, new: dict, threshold: float
 ) -> tuple[list[dict], list[str], list[str]]:
@@ -115,6 +169,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--repo", default=".", help="directory holding BENCH_r*.json")
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="regression threshold as a fraction (default 0.10)")
+    ap.add_argument("--contracts", default=None,
+                    help="golden contract dir for the collective-inventory "
+                    "cross-check (default: the source checkout's "
+                    "learning_jax_sharding_tpu/analysis/golden, resolved "
+                    "from this script's location — NOT --repo, which may "
+                    "be a bare artifacts dir; pass '' to disable)")
+    ap.add_argument("--contract-name", default="bench_headline",
+                    help="golden contract the bench inventory is held to")
     ap.add_argument("--json", action="store_true", help="machine output")
     args = ap.parse_args(argv)
 
@@ -135,6 +197,30 @@ def main(argv: list[str] | None = None) -> int:
     docs = [json.loads(p.read_text()) for p in paths]
     rows, added, removed = compare(docs[0], docs[1], args.threshold)
     regressed = [r for r in rows if r["regressed"]]
+
+    drift: list[str] = []
+    contracts = args.contracts
+    if contracts is None:
+        # Anchored to the script's checkout, not --repo: CI points
+        # --repo at a bare BENCH-artifacts dir, and a default that
+        # resolved there would silently skip the gate every run.
+        contracts = str(
+            pathlib.Path(__file__).resolve().parents[1]
+            / "learning_jax_sharding_tpu" / "analysis" / "golden"
+        )
+    if contracts:
+        golden = pathlib.Path(contracts) / f"{args.contract_name}.json"
+        inventory = extract_collective_inventory(docs[1])
+        if inventory is None:
+            print(f"bench_compare: {paths[1].name} carries no collective "
+                  "inventory (pre-telemetry round) — contract check skipped",
+                  file=sys.stderr)
+        elif not golden.exists():
+            print(f"bench_compare: no golden contract at {golden} — "
+                  "contract check skipped", file=sys.stderr)
+        else:
+            drift = check_collective_contract(inventory, golden)
+
     if args.json:
         print(json.dumps(
             {
@@ -142,6 +228,7 @@ def main(argv: list[str] | None = None) -> int:
                 "threshold": args.threshold, "metrics": rows,
                 "added": added, "removed": removed,
                 "regressions": [r["metric"] for r in regressed],
+                "collective_drift": drift,
             },
             indent=2,
         ))
@@ -158,9 +245,12 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  + {k} (new)")
         for k in removed:
             print(f"  - {k} (gone)")
+        for d in drift:
+            print(f"  ! {d}")
         n = len(regressed)
-        print(f"bench_compare: {len(rows)} compared, {n} regression(s)")
-    return 1 if regressed else 0
+        print(f"bench_compare: {len(rows)} compared, {n} regression(s), "
+              f"{len(drift)} collective drift(s)")
+    return 1 if (regressed or drift) else 0
 
 
 if __name__ == "__main__":

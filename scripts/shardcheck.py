@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""shardcheck: the repo's static sharding-analysis gate (CI-runnable).
+
+Three passes over the codebase, all pre-run (nothing executes a train or
+serve step; the contract/jaxpr passes COMPILE entry points on an 8-device
+emulated mesh, the AST pass only reads source):
+
+* ``contracts`` — compile every registered jitted entry point
+  (``analysis/entrypoints.py``: train step, ZeRO-1 update, serving
+  prefill/decode, MoE dispatch, ring/Ulysses attention) and diff its
+  collective inventory against the golden contracts in
+  ``analysis/golden/*.json``. Catches: a new/missing collective per
+  (op, mesh-axis) group, oversized wire buffers, collectives inside
+  while bodies, oversized replicated constants.
+* ``jaxpr``     — jaxpr + donation lint over the train-shaped entry
+  points: silent f32 promotions in bf16 graphs, dead equations, and
+  donations requested-but-dropped / eligible-but-never-requested
+  (annotated with ``utils.memory.memory_plan`` bytes at stake).
+* ``ast``       — repo-wide source lint (jit-in-loop, non-hashable
+  static args, closure-captured device arrays, raw unsynced clocks)
+  under the ``analysis/baseline.json`` suppression budget.
+
+Regenerating goldens after an INTENDED sharding change::
+
+    python scripts/shardcheck.py --update-golden          # all entry points
+    python scripts/shardcheck.py --update-golden --only train_step
+
+then review the JSON diff like any other code change — the diff IS the
+communication-pattern review.
+
+Exit codes: 0 clean, 1 findings, 2 infrastructure error. Findings also
+land in the process flight recorder / a fresh registry and are written
+as ``shardcheck.json`` under ``$LJST_ARTIFACT_DIR`` (when set), so the
+static verdicts ride the same diagnosis surfaces as PR-2's runtime
+layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+PASSES = ("contracts", "jaxpr", "ast")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--pass", dest="passes", action="append", choices=PASSES,
+        help="run only this pass (repeatable; default: all three)",
+    )
+    ap.add_argument(
+        "--update-golden", action="store_true",
+        help="(re)write analysis/golden/*.json from the current "
+        "compilations instead of checking — review the diff",
+    )
+    ap.add_argument("--only", action="append", metavar="ENTRY",
+                    help="restrict contract/jaxpr passes to this entry "
+                    "point (repeatable)")
+    ap.add_argument("--golden-dir", default=None,
+                    help="golden contract directory "
+                    "(default: analysis/golden)")
+    ap.add_argument("--baseline", default=None,
+                    help="AST suppression file "
+                    "(default: analysis/baseline.json)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="emulated device count for the compile passes")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    passes = tuple(dict.fromkeys(args.passes)) if args.passes else PASSES
+    needs_mesh = args.update_golden or {"contracts", "jaxpr"} & set(passes)
+    if needs_mesh:
+        try:
+            force_emulated_devices(args.devices)
+        except RuntimeError as e:  # backend already initialized differently
+            print(f"shardcheck: {e}", file=sys.stderr)
+            return 2
+
+    from learning_jax_sharding_tpu.analysis import (
+        BASELINE_PATH,
+        GOLDEN_DIR,
+        report_findings,
+        run_ast_pass,
+        run_contract_pass,
+        run_jaxpr_pass,
+    )
+    from learning_jax_sharding_tpu.telemetry import MetricsRegistry
+    from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+        artifact_dir,
+        default_flight_recorder,
+    )
+
+    golden_dir = pathlib.Path(args.golden_dir or GOLDEN_DIR)
+    baseline = pathlib.Path(args.baseline or BASELINE_PATH)
+
+    if args.update_golden:
+        from learning_jax_sharding_tpu.analysis.entrypoints import (
+            build_entry_programs,
+        )
+
+        t0 = time.perf_counter()
+        run_contract_pass(golden_dir, names=args.only, update=True)
+        # Only the REGENERATED goldens — the operator is about to review
+        # the JSON diff, and listing untouched contracts as written would
+        # misstate what changed. (Program construction is lazy: building
+        # the name list compiles nothing.)
+        wrote = sorted(
+            f"{p.name}.json" for p in build_entry_programs(args.only)
+        )
+        print(f"shardcheck: wrote goldens to {golden_dir} "
+              f"({time.perf_counter() - t0:.1f}s): {wrote}")
+        return 0
+
+    # One entry-program list shared by the compile passes: their
+    # per-program caches hold each built state/step and its single AOT
+    # compile, so contracts + jaxpr don't pay the compiles twice.
+    programs = None
+    if {"contracts", "jaxpr"} & set(passes):
+        from learning_jax_sharding_tpu.analysis.entrypoints import (
+            build_entry_programs,
+        )
+
+        programs = build_entry_programs(args.only)
+
+    t0 = time.perf_counter()
+    findings = []
+    timings: dict[str, float] = {}
+    for name in passes:
+        tp = time.perf_counter()
+        if name == "contracts":
+            findings += run_contract_pass(
+                golden_dir, names=args.only, programs=programs
+            )
+        elif name == "jaxpr":
+            findings += run_jaxpr_pass(
+                names=args.only, baseline=baseline, programs=programs
+            )
+        else:
+            findings += run_ast_pass(_REPO, baseline=baseline)
+        timings[name] = time.perf_counter() - tp
+    wall = time.perf_counter() - t0
+
+    registry = MetricsRegistry()
+    report_findings(
+        findings, recorder=default_flight_recorder(), registry=registry
+    )
+    doc = {
+        "passes": list(passes),
+        "wall_seconds": round(wall, 2),
+        "pass_seconds": {k: round(v, 2) for k, v in timings.items()},
+        "findings": [f.to_dict() for f in findings],
+    }
+    import os
+
+    if os.environ.get("LJST_ARTIFACT_DIR"):
+        out = artifact_dir("shardcheck") / "shardcheck.json"
+        out.write_text(json.dumps(doc, indent=2))
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        print(f"shardcheck: {len(findings)} finding(s) across "
+              f"{'+'.join(passes)} in {wall:.1f}s "
+              f"({', '.join(f'{k} {v:.1f}s' for k, v in timings.items())})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,3 +141,68 @@ class TestMain:
         doc = json.loads(capsys.readouterr().out)
         assert doc["regressions"] == []
         assert doc["metrics"]
+
+
+def _doc_with_inventory(collectives):
+    d = _doc(["[bench] 125M decode, bf16 (b=8): 10,000 tok/s"])
+    d["tail"] += "\n" + json.dumps({
+        "metric": "case6_attention_tflops_per_chip", "value": 100.0,
+        "telemetry": {"headline_collectives": collectives},
+    })
+    return d
+
+
+class TestCollectiveContractGate:
+    """Round-8 satellite: the bench trajectory gate also holds the bench
+    JSON's collective inventory to the golden shardcheck contract — comm
+    drift fails like a metric regression."""
+
+    GOLDEN = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "learning_jax_sharding_tpu" / "analysis" / "golden"
+    )
+
+    def test_inventory_extraction_from_tail(self):
+        inv = bench_compare.extract_collective_inventory(
+            _doc_with_inventory({"all-reduce": 0, "all-gather": 2})
+        )
+        assert inv == {"all-reduce": 0, "all-gather": 2}
+        assert bench_compare.extract_collective_inventory(OLD) is None
+
+    def test_clean_inventory_passes(self, tmp_path):
+        zeros = {op: 0 for op in (
+            "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+            "collective-permute",
+        )}
+        drift = bench_compare.check_collective_contract(
+            zeros, self.GOLDEN / "bench_headline.json"
+        )
+        assert drift == []
+
+    def test_inventory_drift_fails_main(self, tmp_path, capsys):
+        w = TestMain()._write
+        w(tmp_path, 1, OLD)
+        w(tmp_path, 2, _doc_with_inventory({"all-gather": 3}))
+        rc = bench_compare.main([
+            "--repo", str(tmp_path), "--contracts", str(self.GOLDEN),
+        ])
+        assert rc == 1
+        assert "collective inventory drift" in capsys.readouterr().out
+
+    def test_missing_inventory_skips_with_note(self, tmp_path, capsys):
+        w = TestMain()._write
+        w(tmp_path, 1, OLD)
+        w(tmp_path, 2, OLD)
+        rc = bench_compare.main([
+            "--repo", str(tmp_path), "--contracts", str(self.GOLDEN),
+        ])
+        assert rc == 0
+        assert "contract check skipped" in capsys.readouterr().err
+
+    def test_disable_with_empty_contracts(self, tmp_path):
+        w = TestMain()._write
+        w(tmp_path, 1, OLD)
+        w(tmp_path, 2, _doc_with_inventory({"all-gather": 3}))
+        assert bench_compare.main(
+            ["--repo", str(tmp_path), "--contracts", ""]
+        ) == 0

@@ -12,6 +12,10 @@ import pytest
 from learning_jax_sharding_tpu.parallel.hlo import (
     COLLECTIVE_OPS,
     collective_counts,
+    collective_instructions,
+    constant_instructions,
+    hlo_computations,
+    while_scoped_computations,
 )
 
 
@@ -85,6 +89,16 @@ ENTRY %all-reduce_main {
         assert counts["collective-permute"] == 1
         assert counts["all-to-all"] == 1
 
+    def test_headerless_snippets_still_scan(self):
+        # Instruction-only snippets (no computation headers) must keep
+        # working: computation=None, never in_while.
+        hlo = """
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %x), replica_groups={{0,1}}, to_apply=%add
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["computation"] is None
+        assert ins["in_while"] is False
+
     def test_compiled_function_counts_match_text_counts(self, mesh24, rng):
         """The regex against REAL compiler output: a psum matmul's
         optimized HLO must contain exactly the all-reduce the explicit
@@ -106,3 +120,138 @@ ENTRY %all-reduce_main {
         dones = text.count("all-reduce-done(")
         starts = text.count("all-reduce-start(")
         assert counts["all-reduce"] >= dones == starts
+
+
+#: A realistic two-computation module: one collective in the ENTRY body,
+#: one inside the while's body computation (whose params are tuple-typed —
+#: nested parens the header parser must survive).
+_WHILE_HLO = """
+HloModule jit_f, is_scheduled=true, entry_computation_layout={(f32[4,4]{1,0})->f32[4,4]{1,0}}
+
+%region_body (param: (s32[], f32[4,4])) -> (s32[], f32[4,4]) {
+  %param = (s32[], f32[4,4]{1,0}) parameter(0)
+  %gte = f32[4,4]{1,0} get-tuple-element((s32[], f32[4,4]{1,0}) %param), index=1
+  %ar.body = f32[4,4]{1,0} all-reduce(f32[4,4]{1,0} %gte), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add
+}
+
+%region_cond (param.1: (s32[], f32[4,4])) -> pred[] {
+  %param.1 = (s32[], f32[4,4]{1,0}) parameter(0)
+  ROOT %lt = pred[] compare(s32[] %c, s32[] %n), direction=LT
+}
+
+ENTRY %main_spmd (p0: f32[4,4]) -> f32[4,4] {
+  %p0 = f32[4,4]{1,0} parameter(0)
+  %ag.entry = f32[8,4]{1,0} all-gather(f32[4,4]{1,0} %p0), replica_groups={{0,4},{1,5},{2,6},{3,7}}, dimensions={0}
+  %while.1 = (s32[], f32[4,4]{1,0}) while((s32[], f32[4,4]{1,0}) %tuple.0), condition=%region_cond, body=%region_body
+}
+"""
+
+
+class TestWhileBodyScoping:
+    """`_INSTR_RE` matches scoped to their computation: collectives inside
+    while bodies are per-iteration cost and must be distinguishable from
+    entry-body ones (the contract pass's ``while-loop-collective`` rule
+    stands on this)."""
+
+    def test_computations_split_with_tuple_typed_params(self):
+        comps = hlo_computations(_WHILE_HLO)
+        assert set(comps) == {"region_body", "region_cond", "main_spmd"}
+        assert "all-reduce(" in comps["region_body"]
+        assert "while(" in comps["main_spmd"]
+
+    def test_while_scope_closure(self):
+        assert while_scoped_computations(_WHILE_HLO) == {
+            "region_body", "region_cond",
+        }
+
+    def test_instructions_carry_scope(self):
+        by_op = {
+            i["op"]: i for i in collective_instructions(_WHILE_HLO)
+        }
+        assert by_op["all-reduce"]["in_while"] is True
+        assert by_op["all-reduce"]["computation"] == "region_body"
+        assert by_op["all-gather"]["in_while"] is False
+        assert by_op["all-gather"]["computation"] == "main_spmd"
+
+    def test_counts_unaffected_by_scoping(self):
+        counts = collective_counts(_WHILE_HLO)
+        assert counts["all-reduce"] == 1
+        assert counts["all-gather"] == 1
+
+    def test_nested_call_from_while_body_is_scoped(self):
+        hlo = """
+%inner (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+  %ar = f32[4]{0} all-reduce(f32[4]{0} %p), replica_groups={{0,1}}, to_apply=%add
+}
+
+%body (param: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %param = (s32[], f32[4]{0}) parameter(0)
+  %fus = f32[4]{0} fusion(f32[4]{0} %x), kind=kLoop, calls=%inner
+}
+
+ENTRY %main (p0: f32[4]) -> f32[4] {
+  %w = (s32[], f32[4]{0}) while((s32[], f32[4]{0}) %t), condition=%cond, body=%body
+}
+"""
+        assert "inner" in while_scoped_computations(hlo)
+        [ins] = collective_instructions(hlo)
+        assert ins["in_while"] is True
+
+    def test_pred_conditional_branch_in_while_body_is_scoped(self):
+        # XLA prints two-branch conditionals as true_computation=/
+        # false_computation= (not branch_computations) — a collective
+        # hiding in such a branch inside a while body is per-iteration
+        # cost and must be scoped.
+        hlo = """
+%branch_t (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+  %ag = f32[8]{0} all-gather(f32[4]{0} %p), replica_groups={{0,1}}, dimensions={0}
+}
+
+%branch_f (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+}
+
+%body (param: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %param = (s32[], f32[4]{0}) parameter(0)
+  %c = f32[4]{0} conditional(pred[] %pr, f32[4]{0} %x, f32[4]{0} %x), true_computation=%branch_t, false_computation=%branch_f
+}
+
+ENTRY %main (p0: f32[4]) -> f32[4] {
+  %w = (s32[], f32[4]{0}) while((s32[], f32[4]{0}) %t), condition=%cond, body=%body
+}
+"""
+        assert {"branch_t", "branch_f"} <= while_scoped_computations(hlo)
+        [ins] = collective_instructions(hlo)
+        assert ins["in_while"] is True
+
+    def test_reduction_to_apply_is_not_an_edge(self):
+        # `to_apply=%add` names a scalar reducer, not executed-inside-loop
+        # user code; following it would misfile computations named there.
+        hlo = """
+%body (param: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %param = (s32[], f32[4]{0}) parameter(0)
+}
+
+ENTRY %main (p0: f32[4]) -> f32[4] {
+  %r = f32[] reduce(f32[4]{0} %p0, f32[] %z), dimensions={0}, to_apply=%add
+  %w = (s32[], f32[4]{0}) while((s32[], f32[4]{0}) %t), condition=%cond, body=%body
+}
+"""
+        assert "add" not in while_scoped_computations(hlo)
+
+
+class TestConstantInstructions:
+    def test_sizes_and_threshold(self):
+        hlo = """
+ENTRY %main (p0: f32[4]) -> f32[4] {
+  %small = s32[] constant(3)
+  %big = f32[512,512]{1,0} constant({...})
+}
+"""
+        all_ = constant_instructions(hlo)
+        assert {c["bytes"] for c in all_} == {4, 512 * 512 * 4}
+        only_big = constant_instructions(hlo, min_bytes=1024)
+        assert [c["bytes"] for c in only_big] == [512 * 512 * 4]
+        assert only_big[0]["computation"] == "main"

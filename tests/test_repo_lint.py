@@ -1,0 +1,61 @@
+"""The repo-wide AST lint as a tier-1 gate (shardcheck level 3 in CI).
+
+``analysis.source_lint`` over the source surfaces under the checked-in
+``analysis/baseline.json`` budget: NEW findings fail here, pre-existing
+ones ride their reasoned suppressions. This is the generalization of
+``test_timing_audit``'s cases/-only tripwire to the whole repo — that
+test stays as the stricter cases/ pin (no baseline there), this one
+keeps the framework/scripts surfaces from growing new footguns.
+
+Pure source analysis: no devices, no compiles — milliseconds, so it can
+sit in tier-1 unconditionally.
+"""
+
+import pathlib
+
+from learning_jax_sharding_tpu.analysis import (
+    BASELINE_PATH,
+    load_baseline,
+    run_ast_pass,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def test_repo_source_lint_clean_under_baseline():
+    findings = run_ast_pass(REPO)
+    assert not findings, (
+        "new static-lint findings (fix them, or — for a reviewed false "
+        "positive — add a reasoned entry to analysis/baseline.json):\n"
+        + "\n".join(str(f) for f in findings)
+    )
+
+
+def test_baseline_entries_carry_reasons():
+    import json
+
+    doc = json.loads(BASELINE_PATH.read_text())
+    for s in doc["suppressions"]:
+        assert s.get("reason"), f"baseline entry without a reason: {s}"
+
+
+def test_baseline_has_no_dead_budget():
+    """Every suppression must still match at least one finding — a stale
+    entry means the debt was paid and the budget should be deleted (or
+    tightened), not silently carried."""
+    from collections import Counter
+
+    from learning_jax_sharding_tpu.analysis import lint_tree
+
+    live = Counter(
+        (f.where.rsplit(":", 1)[0], f.rule) for f in lint_tree(REPO)
+    )
+    budget = load_baseline(BASELINE_PATH)
+    stale = {k: v for k, v in budget.items() if live.get(k, 0) == 0}
+    assert not stale, f"baseline entries with no remaining findings: {stale}"
+    loose = {
+        k: (live[k], v) for k, v in budget.items() if 0 < live[k] < v
+    }
+    assert not loose, (
+        f"baseline budgets looser than reality (tighten counts): {loose}"
+    )
