@@ -508,6 +508,13 @@ def bench_serving_125m():
     )
     PAGES = 8 * 10 + 1 + 12   # 8 slots x ceil(608/64) + scratch + slack
     plain = make_continuous_engine(cfg, mesh, RULES_DP_TP, **common)
+    # The FUSED scheduler (round 9): every dispatch advances decode AND
+    # pushes budgeted refill — the ITL/queue-wait engine. Budget 128 (two
+    # chunks + the decode wave) from the perf_mixed.py ladder.
+    mixed = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, **common, mixed=True,
+        token_budget=128 + 8,
+    )
     paged4 = make_continuous_engine(
         cfg, mesh, RULES_DP_TP, **common, dequantize="fused",
         paged_pages=PAGES, page_size=64,
@@ -525,12 +532,13 @@ def bench_serving_125m():
 
     variants = [
         ("bf16 engine", plain, params, None),
+        ("bf16 mixed engine", mixed, params, None),
         ("int4-fused + paged", paged4, q4, None),
         ("int4 + paged + prefix (cold)", pfx4, q4, "cold"),
         ("int4 + paged + prefix (warm)", pfx4, q4, "warm"),
     ]
     # Warm every executable once (compiles excluded from the ladder).
-    for _, serve, tree, mode in variants[:3]:
+    for _, serve, tree, mode in variants[:4]:
         serve(tree, prompts[:8])
     times = {name: [] for name, *_ in variants}
     toks = {}
@@ -594,31 +602,62 @@ def bench_serving_125m():
     # Staggered-arrival latency (VERDICT r4 item 1): requests arrive over
     # time through the persistent engine's streaming API; TTFT and
     # per-token latency percentiles come from the engine's own telemetry.
-    eng = plain.engine
-    eng.decode_chain = 1        # latency-sensitive: no chain coarsening
-    eng.reset_stats()
-    arrivals = list(prompts[:16])
-    gap = 0.05                       # 20 req/s offered load
-    t0 = _time.perf_counter()
-    nxt = 0
-    while eng.has_work() or nxt < len(arrivals):
-        while (
-            nxt < len(arrivals)
-            and _time.perf_counter() - t0 >= nxt * gap
-        ):
-            eng.add_request(arrivals[nxt])
-            nxt += 1
-        eng.step(params)
-    eng.pop_finished()
-    lat = eng.latency_stats()
-    _log(
-        f"[bench] 125M serving latency (16 staggered arrivals, "
-        f"{1 / gap:.0f} req/s): TTFT p50 {lat['ttft_p50'] * 1e3:.0f} ms / "
-        f"p99 {lat['ttft_p99'] * 1e3:.0f} ms, TPOT p50 "
-        f"{lat['tpot_p50'] * 1e3:.1f} ms, ITL p99 "
-        f"{lat['itl_p99'] * 1e3:.0f} ms, queue wait p50 "
-        f"{lat['queue_wait_p50'] * 1e3:.0f} ms"
+    # Round 9: the TRACKED line runs the MIXED engine (decode advances in
+    # every dispatch, refill rides the token budget, admission at chunk
+    # granularity); the split engine's numbers stay as the stall
+    # baseline so bench_compare sees both trajectories.
+    def staggered(eng, label):
+        eng.decode_chain = 1    # latency-sensitive: no chain coarsening
+        eng.reset_stats()
+        arrivals = list(prompts[:16])
+        gap = 0.05                   # 20 req/s offered load
+        t0 = _time.perf_counter()
+        nxt = 0
+        while eng.has_work() or nxt < len(arrivals):
+            while (
+                nxt < len(arrivals)
+                and _time.perf_counter() - t0 >= nxt * gap
+            ):
+                eng.add_request(arrivals[nxt])
+                nxt += 1
+            eng.step(params)
+        dt = _time.perf_counter() - t0
+        outs = eng.pop_finished()
+        toks = sum(len(o) - 544 for o in outs.values())
+        lat = eng.latency_stats()
+        extras = f", {toks / dt:,.0f} tok/s"
+        if lat.get("refill_frac") is not None:
+            extras += f", refill {lat['refill_frac']:.0%} of engine time"
+        if lat.get("decode_stall_share") is not None:
+            extras += f", decode stalled {lat['decode_stall_share']:.0%}"
+        _log(
+            f"[bench] 125M serving latency{label} (16 staggered arrivals, "
+            f"{1 / gap:.0f} req/s): TTFT p50 {lat['ttft_p50'] * 1e3:.0f} ms"
+            f" / p99 {lat['ttft_p99'] * 1e3:.0f} ms, TPOT p50 "
+            f"{lat['tpot_p50'] * 1e3:.1f} ms, ITL p99 "
+            f"{lat['itl_p99'] * 1e3:.0f} ms, queue wait p50 "
+            f"{lat['queue_wait_p50'] * 1e3:.0f} ms{extras}"
+        )
+
+    # The latency engine re-tunes the two mixed knobs (perf_mixed.py
+    # ladder): budget 128+B bounds each fused dispatch (the ITL gap a
+    # decoding row sees while prompts stream), and decode_block_steps=8
+    # bounds the PURE-DECODE fallback's token-visibility gap (in mixed
+    # mode the block program only runs when there is no refill to fuse,
+    # so a small K costs a few extra tail dispatches, not refill
+    # overlap).
+    mixed_lat = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP,
+        **{**common, "decode_block_steps": 8},
+        mixed=True, token_budget=128 + 8,
     )
+    # Warm before the tracked run: this engine's executables (its
+    # decode_block_steps differs from the ladder's warmed engines) must
+    # compile outside the measured window — staggered() resets stats, so
+    # the warm pass leaves no trace in the gated percentiles.
+    mixed_lat(params, prompts[:8])
+    staggered(mixed_lat.engine, "")
+    staggered(plain.engine, " split-engine baseline")
 
 
 def _device_ready(timeout_s: float = 600.0) -> bool:

@@ -184,14 +184,19 @@ def _sharded_serving_params(model, mesh, rules):
         )(jax.random.key(0), probe)["params"]
 
 
-def _engine_programs(*, speculative: bool) -> list[EntryProgram]:
+def _engine_programs(
+    *, speculative: bool, mixed: bool = False
+) -> list[EntryProgram]:
     """Prefill + decode via a real (tiny) ContinuousEngine: one short
     serve populates the dispatch-arg caches, then each program relowers
     AOT (``ContinuousEngine.program_hlo``) under the engine's own golden
     names (``contract_name`` — ``spec_``-prefixed for the speculative
     family, whose refill also prefills the draft cache). first_refill is
     covered too — single-chunk prefills must not be silently
-    contract-free."""
+    contract-free. With ``mixed`` the engine runs the FUSED
+    refill+decode scheduler and contributes only its ``mixed_step`` /
+    ``spec_mixed_step`` golden (the refill/decode family is already
+    pinned by the split engines)."""
     import dataclasses as dc
 
     from learning_jax_sharding_tpu.models.serving import ContinuousEngine
@@ -208,14 +213,14 @@ def _engine_programs(*, speculative: bool) -> list[EntryProgram]:
         params = _sharded_serving_params(
             Transformer(cfg), mesh, RULES_TP_SERVING
         )
-        kwargs: dict = {}
+        kwargs: dict = dict(mixed=mixed) if mixed else {}
         d_params = None
         if speculative:
             d_cfg = dc.replace(cfg, num_layers=1)
             d_params = _sharded_serving_params(
                 Transformer(d_cfg), mesh, RULES_TP_SERVING
             )
-            kwargs = dict(draft_config=d_cfg, num_draft=2)
+            kwargs.update(draft_config=d_cfg, num_draft=2)
         eng = ContinuousEngine(
             cfg, mesh, RULES_TP_SERVING,
             batch_size=2, max_new_tokens=8, refill_chunk=16,
@@ -232,10 +237,13 @@ def _engine_programs(*, speculative: bool) -> list[EntryProgram]:
         }
         return built["hlo"]
 
-    names = (
-        ("spec_first_prefill", "spec_prefill", "spec_decode_step")
-        if speculative else ("first_prefill", "prefill", "decode_step")
-    )
+    if mixed:
+        names = ("spec_mixed_step",) if speculative else ("mixed_step",)
+    else:
+        names = (
+            ("spec_first_prefill", "spec_prefill", "spec_decode_step")
+            if speculative else ("first_prefill", "prefill", "decode_step")
+        )
     return [
         EntryProgram(name, mesh, lambda name=name: ensure()[name])
         for name in names
@@ -246,7 +254,43 @@ def _serving_programs() -> list[EntryProgram]:
     return [
         *_engine_programs(speculative=False),
         *_engine_programs(speculative=True),
+        *_engine_programs(speculative=False, mixed=True),
+        *_engine_programs(speculative=True, mixed=True),
     ]
+
+
+def _zero1_q8() -> EntryProgram:
+    """The quantized-comm ZeRO-1 update (``training.zero.
+    make_zero1_update(quantized_comm=True)``): its golden pins the int8
+    ring sync — collective-permutes on the data axis inside the
+    reduce-scatter/all-gather loops — next to the model-axis collectives
+    the plain ``zero1_update`` already records."""
+    import jax
+
+    from learning_jax_sharding_tpu.parallel.logical import activate
+
+    mesh = _mesh24()
+
+    def hlo():
+        from learning_jax_sharding_tpu.models.transformer import (
+            next_token_loss,
+        )
+        from learning_jax_sharding_tpu.training.zero import (
+            make_zero1_update,
+        )
+
+        cfg, state, batch, _, rules = _train_state_and_step(
+            mesh, zero1_axis="data"
+        )
+        step = make_zero1_update(
+            jax.tree.map(lambda x: x.sharding, state),
+            {k: v.sharding for k, v in batch.items()}, mesh, rules,
+            loss_fn=next_token_loss, quantized_comm=True,
+        )
+        with activate(mesh, rules):
+            return step.jitted.lower(state, batch).compile().as_text()
+
+    return EntryProgram("zero1_update_q8", mesh, hlo)
 
 
 def _moe_dispatch() -> EntryProgram:
@@ -334,6 +378,7 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         # or fit(contract=..., watchdog=...) could never launch.
         _train_like("train_step_gn", with_grad_norm=True, audit=False),
         _train_like("zero1_update", zero1_axis="data"),
+        _zero1_q8(),
         *_serving_programs(),
         _moe_dispatch(),
         _seq_attention("ring_attention"),

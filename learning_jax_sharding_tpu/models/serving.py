@@ -53,6 +53,14 @@ TPU-shaped design — the host drives, the device stays static:
   sampled speculative outputs are schedule-independent like every other
   engine mode.
 
+* MIXED scheduling (``mixed=True``, round 9): one FUSED program per
+  iteration advances all decoding rows by one token AND pushes a
+  token-budgeted refill chunk for admitting/streaming rows (refill rows
+  ride their ragged ``chunk_lengths``, decode rows ride with length 1) —
+  decode never stalls behind another slot's prefill, and admission lands
+  at chunk granularity on every dispatch instead of at decode-block
+  boundaries.
+
 Oracles (test-pinned): under GREEDY decoding every request's output is
 bit-identical to a rectangular single-prompt ``make_generate_fn`` run —
 slot reuse, chunk scheduling, speculation, and engine persistence change
@@ -224,6 +232,35 @@ class ContinuousEngine:
     latency-sensitive arrivals low; ``decode_chain`` is a public
     attribute, tunable per phase at runtime).
 
+    ``mixed=True``: the FUSED refill+decode scheduler (round 9). The
+    split engine dispatches refill OR decode per iteration, so every
+    decoding row pauses while another slot's prompt streams through
+    refill chunks — measured at 86-87% of engine time on the 125M
+    serving bench, the direct cause of its ITL p99 and queue-wait tails.
+    The mixed engine runs ONE compiled program per iteration
+    (``mixed_step`` / ``spec_mixed_step``) in which every decoding row
+    advances one token (speculative: one draft-verify round with per-row
+    rollback) AND pending prompts push refill chunks under
+    ``token_budget`` — a per-dispatch token ceiling (decode rows funded
+    first; refill takes the remainder; uncapped when nothing is
+    decoding). Admission happens at EVERY dispatch, at chunk
+    granularity. The two-steady-state-programs invariant holds — fixed
+    ``(B, refill_chunk)`` shapes, no recompiles — and ``decode_chain``
+    still carries device-to-device (each link is one mixed step, so a
+    chain emits ``chain`` decode tokens per host sync). PURE-DECODE
+    phases (no pending prompt tokens anywhere) fall through to the
+    K-token ``decode_block`` — a fused link costs one dispatch per token
+    and exists to overlap refill; with nothing to overlap, the scanned
+    block's decode throughput wins and admission loses nothing (a queued
+    request only rides out a block when every slot is busy). Greedy outputs
+    stay bit-identical to the split engine (ragged rows are independent:
+    each row's computation is exactly what the split programs run for
+    it), and sampled streams are identical too (draws keyed by request
+    id and position, never by schedule) — test-pinned. ``token_budget``
+    is a public runtime-tunable attribute like ``decode_chain``: size it
+    to the per-dispatch latency you can afford between decode tokens
+    (see PERF.md round 9 for the measured ladder).
+
     ``dequantize``: serve QUANTIZED target weights, exactly as
     ``make_generate_fn`` does — ``True`` for an int8/int4 tree from
     ``quantize_tree`` dequantized inside the jitted steps, ``"fused"`` /
@@ -317,6 +354,8 @@ class ContinuousEngine:
         refill_chunk: int = 64,
         decode_block_steps: int = 16,
         decode_chain: int = 1,
+        mixed: bool = False,
+        token_budget: int | None = None,
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
@@ -341,6 +380,12 @@ class ContinuousEngine:
             )
         if decode_chain < 1:
             raise ValueError(f"decode_chain must be >= 1, got {decode_chain}")
+        if token_budget is not None and not mixed:
+            raise ValueError("token_budget requires mixed=True")
+        if token_budget is not None and token_budget < 1:
+            raise ValueError(
+                f"token_budget must be >= 1, got {token_budget}"
+            )
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
@@ -552,14 +597,172 @@ class ContinuousEngine:
             )
             return toks.T, active, remaining, cache   # (B, K) tokens
 
+        def spec_round(carry, params, d_params, rid, rng):
+            """ONE draft-verify ROUND with PER-ROW acceptance and rollback —
+            THE shared speculative core of the engine: ``decode_block_spec``
+            scans it ``decode_block_steps`` times, ``spec_mixed_step`` runs
+            it once after its fused refill sub-step, so the acceptance /
+            emission / rollback rules cannot drift between the two program
+            families. Frozen rows (``active == 0`` — idle, refilling, or
+            retired) ride every sub-call with length 0 and ``n_emit`` 0, so
+            the round's rollback broadcast re-asserts their current ``pos``
+            without moving it."""
+            idx = jnp.arange(num_draft + 1)
+            (tok, active, pos, remaining, count, buffer, acc, prop,
+             t_cache, d_cache) = carry
+            # Each row's next GENERATED position (the refill's pick was
+            # position 0 of its stream).
+            gen = max_new_tokens - remaining
+
+            # 1. Draft proposes per row (frozen rows ride with length 0).
+            if temperature == 0.0:
+
+                def draft_step(c, j):
+                    prev, dc = c
+                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                    nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
+                    return (nxt, dc), nxt
+
+                (last_d, d_cache), drafts = jax.lax.scan(
+                    draft_step, (tok, d_cache), jnp.arange(num_draft)
+                )
+                q_all = None
+            else:
+
+                def draft_step(c, j):
+                    prev, dc = c
+                    lg, dc = d_apply(d_params, dc, prev[:, None], active)
+                    fl = to_flogits(lg[:, -1])
+                    nxt = jax.vmap(jax.random.categorical)(
+                        spec_keys(rng, rid, gen + j, 0), fl
+                    ).astype(jnp.int32)
+                    nxt = jnp.where(active == 1, nxt, prev)
+                    return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
+
+                (last_d, d_cache), (drafts, q_all) = jax.lax.scan(
+                    draft_step, (tok, d_cache), jnp.arange(num_draft)
+                )
+            drafts = drafts.T
+            _, d_cache = d_apply(
+                d_params, d_cache, last_d[:, None], active
+            )
+
+            # 2. One chunked target verify.
+            chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
+            t_logits, t_cache = apply(
+                params, t_cache, chunk, active * (num_draft + 1)
+            )
+
+            # 3. Per-row acceptance; emitted = accepted drafts + the
+            #    bonus/correction (greedy) or residual sample (sampling) —
+            #    the shared cores, models/speculative.py.
+            if temperature == 0.0:
+                m, emitted, _ = greedy_accept_emit(
+                    drafts, _greedy(t_logits)
+                )
+            else:
+                q_all = jnp.moveaxis(q_all, 0, 1)    # (B, num_draft, V)
+                p_all = jax.nn.softmax(to_flogits(t_logits), axis=-1)
+                p_at = jnp.take_along_axis(
+                    p_all[:, :num_draft], drafts[..., None], axis=-1
+                )[..., 0]
+                q_at = jnp.take_along_axis(
+                    q_all, drafts[..., None], axis=-1
+                )[..., 0]
+                u = jax.vmap(
+                    lambda j: jax.vmap(jax.random.uniform)(
+                        spec_keys(rng, rid, gen + j, 1)
+                    ),
+                    out_axes=1,
+                )(jnp.arange(num_draft))             # (B, num_draft)
+                accept = u * q_at < p_at
+                m = jnp.sum(
+                    jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+                )
+                q_pad = jnp.concatenate(
+                    [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
+                )
+
+                def take_m(x):
+                    return jnp.take_along_axis(
+                        x, m[:, None, None], axis=1
+                    )[:, 0]
+
+                p_m = take_m(p_all)
+                residual = jnp.maximum(p_m - take_m(q_pad), 0.0)
+                mass = jnp.sum(residual, axis=-1, keepdims=True)
+                residual = jnp.where(mass > 0, residual / mass, p_m)
+                token_m = jax.vmap(jax.random.categorical)(
+                    spec_keys(rng, rid, gen + m, 2), jnp.log(residual)
+                ).astype(jnp.int32)
+                emitted = emit_vector(drafts, m, token_m)
+
+            # 4. Truncate each row's emission at EOS and at its budget.
+            raw = 1 + m
+            if eos_id is not None:
+                hit = (emitted == eos_id) & (idx[None, :] < raw[:, None])
+                any_hit = jnp.any(hit, axis=1)
+                first = jnp.argmax(hit, axis=1)
+                n_stop = jnp.where(any_hit, first + 1, raw)
+            else:
+                any_hit = jnp.zeros_like(active, dtype=bool)
+                n_stop = raw
+            n_emit = jnp.minimum(n_stop, remaining) * active
+
+            # 5. Append at each row's own offset; advance the pending
+            #    token to the last emitted one.
+            buffer = row_update_masked(
+                buffer, emitted, count, n_emit, seq_dim=1
+            )
+            new_tok = jnp.take_along_axis(
+                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0]
+            tok = jnp.where(active == 1, new_tok, tok)
+
+            # 6. Per-row rollback: the row's new index is pos + n_emit
+            #    (frozen rows: +0, i.e. their current index — one
+            #    broadcast serves all rows).
+            pos = pos + n_emit
+            t_cache = _rollback(t_cache, pos)
+            d_cache = _rollback(d_cache, pos)
+
+            remaining = remaining - n_emit
+            count = count + n_emit
+            # Acceptance telemetry: verifier acceptance per live round
+            # (before EOS/budget truncation — the DRAFT's quality, which
+            # is what the operator tunes num_draft against).
+            acc = acc + m * active
+            prop = prop + active * num_draft
+            stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
+            active = (
+                active
+                * (remaining > 0).astype(jnp.int32)
+                * (1 - stopped_eos.astype(jnp.int32))
+            )
+            return (
+                tok, active, pos, remaining, count, buffer, acc, prop,
+                t_cache, d_cache
+            )
+
+        def _spec_carry_init(tok, active, pos, remaining, width):
+            b = tok.shape[0]
+            return (
+                tok, active, pos, remaining,
+                jnp.zeros((b,), jnp.int32),          # count
+                jnp.zeros((b, width), jnp.int32),    # buffer
+                jnp.zeros((b,), jnp.int32),          # acc
+                jnp.zeros((b,), jnp.int32),          # prop
+            )
+
         @jax.jit
         def decode_block_spec(
             params, d_params, t_cache, d_cache, tok, active, pos, remaining,
             rid, rng,
         ):
             """Speculative decode block: ``decode_block_steps`` draft-verify
-            ROUNDS, each emitting 1..num_draft+1 tokens per row with PER-ROW
-            acceptance and rollback (the ragged-cache machinery of
+            ROUNDS (``spec_round`` — the shared core), each emitting
+            1..num_draft+1 tokens per row with PER-ROW acceptance and
+            rollback (the ragged-cache machinery of
             ``models/speculative.py::generate_ragged``, driven inside the
             engine's scan). ``pos`` is each row's current cache index
             (prompt_len + emitted - 1); EOS and budget truncate a round's
@@ -574,156 +777,16 @@ class ContinuousEngine:
             sampled output is independent of batch composition, round
             boundaries, and block boundaries (rollback re-derives draws)."""
             width = decode_block_steps * (num_draft + 1)
-            idx = jnp.arange(num_draft + 1)
 
             def body(carry, _):
-                (tok, active, pos, remaining, count, buffer, acc, prop,
-                 t_cache, d_cache) = carry
-                # Each row's next GENERATED position (the refill's pick was
-                # position 0 of its stream).
-                gen = max_new_tokens - remaining
+                return spec_round(carry, params, d_params, rid, rng), None
 
-                # 1. Draft proposes per row (frozen rows ride with length 0).
-                if temperature == 0.0:
-
-                    def draft_step(c, j):
-                        prev, dc = c
-                        lg, dc = d_apply(d_params, dc, prev[:, None], active)
-                        nxt = jnp.where(active == 1, _greedy(lg[:, -1]), prev)
-                        return (nxt, dc), nxt
-
-                    (last_d, d_cache), drafts = jax.lax.scan(
-                        draft_step, (tok, d_cache), jnp.arange(num_draft)
-                    )
-                    q_all = None
-                else:
-
-                    def draft_step(c, j):
-                        prev, dc = c
-                        lg, dc = d_apply(d_params, dc, prev[:, None], active)
-                        fl = to_flogits(lg[:, -1])
-                        nxt = jax.vmap(jax.random.categorical)(
-                            spec_keys(rng, rid, gen + j, 0), fl
-                        ).astype(jnp.int32)
-                        nxt = jnp.where(active == 1, nxt, prev)
-                        return (nxt, dc), (nxt, jax.nn.softmax(fl, axis=-1))
-
-                    (last_d, d_cache), (drafts, q_all) = jax.lax.scan(
-                        draft_step, (tok, d_cache), jnp.arange(num_draft)
-                    )
-                drafts = drafts.T
-                _, d_cache = d_apply(
-                    d_params, d_cache, last_d[:, None], active
-                )
-
-                # 2. One chunked target verify.
-                chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
-                t_logits, t_cache = apply(
-                    params, t_cache, chunk, active * (num_draft + 1)
-                )
-
-                # 3. Per-row acceptance; emitted = accepted drafts + the
-                #    bonus/correction (greedy) or residual sample (sampling) —
-                #    the shared cores, models/speculative.py.
-                if temperature == 0.0:
-                    m, emitted, _ = greedy_accept_emit(
-                        drafts, _greedy(t_logits)
-                    )
-                else:
-                    q_all = jnp.moveaxis(q_all, 0, 1)    # (B, num_draft, V)
-                    p_all = jax.nn.softmax(to_flogits(t_logits), axis=-1)
-                    p_at = jnp.take_along_axis(
-                        p_all[:, :num_draft], drafts[..., None], axis=-1
-                    )[..., 0]
-                    q_at = jnp.take_along_axis(
-                        q_all, drafts[..., None], axis=-1
-                    )[..., 0]
-                    u = jax.vmap(
-                        lambda j: jax.vmap(jax.random.uniform)(
-                            spec_keys(rng, rid, gen + j, 1)
-                        ),
-                        out_axes=1,
-                    )(jnp.arange(num_draft))             # (B, num_draft)
-                    accept = u * q_at < p_at
-                    m = jnp.sum(
-                        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
-                    )
-                    q_pad = jnp.concatenate(
-                        [q_all, jnp.zeros_like(q_all[:, :1])], axis=1
-                    )
-
-                    def take_m(x):
-                        return jnp.take_along_axis(
-                            x, m[:, None, None], axis=1
-                        )[:, 0]
-
-                    p_m = take_m(p_all)
-                    residual = jnp.maximum(p_m - take_m(q_pad), 0.0)
-                    mass = jnp.sum(residual, axis=-1, keepdims=True)
-                    residual = jnp.where(mass > 0, residual / mass, p_m)
-                    token_m = jax.vmap(jax.random.categorical)(
-                        spec_keys(rng, rid, gen + m, 2), jnp.log(residual)
-                    ).astype(jnp.int32)
-                    emitted = emit_vector(drafts, m, token_m)
-
-                # 4. Truncate each row's emission at EOS and at its budget.
-                raw = 1 + m
-                if eos_id is not None:
-                    hit = (emitted == eos_id) & (idx[None, :] < raw[:, None])
-                    any_hit = jnp.any(hit, axis=1)
-                    first = jnp.argmax(hit, axis=1)
-                    n_stop = jnp.where(any_hit, first + 1, raw)
-                else:
-                    any_hit = jnp.zeros_like(active, dtype=bool)
-                    n_stop = raw
-                n_emit = jnp.minimum(n_stop, remaining) * active
-
-                # 5. Append at each row's own offset; advance the pending
-                #    token to the last emitted one.
-                buffer = row_update_masked(
-                    buffer, emitted, count, n_emit, seq_dim=1
-                )
-                new_tok = jnp.take_along_axis(
-                    emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
-                )[:, 0]
-                tok = jnp.where(active == 1, new_tok, tok)
-
-                # 6. Per-row rollback: the row's new index is pos + n_emit
-                #    (frozen rows: +0, i.e. their current index — one
-                #    broadcast serves all rows).
-                pos = pos + n_emit
-                t_cache = _rollback(t_cache, pos)
-                d_cache = _rollback(d_cache, pos)
-
-                remaining = remaining - n_emit
-                count = count + n_emit
-                # Acceptance telemetry: verifier acceptance per live round
-                # (before EOS/budget truncation — the DRAFT's quality, which
-                # is what the operator tunes num_draft against).
-                acc = acc + m * active
-                prop = prop + active * num_draft
-                stopped_eos = any_hit & (n_stop <= n_emit) & (active == 1)
-                active = (
-                    active
-                    * (remaining > 0).astype(jnp.int32)
-                    * (1 - stopped_eos.astype(jnp.int32))
-                )
-                return (
-                    tok, active, pos, remaining, count, buffer, acc, prop,
-                    t_cache, d_cache
-                ), None
-
-            b = tok.shape[0]
-            buffer = jnp.zeros((b, width), jnp.int32)
-            count = jnp.zeros((b,), jnp.int32)
-            acc = jnp.zeros((b,), jnp.int32)
-            prop = jnp.zeros((b,), jnp.int32)
             (tok, active, pos, remaining, count, buffer, acc, prop,
              t_cache, d_cache), _ = (
                 jax.lax.scan(
                     body,
-                    (tok, active, pos, remaining, count, buffer, acc, prop,
-                     t_cache, d_cache),
+                    _spec_carry_init(tok, active, pos, remaining, width)
+                    + (t_cache, d_cache),
                     None,
                     length=decode_block_steps,
                 )
@@ -734,6 +797,84 @@ class ContinuousEngine:
             return (
                 buffer, count, acc, prop, tok, pos, active, remaining,
                 t_cache, d_cache,
+            )
+
+        @jax.jit
+        def mixed_step(
+            params, cache, chunk, lengths, reset_mask, reset_to, tok,
+            active, remaining, rid, rng,
+        ):
+            """ONE FUSED engine iteration (``mixed=True``): every DECODING
+            row advances one token AND every scheduled REFILL row pushes its
+            budgeted prompt chunk, in a single compiled dispatch — decode
+            never waits for another slot's prefill to stream through.
+
+            Decode rows ride the ragged chunk with length 1 (their pending
+            token spliced into column 0); refill rows ride with their
+            host-scheduled ``chunk_lengths`` (admission resets applied
+            first, exactly as in ``refill_step``); idle rows ride with
+            length 0. The per-row computation is identical to what
+            ``refill_step`` / ``decode_block``'s scan body would have done
+            for that row — ragged rows are independent — so greedy token
+            streams stay bit-identical to the split-program engine
+            (test-pinned). Carries (tok/active/remaining) ride the return so
+            ``decode_chain`` links can flow device-to-device with one host
+            sync per chain."""
+            cache = _reset_rows(cache, reset_mask, reset_to)
+            dec = active == 1   # decoding rows never hold pending tokens
+            eff_len = jnp.where(dec, 1, lengths)
+            chunk = chunk.at[:, 0].set(jnp.where(dec, tok, chunk[:, 0]))
+            logits, cache = apply(params, cache, chunk, eff_len)
+            pick = jnp.take_along_axis(
+                logits, jnp.maximum(eff_len - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            # Refill rows sample their stream's position 0 (the refill
+            # pick); decode rows their current generated position — the
+            # same keys the split programs use.
+            pos = jnp.where(dec, max_new_tokens - remaining, 0)
+            nxt = sample_rows(pick, rng, rid, pos)
+            tok = jnp.where(dec, nxt, tok)
+            remaining = remaining - dec.astype(jnp.int32)
+            if eos_id is not None:
+                active = active * jnp.where(
+                    dec, (nxt != eos_id).astype(jnp.int32), 1
+                )
+            active = active * jnp.where(
+                dec, (remaining > 0).astype(jnp.int32), 1
+            )
+            return nxt, tok, active, remaining, cache
+
+        @jax.jit
+        def spec_mixed_step(
+            params, d_params, t_cache, d_cache, chunk, lengths, reset_mask,
+            reset_to, tok, active, pos, remaining, rid, rng,
+        ):
+            """The speculative fused iteration: the budgeted refill chunk
+            streams through TARGET AND DRAFT (decoding rows ride with
+            length 0), then ONE draft-verify round (``spec_round`` — the
+            same per-row acceptance/rollback core as ``decode_block_spec``)
+            advances every decoding row by 1..num_draft+1 tokens. ``pos``
+            tracks every row's cache index: refill rows advance by their
+            chunk length BEFORE the round, so the round's rollback
+            broadcast re-asserts (never clobbers) their refill advance."""
+            t_cache = _reset_rows(t_cache, reset_mask, reset_to)
+            d_cache = _reset_rows(d_cache, reset_mask, reset_to)
+            r_logits, t_cache = apply(params, t_cache, chunk, lengths)
+            _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
+            r_pick = jnp.take_along_axis(
+                r_logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            first_tok = sample_rows(r_pick, rng, rid, jnp.zeros_like(rid))
+            pos = pos + lengths
+            (tok, active, pos, remaining, count, buffer, acc, prop,
+             t_cache, d_cache) = spec_round(
+                _spec_carry_init(tok, active, pos, remaining, num_draft + 1)
+                + (t_cache, d_cache),
+                params, d_params, rid, rng,
+            )
+            return (
+                first_tok, buffer, count, acc, prop, tok, pos, active,
+                remaining, t_cache, d_cache,
             )
 
         # --- engine configuration and compiled programs -------------------
@@ -748,6 +889,15 @@ class ContinuousEngine:
         # throughput phases and drop it to 1 for latency-sensitive
         # arrival bursts (read at each dispatch).
         self.decode_chain = decode_chain
+        self._mixed = bool(mixed)
+        # Public and runtime-tunable like decode_chain: the per-dispatch
+        # token ceiling of the MIXED scheduler (decode rows funded first,
+        # refill takes the remainder). The default funds one full refill
+        # chunk alongside a full decode wave; read at each dispatch.
+        self.token_budget = (
+            token_budget if token_budget is not None
+            else refill_chunk + batch_size
+        )
         self._num_draft = num_draft
         self._speculative = speculative
         self._paged = paged
@@ -760,6 +910,8 @@ class ContinuousEngine:
         self._refill_step_fn = refill_step
         self._decode_block_fn = decode_block
         self._decode_block_spec_fn = decode_block_spec
+        self._mixed_step_fn = mixed_step
+        self._spec_mixed_step_fn = spec_mixed_step
 
         # --- persistent state ---------------------------------------------
         self.rng = jax.random.key(0)
@@ -783,6 +935,7 @@ class ContinuousEngine:
         self._last_first_refill_args = None
         self._last_refill_args = None
         self._last_decode_args = None
+        self._last_mixed_args = None
         self._init_telemetry(registry, tracer, slo, recorder)
         self._init_slots()
         if paged:
@@ -856,6 +1009,16 @@ class ContinuousEngine:
             "engine_refill_dispatches_total", "refill dispatches")
         self._c_decode_n = r.counter(
             "engine_decode_dispatches_total", "decode dispatches")
+        self._c_mixed_s = r.counter(
+            "engine_mixed_seconds_total",
+            "host-observed fused refill+decode dispatch+sync seconds")
+        self._c_mixed_n = r.counter(
+            "engine_mixed_dispatches_total",
+            "fused refill+decode dispatches")
+        self._c_stall_s = r.counter(
+            "engine_decode_stall_seconds_total",
+            "dispatch seconds during which decoding rows sat idle "
+            "behind another slot's refill")
         self._c_creations = r.counter(
             "engine_cache_creations_total", "cache-creating first refills")
         self._g_queue = r.gauge(
@@ -940,7 +1103,7 @@ class ContinuousEngine:
             for c in (
                 self._c_preempt, self._c_pfx_hits, self._c_pfx_pages,
                 self._c_spec_acc, self._c_spec_prop, self._c_refill_s,
-                self._c_decode_s,
+                self._c_decode_s, self._c_mixed_s, self._c_stall_s,
             )
         }
         # Window high-water for the page-pool gauge (live value rides on).
@@ -976,6 +1139,7 @@ class ContinuousEngine:
         self._cast_src = self._cast_out = None
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
+        self._last_mixed_args = None
         if self._paged:
             self._init_pool()
 
@@ -1182,6 +1346,7 @@ class ContinuousEngine:
         # swap. Drop them; the next dispatch re-captures.
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
+        self._last_mixed_args = None
         return out
 
     def add_request(self, prompt, *, rid: int | None = None) -> int:
@@ -1694,22 +1859,365 @@ class ContinuousEngine:
                         )
         return True
 
+    def _schedule_refill(self, budget):
+        """The token-budget refill schedule for ONE mixed link: FCFS over
+        slots with pending prompt tokens (admission order — the oldest
+        request's prompt streams first), each taking
+        ``min(pending, refill_chunk, budget left)``. Returns
+        ``(chunk, lengths, starved)`` — ``starved`` counts slots that held
+        pending tokens but got none this link (the scheduler decision the
+        flight recorder logs)."""
+        b = self._b
+        lengths = np.zeros((b,), np.int32)
+        chunk = np.zeros((b, self._refill_chunk), np.int32)
+        starved = 0
+        order = sorted(
+            (s for s in range(b) if self._pending[s].size),
+            # Admission order, not request id: callers may pass arbitrary
+            # rids to add_request. Same-pass admissions share admit_t, so
+            # arrival breaks the tie; a preempted request keeps its first
+            # admission time and so its place in line.
+            key=lambda s: (
+                self._slot_req[s].admit_t, self._slot_req[s].arrival_t
+            ),
+        )
+        for slot in order:
+            if budget <= 0:
+                starved += 1
+                continue
+            n = min(self._pending[slot].size, self._refill_chunk, budget)
+            if self._paged:
+                consumed = self._plen[slot] - self._pending[slot].size
+                try:
+                    self._ensure(slot, consumed + n)
+                except RuntimeError:
+                    # Backpressure, exactly as in _refill_dispatch: requeue
+                    # unless this request is the only one holding pages.
+                    if not any(
+                        self._req[s] >= 0
+                        for s in range(b) if s != slot
+                    ):
+                        raise
+                    self._unadmit(slot)
+                    self._c_preempt.inc()
+                    continue
+            chunk[slot, :n] = self._pending[slot][:n]
+            lengths[slot] = n
+            budget -= n
+        return chunk, lengths, starved
+
+    def _mixed_dispatch(self, params, d_params, retired):
+        # The FUSED scheduler iteration (``mixed=True``): up to
+        # ``decode_chain`` mixed links dispatched back-to-back, each
+        # advancing every decoding row by one token (speculative: one
+        # draft-verify round) AND pushing budgeted refill chunks for
+        # admitting/streaming rows — decode rows are funded first out of
+        # ``token_budget``, refill takes the remainder (uncapped when no
+        # row is decoding: with no one to protect, refill runs at the
+        # split engine's full width). Carries flow device-to-device; ONE
+        # host sync at the end. Cache creation still routes through the
+        # refill path (the one-shot ``first_refill`` program). Returns
+        # the program class that actually ran ("mixed" / "refill" /
+        # "decode" — step() books wall time per class) or False when
+        # nothing dispatched.
+        if self._cache is None:
+            return (
+                "refill"
+                if self._refill_dispatch(params, d_params, retired)
+                else False
+            )
+        b = self._b
+        if not any(p.size for p in self._pending):
+            # PURE-DECODE phase: nothing to fuse — run the K-token decode
+            # block (full decode throughput; a fused link costs one
+            # dispatch per token and exists to overlap refill, absent
+            # here). Admission is unaffected: _admit ran before this
+            # dispatch, and a queued request only waits on a block when
+            # every slot is busy — in which case it could not have been
+            # admitted under any granularity.
+            return (
+                "decode"
+                if self._decode_dispatch(params, d_params, retired)
+                else False
+            )
+        if self._speculative and not self._active.any():
+            # PURE-REFILL phase in speculative mode: a fused link would
+            # pay a full draft-verify round with every row frozen (draft
+            # applies, a verify apply, two rollback broadcasts — zero
+            # tokens out) on top of the refill. Outputs are
+            # schedule-independent, so run the split refill path until a
+            # row starts decoding. (A non-speculative refill-only link
+            # costs what refill_step costs; no fallback needed there.)
+            return (
+                "refill"
+                if self._refill_dispatch(params, d_params, retired)
+                else False
+            )
+        per_link = (self._num_draft + 1) if self._speculative else 1
+
+        def chain_cap(remaining, active):
+            # Links the longest-running decoding row can still use
+            # (optimistic for speculative — same convention as
+            # _decode_dispatch's per-block cap).
+            if not active.any():
+                return 0
+            return -(-int(remaining[active].max()) // per_link)
+
+        remaining = np.asarray(
+            [max(0, self._max_new - e) for e in self._emitted], np.int32
+        )
+        chain_dec = chain_cap(remaining, self._active)
+        if self._paged and self._active.any():
+            # Cover every decode position this chain can write, with the
+            # decode path's recompute-preemption fallback.
+            links_hint = min(self.decode_chain, max(chain_dec, 1))
+            for slot in range(b):
+                if not self._active[slot]:
+                    continue
+                pos_s = self._plen[slot] + self._emitted[slot] - 1
+                span = min(int(remaining[slot]), links_hint * per_link)
+                if self._speculative:
+                    span += self._num_draft + 1
+                try:
+                    self._ensure(slot, pos_s + span)
+                except RuntimeError:
+                    if not any(
+                        self._req[s] >= 0 for s in range(b) if s != slot
+                    ):
+                        raise
+                    self._unadmit(slot)
+                    self._c_preempt.inc()
+            remaining = np.asarray(
+                [max(0, self._max_new - e) for e in self._emitted],
+                np.int32,
+            )
+            chain_dec = chain_cap(remaining, self._active)
+        was_active = self._active.copy()
+        n_active = int(was_active.sum())
+        tok_d = jnp.asarray(self._tok)
+        active_d = jnp.asarray(was_active.astype(np.int32))
+        remaining_d = jnp.asarray(remaining)
+        rid = self._rid_arr()
+        if self._speculative:
+            # Every row's CURRENT cache index: decoding rows at
+            # prompt + emitted - 1, refilling rows at their consumed
+            # count (the round's rollback broadcast must re-assert, never
+            # rewind, a refill advance — the device adds each link's
+            # chunk lengths on top of this).
+            pos_d = jnp.asarray(
+                np.asarray(
+                    [
+                        max(0, self._plen[s] + self._emitted[s] - 1)
+                        if was_active[s]
+                        else (
+                            self._plen[s] - self._pending[s].size
+                            if self._req[s] >= 0 else 0
+                        )
+                        for s in range(b)
+                    ],
+                    np.int32,
+                )
+            )
+            t_cache, d_cache = self._cache
+        segs = []
+        starved_total = 0
+        refill_scheduled = 0
+        for link in range(max(1, self.decode_chain)):
+            # Decode rows are funded at their true per-link consumption:
+            # 1 token plain, num_draft + 1 verify-chunk positions
+            # speculative — otherwise a spec dispatch overruns the
+            # documented per-dispatch ceiling by n_active * num_draft.
+            budget = (
+                max(0, self.token_budget - n_active * per_link)
+                if n_active else b * self._refill_chunk
+            )
+            chunk, lengths, starved = self._schedule_refill(budget)
+            has_decode = n_active > 0 and link < chain_dec
+            if not lengths.any() and not has_decode:
+                break
+            starved_total += starved
+            refill_scheduled += int(lengths.sum())
+            if self._paged:
+                self._cache = (
+                    (t_cache, d_cache) if self._speculative else self._cache
+                )
+                self._cache = self._set_tables(self._cache)
+                if self._speculative:
+                    t_cache, d_cache = self._cache
+            # COPIES of the admission resets (see _refill_dispatch: the
+            # dispatch is async; an aliased in-place clear would corrupt
+            # it). Link 0 carries every pending reset — including rows the
+            # budget starved this link: the on-device counter reset is
+            # idempotent and nothing advances a row before its first
+            # chunk, so resetting early is safe and the flags can clear.
+            chunk_d = jnp.asarray(chunk)
+            lengths_d = jnp.asarray(lengths)
+            reset_d = jnp.asarray(self._needs_reset.copy())
+            reset_to_d = jnp.asarray(self._reset_to.copy())
+            if self._speculative:
+                with annotate("engine.spec_mixed_step"):
+                    (first_tok, buffer, counts, acc, prop, tok_d, pos_d,
+                     active_d, remaining_d, t_cache, d_cache) = (
+                        self._spec_mixed_step_fn(
+                            params, d_params, t_cache, d_cache, chunk_d,
+                            lengths_d, reset_d, reset_to_d, tok_d,
+                            active_d, pos_d, remaining_d, rid, self.rng,
+                        )
+                    )
+                args = (
+                    params, d_params, t_cache, d_cache, chunk_d,
+                    lengths_d, reset_d, reset_to_d, tok_d, active_d,
+                    pos_d, remaining_d, rid, self.rng,
+                )
+            else:
+                with annotate("engine.mixed_step"):
+                    first_tok, tok_d, active_d, remaining_d, self._cache = (
+                        self._mixed_step_fn(
+                            params, self._cache, chunk_d, lengths_d,
+                            reset_d, reset_to_d, tok_d, active_d,
+                            remaining_d, rid, self.rng,
+                        )
+                    )
+                buffer = counts = acc = prop = None
+                args = (
+                    params, self._cache, chunk_d, lengths_d, reset_d,
+                    reset_to_d, tok_d, active_d, remaining_d, rid,
+                    self.rng,
+                )
+            self._last_mixed_args = lambda a=args: a
+            self._needs_reset[:] = False
+            self._reset_to[:] = 0
+            # Advance the host-side pending views NOW (later links read
+            # them); completions are processed after the single sync.
+            seg_completes = []
+            for slot in range(b):
+                if lengths[slot]:
+                    self._pending[slot] = (
+                        self._pending[slot][lengths[slot]:]
+                    )
+                    if (
+                        self._pending[slot].size == 0
+                        and self._req[slot] >= 0
+                    ):
+                        seg_completes.append(slot)
+            segs.append(
+                (first_tok, buffer, counts, acc, prop, seg_completes)
+            )
+        if not segs:
+            return False
+        if self._speculative:
+            self._cache = (t_cache, d_cache)
+        self.recorder.record(
+            "engine.mixed_schedule", links=len(segs),
+            decode_rows=n_active, refill_tokens=refill_scheduled,
+            starved=starved_total, budget=self.token_budget,
+            queue_depth=len(self._queue),
+        )
+        for first_tok, buffer, counts, acc, prop, seg_completes in segs:
+            first_np = np.asarray(first_tok)   # each link's own sync
+            now = time.perf_counter()
+            for slot in seg_completes:
+                # Prompt complete: its first token came from this link's
+                # refill pick (same rule as _refill_dispatch).
+                t = int(first_np[slot])
+                self._out[slot].append(t)
+                self._emitted[slot] = 1
+                self._tok[slot] = t
+                self._slot_req[slot].first_token_t = now
+                self._ttimes[slot].append(now)
+                self.tracer.instant(
+                    "request.first_token", rid=self._req[slot]
+                )
+                if (self._eos is not None and t == self._eos) or (
+                    self._max_new == 1
+                ):
+                    self._retire(slot, now, retired)
+                else:
+                    self._active[slot] = True
+            if self._speculative:
+                counts_np = np.asarray(counts)
+                buffer_np = np.asarray(buffer)
+                self._c_spec_acc.inc(int(np.asarray(acc).sum()))
+                self._c_spec_prop.inc(int(np.asarray(prop).sum()))
+            for slot in range(b):
+                # Decode consumption: rows decoding at CHAIN START that
+                # are still live (a row retired while processing an
+                # earlier link froze on device — its later-link lanes
+                # carry no real tokens).
+                if was_active[slot] and self._req[slot] >= 0:
+                    if self._speculative:
+                        toks = buffer_np[slot, : counts_np[slot]].tolist()
+                    else:
+                        toks = [int(first_np[slot])]
+                    self._consume(slot, toks, now, retired)
+        return "mixed"
+
     def step(self, params, draft_params=None) -> list[int]:
         """ONE scheduler iteration: admit queued requests into idle
         slots, then run exactly one dispatch — a refill chunk if any slot
         has pending prompt tokens, else a decode block if any row is
-        active, else nothing. Returns the ids of requests that finished
-        during this step (their outputs await ``pop_finished()``)."""
+        active, else nothing. With ``mixed=True`` the one dispatch is the
+        FUSED program instead: every decoding row advances (one token per
+        link, or one draft-verify round) AND pending prompts push
+        budgeted refill chunks, so decode never stalls behind refill and
+        admission lands at every dispatch. Returns the ids of requests
+        that finished during this step (their outputs await
+        ``pop_finished()``)."""
         self._check_draft_args(draft_params)
         params, d_params = self._cast_params(params, draft_params)
         retired: list[int] = []
         with activate(self._mesh, self._rules):
             self._admit()
+            # Decode-stall accounting: a dispatch "stalls decode" when
+            # rows were actively decoding but the dispatch advanced none
+            # of them (the split engine's refill). The SLO feed sees a
+            # 0/1 stall indicator per dispatch-with-active-rows, so a
+            # ``decode_stall_share`` target reads as the fraction of such
+            # dispatches that parked decode behind refill.
+            had_active = bool(self._active.any())
             t0 = time.perf_counter()
-            if self._refill_dispatch(params, d_params, retired):
+            if self._mixed:
+                # Wall time accrues to the program class that actually
+                # ran: _mixed_dispatch's fallthroughs (cache creation and
+                # speculative pure-refill → "refill", pure-decode block →
+                # "decode") must land in refill_s/decode_s, not mixed_s,
+                # or refill_frac understates refill serialization. A
+                # "refill" here never has active rows (creation precedes
+                # any decode; the spec fallback requires none), so it
+                # cannot stall decode.
+                kind = self._mixed_dispatch(params, d_params, retired)
+                if kind:
+                    dt = time.perf_counter() - t0
+                    if kind == "refill":
+                        self._c_refill_s.inc(dt)
+                        self._c_refill_n.inc()
+                        self.tracer.complete(
+                            "engine.refill", t0, dt, retired=len(retired)
+                        )
+                    elif kind == "decode":
+                        self._c_decode_s.inc(dt)
+                        self._c_decode_n.inc()
+                        self.tracer.complete(
+                            "engine.decode", t0, dt, retired=len(retired)
+                        )
+                        if had_active and self.slo is not None:
+                            self.slo.observe("decode_stall_share", 0.0)
+                    else:
+                        self._c_mixed_s.inc(dt)
+                        self._c_mixed_n.inc()
+                        self.tracer.complete(
+                            "engine.mixed", t0, dt, retired=len(retired)
+                        )
+                        if had_active and self.slo is not None:
+                            self.slo.observe("decode_stall_share", 0.0)
+            elif self._refill_dispatch(params, d_params, retired):
                 dt = time.perf_counter() - t0
                 self._c_refill_s.inc(dt)
                 self._c_refill_n.inc()
+                if had_active:
+                    self._c_stall_s.inc(dt)
+                    if self.slo is not None:
+                        self.slo.observe("decode_stall_share", 1.0)
                 self.tracer.complete(
                     "engine.refill", t0, dt, retired=len(retired)
                 )
@@ -1720,6 +2228,8 @@ class ContinuousEngine:
                 dt = time.perf_counter() - t0
                 self._c_decode_s.inc(dt)
                 self._c_decode_n.inc()
+                if had_active and self.slo is not None:
+                    self.slo.observe("decode_stall_share", 0.0)
                 self.tracer.complete(
                     "engine.decode", t0, dt, retired=len(retired)
                 )
@@ -1753,10 +2263,17 @@ class ContinuousEngine:
         out.update(pcts([c["e2e"] for c in comp], "e2e"))
         refill_s = self._win_delta(self._c_refill_s)
         decode_s = self._win_delta(self._c_decode_s)
-        busy = refill_s + decode_s
+        mixed_s = self._win_delta(self._c_mixed_s)
+        stall_s = self._win_delta(self._c_stall_s)
+        busy = refill_s + decode_s + mixed_s
         out.update(
-            refill_s=refill_s, decode_s=decode_s,
+            refill_s=refill_s, decode_s=decode_s, mixed_s=mixed_s,
             refill_frac=(refill_s / busy) if busy else None,
+            # Decode-stall share: the fraction of dispatched engine time
+            # that parked decoding rows behind another slot's refill —
+            # the number the mixed engine exists to drive to ~0.
+            decode_stall_s=stall_s,
+            decode_stall_share=(stall_s / busy) if busy else None,
         )
         return out
 
@@ -1805,6 +2322,11 @@ class ContinuousEngine:
             fns["decode_block_spec"] = self._decode_block_spec_fn
         else:
             fns["decode_block"] = self._decode_block_fn
+        if self._mixed:
+            fns["mixed_step"] = (
+                self._spec_mixed_step_fn if self._speculative
+                else self._mixed_step_fn
+            )
         return {k: cache_size(f) for k, f in fns.items()}
 
     def _dispatched_programs(self):
@@ -1831,6 +2353,12 @@ class ContinuousEngine:
             else:
                 fn, name = self._decode_block_fn, "decode_block"
             out.append((name, fn, self._last_decode_args()))
+        if self._last_mixed_args is not None:
+            fn = (
+                self._spec_mixed_step_fn if self._speculative
+                else self._mixed_step_fn
+            )
+            out.append(("mixed_step", fn, self._last_mixed_args()))
         return out
 
     def _program_reports(self) -> dict[str, dict]:
@@ -1880,6 +2408,7 @@ class ContinuousEngine:
         "refill_step": "prefill",
         "decode_block": "decode_step",
         "decode_block_spec": "decode_step",
+        "mixed_step": "mixed_step",
     }
 
     def contract_name(self, program: str) -> str:

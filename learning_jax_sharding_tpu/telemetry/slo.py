@@ -13,8 +13,14 @@ fast are we burning error budget". This module answers it in-process:
   threshold`` for at least ``objective`` of events (e.g. "p99 TTFT under
   500 ms" is ``SLOTarget("ttft", 0.5, objective=0.99)``).
 * :class:`SLOMonitor` — observes metric values (the engine feeds
-  TTFT/TPOT/ITL/queue-wait per retirement when constructed with
-  ``slo=monitor``), maintains per-target good/bad counts and the BURN RATE
+  TTFT/TPOT/ITL/queue-wait/e2e per retirement when constructed with
+  ``slo=monitor``, plus — round 9 — a per-dispatch ``decode_stall_share``
+  0/1 indicator whenever rows were actively decoding: 1 when the
+  dispatch parked them behind another slot's refill (the split engine's
+  refill), 0 when they advanced (decode, or the fused ``mixed_step``) —
+  so a ``decode_stall_share`` target reads as the fraction of
+  decode-live dispatches that stalled decode), maintains per-target
+  good/bad counts and the BURN RATE
   — the windowed bad fraction over the error budget ``1 - objective``;
   burn rate 1.0 means exactly consuming budget, >1 means the target fails
   if the window's behavior persists. Counters/gauges mirror into a

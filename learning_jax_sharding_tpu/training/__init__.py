@@ -25,6 +25,7 @@ from learning_jax_sharding_tpu.training.precision import (  # noqa: F401
     master_weights,
 )
 from learning_jax_sharding_tpu.training.zero import (  # noqa: F401
+    make_zero1_update,
     zero1_shardings,
 )
 

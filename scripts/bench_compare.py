@@ -55,6 +55,17 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     (re.compile(r"([\d.]+)\s*us/forward"), "us_per_forward", False),
     (re.compile(r"TTFT p50 ([\d.]+)\s*ms"), "ttft_p50_ms", False),
     (re.compile(r"p99 ([\d.]+)\s*ms"), "p99_ms", False),
+    # Round-9 serving-latency gates: ITL p99 and queue wait are the
+    # numbers the mixed engine exists to hold down; refill share and
+    # decode-stall share regress UPWARD when decode re-stalls behind
+    # refill — all four are direction-aware like every other metric.
+    (re.compile(r"ITL p99 ([\d.]+)\s*ms"), "itl_p99_ms", False),
+    (re.compile(r"queue wait p50 ([\d.]+)\s*ms"), "queue_wait_p50_ms",
+     False),
+    (re.compile(r"refill ([\d.]+)% of engine time"), "refill_share_pct",
+     False),
+    (re.compile(r"decode stalled ([\d.]+)%"), "decode_stall_share_pct",
+     False),
     (re.compile(r"agreement vs plain: ([\d.]+)%"), "agreement_pct", True),
 ]
 

@@ -1,0 +1,115 @@
+"""Round-9 ablation: the mixed engine's token-budget ladder.
+
+The fused ``mixed_step`` trades per-dispatch latency (what a decoding
+row waits between its tokens) against refill throughput (how fast
+queued prompts stream in): every dispatch advances all decode rows AND
+up to ``token_budget - active`` refill tokens. This script records the
+ladder that justifies the shipped default and the bench's tuned value:
+
+1. the DECODE-ONLY floor — the staggered-arrival workload served with
+   ``token_budget = batch_size`` (refill gets only what decode leaves,
+   i.e. nothing while a full wave decodes): best possible ITL, worst
+   queue wait;
+2. the budget sweep — token_budget in {B, 64+B, 128+B, 256+B, inf};
+3. the split-engine baseline (``mixed=False``) — the decode-stall
+   regime the fused scheduler replaces.
+
+Per rung: ITL p99, TTFT p50, queue-wait p50, tok/s, refill share, and
+decode-stall share, from the engine's own telemetry. The staggered
+16-arrival/20 req/s workload is bench.py's serving-latency headline.
+
+Run from /root/repo:  python - < scripts/perf_mixed.py
+"""
+import dataclasses
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_125M,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+cfg = dataclasses.replace(
+    CONFIG_125M, max_seq_len=1024, decode_attention="blocked"
+)
+mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+rng = np.random.default_rng(0)
+model = Transformer(cfg)
+probe = np.zeros((8, 64), np.int32)
+params = nn.meta.unbox(
+    jax.jit(lambda r, t: model.init({"params": r}, t))(
+        jax.random.key(0), probe
+    )["params"]
+)
+B, NEW, PLEN = 8, 32, 544
+system = rng.integers(1, cfg.vocab_size, size=(512,)).astype(np.int32)
+prompts = [
+    np.concatenate(
+        [system, rng.integers(1, cfg.vocab_size, size=(32,)).astype(np.int32)]
+    )
+    for _ in range(16)
+]
+
+
+def staggered(engine, gap=0.05):
+    engine.decode_chain = 1
+    engine.reset_stats()
+    t0 = time.perf_counter()
+    nxt = 0
+    while engine.has_work() or nxt < len(prompts):
+        while (
+            nxt < len(prompts)
+            and time.perf_counter() - t0 >= nxt * gap
+        ):
+            engine.add_request(prompts[nxt])
+            nxt += 1
+        engine.step(params)
+    dt = time.perf_counter() - t0
+    outs = engine.pop_finished()
+    toks = sum(len(o) - PLEN for o in outs.values())
+    lat = engine.latency_stats()
+    return dict(
+        itl_p99=lat["itl_p99"], ttft_p50=lat["ttft_p50"],
+        queue_wait_p50=lat["queue_wait_p50"], tok_s=toks / dt,
+        refill_share=lat["refill_frac"] or 0.0,
+        stall_share=lat["decode_stall_share"] or 0.0,
+    )
+
+
+common = dict(
+    batch_size=B, max_new_tokens=NEW, refill_chunk=64,
+    inference_dtype=jnp.bfloat16, decode_block_steps=NEW,
+)
+BIG = 10**9   # effectively uncapped: the full-width refill regime
+# In mixed mode decode_block_steps sizes only the PURE-DECODE fallback
+# block (no refill to fuse), i.e. the tail's token-visibility gap — the
+# K=8 rungs are the latency tuning bench.py ships.
+rungs = [
+    ("split engine (mixed=False)", dict()),
+    (f"mixed, budget={B} (decode-only floor)", dict(mixed=True, token_budget=B)),
+    (f"mixed, budget=64+{B}", dict(mixed=True, token_budget=64 + B)),
+    (f"mixed, budget=128+{B}", dict(mixed=True, token_budget=128 + B)),
+    (f"mixed, budget=128+{B}, tail K=8",
+     dict(mixed=True, token_budget=128 + B, decode_block_steps=8)),
+    (f"mixed, budget=256+{B}", dict(mixed=True, token_budget=256 + B)),
+    ("mixed, budget=inf", dict(mixed=True, token_budget=BIG)),
+]
+print(f"{'variant':38s} {'ITL p99':>9s} {'TTFT p50':>9s} "
+      f"{'wait p50':>9s} {'tok/s':>7s} {'refill':>7s} {'stall':>6s}")
+for name, kw in rungs:
+    serve = make_continuous_engine(cfg, mesh, RULES_DP_TP, **{**common, **kw})
+    eng = serve.engine
+    staggered(eng)              # warm every executable (compiles excluded)
+    r = staggered(eng)
+    print(
+        f"{name:38s} {r['itl_p99'] * 1e3:7.1f}ms {r['ttft_p50'] * 1e3:7.0f}ms "
+        f"{r['queue_wait_p50'] * 1e3:7.0f}ms {r['tok_s']:7.0f} "
+        f"{r['refill_share']:6.0%} {r['stall_share']:5.0%}"
+    )

@@ -58,6 +58,33 @@ class TestExtraction:
         assert m["moe_step:act_mfu_pct"] == (33.0, True)
         assert "moe_step:mfu_pct" not in m
 
+    def test_serving_latency_gates_direction_aware(self):
+        """The round-9 serving gates: ITL p99, queue wait p50, refill
+        share, and decode-stall share all regress when they RISE."""
+        line = (
+            "[bench] 125M serving latency (16 staggered arrivals, "
+            "20 req/s): TTFT p50 220 ms / p99 410 ms, TPOT p50 5.4 ms, "
+            "ITL p99 80 ms, queue wait p50 190 ms, 310 tok/s, refill "
+            "41% of engine time, decode stalled 0%"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        name = "125M_serving_latency_(16_staggered_arrivals,_20_req/s)"
+        assert m[f"{name}:itl_p99_ms"] == (80.0, False)
+        assert m[f"{name}:queue_wait_p50_ms"] == (190.0, False)
+        assert m[f"{name}:refill_share_pct"] == (41.0, False)
+        assert m[f"{name}:decode_stall_share_pct"] == (0.0, False)
+        assert m[f"{name}:tok_s"] == (310.0, True)
+        # The generic p99 pattern still reads TTFT's p99 (first match),
+        # not ITL's — the ITL gate is its own key.
+        assert m[f"{name}:p99_ms"] == (410.0, False)
+        worse = _doc([line.replace("ITL p99 80 ms", "ITL p99 180 ms")
+                     .replace("refill 41%", "refill 88%")])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{name}:itl_p99_ms"]["regressed"]
+        assert by[f"{name}:refill_share_pct"]["regressed"]
+        assert not by[f"{name}:queue_wait_p50_ms"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

@@ -1,0 +1,392 @@
+"""Fused mixed prefill+decode step (models/serving.py, ``mixed=True``).
+
+THE oracle, inherited from test_serving.py and applied to the fused
+scheduler: scheduling must never change results. Every mixed-engine
+output — fresh prompts, mid-stream admits, prefix hits, long prompts
+spanning several chunks, budget starvation, chained links, speculative
+rounds — must be BIT-IDENTICAL to the split refill/decode engine (which
+is itself pinned to rectangular single runs), and sampled streams must
+be identical too (draws are keyed by request id and generated position,
+never by schedule).
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import (
+    RULES_DP_TP,
+    RULES_TP_SERVING,
+)
+
+NEW = 6
+
+DRAFT_CFG = dataclasses.replace(
+    CONFIG_TINY, num_layers=1, hidden=64, dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def setup(mesh22):
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    model = Transformer(cfg)
+    probe = np.zeros((2, 8), np.int32)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(3), probe
+        )["params"]
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in (3, 9, 5, 1, 12, 7, 4)
+    ]
+    return cfg, params, prompts
+
+
+@pytest.fixture(scope="module")
+def classic_ref(setup, mesh22):
+    """The split-engine outputs the fused engine is held bit-identical
+    to (the split engine itself is pinned to rectangular single runs in
+    test_serving.py)."""
+    cfg, params, prompts = setup
+    serve = make_continuous_engine(
+        cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=4,
+    )
+    return serve(params, prompts)
+
+
+def _draft_params():
+    model = Transformer(DRAFT_CFG)
+    toks = np.zeros((2, 8), np.int32)
+    return nn.meta.unbox(
+        model.init({"params": jax.random.key(7)}, toks)["params"]
+    )
+
+
+class TestMixedEngine:
+    def test_matches_split_engine(self, setup, mesh22, classic_ref):
+        """7 mixed-length requests through 2 slots, refill_chunk 4 (the
+        12-token prompt spans 3 chunks): every fused-engine output equals
+        the split engine's bit for bit, and the fused program actually
+        dispatched (the workload interleaves refilling and decoding
+        slots)."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(classic_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.engine._c_mixed_n.value > 0
+        # Steady state: one executable per program, no recompiles.
+        assert serve.engine.compile_counts()["mixed_step"] == 1
+
+    @pytest.mark.slow
+    def test_budget_starvation_exact(self, setup, mesh22, classic_ref):
+        """A token budget SMALLER than one refill chunk forces prompts to
+        trickle in over many dispatches while decode rows keep advancing
+        — results must not move."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, token_budget=3,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(classic_ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    @pytest.mark.slow
+    def test_chained_links_bit_identical(self, setup, mesh22, classic_ref):
+        """decode_chain > 1: links carry tok/active/remaining
+        device-to-device with one host sync per chain — cannot change
+        results."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, decode_chain=3,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(classic_ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    @pytest.mark.slow
+    def test_eos_retires_mid_stream(self, setup, mesh22):
+        """EOS emitted by a decode row inside a fused dispatch retires
+        the row exactly where the split engine stops it."""
+        cfg, params, prompts = setup
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4,
+        )
+        ref = split(params, prompts)
+        eos = int(ref[0][len(prompts[0]) + 1])
+        for mixed in (False, True):
+            serve = make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, refill_chunk=4, eos_id=eos,
+                mixed=mixed,
+            )
+            outs = serve(params, prompts)
+            if not mixed:
+                eos_ref = outs
+            else:
+                for r, g in zip(eos_ref, outs):
+                    np.testing.assert_array_equal(g, r)
+
+    def test_streaming_mid_admits(self, setup, mesh22, classic_ref):
+        """The arrival process the fused scheduler exists for: requests
+        admitted WHILE other rows decode ride the same dispatches —
+        admission at every mixed dispatch, outputs unchanged."""
+        cfg, params, prompts = setup
+        eng = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        ).engine
+        eng.add_request(prompts[0], rid=0)
+        eng.add_request(prompts[1], rid=1)
+        outs, steps, pending = {}, 0, list(range(2, 7))
+        while eng.has_work() or pending:
+            eng.step(params)
+            steps += 1
+            if steps % 2 == 0 and pending:
+                i = pending.pop(0)
+                eng.add_request(prompts[i], rid=i)
+            outs.update(eng.pop_finished())
+        for i, r in enumerate(classic_ref):
+            np.testing.assert_array_equal(outs[i], r)
+
+    def test_long_prompt_chunked_paged(self, setup, mesh22):
+        """A 44-token prompt through 8-token chunks on the PAGED fused
+        engine: refill spans 6 budgeted dispatches while the short rows
+        decode alongside; pages allocate for refill AND decode writes of
+        the same dispatch."""
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        rng = np.random.default_rng(5)
+        long_prompts = [
+            rng.integers(1, cfg.vocab_size, size=(44,)).astype(np.int32),
+            prompts[0], prompts[2],
+        ]
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8,
+        )
+        ref = split(params, long_prompts)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8,
+        )
+        outs = serve(params, long_prompts)
+        for r, g in zip(ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    def test_prefix_hits_across_calls(self, setup, mesh22):
+        """Prefix caching under the fused scheduler: a second serve()
+        call re-admits shared-prefix prompts with pages already mapped
+        (reset_to > 0 riding the fused dispatch) — outputs bit-identical,
+        hits counted."""
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        rng = np.random.default_rng(9)
+        system = rng.integers(1, cfg.vocab_size, size=(16,)).astype(np.int32)
+        queue = [
+            np.concatenate([
+                system,
+                rng.integers(1, cfg.vocab_size, size=(4,)).astype(np.int32),
+            ])
+            for _ in range(4)
+        ]
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8,
+        )
+        ref = split(params, queue)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8, prefix_cache=True,
+        )
+        cold = serve(params, queue)
+        warm = serve(params, queue)
+        for r, g in zip(ref, cold):
+            np.testing.assert_array_equal(g, r)
+        for r, g in zip(ref, warm):
+            np.testing.assert_array_equal(g, r)
+        assert serve.last_stats["prefix_hits"] == len(queue)
+
+    @pytest.mark.slow
+    def test_sampled_streams_schedule_independent(self, setup, mesh22):
+        """temperature > 0: the fused engine (different batch size AND a
+        starving budget — a maximally different schedule) must emit the
+        IDENTICAL sampled stream per request: draws are keyed by (request
+        id, generated position), never by schedule."""
+        cfg, params, prompts = setup
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8,
+        )
+        fused = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8, mixed=True,
+            token_budget=5,
+        )
+        a = split(params, prompts, rng=jax.random.key(42))
+        b = fused(params, prompts, rng=jax.random.key(42))
+        for r, g in zip(a, b):
+            np.testing.assert_array_equal(g, r)
+
+    def test_stall_telemetry(self, setup, mesh22):
+        """The metric this PR exists to move: the split engine records
+        decode-stall seconds (refill dispatches that parked active
+        decode rows); the fused engine records none and accrues its time
+        under mixed_s."""
+        cfg, params, prompts = setup
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4,
+        )
+        split(params, prompts)
+        lat = split.last_latency
+        assert lat["decode_stall_s"] > 0
+        assert lat["decode_stall_share"] > 0
+        assert lat["mixed_s"] == 0
+        fused = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        fused(params, prompts)
+        lat = fused.last_latency
+        assert lat["decode_stall_s"] == 0
+        assert lat["decode_stall_share"] == 0
+        assert lat["mixed_s"] > 0
+
+    def test_scheduler_flight_recorder_events(self, setup, mesh22):
+        """Every fused dispatch logs its scheduling decision (links,
+        decode rows, refill tokens, starvation) to the flight recorder."""
+        from learning_jax_sharding_tpu.telemetry import FlightRecorder
+
+        cfg, params, prompts = setup
+        rec = FlightRecorder()
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, token_budget=1, recorder=rec,
+        )
+        serve(params, prompts[:3])
+        evs = rec.events("engine.mixed_schedule")
+        assert evs, "no scheduler decisions recorded"
+        assert all(
+            {"links", "decode_rows", "refill_tokens", "starved", "budget"}
+            <= set(e) for e in evs
+        )
+        # The tight budget must actually have starved someone at least once.
+        assert any(e["starved"] > 0 for e in evs)
+
+    def test_validation(self, setup, mesh22):
+        cfg, params, prompts = setup
+        with pytest.raises(ValueError, match="token_budget requires"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+                token_budget=8,
+            )
+        with pytest.raises(ValueError, match="token_budget"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+                mixed=True, token_budget=0,
+            )
+
+
+class TestSpeculativeMixed:
+    """spec_mixed_step: budgeted refill through target AND draft plus one
+    draft-verify round per link, per-row acceptance and rollback intact."""
+
+    @pytest.mark.slow
+    def test_matches_split_engine(self, setup, mesh22, classic_ref):
+        """Weak draft (near-zero acceptance): per-row rollback runs every
+        round while other slots refill in the same dispatch — outputs
+        bit-identical to the plain split engine."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, draft_config=DRAFT_CFG,
+            num_draft=3,
+        )
+        outs = serve(params, prompts, draft_params=_draft_params())
+        for r, g in zip(classic_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.engine._c_mixed_n.value > 0
+
+    def test_per_row_rollback(self, setup, mesh22, classic_ref):
+        """Self-draft (acceptance 1.0) next to a fresh admit mid-stream:
+        one row fast-forwards num_draft+1 tokens per round while its
+        neighbor refills in the same fused dispatch — each row's rollback
+        index is its own. Acceptance stats must survive the fused path."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, draft_config=cfg, num_draft=2,
+        )
+        outs = serve(params, prompts, draft_params=params)
+        for r, g in zip(classic_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.last_stats["spec_accept_rate"] == 1.0
+
+    @pytest.mark.slow
+    def test_paged_speculative_mixed(self, setup, mesh22):
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8,
+        )
+        ref = split(params, prompts[:4])
+        dcfg = dataclasses.replace(
+            DRAFT_CFG, max_seq_len=64, decode_attention="blocked"
+        )
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            draft_config=dcfg, num_draft=2, paged_pages=20, page_size=8,
+        )
+        outs = serve(params, prompts[:4], draft_params=_draft_params())
+        for r, g in zip(ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    @pytest.mark.slow
+    def test_sampled_speculative_schedule_independent(self, setup, mesh22):
+        """Speculative SAMPLING through the fused path: same draws as the
+        split speculative engine (position-keyed rejection streams)."""
+        cfg, params, prompts = setup
+        split = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8,
+            draft_config=DRAFT_CFG, num_draft=2,
+        )
+        fused = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8, mixed=True,
+            draft_config=DRAFT_CFG, num_draft=2, token_budget=6,
+        )
+        dp = _draft_params()
+        a = split(params, prompts[:4], rng=jax.random.key(9), draft_params=dp)
+        b = fused(params, prompts[:4], rng=jax.random.key(9), draft_params=dp)
+        for r, g in zip(a, b):
+            np.testing.assert_array_equal(g, r)

@@ -501,8 +501,9 @@ class TestCheckedInGoldens:
     (cases/case20_shardcheck.py runs the full loop)."""
 
     REQUIRED = (
-        "train_step", "zero1_update", "prefill", "decode_step",
-        "spec_prefill", "spec_decode_step",
+        "train_step", "zero1_update", "zero1_update_q8", "prefill",
+        "decode_step", "mixed_step",
+        "spec_prefill", "spec_decode_step", "spec_mixed_step",
         "moe_dispatch", "ring_attention", "ulysses_attention",
     )
 
@@ -520,10 +521,24 @@ class TestCheckedInGoldens:
         # The sharded entry points must not have recorded vacuous
         # (replicated, no-comms) contracts: each of these programs
         # provably communicates on its mesh.
-        for name in ("train_step", "zero1_update", "prefill",
-                     "decode_step", "moe_dispatch"):
+        for name in ("train_step", "zero1_update", "zero1_update_q8",
+                     "prefill", "decode_step", "mixed_step",
+                     "spec_mixed_step", "moe_dispatch"):
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.collectives, f"{name} golden records no collectives"
+
+    def test_q8_golden_records_the_ring(self):
+        """The quantized grad-sync golden must pin the int8 ring's
+        collective-permutes — the whole point of its contract: a silent
+        fall-back to the fp32 all-reduce would show up as these ops
+        vanishing."""
+        from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
+
+        c = Contract.load(GOLDEN_DIR / "zero1_update_q8.json")
+        assert any(
+            k.startswith("collective-permute") for k in c.collectives
+        ), c.collectives
+        assert c.while_collectives >= 1   # the ring hops ride fori_loops
 
     def test_ring_golden_admits_while_collectives(self):
         from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
