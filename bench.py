@@ -630,6 +630,14 @@ def bench_serving_125m():
             extras += f", refill {lat['refill_frac']:.0%} of engine time"
         if lat.get("decode_stall_share") is not None:
             extras += f", decode stalled {lat['decode_stall_share']:.0%}"
+        # Recovery-policy telemetry (round 10): with no faults these must
+        # hold at 0 — bench_compare gates them direction-aware, so the
+        # deadline/admission hooks can't silently start shedding clean
+        # traffic.
+        extras += (
+            f", shed {lat.get('shed_rate', 0.0):.0%}"
+            f", deadline miss {lat.get('deadline_miss_rate', 0.0):.0%}"
+        )
         _log(
             f"[bench] 125M serving latency{label} (16 staggered arrivals, "
             f"{1 / gap:.0f} req/s): TTFT p50 {lat['ttft_p50'] * 1e3:.0f} ms"
@@ -646,10 +654,15 @@ def bench_serving_125m():
     # mode the block program only runs when there is no refill to fuse,
     # so a small K costs a few extra tail dispatches, not refill
     # overlap).
+    # Recovery hooks ON but never tripping (round 10): a 300 s TTL and a
+    # 256-deep queue bound are far beyond this workload, so the tracked
+    # line now PRICES the deadline sweep + admission check — the <2%
+    # overhead budget PERF.md round 10 measures (scripts/perf_recovery.py).
     mixed_lat = make_continuous_engine(
         cfg, mesh, RULES_DP_TP,
         **{**common, "decode_block_steps": 8},
         mixed=True, token_budget=128 + 8,
+        deadline_s=300.0, max_queue=256,
     )
     # Warm before the tracked run: this engine's executables (its
     # decode_block_steps differs from the ladder's warmed engines) must

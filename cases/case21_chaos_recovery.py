@@ -1,0 +1,77 @@
+"""Case 21 — chaos recovery: inject every fault class, watch the stack heal.
+
+Cases 18/19 made the stack observable and diagnosable; this driver
+closes the loop by PROVING recovery. The full fault × policy matrix
+(``robustness.matrix``) runs end to end on the emulated mesh:
+
+* serving — a poison request (injected NaN-trap / hang-watchdog abort)
+  is quarantined after ``max_dispatch_strikes`` while its batchmates
+  recompute to bit-identical outputs; slowed dispatches trip per-request
+  DEADLINES (terminal ``"deadline"`` status through ``pop_finished``,
+  never a silent drop); an injected page-alloc OOM takes the
+  recompute-preemption path; a corrupted queued prompt is failed as
+  ``"malformed"``; offered load past the queue bound is SHED while the
+  SLO burn rate walks the degradation ladder.
+* training — a poisoned batch goes NaN INSIDE the jitted step and the
+  on-device guard refuses the update (bounded skips); a loss spike
+  rolls back to the last checkpoint and replays; SIGTERM forces an
+  emergency checkpoint and the resumed run's trajectory is bit-identical
+  to an uninterrupted one; a truncated newest checkpoint falls back to
+  the previous retained step.
+
+Every injection and every recovery action lands in the flight recorder
+— the artifact bundle shows the incident timeline next to the verdict.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case21``, else a
+temp dir): ``chaos_matrix.json`` (per-cell verdicts) + ``events.json``
+(the recorder ring).
+
+Run: ``python cases/case21_chaos_recovery.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import json
+import pathlib
+import sys
+
+from learning_jax_sharding_tpu.robustness.matrix import run_matrix
+from learning_jax_sharding_tpu.telemetry import default_flight_recorder
+from learning_jax_sharding_tpu.telemetry.flight_recorder import artifact_dir
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case21")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    print("case21: running the fault x policy matrix")
+    results = run_matrix(verbose=True)
+    bad = [r for r in results if not r["recovered"]]
+
+    (out / "chaos_matrix.json").write_text(
+        json.dumps(
+            {
+                "cells": len(results),
+                "recovered": len(results) - len(bad),
+                "results": results,
+            },
+            indent=2, default=str,
+        )
+    )
+    rec = default_flight_recorder()
+    (out / "events.json").write_text(
+        json.dumps(rec.events()[-500:], indent=2, default=str)
+    )
+    print(f"case21: {len(results) - len(bad)}/{len(results)} cells "
+          f"recovered; artifacts in {out}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,9 @@ def _tiny_cfg():
     return dc.replace(CONFIG_TINY, dtype=jnp.float32)
 
 
-def _train_state_and_step(mesh, *, zero1_axis=None, with_grad_norm=False):
+def _train_state_and_step(
+    mesh, *, zero1_axis=None, with_grad_norm=False, skip_nonfinite=False
+):
     import jax
 
     from learning_jax_sharding_tpu.data.datasets import SyntheticLMDataset
@@ -91,13 +93,14 @@ def _train_state_and_step(mesh, *, zero1_axis=None, with_grad_norm=False):
     step = make_train_step(
         state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
         RULES_DP_TP, loss_fn=next_token_loss,
-        with_grad_norm=with_grad_norm,
+        with_grad_norm=with_grad_norm, skip_nonfinite=skip_nonfinite,
     )
     return cfg, state, batch, step, RULES_DP_TP
 
 
 def _train_like(
-    name: str, *, zero1_axis=None, with_grad_norm=False, audit=True
+    name: str, *, zero1_axis=None, with_grad_norm=False,
+    skip_nonfinite=False, audit=True
 ) -> EntryProgram:
     import dataclasses as dc
 
@@ -112,7 +115,8 @@ def _train_like(
     def ensure():
         if not built:
             built["v"] = _train_state_and_step(
-                mesh, zero1_axis=zero1_axis, with_grad_norm=with_grad_norm
+                mesh, zero1_axis=zero1_axis, with_grad_norm=with_grad_norm,
+                skip_nonfinite=skip_nonfinite,
             )
         return built["v"]
 
@@ -377,6 +381,17 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         # whose global-norm epilogue adds collectives — its own golden,
         # or fit(contract=..., watchdog=...) could never launch.
         _train_like("train_step_gn", with_grad_norm=True, audit=False),
+        # The resilience regime: fit(resilience=...) compiles the
+        # on-device non-finite guard (update gated by
+        # isfinite(loss + grad_norm) selects). The guard is supposed to
+        # add NO collectives over train_step_gn, but XLA's layout/CSE
+        # differs slightly once the selects are in — its own golden pins
+        # the actual program, so fit(contract=, resilience=) launches
+        # against what it really runs.
+        _train_like(
+            "train_step_skip", with_grad_norm=True, skip_nonfinite=True,
+            audit=False,
+        ),
         _train_like("zero1_update", zero1_axis="data"),
         _zero1_q8(),
         *_serving_programs(),

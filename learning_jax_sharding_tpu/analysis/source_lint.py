@@ -24,6 +24,13 @@ structure matters:
   ``perf_counter`` call) with no honest sync idiom within ±10 lines:
   times dispatch, not execution (the reference's original flaw,
   case6_attention.py:234-238).
+* ``swallowed-exception`` — a bare ``except:`` that does not re-raise,
+  or an ``except Exception/BaseException:`` whose body is only
+  ``pass``/``...``: the failure vanishes without a record — in a
+  recovery-oriented stack (``robustness/``) every swallowed exception
+  is a fault the flight recorder never saw. Catch the narrowest type
+  and at least ``recorder.record(...)`` it; genuinely-intentional
+  crash-path guards ride the baseline with a reason.
 
 Findings carry ``file:line`` and a stable rule id; pre-existing hits are
 carried in ``analysis/baseline.json`` — a (file, rule) → count budget —
@@ -228,6 +235,47 @@ class _Visitor(ast.NodeVisitor):
                     "list/dict default raises `unhashable type` on "
                     "first use (use a tuple/frozen value)",
                 ))
+
+    # --- swallowed exceptions: failures that leave no trace -------------
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        def is_noop(stmt):
+            return isinstance(stmt, ast.Pass) or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is ...
+            )
+
+        reraises = any(
+            isinstance(s, ast.Raise) for s in ast.walk(node)
+        )
+        if node.type is None:
+            if not reraises:
+                self.findings.append(Finding(
+                    "ast", "swallowed-exception",
+                    f"{self.path}:{node.lineno}",
+                    "bare `except:` without a re-raise — catches "
+                    "everything (including KeyboardInterrupt/SystemExit) "
+                    "and the failure leaves no trace; catch the "
+                    "narrowest type and record the error",
+                ))
+        else:
+            broad = {
+                _dotted(n)
+                for n in (
+                    node.type.elts
+                    if isinstance(node.type, ast.Tuple) else [node.type]
+                )
+            } & {"Exception", "BaseException"}
+            if broad and all(is_noop(s) for s in node.body):
+                self.findings.append(Finding(
+                    "ast", "swallowed-exception",
+                    f"{self.path}:{node.lineno}",
+                    f"`except {'/'.join(sorted(broad))}: pass` — the "
+                    "failure vanishes without a record; catch the "
+                    "narrowest type and at least record it to the "
+                    "flight recorder",
+                ))
+        self.generic_visit(node)
 
     def _check_captures(self, node):
         params = {

@@ -107,9 +107,37 @@ from learning_jax_sharding_tpu.models.transformer import (
     TransformerConfig,
 )
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
+from learning_jax_sharding_tpu.robustness.chaos import InjectedFault, chaos_hook
 from learning_jax_sharding_tpu.telemetry import MetricsRegistry, Tracer
 from learning_jax_sharding_tpu.telemetry.compile_watch import cache_size
 from learning_jax_sharding_tpu.utils.profiling import annotate
+
+#: Dispatch failures the engine RECOVERS from (quarantine/requeue)
+#: instead of propagating: the chaos harness's injected faults and the
+#: NaN-trap FloatingPointError a checking()-style dispatch raises. Real
+#: infrastructure errors (OOM, XLA internal) still propagate — recovery
+#: must never guess.
+_RECOVERABLE_DISPATCH = (InjectedFault, FloatingPointError)
+
+
+class AdmissionError(RuntimeError):
+    """Admission control rejected the request (bounded queue full, or
+    the degradation ladder reached its shedding level). The caller
+    should back off / retry elsewhere — nothing was enqueued."""
+
+
+@dataclasses.dataclass
+class RequestFailure:
+    """A request that retired WITHOUT completing, surfaced through
+    ``pop_finished`` so failures are a terminal status, never a silent
+    drop. ``tokens`` carries the partial ``[prompt, generated...]``
+    output when the request had been admitted (None when it failed in
+    the queue)."""
+
+    rid: int
+    status: str                      # deadline|poisoned|malformed|shutdown
+    error: str | None = None
+    tokens: np.ndarray | None = None
 
 
 def _reset_rows(
@@ -147,6 +175,10 @@ class _Request:
     first_token_t: float | None = None
     finish_t: float | None = None
     tokens: np.ndarray | None = None      # final [prompt, generated...]
+    status: str = "ok"                    # or deadline|poisoned|malformed|shutdown
+    error: str | None = None
+    deadline_s: float | None = None       # per-request TTL override
+    strikes: int = 0                      # dispatch faults while admitted
 
 
 class ContinuousEngine:
@@ -340,6 +372,37 @@ class ContinuousEngine:
     burn-rate targets, exported through the engine registry); and
     ``collective_axis_volume()`` attributes each program's collective
     bytes to the mesh axes that carry them.
+
+    RECOVERY (round 10): detection is wired to action —
+
+    * ``deadline_s`` (engine default, per-request override on
+      ``add_request``): a request older than its TTL is EVICTED with
+      terminal status ``"deadline"`` — queued or mid-flight — and
+      surfaced through ``pop_finished`` as a :class:`RequestFailure`
+      (partial tokens included), never a silent drop.
+    * ``max_queue``: bounded admission — an arrival past the bound is
+      SHED (:class:`AdmissionError`, nothing enqueued), so backpressure
+      reaches the frontend instead of growing an unbounded queue whose
+      every entry will miss its SLO together.
+    * ``degradation=``
+      :class:`~learning_jax_sharding_tpu.robustness.DegradationLadder`
+      (requires ``slo=``): the monitor's burn rate walks disable
+      speculation → halve ``token_budget`` → shed admits, with
+      hysteresis; every transition lands in the flight recorder and the
+      ``engine_degradation_level`` gauge. De-escalation restores the
+      knobs it took over.
+    * poison quarantine (``max_dispatch_strikes``): a dispatch that
+      raises a recoverable fault (injected NaN-trap/hang-watchdog abort
+      — see :mod:`~learning_jax_sharding_tpu.robustness.chaos`) strikes
+      every involved request; repeat offenders are FAILED
+      (``"poisoned"``) and isolated, the rest are requeued and
+      re-admitted one at a time (probation) so the poison trips alone —
+      then recomputed exactly (the ``_unadmit`` recompute-preemption
+      guarantee), so survivors' outputs are bit-identical to a
+      fault-free run (test-pinned).
+    * ``close()`` drains: every in-flight/queued request gets terminal
+      status ``"shutdown"`` before the device state drops — callers
+      polling ``pop_finished`` always terminate. Idempotent.
     """
 
     def __init__(
@@ -373,7 +436,25 @@ class ContinuousEngine:
         tracer: Optional[Tracer] = None,
         slo: Any | None = None,
         recorder: Any | None = None,
+        deadline_s: float | None = None,
+        max_queue: int | None = None,
+        degradation: Any | None = None,
+        max_dispatch_strikes: int = 2,
     ):
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_dispatch_strikes < 1:
+            raise ValueError(
+                f"max_dispatch_strikes must be >= 1, got "
+                f"{max_dispatch_strikes}"
+            )
+        if degradation is not None and slo is None:
+            raise ValueError(
+                "degradation needs slo=SLOMonitor(...): the ladder is "
+                "driven by the monitor's burn rate"
+            )
         if batch_size < 1 or refill_chunk < 1 or decode_block_steps < 1:
             raise ValueError(
                 "batch_size, refill_chunk, decode_block_steps must be >= 1"
@@ -900,6 +981,16 @@ class ContinuousEngine:
         )
         self._num_draft = num_draft
         self._speculative = speculative
+        # Recovery policies (round 10): request TTLs, admission control,
+        # the burn-rate degradation ladder, and poison quarantine.
+        self._deadline_s = deadline_s
+        self._any_req_deadline = False
+        self._max_queue = max_queue
+        self._ladder = degradation
+        self._max_strikes = max_dispatch_strikes
+        self._spec_disabled = False
+        self._shed_all = False
+        self._base_budget: int | None = None
         self._paged = paged
         self._paged_pages = paged_pages
         self._page_size = page_size
@@ -935,6 +1026,7 @@ class ContinuousEngine:
         self._last_first_refill_args = None
         self._last_refill_args = None
         self._last_decode_args = None
+        self._last_decode_plain_args = None   # degraded-spec decode_block
         self._last_mixed_args = None
         self._init_telemetry(registry, tracer, slo, recorder)
         self._init_slots()
@@ -1021,6 +1113,25 @@ class ContinuousEngine:
             "behind another slot's refill")
         self._c_creations = r.counter(
             "engine_cache_creations_total", "cache-creating first refills")
+        self._c_shed = r.counter(
+            "engine_shed_total",
+            "arrivals rejected by admission control (bounded queue or "
+            "degradation-ladder shedding)")
+        self._c_deadline = r.counter(
+            "engine_deadline_evictions_total",
+            "requests failed by their TTL deadline (queued or in-flight)")
+        self._c_quarantined = r.counter(
+            "engine_quarantined_total",
+            "requests failed as poison after repeated dispatch faults")
+        self._c_dispatch_faults = r.counter(
+            "engine_dispatch_faults_total",
+            "dispatches aborted by a recoverable fault")
+        self._c_req_failed = r.counter(
+            "engine_requests_failed_total",
+            "requests retired with a non-ok terminal status")
+        self._g_degraded = r.gauge(
+            "engine_degradation_level",
+            "current graceful-degradation ladder level (0 = normal)")
         self._g_queue = r.gauge(
             "engine_queue_depth", "requests waiting for a slot")
         self._g_active = r.gauge(
@@ -1104,6 +1215,8 @@ class ContinuousEngine:
                 self._c_preempt, self._c_pfx_hits, self._c_pfx_pages,
                 self._c_spec_acc, self._c_spec_prop, self._c_refill_s,
                 self._c_decode_s, self._c_mixed_s, self._c_stall_s,
+                self._c_requests, self._c_finished, self._c_shed,
+                self._c_deadline, self._c_req_failed,
             )
         }
         # Window high-water for the page-pool gauge (live value rides on).
@@ -1122,26 +1235,36 @@ class ContinuousEngine:
             self._init_pool()
 
     def close(self):
-        """Drop the engine's device state (KV cache + page pool) so HBM
-        can be reclaimed between bursts — the persistent engine otherwise
-        pins its caches for the object's lifetime. Requires an IDLE
-        engine (in-flight requests depend on the cache being dropped);
-        completed-but-unpopped results are host-side and survive. The
-        engine stays usable: the next dispatch re-creates the cache
-        (``cache_creations`` increments). The prefix registry is cleared
-        too — its retained K/V lived in the dropped arrays."""
-        if self.has_work():
-            raise RuntimeError(
-                "close() requires an idle engine: in-flight requests "
-                "depend on the cache being dropped"
+        """Shut the engine down to idle: every in-flight or queued
+        request is DRAINED TO A TERMINAL STATUS (``"shutdown"`` — a
+        :class:`RequestFailure` with any partial tokens, surfaced
+        through ``pop_finished``; never a silent drop a caller would
+        poll forever), then the device state (KV cache + page pool +
+        prefix registry) is released so HBM can be reclaimed.
+        IDEMPOTENT: closing an idle/closed engine is a no-op beyond the
+        state drop. Completed-but-unpopped results are host-side and
+        survive. The engine stays usable: the next dispatch re-creates
+        the cache (``cache_creations`` increments)."""
+        now = time.perf_counter()
+        for slot in range(self._b):
+            if self._slot_req[slot] is not None:
+                self._fail_slot(slot, "shutdown", "engine closed", now)
+        while self._queue:
+            self._fail_request(
+                self._queue.popleft(), "shutdown",
+                "engine closed before admission", now=now,
             )
+        self._g_queue.set(0)
+        self._g_active.set(0)
         self._cache = None
         self._cast_src = self._cast_out = None
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
+        self._last_decode_plain_args = None
         self._last_mixed_args = None
         if self._paged:
             self._init_pool()
+        self.recorder.record("engine.close")
 
     def flush_prefix_cache(self):
         """Drop EVERY retained prefix page — call between checkpoints:
@@ -1169,6 +1292,10 @@ class ContinuousEngine:
     # --- page allocator ----------------------------------------------------
 
     def _take_page(self):
+        # Chaos seam: kind="oom" raises this allocator's own
+        # RuntimeError, driving the recompute-preemption backpressure
+        # path without actually draining the pool.
+        chaos_hook("engine.page_alloc", free=len(self._free_pages))
         if self._free_pages:
             return self._free_pages.pop()
         if self._cached_lru:
@@ -1346,16 +1473,48 @@ class ContinuousEngine:
         # swap. Drop them; the next dispatch re-captures.
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
+        self._last_decode_plain_args = None
         self._last_mixed_args = None
         return out
 
-    def add_request(self, prompt, *, rid: int | None = None) -> int:
+    def add_request(
+        self, prompt, *, rid: int | None = None,
+        deadline_s: float | None = None,
+    ) -> int:
         """Enqueue one request (the arrival process). Returns its id —
         the key ``pop_finished()`` will report it under, and (at
         ``temperature > 0``) the identity its sampling streams are keyed
-        by. Admission happens inside a later ``step()``."""
+        by. Admission happens inside a later ``step()``.
+
+        ``deadline_s`` overrides the engine's default TTL for this
+        request (arrival-to-retirement; exceeded → failed with status
+        ``"deadline"``). Raises :class:`AdmissionError` when admission
+        control sheds the arrival (queue at ``max_queue``, or the
+        degradation ladder at its shedding level) — nothing is
+        enqueued, so the caller can back off.
+        """
         p = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_prompt(p)
+        if self._shed_all or (
+            self._max_queue is not None
+            and len(self._queue) >= self._max_queue
+        ):
+            self._c_shed.inc()
+            why = (
+                "degradation ladder is shedding"
+                if self._shed_all
+                else f"queue full ({self._max_queue})"
+            )
+            self.recorder.record(
+                "engine.shed", reason=why, queue_depth=len(self._queue),
+            )
+            raise AdmissionError(f"request shed: {why}")
+        if deadline_s is not None:
+            if deadline_s <= 0:
+                raise ValueError(
+                    f"deadline_s must be > 0, got {deadline_s}"
+                )
+            self._any_req_deadline = True
         if rid is None:
             rid = self._next_rid
             self._next_rid += 1
@@ -1371,7 +1530,10 @@ class ContinuousEngine:
                 raise ValueError(f"request id {rid} already in use")
             self._next_rid = max(self._next_rid, rid + 1)
         self._queue.append(
-            _Request(rid=rid, prompt=p, arrival_t=time.perf_counter())
+            _Request(
+                rid=rid, prompt=p, arrival_t=time.perf_counter(),
+                deadline_s=deadline_s,
+            )
         )
         self._c_requests.inc()
         self._g_queue.set(len(self._queue))
@@ -1387,10 +1549,22 @@ class ContinuousEngine:
     def has_work(self) -> bool:
         return bool(self._queue) or any(r >= 0 for r in self._req)
 
-    def pop_finished(self) -> dict[int, np.ndarray]:
-        """Collect every request completed since the last pop:
-        ``{rid: [prompt, generated...]}``."""
-        fin = {rid: r.tokens for rid, r in self._finished.items()}
+    def pop_finished(self) -> dict[int, Any]:
+        """Collect every request RETIRED since the last pop. Completed
+        requests map to their ``[prompt, generated...]`` token array;
+        requests that hit a recovery policy (deadline TTL, poison
+        quarantine, malformed admission, ``close()``) map to a
+        :class:`RequestFailure` carrying the terminal status and any
+        partial tokens — an error is a result, never a silent drop."""
+        fin = {
+            rid: (
+                r.tokens if r.status == "ok"
+                else RequestFailure(
+                    rid=rid, status=r.status, error=r.error, tokens=r.tokens,
+                )
+            )
+            for rid, r in self._finished.items()
+        }
         self._finished = {}
         return fin
 
@@ -1451,6 +1625,123 @@ class ContinuousEngine:
         if self._paged:
             self._release(slot)
 
+    def _fail_request(self, r, status, error, *, now=None, tokens=None):
+        """Retire ``r`` with a terminal non-ok status: surfaced through
+        ``pop_finished`` as a :class:`RequestFailure` — the recovery
+        policies' one exit path (deadline, quarantine, malformed,
+        shutdown)."""
+        now = time.perf_counter() if now is None else now
+        r.status = status
+        r.error = error
+        r.finish_t = now
+        if tokens is not None:
+            r.tokens = tokens
+        self._c_req_failed.inc()
+        self.recorder.record(
+            "engine.request_failed", rid=r.rid, status=status, error=error,
+        )
+        if r.admit_t is not None:
+            # async_begin was issued at first admission; close the span
+            # so the trace shows the failed request's full lifetime.
+            self.tracer.async_end("request", r.rid, status=status)
+        self._finished[r.rid] = r
+
+    def _fail_slot(self, slot, status, error, now=None):
+        """Fail the request occupying ``slot`` and free the slot — the
+        in-flight arm of :meth:`_fail_request` (partial output kept:
+        the caller sees how far the request got)."""
+        r = self._slot_req[slot]
+        self._fail_request(
+            r, status, error, now=now,
+            tokens=np.asarray(self._out[slot], np.int32),
+        )
+        if self._paged:
+            # Never register a failed request's pages: a deadline/poison
+            # eviction can land mid-prefill, with pages partially written.
+            self._release(slot, register=False)
+        self._slot_req[slot] = None
+        self._req[slot] = -1
+        self._active[slot] = False
+        self._pending[slot] = np.zeros((0,), np.int32)
+        self._needs_reset[slot] = False
+        self._reset_to[slot] = 0
+
+    def _sweep_deadlines(self):
+        """TTL eviction: fail every queued or in-flight request whose
+        age exceeds its deadline (per-request ``deadline_s`` override,
+        else the engine default). Skipped in O(1) when no deadline is
+        configured anywhere."""
+        if self._deadline_s is None and not self._any_req_deadline:
+            return
+        if self._deadline_s is None:
+            # Engine-level TTL off: the sweep exists only for per-request
+            # deadlines. Re-arm the O(1) skip once none remain live —
+            # one early request with a TTL must not tax every later step
+            # of the engine's lifetime.
+            if not any(
+                r.deadline_s is not None for r in self._queue
+            ) and not any(
+                r is not None and r.deadline_s is not None
+                for r in self._slot_req
+            ):
+                self._any_req_deadline = False
+                return
+        now = time.perf_counter()
+
+        def expired(r):
+            dl = r.deadline_s if r.deadline_s is not None else self._deadline_s
+            return dl is not None and now - r.arrival_t > dl
+
+        if any(expired(r) for r in self._queue):
+            keep = deque()
+            for r in self._queue:
+                if expired(r):
+                    self._c_deadline.inc()
+                    self._fail_request(
+                        r, "deadline", "deadline exceeded in queue", now=now,
+                    )
+                else:
+                    keep.append(r)
+            self._queue = keep
+            self._g_queue.set(len(self._queue))
+        for slot in range(self._b):
+            r = self._slot_req[slot]
+            if r is not None and expired(r):
+                self._c_deadline.inc()
+                self._fail_slot(
+                    slot, "deadline", "deadline exceeded in flight", now,
+                )
+
+    def _on_dispatch_fault(self, e):
+        """A dispatch raised a RECOVERABLE fault (injected NaN-trap /
+        hang-watchdog abort). Every involved request earns a strike;
+        requests at ``max_dispatch_strikes`` are FAILED as poison, the
+        rest are requeued (recompute preemption — exact, see
+        ``_unadmit``) and re-admitted ONE AT A TIME (probation, see
+        ``_admit``) so the poison trips alone instead of striking its
+        batchmates to death. The engine's device state needs no repair:
+        re-admission resets every per-row counter."""
+        self._c_dispatch_faults.inc()
+        self.recorder.record(
+            "engine.dispatch_fault",
+            error=type(e).__name__, message=str(e),
+            rids=[r for r in self._req if r >= 0],
+        )
+        now = time.perf_counter()
+        for slot in range(self._b):
+            r = self._slot_req[slot]
+            if r is None:
+                continue
+            r.strikes += 1
+            if r.strikes >= self._max_strikes:
+                self._c_quarantined.inc()
+                self.recorder.record(
+                    "engine.quarantine", rid=r.rid, strikes=r.strikes,
+                )
+                self._fail_slot(slot, "poisoned", str(e), now)
+            else:
+                self._unadmit(slot)
+
     def _consume(self, slot, tokens, now, retired):
         # Append a decode dispatch's tokens for one slot; retire at
         # EOS or budget — ONE copy of the retirement rule for both
@@ -1495,12 +1786,62 @@ class ContinuousEngine:
         self._needs_reset[slot] = False
         self._reset_to[slot] = 0
 
+    def _admission_ok(self, p: np.ndarray) -> str | None:
+        """Cheap admission-time re-validation: the queue is not trusted
+        between ``add_request`` and admission — a frontend race (or the
+        chaos harness) can corrupt a queued prompt, and a malformed
+        prompt must FAIL THE REQUEST, not wedge the slot or crash the
+        scheduler. Shape/dtype here; sequence budgets via THE validator
+        (``_validate_prompt`` — target AND draft configs), so the two
+        paths cannot drift."""
+        if p.ndim != 1 or p.dtype.kind not in "iu":
+            return f"malformed prompt (shape {p.shape}, dtype {p.dtype})"
+        try:
+            self._validate_prompt(p)
+        except ValueError as e:
+            return str(e)
+        return None
+
+    def _pop_admittable(self):
+        """The next request to admit, honoring PROBATION: while any
+        request carries dispatch strikes, suspects are re-admitted ONE
+        AT A TIME into an otherwise idle engine (so a poison request
+        trips its fault alone and its former batchmates are never
+        struck to quarantine alongside it), and nothing else admits
+        until the suspects are cleared (completed or failed)."""
+        if any(
+            r is not None and r.strikes > 0 for r in self._slot_req
+        ):
+            return None             # a suspect is live: solo probation
+        si = next(
+            (i for i, r in enumerate(self._queue) if r.strikes > 0), None
+        )
+        if si is None:
+            return self._queue.popleft()
+        if any(q >= 0 for q in self._req):
+            return None             # wait for idle before the next suspect
+        r = self._queue[si]
+        del self._queue[si]
+        return r
+
     def _admit(self):
         b = self._b
         now = time.perf_counter()
         for slot in range(b):
             if self._req[slot] < 0 and self._queue:
-                r = self._queue.popleft()
+                r = self._pop_admittable()
+                if r is None:
+                    break
+                r.prompt = np.asarray(
+                    chaos_hook("engine.admit", value=r.prompt, rid=r.rid)
+                )
+                bad = self._admission_ok(r.prompt)
+                if bad is not None:
+                    self.recorder.record(
+                        "engine.malformed", rid=r.rid, error=bad,
+                    )
+                    self._fail_request(r, "malformed", bad, now=now)
+                    continue
                 # A preempted request keeps its first admission time (and
                 # counts its prefix hit once — re-admission re-maps the
                 # same pages, not new savings).
@@ -1580,6 +1921,10 @@ class ContinuousEngine:
                     lengths[slot] = n
             if not lengths.any():
                 break
+            chaos_hook(
+                "engine.dispatch", phase="refill",
+                rids=[r for r in self._req if r >= 0],
+            )
             if self._paged:
                 for slot in range(b):
                     if lengths[slot]:
@@ -1723,6 +2068,14 @@ class ContinuousEngine:
         if not self._active.any():
             return False
         b = self._b
+        # Degradation level 1 turns the draft-verify rounds off: the
+        # SPEC engine decodes through the plain decode_block (its own
+        # target apply — the same program a non-spec engine runs, so it
+        # checks against the plain ``decode_step`` golden). The draft
+        # cache sits idle; on re-enable its stale K/V only costs
+        # acceptance rate, never correctness — the verifier decides
+        # every emitted token.
+        spec = self._speculative and not self._spec_disabled
         remaining = np.asarray(
             [max(0, self._max_new - e) for e in self._emitted], np.int32
         )
@@ -1734,9 +2087,13 @@ class ContinuousEngine:
         # priced) no-op blocks.
         worst = int(remaining[self._active].max())
         per_block = self._block_steps * (
-            (self._num_draft + 1) if self._speculative else 1
+            (self._num_draft + 1) if spec else 1
         )
         chain = min(self.decode_chain, -(-worst // per_block))
+        chaos_hook(
+            "engine.dispatch", phase="decode",
+            rids=[r for r in self._req if r >= 0],
+        )
         if self._paged:
             # Cover every position this chain can write: chain·K new
             # tokens per row (plain), or chain·K rounds of up to
@@ -1746,7 +2103,7 @@ class ContinuousEngine:
                 if not self._active[slot]:
                     continue
                 pos_s = self._plen[slot] + self._emitted[slot] - 1
-                if self._speculative:
+                if spec:
                     span = (
                         min(
                             int(remaining[slot]),
@@ -1783,7 +2140,7 @@ class ContinuousEngine:
         active_d = jnp.asarray(self._active.astype(np.int32))
         remaining_d = jnp.asarray(remaining)
         rid = self._rid_arr()
-        if self._speculative:
+        if spec:
             # Each row's current cache index: prompt + emitted - 1 (its
             # pending token is not yet in the cache).
             pos_d = jnp.asarray(
@@ -1831,12 +2188,18 @@ class ContinuousEngine:
                             now, retired,
                         )
         else:
+            if self._speculative:
+                # Degraded: advance the TARGET cache only; the idle
+                # draft cache rides along untouched.
+                cache, d_cache = self._cache
+            else:
+                cache, d_cache = self._cache, None
             segs = []
             for _ in range(chain):
                 with annotate("engine.decode_block"):
-                    toks, active_d, remaining_d, self._cache = (
+                    toks, active_d, remaining_d, cache = (
                         self._decode_block_fn(
-                            params, self._cache, tok_d, active_d,
+                            params, cache, tok_d, active_d,
                             remaining_d, rid, self.rng,
                         )
                     )
@@ -1844,10 +2207,18 @@ class ContinuousEngine:
                 # (frozen rows repeat their token — correct carry).
                 tok_d = toks[:, -1]
                 segs.append(toks)
-            self._last_decode_args = lambda: (
-                params, self._cache, tok_d, active_d, remaining_d, rid,
-                self.rng,
-            )
+            if self._speculative:
+                self._cache = (cache, d_cache)
+                self._last_decode_plain_args = lambda: (
+                    params, self._cache[0], tok_d, active_d, remaining_d,
+                    rid, self.rng,
+                )
+            else:
+                self._cache = cache
+                self._last_decode_args = lambda: (
+                    params, self._cache, tok_d, active_d, remaining_d,
+                    rid, self.rng,
+                )
             segs = [np.asarray(t) for t in segs]   # ONE sync
             now = time.perf_counter()
             was_active = self._active.copy()
@@ -1927,6 +2298,25 @@ class ContinuousEngine:
                 else False
             )
         b = self._b
+        if self._speculative and self._spec_disabled:
+            # Degradation level >= 1 on a speculative MIXED engine: run
+            # the SPLIT programs (refill_step still prefills the draft
+            # cache, so re-enabling speculation stays sound; decode runs
+            # the plain decode_block via _decode_dispatch's degraded
+            # path). Everything dispatched here is an already-known
+            # program family — an overload incident must not trigger
+            # fresh compiles of a one-off fused variant.
+            if any(p.size for p in self._pending):
+                return (
+                    "refill"
+                    if self._refill_dispatch(params, d_params, retired)
+                    else False
+                )
+            return (
+                "decode"
+                if self._decode_dispatch(params, d_params, retired)
+                else False
+            )
         if not any(p.size for p in self._pending):
             # PURE-DECODE phase: nothing to fuse — run the K-token decode
             # block (full decode throughput; a fused link costs one
@@ -2019,6 +2409,10 @@ class ContinuousEngine:
                 )
             )
             t_cache, d_cache = self._cache
+        chaos_hook(
+            "engine.dispatch", phase="mixed",
+            rids=[r for r in self._req if r >= 0],
+        )
         segs = []
         starved_total = 0
         refill_scheduled = 0
@@ -2152,6 +2546,46 @@ class ContinuousEngine:
                     self._consume(slot, toks, now, retired)
         return "mixed"
 
+    @property
+    def degradation_level(self) -> int:
+        """Current graceful-degradation level (0 when no ladder is
+        attached): 0 normal, 1 speculation off, 2 reduced
+        ``token_budget``, 3 shedding new admits."""
+        return self._ladder.level if self._ladder is not None else 0
+
+    def _apply_degradation(self):
+        """Feed the SLO burn rate into the attached ladder and apply a
+        level change to the engine's runtime knobs. The levers are the
+        SAME public knobs an operator can turn (``token_budget``), so
+        de-escalation restores the value captured when the ladder took
+        it over, not a constructor constant."""
+        if self._ladder is None or self.slo is None:
+            return
+        burn = max(
+            (self.slo.burn_rate(t.name) for t in self.slo.targets),
+            default=0.0,
+        )
+        prev = self._ladder.level
+        level = self._ladder.update(burn)
+        if level == prev:
+            return
+        if self._speculative:
+            self._spec_disabled = level >= 1
+        if self._mixed:
+            if level >= 2 and self._base_budget is None:
+                self._base_budget = self.token_budget
+                self.token_budget = max(self._b, self.token_budget // 2)
+            elif level < 2 and self._base_budget is not None:
+                self.token_budget = self._base_budget
+                self._base_budget = None
+        self._shed_all = level >= 3
+        self._g_degraded.set(level)
+        self.recorder.record(
+            "engine.degrade", level=level, name=self._ladder.name,
+            burn_rate=burn, spec_disabled=self._spec_disabled,
+            token_budget=self.token_budget, shedding=self._shed_all,
+        )
+
     def step(self, params, draft_params=None) -> list[int]:
         """ONE scheduler iteration: admit queued requests into idle
         slots, then run exactly one dispatch — a refill chunk if any slot
@@ -2167,6 +2601,10 @@ class ContinuousEngine:
         params, d_params = self._cast_params(params, draft_params)
         retired: list[int] = []
         with activate(self._mesh, self._rules):
+            # TTL eviction before admission: an expired queued request
+            # must not take a slot, and an expired in-flight one frees
+            # its slot for this step's admission.
+            self._sweep_deadlines()
             self._admit()
             # Decode-stall accounting: a dispatch "stalls decode" when
             # rows were actively decoding but the dispatch advanced none
@@ -2176,63 +2614,81 @@ class ContinuousEngine:
             # dispatches that parked decode behind refill.
             had_active = bool(self._active.any())
             t0 = time.perf_counter()
-            if self._mixed:
-                # Wall time accrues to the program class that actually
-                # ran: _mixed_dispatch's fallthroughs (cache creation and
-                # speculative pure-refill → "refill", pure-decode block →
-                # "decode") must land in refill_s/decode_s, not mixed_s,
-                # or refill_frac understates refill serialization. A
-                # "refill" here never has active rows (creation precedes
-                # any decode; the spec fallback requires none), so it
-                # cannot stall decode.
-                kind = self._mixed_dispatch(params, d_params, retired)
-                if kind:
+            try:
+                if self._mixed:
+                    # Wall time accrues to the program class that actually
+                    # ran: _mixed_dispatch's fallthroughs (cache creation and
+                    # speculative pure-refill → "refill", pure-decode block →
+                    # "decode") must land in refill_s/decode_s, not mixed_s,
+                    # or refill_frac understates refill serialization. A
+                    # "refill" here CAN hold active decode rows in exactly
+                    # one regime — the degradation ladder's split fallback
+                    # on a speculative engine — and then it stalls decode
+                    # like the split engine's refill does, so it books
+                    # stall time and the SLO stream sees it: the ladder is
+                    # driven by that monitor, and a degraded engine must
+                    # not blind the very telemetry that degraded it.
+                    kind = self._mixed_dispatch(params, d_params, retired)
+                    if kind:
+                        dt = time.perf_counter() - t0
+                        if kind == "refill":
+                            self._c_refill_s.inc(dt)
+                            self._c_refill_n.inc()
+                            if had_active:
+                                self._c_stall_s.inc(dt)
+                                if self.slo is not None:
+                                    self.slo.observe(
+                                        "decode_stall_share", 1.0
+                                    )
+                            self.tracer.complete(
+                                "engine.refill", t0, dt, retired=len(retired)
+                            )
+                        elif kind == "decode":
+                            self._c_decode_s.inc(dt)
+                            self._c_decode_n.inc()
+                            self.tracer.complete(
+                                "engine.decode", t0, dt, retired=len(retired)
+                            )
+                            if had_active and self.slo is not None:
+                                self.slo.observe("decode_stall_share", 0.0)
+                        else:
+                            self._c_mixed_s.inc(dt)
+                            self._c_mixed_n.inc()
+                            self.tracer.complete(
+                                "engine.mixed", t0, dt, retired=len(retired)
+                            )
+                            if had_active and self.slo is not None:
+                                self.slo.observe("decode_stall_share", 0.0)
+                elif self._refill_dispatch(params, d_params, retired):
                     dt = time.perf_counter() - t0
-                    if kind == "refill":
-                        self._c_refill_s.inc(dt)
-                        self._c_refill_n.inc()
-                        self.tracer.complete(
-                            "engine.refill", t0, dt, retired=len(retired)
-                        )
-                    elif kind == "decode":
-                        self._c_decode_s.inc(dt)
-                        self._c_decode_n.inc()
-                        self.tracer.complete(
-                            "engine.decode", t0, dt, retired=len(retired)
-                        )
-                        if had_active and self.slo is not None:
-                            self.slo.observe("decode_stall_share", 0.0)
-                    else:
-                        self._c_mixed_s.inc(dt)
-                        self._c_mixed_n.inc()
-                        self.tracer.complete(
-                            "engine.mixed", t0, dt, retired=len(retired)
-                        )
-                        if had_active and self.slo is not None:
-                            self.slo.observe("decode_stall_share", 0.0)
-            elif self._refill_dispatch(params, d_params, retired):
-                dt = time.perf_counter() - t0
-                self._c_refill_s.inc(dt)
-                self._c_refill_n.inc()
-                if had_active:
-                    self._c_stall_s.inc(dt)
-                    if self.slo is not None:
-                        self.slo.observe("decode_stall_share", 1.0)
-                self.tracer.complete(
-                    "engine.refill", t0, dt, retired=len(retired)
-                )
-            elif self._decode_dispatch(params, d_params, retired):
-                # Only DISPATCHED time accrues: an idle poll (streaming
-                # drivers spin step() between arrivals) must not drown
-                # the refill/decode split.
-                dt = time.perf_counter() - t0
-                self._c_decode_s.inc(dt)
-                self._c_decode_n.inc()
-                if had_active and self.slo is not None:
-                    self.slo.observe("decode_stall_share", 0.0)
-                self.tracer.complete(
-                    "engine.decode", t0, dt, retired=len(retired)
-                )
+                    self._c_refill_s.inc(dt)
+                    self._c_refill_n.inc()
+                    if had_active:
+                        self._c_stall_s.inc(dt)
+                        if self.slo is not None:
+                            self.slo.observe("decode_stall_share", 1.0)
+                    self.tracer.complete(
+                        "engine.refill", t0, dt, retired=len(retired)
+                    )
+                elif self._decode_dispatch(params, d_params, retired):
+                    # Only DISPATCHED time accrues: an idle poll (streaming
+                    # drivers spin step() between arrivals) must not drown
+                    # the refill/decode split.
+                    dt = time.perf_counter() - t0
+                    self._c_decode_s.inc(dt)
+                    self._c_decode_n.inc()
+                    if had_active and self.slo is not None:
+                        self.slo.observe("decode_stall_share", 0.0)
+                    self.tracer.complete(
+                        "engine.decode", t0, dt, retired=len(retired)
+                    )
+            except _RECOVERABLE_DISPATCH as e:
+                # Poison-request quarantine: strike every involved
+                # request, fail the repeat offenders, requeue the rest
+                # for probationary (solo) recompute — see
+                # _on_dispatch_fault. Infrastructure errors propagate.
+                self._on_dispatch_fault(e)
+            self._apply_degradation()
         self._g_active.set(int(self._active.sum()))
         self._g_queue.set(len(self._queue))
         return retired
@@ -2274,6 +2730,24 @@ class ContinuousEngine:
             # the number the mixed engine exists to drive to ~0.
             decode_stall_s=stall_s,
             decode_stall_share=(stall_s / busy) if busy else None,
+        )
+        # Recovery-policy telemetry (round 10), window-derived like the
+        # rest: shed_rate is the fraction of ARRIVALS admission control
+        # rejected; deadline_miss_rate the fraction of RETIREMENTS that
+        # were TTL evictions — both gated direction-aware by
+        # scripts/bench_compare.py so robustness hooks can't silently
+        # regress the serving trajectory.
+        shed = self._win_delta(self._c_shed)
+        offered = self._win_delta(self._c_requests) + shed
+        done = (
+            self._win_delta(self._c_finished)
+            + self._win_delta(self._c_req_failed)
+        )
+        dl = self._win_delta(self._c_deadline)
+        out.update(
+            shed_rate=(shed / offered) if offered else 0.0,
+            deadline_miss_rate=(dl / done) if done else 0.0,
+            failed=int(self._win_delta(self._c_req_failed)),
         )
         return out
 
@@ -2320,6 +2794,10 @@ class ContinuousEngine:
         }
         if self._speculative:
             fns["decode_block_spec"] = self._decode_block_spec_fn
+            if self._last_decode_plain_args is not None:
+                # The degradation ladder's plain decode path has
+                # dispatched: its executable cache is a live program too.
+                fns["decode_block"] = self._decode_block_fn
         else:
             fns["decode_block"] = self._decode_block_fn
         if self._mixed:
@@ -2353,6 +2831,14 @@ class ContinuousEngine:
             else:
                 fn, name = self._decode_block_fn, "decode_block"
             out.append((name, fn, self._last_decode_args()))
+        if self._last_decode_plain_args is not None:
+            # The degradation ladder's target-only decode on a SPEC
+            # engine — the same program a plain engine runs, visible to
+            # the contract pass under the plain ``decode_step`` golden.
+            out.append((
+                "decode_block", self._decode_block_fn,
+                self._last_decode_plain_args(),
+            ))
         if self._last_mixed_args is not None:
             fn = (
                 self._spec_mixed_step_fn if self._speculative
@@ -2413,6 +2899,13 @@ class ContinuousEngine:
 
     def contract_name(self, program: str) -> str:
         base = self.CONTRACT_NAMES.get(program, program)
+        if program == "decode_block":
+            # The plain decode program keeps its plain golden even on a
+            # speculative engine: the degradation ladder dispatches it
+            # with the target cache only, and it compiles to the same
+            # HLO a non-speculative engine's decode_block does — no new
+            # steady-state program beyond the documented set.
+            return base
         return f"spec_{base}" if self._speculative else base
 
     def check_contracts(self, golden_dir):
@@ -2511,10 +3004,20 @@ class ContinuousEngine:
                 # state (and the registry — partial writes may alias it).
                 self.reset()
                 self._finished = stash
-        results = [
-            np.asarray(self._finished.pop(i).tokens, np.int32)
-            for i in range(len(prompts))
-        ]
+        results = []
+        for i in range(len(prompts)):
+            r = self._finished.pop(i)
+            if r.status == "ok":
+                results.append(np.asarray(r.tokens, np.int32))
+            else:
+                # Recovery policies can retire a request WITHOUT
+                # completing it (deadline TTL, poison quarantine,
+                # malformed) — its queue-order slot carries the terminal
+                # status instead of tokens, never a silent gap.
+                results.append(RequestFailure(
+                    rid=r.rid, status=r.status, error=r.error,
+                    tokens=r.tokens,
+                ))
         self._finished = stash
         return results
 

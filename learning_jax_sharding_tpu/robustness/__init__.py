@@ -1,0 +1,57 @@
+"""Fault injection and end-to-end recovery policies.
+
+PR 2 built the *detection* half (watchdogs, flight recorder, SLO burn
+rates) and ``training/checkpoint.py`` the *persistence* half; nothing
+connected detection to recovery, and nothing could PROVE recovery works.
+This package closes the loop:
+
+* :mod:`.chaos` — a deterministic fault-injection harness. Faults fire
+  at named seam points (``chaos_hook`` calls compiled into
+  ``models/serving.py``, ``training/loop.py``,
+  ``training/checkpoint.py``) on exact invocation indices, so every
+  chaos run is reproducible; each injection is logged to the PR-2
+  flight recorder next to the recovery events it provokes.
+* :mod:`.policies` — the serving graceful-degradation ladder
+  (:class:`DegradationLadder`): SLO burn rate drives a hysteresis
+  state machine over disable-speculation → shrink ``token_budget`` →
+  shed new admits.
+* :mod:`.recovery` — training-side recovery configuration
+  (:class:`ResilienceConfig`) and the preemption signal
+  (:class:`PreemptionError`): non-finite step skip with bounded
+  retries, loss-spike rollback to the last checkpoint, emergency
+  checkpoint on SIGTERM/watchdog trip.
+* :mod:`.matrix` — the end-to-end fault × policy matrix
+  (``run_matrix``), shared by ``tests/test_chaos.py`` (tier-1 gate)
+  and ``scripts/chaos_matrix.py`` (CLI, nonzero exit on any
+  unrecovered cell). NOT imported here: it imports the serving engine,
+  which imports :mod:`.chaos` — importing it at package init would
+  cycle.
+
+The hooks cost one module-global ``None`` check per dispatch when no
+injector is active — measured <2% on the tracked serving-bench latency
+line (PERF.md round 10).
+"""
+
+from learning_jax_sharding_tpu.robustness.chaos import (
+    ChaosInjector,
+    Fault,
+    InjectedFault,
+    chaos_hook,
+    corrupt_latest_checkpoint,
+)
+from learning_jax_sharding_tpu.robustness.policies import DegradationLadder
+from learning_jax_sharding_tpu.robustness.recovery import (
+    PreemptionError,
+    ResilienceConfig,
+)
+
+__all__ = [
+    "ChaosInjector",
+    "DegradationLadder",
+    "Fault",
+    "InjectedFault",
+    "PreemptionError",
+    "ResilienceConfig",
+    "chaos_hook",
+    "corrupt_latest_checkpoint",
+]

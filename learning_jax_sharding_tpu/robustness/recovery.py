@@ -1,0 +1,81 @@
+"""Training-side recovery configuration and the preemption signal.
+
+``training/loop.py::fit(resilience=ResilienceConfig(...))`` turns the
+PR-2 detection layer into action:
+
+* **non-finite step skip** — the train step is compiled with
+  ``skip_nonfinite`` (``training/pipeline.py``): the update is gated ON
+  DEVICE by ``isfinite(loss) & isfinite(grad_norm)``, so a NaN/Inf step
+  can never write corrupted params/optimizer state; the host sees the
+  non-finite loss, records a ``step_skipped`` event, and moves to the
+  next batch. ``max_skips`` bounds CONSECUTIVE skips — a persistent
+  NaN means the state or data is broken, and the run escalates
+  (emergency checkpoint + ``NonFiniteError``) instead of silently
+  spinning.
+* **loss-spike rollback** — a finite loss beyond ``spike_factor`` × the
+  running EMA (the same detector shape as ``telemetry.watchdog``)
+  restores the last retained checkpoint and replays from its step;
+  bounded by ``max_rollbacks``.
+* **emergency checkpoint + preemption-safe resume** — SIGTERM (cloud
+  preemption) sets a flag the loop checks each step: the current state
+  is force-saved, the save is awaited, and :class:`PreemptionError` is
+  raised naming the step. A later ``fit()`` with the same
+  ``checkpoint_dir`` resumes bit-identically (the loader is
+  step-indexed; pinned by the preemption drill in
+  ``tests/test_chaos.py``). The same emergency save runs before a
+  watchdog escalation raises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class PreemptionError(RuntimeError):
+    """``fit()`` was preempted (SIGTERM) and stopped AFTER persisting an
+    emergency checkpoint — re-run with the same ``checkpoint_dir`` to
+    resume bit-identically from ``step``."""
+
+    def __init__(self, step: int, checkpoint_dir: str | None = None):
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+        msg = f"preempted at step {step}"
+        if checkpoint_dir:
+            msg += f" (emergency checkpoint saved under {checkpoint_dir})"
+        super().__init__(msg)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Recovery policy knobs for ``fit(resilience=...)``.
+
+    ``skip_nonfinite`` compiles the guarded step (see module docstring);
+    it implies the grad-norm epilogue and pins its own SPMD contract —
+    the ``train_step_skip`` golden (the guard's selects add no
+    collectives, but the compiled layout differs from ``train_step_gn``
+    enough to deserve its own pin). ``rollback_on_spike`` needs a
+    ``checkpoint_dir`` on the loop config to have anything to roll back
+    to.
+    """
+
+    skip_nonfinite: bool = True
+    max_skips: int = 3               # consecutive non-finite steps tolerated
+    rollback_on_spike: bool = False
+    spike_factor: float = 10.0
+    spike_min_steps: int = 5
+    spike_ema_alpha: float = 0.1
+    max_rollbacks: int = 1
+    emergency_checkpoint: bool = True
+    handle_sigterm: bool = True
+
+    def __post_init__(self):
+        if self.max_skips < 0:
+            raise ValueError(f"max_skips must be >= 0, got {self.max_skips}")
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be > 1, got {self.spike_factor}"
+            )

@@ -62,6 +62,7 @@ class Tracer:
 
         self.enabled = enabled
         self.dropped = 0
+        self.sink_errors = 0   # on_event sink raises (counted, not fatal)
         self._events: "collections.deque[dict]" = collections.deque(
             maxlen=max_events
         )
@@ -90,7 +91,11 @@ class Tracer:
             try:
                 cb(ev)
             except Exception:
-                pass
+                # A raising sink must not take the traced code down with
+                # it — but the failure must not vanish either
+                # (swallowed-exception lint): count it, so a broken
+                # recorder attachment is visible in the tracer's state.
+                self.sink_errors += 1
 
     def _base(self, name: str, ph: str, **extra) -> dict:
         ev = {

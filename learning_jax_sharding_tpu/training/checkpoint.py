@@ -57,6 +57,7 @@ class CheckpointManager:
         *,
         max_to_keep: int = 3,
         save_interval_steps: int = 1,
+        recorder: Any | None = None,
     ):
         self._mgr = ocp.CheckpointManager(
             os.path.abspath(os.fspath(directory)),
@@ -66,6 +67,10 @@ class CheckpointManager:
                 enable_async_checkpointing=True,
             ),
         )
+        # Restore-failure events (corrupt checkpoint → fallback) land in
+        # the flight recorder when one is given — the resume path is
+        # exactly where a post-mortem needs the trail.
+        self._recorder = recorder
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Persist ``state`` at ``step``. Returns False when skipped by the
@@ -83,13 +88,45 @@ class CheckpointManager:
             int(step), args=ocp.args.StandardRestore(as_abstract(like))
         )
 
-    def restore_latest(self, *, like: Any) -> Any | None:
-        """Resume from the newest checkpoint, or None if the directory is
-        empty — callers fall through to their fresh init."""
-        step = self.latest_step()
-        if step is None:
+    def restore_latest(self, *, like: Any, strict: bool = False) -> Any | None:
+        """Resume from the newest RESTORABLE checkpoint, or None if the
+        directory is empty — callers fall through to their fresh init.
+
+        A corrupted/truncated newest step (a preemption mid-write, bit
+        rot) FALLS BACK to the next older retained step instead of
+        killing the resume — that is what retention exists for. Every
+        failed step is recorded (``checkpoint.corrupt`` in the attached
+        flight recorder); if EVERY retained step fails the last error
+        propagates (silently training from step 0 over a broken
+        directory would be worse than crashing). ``strict=True``
+        restores only the newest or raises."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
             return None
-        return self.restore(step, like=like)
+        last_err: Exception | None = None
+        for step in steps:
+            try:
+                restored = self.restore(step, like=like)
+            except Exception as e:
+                last_err = e
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "checkpoint.corrupt", step=step,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                if strict:
+                    raise
+                continue
+            if step != steps[0] and self._recorder is not None:
+                self._recorder.record(
+                    "checkpoint.fallback", restored_step=step,
+                    skipped=[s for s in steps if s > step],
+                )
+            return restored
+        raise RuntimeError(
+            f"every retained checkpoint failed to restore "
+            f"(tried newest-first: {steps})"
+        ) from last_err
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

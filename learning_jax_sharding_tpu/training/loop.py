@@ -135,6 +135,7 @@ def fit(
     heartbeat: Any | None = None,
     recorder: Any | None = None,
     contract: Any | None = None,
+    resilience: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Train ``model`` on ``dataset`` for ``cfg.steps`` steps.
 
@@ -195,7 +196,28 @@ def fit(
             — an accidental weight all-gather should cost one failed
             launch, not a week of a slow hot loop. The findings land in
             the flight recorder/registry first.
+        resilience: optional
+            :class:`~learning_jax_sharding_tpu.robustness.ResilienceConfig`
+            — recovery POLICIES on top of the detection stack: the step
+            compiles with the on-device non-finite guard
+            (``skip_nonfinite`` — a NaN/Inf step cannot write corrupted
+            state; bounded consecutive skips, then escalation), a
+            finite loss beyond the spike EMA optionally ROLLS BACK to
+            the last retained checkpoint and replays, SIGTERM triggers
+            an EMERGENCY CHECKPOINT and raises
+            :class:`~learning_jax_sharding_tpu.robustness.PreemptionError`
+            (re-running with the same ``checkpoint_dir`` resumes
+            bit-identically — the preemption drill pinned in
+            ``tests/test_zero_downtime.py``), and a watchdog escalation
+            saves before it raises. Every action lands in the flight
+            recorder.
     """
+    import math
+    import signal
+    import threading
+
+    from learning_jax_sharding_tpu.robustness.chaos import chaos_hook
+    from learning_jax_sharding_tpu.robustness.recovery import PreemptionError
     from learning_jax_sharding_tpu.telemetry import (
         CompileWatch,
         Tracer,
@@ -244,6 +266,12 @@ def fit(
             # The watchdog needs the grad-norm on device; the step
             # computes it inside the backward's epilogue (no extra sync).
             extra.setdefault("with_grad_norm", True)
+        if resilience is not None and resilience.skip_nonfinite:
+            # The on-device update guard (training/pipeline.py): a
+            # non-finite loss/grad-norm step keeps the old
+            # params/opt_state — forces the grad-norm dict output, so
+            # the host sees WHY a step was skipped.
+            extra.setdefault("skip_nonfinite", True)
         step_fn = make_train_step(
             state_sh, {k: v.sharding for k, v in sample.items()}, mesh,
             rules, loss_fn=loss_fn, **extra,
@@ -266,10 +294,18 @@ def fit(
             # (extra reductions), which has its OWN golden — checking
             # that program against the plain train_step contract would
             # fail every healthy watchdog run at launch.
-            golden_name = (
-                "train_step_gn" if extra.get("with_grad_norm")
-                else "train_step"
-            )
+            # Three train-step program regimes, three goldens: plain,
+            # the watchdog's grad-norm epilogue, and the resilience
+            # guard (grad-norm + update-gating selects — XLA lays the
+            # collectives out slightly differently once the selects are
+            # in, so it pins its own golden; analysis/entrypoints.py
+            # generates all three).
+            if extra.get("skip_nonfinite"):
+                golden_name = "train_step_skip"
+            elif extra.get("with_grad_norm"):
+                golden_name = "train_step_gn"
+            else:
+                golden_name = "train_step"
             with tr.span("fit.contract_check"), activate(mesh, rules):
                 enforce_contract(
                     contract, step_fn.jitted, state, sample, mesh=mesh,
@@ -284,11 +320,17 @@ def fit(
                 cfg.checkpoint_dir,
                 max_to_keep=cfg.max_checkpoints,
                 save_interval_steps=cfg.checkpoint_every,
+                recorder=rec,
             )
+            # restore_latest falls back past a corrupted newest step
+            # (preemption mid-write) to an older retained one — the
+            # resume path must survive exactly the crash that made the
+            # resume necessary.
             restored = ckpt.restore_latest(like=state)
             if restored is not None:
                 state = restored
                 start_step = int(state.step)
+                rec.record("train_restore", step=start_step)
 
     with tr.span("fit.cost_analysis"), activate(mesh, rules):
         flops = compiled_flops(step_fn.jitted, state, sample)
@@ -304,6 +346,22 @@ def fit(
         log_every=cfg.log_every,
         registry=registry,
     )
+    def emergency_save(reason: str) -> bool:
+        # The incident-path checkpoint: persist the CURRENT state (with
+        # the skip guard on it is the last healthy state) before the
+        # raise, so the operator resumes instead of rerunning. Forced
+        # and awaited — a preemption gives no second chance.
+        if (
+            ckpt is None or resilience is None
+            or not resilience.emergency_checkpoint
+        ):
+            return False
+        step_now = int(state.step)
+        ckpt.save(step_now, state, force=True)
+        ckpt.wait()
+        rec.record("emergency_checkpoint", step=step_now, reason=reason)
+        return True
+
     def escalate():
         # A probe came back non-finite. Localize: re-run the flagged
         # step's batch (still held in the recent-batch window) under
@@ -311,6 +369,7 @@ def fit(
         # against the CURRENT state, so data-induced NaNs localize
         # exactly while state-drift ones may come back clean (recorded
         # either way). Then dump the post-mortem bundle and raise.
+        emergency_save("watchdog_escalation")
         bad = watchdog.first_bad_step
         batch = recent.get(bad)
         localized = None
@@ -330,13 +389,59 @@ def fit(
     batches = None
     if cfg.prefetch > 0:
         batches = loader.prefetched(cfg.prefetch, start=start_step)
+
+    def reseek(step: int):
+        # The prefetch pipeline is positional; a rollback rewinds it by
+        # rebuilding from the restored step (the loader itself is
+        # random-access, so the replayed sequence is exact).
+        nonlocal batches
+        if batches is not None:
+            batches.close()
+            batches = loader.prefetched(cfg.prefetch, start=step)
+
+    # SIGTERM → emergency checkpoint → PreemptionError: the cloud
+    # preemption path. Handler installed only from the main thread
+    # (signal API constraint) and restored in the finally.
+    sig = {"tripped": False}
+    sig_installed = False
+    prev_sig: Any = None
+    if (
+        resilience is not None and resilience.handle_sigterm
+        and threading.current_thread() is threading.main_thread()
+    ):
+        def _on_sigterm(signum, frame):
+            sig["tripped"] = True
+
+        prev_sig = signal.signal(signal.SIGTERM, _on_sigterm)
+        sig_installed = True
+
+    c_skips = (
+        registry.counter(
+            "train_nonfinite_skips_total",
+            "train steps skipped by the non-finite guard",
+        )
+        if registry is not None and resilience is not None else None
+    )
     recent: dict[int, Any] = {}
+    skips = 0          # CONSECUTIVE guarded skips (budget: max_skips)
+    rollbacks = 0
+    ema: float | None = None
+    ema_seen = 0
     compile_watch.start()
     if hb_owned:
         heartbeat.start()
     try:
-        for i in range(start_step, cfg.steps):
+        i = start_step
+        while i < cfg.steps:
+            if sig["tripped"]:
+                saved = emergency_save("sigterm")
+                rec.record(
+                    "preemption", step=int(state.step), checkpointed=saved,
+                )
+                raise PreemptionError(int(state.step), cfg.checkpoint_dir)
+            chaos_hook("train.step", step=i + 1)
             batch = next(batches) if batches is not None else loader.batch_at(i)
+            batch = chaos_hook("train.batch", value=batch, step=i + 1)
             if watchdog is not None:
                 # Keep the async-probe window's batches for escalation.
                 recent[i + 1] = batch
@@ -357,13 +462,74 @@ def fit(
                 # window), so the span measures the step, not its
                 # dispatch — and a wedged sync is flagged.
                 metrics.log(i + 1, loss=loss)
-            rec.record("train_step", step=i + 1, loss=float(loss))
+            # The OBSERVED loss: the chaos seam can corrupt the host
+            # reading (the spike drill) without touching device state.
+            loss_f = chaos_hook("train.loss", value=float(loss), step=i + 1)
+            rec.record("train_step", step=i + 1, loss=loss_f)
+            if resilience is not None:
+                nonfinite = not math.isfinite(loss_f) or (
+                    gnorm is not None and not math.isfinite(float(gnorm))
+                )
+                if nonfinite:
+                    # The guarded step already refused the update; the
+                    # host books the skip and moves to the next batch.
+                    skips += 1
+                    if c_skips is not None:
+                        c_skips.inc()
+                    rec.record(
+                        "step_skipped", step=i + 1, loss=loss_f,
+                        consecutive=skips,
+                    )
+                    if skips > resilience.max_skips:
+                        emergency_save("skip_budget_exhausted")
+                        err = NonFiniteError(i + 1, "loss/grad_norm")
+                        bundle = rec.dump(
+                            registry=registry, tracer=tr, error=err
+                        )
+                        raise NonFiniteError(
+                            i + 1, "loss/grad_norm", bundle=bundle
+                        )
+                    i += 1
+                    continue
+                skips = 0
+                spiking = (
+                    resilience.rollback_on_spike
+                    and ema is not None
+                    and ema_seen >= resilience.spike_min_steps
+                    and abs(loss_f)
+                    > resilience.spike_factor * max(abs(ema), 1e-12)
+                )
+                if spiking:
+                    if (
+                        ckpt is not None
+                        and ckpt.latest_step() is not None
+                        and rollbacks < resilience.max_rollbacks
+                    ):
+                        rollbacks += 1
+                        ckpt.wait()   # the restore target may be in flight
+                        state = ckpt.restore_latest(like=state)
+                        i = int(state.step)
+                        rec.record(
+                            "loss_spike_rollback", step=i, loss=loss_f,
+                            ema=ema, rollbacks=rollbacks,
+                        )
+                        reseek(i)
+                        ema = None
+                        ema_seen = 0
+                        continue
+                    rec.record(
+                        "loss_spike", step=i + 1, loss=loss_f, ema=ema,
+                    )
+                a = resilience.spike_ema_alpha
+                ema = loss_f if ema is None else (1 - a) * ema + a * loss_f
+                ema_seen += 1
             if watchdog is not None:
                 watchdog.probe(i + 1, loss, gnorm)
                 if watchdog.tripped:
                     escalate()
             if ckpt is not None:
                 ckpt.save(i + 1, state)
+            i += 1
         if watchdog is not None:
             watchdog.flush()
             if watchdog.tripped:
@@ -376,6 +542,14 @@ def fit(
         compile_watch.stop()
         if hb_owned:
             heartbeat.stop()
+        if sig_installed:
+            # prev is None when the pre-fit handler was installed from C
+            # (signal.getsignal convention) — restore the default then,
+            # since None is not a valid handler argument.
+            signal.signal(
+                signal.SIGTERM,
+                prev_sig if prev_sig is not None else signal.SIG_DFL,
+            )
         if batches is not None:
             batches.close()
         metrics.close()

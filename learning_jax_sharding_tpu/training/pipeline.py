@@ -155,6 +155,7 @@ def make_train_step(
     grad_accum_steps: int = 1,
     steps_per_call: int = 1,
     with_grad_norm: bool = False,
+    skip_nonfinite: bool = False,
 ) -> Callable[[TrainState, Any], tuple[TrainState, jax.Array]]:
     """Build the jitted SPMD train step: grad → apply_gradients → (state, loss).
 
@@ -196,6 +197,15 @@ def make_train_step(
     the step (``optax.global_norm``, a reduction XLA fuses into the
     backward's epilogue: no extra pass, no extra sync), so a health
     watchdog (``telemetry.watchdog``) can check both numbers on device.
+
+    ``skip_nonfinite``: gate the optimizer update ON DEVICE by
+    ``isfinite(loss) & isfinite(grad_norm)`` — a NaN/Inf step returns the
+    incoming params/optimizer state unchanged (element-wise selects, no
+    new collectives: the program keeps the ``train_step_gn`` SPMD
+    contract), so a bad batch can never write corruption into the state
+    even with donation on. Implies the grad-norm dict output (the host
+    reads the non-finite loss/grad-norm and knows the step was skipped);
+    ``training/loop.py::fit(resilience=...)`` drives it.
 
     ``steps_per_call``: run this many FULL optimizer steps per jitted call
     (a ``lax.scan``); the batch then carries a leading ``(steps_per_call,)``
@@ -268,9 +278,25 @@ def make_train_step(
             (loss_sum, grad_sum), _ = jax.lax.scan(body, init, (accum_idx, micro))
             loss = loss_sum / grad_accum_steps
             grads = jax.tree.map(lambda g: g / grad_accum_steps, grad_sum)
-        if with_grad_norm:
-            out = {"loss": loss, "grad_norm": optax.global_norm(grads)}
-            return state.apply_gradients(grads=grads), out
+        if with_grad_norm or skip_nonfinite:
+            gnorm = optax.global_norm(grads)
+            new_state = state.apply_gradients(grads=grads)
+            if skip_nonfinite:
+                # The guard: params/opt_state keep their OLD buffers when
+                # the step's health check fails — step count still
+                # advances (resume alignment: state.step == loop index).
+                ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+                def sel(new, old):
+                    return jnp.where(ok, new, old)
+
+                new_state = new_state.replace(
+                    params=jax.tree.map(sel, new_state.params, state.params),
+                    opt_state=jax.tree.map(
+                        sel, new_state.opt_state, state.opt_state
+                    ),
+                )
+            return new_state, {"loss": loss, "grad_norm": gnorm}
         return state.apply_gradients(grads=grads), loss
 
     scalar_sh = NamedSharding(mesh, jax.sharding.PartitionSpec())

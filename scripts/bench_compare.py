@@ -66,6 +66,12 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      False),
     (re.compile(r"decode stalled ([\d.]+)%"), "decode_stall_share_pct",
      False),
+    # Round-10 recovery-policy gates: with no faults injected the
+    # tracked line must hold shed and deadline-miss at ~0 — a robustness
+    # hook that starts shedding or missing TTLs under clean load IS a
+    # latency regression, caught here before it ships.
+    (re.compile(r"shed ([\d.]+)%"), "shed_rate_pct", False),
+    (re.compile(r"deadline miss ([\d.]+)%"), "deadline_miss_pct", False),
     (re.compile(r"agreement vs plain: ([\d.]+)%"), "agreement_pct", True),
 ]
 
@@ -151,12 +157,15 @@ def compare(
     old: dict, new: dict, threshold: float
 ) -> tuple[list[dict], list[str], list[str]]:
     """Per-metric deltas plus added/removed names. A REGRESSION is a move
-    past ``threshold`` in the metric's own bad direction."""
+    past ``threshold`` in the metric's own bad direction. A ZERO old
+    value gets a 1-unit floor instead of a div-by-zero pass: the
+    recovery/stall gates hold at exactly 0 in a clean round, and
+    0% → 12% shed must fail the gate, not sail through as delta 0."""
     om, nm = extract_metrics(old), extract_metrics(new)
     rows: list[dict] = []
     for key in sorted(om.keys() & nm.keys()):
         (ov, higher), (nv, _) = om[key], nm[key]
-        delta = (nv - ov) / abs(ov) if ov else 0.0
+        delta = (nv - ov) / (abs(ov) if ov else 1.0)
         worse = -delta if higher else delta
         rows.append(
             {

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Price the recovery hooks: deadline sweep + admission control +
+ladder evaluation on the serving loop, and the emergency-checkpoint
+cost on the training side.
+
+Two measurements (PERF.md round 10):
+
+1. **Serving hook overhead** — the same staggered queue driven through
+   two identical engines, one bare and one with every recovery hook
+   armed but never tripping (roomy TTL, deep queue bound, ladder on a
+   lenient SLO). Interleaved rounds, per-variant medians (the same
+   methodology as the bench ladders). The delta is what every
+   fault-free request pays for the policies — budget: <2% of the
+   tracked serving-bench latency line.
+2. **Emergency-save cost** — ``CheckpointManager.save(force=True)`` +
+   ``wait()`` of a live train state: the one-off price of a SIGTERM /
+   watchdog-trip checkpoint, i.e. how much preemption notice the
+   trainer needs.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+import tempfile
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def serving_overhead(rounds=5, nreq=16):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.robustness import DegradationLadder
+    from learning_jax_sharding_tpu.telemetry.slo import SLOMonitor, SLOTarget
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(5)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(8,)).astype(np.int32)
+        for _ in range(nreq)
+    ]
+    kw = dict(batch_size=4, max_new_tokens=8, refill_chunk=8)
+    # BOTH engines carry the PR-2 SLO feed — the delta isolates the
+    # ROUND-10 hooks (deadline sweep, admission check, ladder eval),
+    # not the pre-existing monitor cost.
+    bare = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, **kw,
+        slo=SLOMonitor([SLOTarget("ttft", 60.0, objective=0.5)]),
+    )
+    armed = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, **kw,
+        deadline_s=300.0, max_queue=256,
+        slo=SLOMonitor([SLOTarget("ttft", 60.0, objective=0.5)]),
+        degradation=DegradationLadder(),
+    )
+
+    def drive(eng):
+        eng.reset_stats()
+        for i, p in enumerate(prompts):
+            eng.add_request(p, deadline_s=300.0 if eng is armed else None)
+        t0 = time.perf_counter()
+        while eng.has_work():
+            eng.step(params)
+        dt = time.perf_counter() - t0
+        eng.pop_finished()
+        return dt
+
+    drive(bare), drive(armed)   # compile warmup, both engines
+    bt, at = [], []
+    for _ in range(rounds):     # interleaved: drift hits both equally
+        bt.append(drive(bare))
+        at.append(drive(armed))
+    b, a = float(np.median(bt)), float(np.median(at))
+    print(
+        f"[perf] recovery hooks: bare {b * 1e3:.1f} ms/queue, armed "
+        f"{a * 1e3:.1f} ms/queue -> overhead {(a - b) / b:+.2%} "
+        f"(deadline sweep + admission check + ladder eval, no faults; "
+        f"{nreq} requests, medians of {rounds})"
+    )
+    return (a - b) / b
+
+
+def emergency_save_cost():
+    import optax
+
+    from learning_jax_sharding_tpu.data import SyntheticLMDataset
+    from learning_jax_sharding_tpu.data.loader import ShardedBatchLoader
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.training.checkpoint import CheckpointManager
+    from learning_jax_sharding_tpu.training.pipeline import sharded_train_state
+
+    mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
+    data = SyntheticLMDataset(
+        vocab_size=CONFIG_TINY.vocab_size, seq_len=32, seed=7
+    )
+    loader = ShardedBatchLoader(data, mesh, 8, spec=("data",))
+    sample = loader.batch_at(0)
+    state, _ = sharded_train_state(
+        Transformer(CONFIG_TINY), optax.adamw(3e-4), sample["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    nbytes = sum(
+        x.nbytes for x in jax.tree.leaves(state) if hasattr(x, "nbytes")
+    )
+    with tempfile.TemporaryDirectory(prefix="ljst_esave_") as d:
+        with CheckpointManager(d) as ckpt:
+            ts = []
+            for step in range(1, 4):
+                t0 = time.perf_counter()
+                ckpt.save(step, state, force=True)
+                ckpt.wait()
+                ts.append(time.perf_counter() - t0)
+    med = float(np.median(ts))
+    print(
+        f"[perf] emergency save: {med * 1e3:.0f} ms forced+awaited "
+        f"({nbytes / 1e6:.1f} MB state, median of {len(ts)}) — the "
+        f"preemption notice fit() needs to persist and re-raise"
+    )
+    return med
+
+
+if __name__ == "__main__":
+    serving_overhead()
+    emergency_save_cost()

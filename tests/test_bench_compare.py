@@ -85,6 +85,29 @@ class TestExtraction:
         assert by[f"{name}:refill_share_pct"]["regressed"]
         assert not by[f"{name}:queue_wait_p50_ms"]["regressed"]
 
+    def test_recovery_gates_direction_aware(self):
+        """The round-10 recovery gates: shed rate and deadline-miss rate
+        regress when they RISE — a robustness hook that starts shedding
+        clean traffic fails the round like any latency regression."""
+        line = (
+            "[bench] 125M serving latency (16 staggered arrivals, "
+            "20 req/s): TTFT p50 220 ms / p99 410 ms, TPOT p50 5.4 ms, "
+            "ITL p99 80 ms, queue wait p50 190 ms, 310 tok/s, "
+            "shed 0%, deadline miss 0%"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        name = "125M_serving_latency_(16_staggered_arrivals,_20_req/s)"
+        assert m[f"{name}:shed_rate_pct"] == (0.0, False)
+        assert m[f"{name}:deadline_miss_pct"] == (0.0, False)
+        worse = _doc([
+            line.replace("shed 0%", "shed 12%")
+            .replace("deadline miss 0%", "deadline miss 9%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{name}:shed_rate_pct"]["regressed"]
+        assert by[f"{name}:deadline_miss_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

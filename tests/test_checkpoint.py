@@ -112,12 +112,52 @@ class TestCheckpoint:
         with CheckpointManager(tmp_path / "empty") as ckpt:
             assert ckpt.restore_latest(like=as_abstract(state)) is None
 
+    def test_corrupted_latest_falls_back_to_previous(self, mesh22, tmp_path):
+        """A truncated newest checkpoint (a preemption mid-write, bit
+        rot) must not kill the resume: restore_latest FALLS BACK to the
+        previous retained step — that is what retention exists for —
+        and records the corrupt/fallback trail in the flight recorder.
+        strict=True keeps the old fail-fast contract."""
+        import pytest
+
+        from learning_jax_sharding_tpu.robustness.chaos import (
+            corrupt_latest_checkpoint,
+        )
+        from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+            FlightRecorder,
+        )
+
+        batch, state, step = _setup(mesh22)
+        rec = FlightRecorder()
+        with CheckpointManager(tmp_path / "ckpt", recorder=rec) as ckpt:
+            ckpt.save(1, state)
+            stepped, _ = step(state, batch)
+            ckpt.save(2, stepped)
+            ckpt.wait()
+            assert corrupt_latest_checkpoint(tmp_path / "ckpt") == 2
+            restored = ckpt.restore_latest(like=state)
+            # The fallback restored checkpoint step 1 — the PRE-step
+            # state's content, not step 2's.
+            assert int(restored.step) == int(state.step)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b)
+                ),
+                state.params, restored.params,
+            )
+            assert [e["step"] for e in rec.events("checkpoint.corrupt")] == [2]
+            assert rec.events("checkpoint.fallback")
+            with pytest.raises(Exception):
+                ckpt.restore_latest(like=state, strict=True)
+
 
 class TestCrossMeshRestore:
     def test_restore_onto_a_different_mesh(self, mesh22, tmp_path):
         """Elastic resharding: save under a 2×2 mesh, restore under 4×2 —
         values identical, every leaf resharded to the NEW mesh's layout
-        (what lets a run resume after the slice size changes)."""
+        (what lets a run resume after the slice size changes). Through
+        ``restore_latest``: the PREEMPTION-RESUME entry point (a
+        preempted run often comes back on a different slice shape)."""
         from learning_jax_sharding_tpu.parallel import build_mesh
 
         _, state, _ = _setup(mesh22)
@@ -128,7 +168,7 @@ class TestCrossMeshRestore:
 
             # Rebuild the abstract target under the new mesh, then restore.
             _, new_state, _ = _setup(mesh42)
-            restored = ckpt.restore(1, like=new_state)
+            restored = ckpt.restore_latest(like=new_state)
 
         old_kernel = state.params["block_0"]["attn"]["query"]["kernel"]
         new_kernel = restored.params["block_0"]["attn"]["query"]["kernel"]

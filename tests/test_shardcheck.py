@@ -461,6 +461,65 @@ class TestSourceLint:
         """
         assert self._rules(src) == []
 
+    def test_bare_except_flags(self):
+        src = """
+        try:
+            f()
+        except:
+            x = 1
+        """
+        assert self._rules(src) == ["swallowed-exception"]
+
+    def test_bare_except_with_reraise_clean(self):
+        src = """
+        try:
+            f()
+        except:
+            cleanup()
+            raise
+        """
+        assert self._rules(src) == []
+
+    def test_broad_except_pass_flags(self):
+        src = """
+        try:
+            f()
+        except Exception:
+            pass
+        """
+        assert self._rules(src) == ["swallowed-exception"]
+
+    def test_broad_except_in_tuple_pass_flags(self):
+        src = """
+        try:
+            f()
+        except (ValueError, Exception):
+            ...
+        """
+        assert self._rules(src) == ["swallowed-exception"]
+
+    def test_broad_except_with_handling_clean(self):
+        # Recording the failure IS handling — the rule only hunts
+        # failures that leave no trace.
+        src = """
+        try:
+            f()
+        except Exception as e:
+            recorder.record("fault", error=str(e))
+        """
+        assert self._rules(src) == []
+
+    def test_narrow_except_pass_clean(self):
+        # A narrow `except KeyError: pass` is a deliberate, bounded
+        # decision — only the broad catches gate.
+        src = """
+        try:
+            f()
+        except KeyError:
+            pass
+        """
+        assert self._rules(src) == []
+
     def test_raw_clock_without_sync(self):
         src = """
         import time
@@ -501,7 +560,8 @@ class TestCheckedInGoldens:
     (cases/case20_shardcheck.py runs the full loop)."""
 
     REQUIRED = (
-        "train_step", "zero1_update", "zero1_update_q8", "prefill",
+        "train_step", "train_step_gn", "train_step_skip",
+        "zero1_update", "zero1_update_q8", "prefill",
         "decode_step", "mixed_step",
         "spec_prefill", "spec_decode_step", "spec_mixed_step",
         "moe_dispatch", "ring_attention", "ulysses_attention",
