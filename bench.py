@@ -673,6 +673,38 @@ def bench_serving_125m():
     staggered(plain.engine, " split-engine baseline")
 
 
+def bench_fleet():
+    """Fleet serving trajectory (round 11): aggregate tok/s and
+    router-side e2e tail vs replica count, plus the disaggregated
+    2-prefill + 2-decode split with its streamed-KV volume.
+
+    The fleet needs device MULTIPLICITY (replica sub-meshes) that the
+    one-chip bench host lacks, so the ladder runs on the emulated
+    8-device mesh in a SUBPROCESS (``scripts/perf_fleet.py
+    --bench-lines``) and its ``[bench]`` lines are relayed verbatim into
+    this run's stderr tail — ``scripts/bench_compare.py`` then gates
+    aggregate tok/s and e2e p99 direction-aware per replica count, like
+    every other tracked line. Router/handoff overhead is what the
+    emulated ladder prices; chip-level scaling claims wait for a
+    multi-chip host."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = pathlib.Path(__file__).resolve().parent / "scripts" / "perf_fleet.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--bench-lines"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"perf_fleet exited {proc.returncode}: {tail}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+
+
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.
 
@@ -799,6 +831,10 @@ def main():
         bench_serving_125m()
     except Exception as e:
         _log(f"[bench] serving bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_fleet()
+    except Exception as e:
+        _log(f"[bench] fleet bench skipped: {type(e).__name__}: {e}")
     try:
         bench_moe_125m()
     except Exception as e:

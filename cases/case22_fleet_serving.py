@@ -1,0 +1,188 @@
+"""Case 22 — fleet serving: disaggregated prefill/decode with a replica
+kill mid-stream.
+
+The round-11 subsystem, end to end on the emulated 8-device mesh:
+
+* **topology** — 2 PREFILL replicas (``max_new_tokens=1``) on devices
+  0-3 and 2 DECODE replicas on devices 4-7, each a (1,2) sub-mesh; one
+  :class:`~learning_jax_sharding_tpu.fleet.FleetRouter` in front;
+* **streamed KV handoff** — every finished prefill's cache row crosses
+  to a decode replica through the explicit resharding transfer plan
+  (``fleet.kv_transfer`` — page-granular segments, counted bytes; the
+  device-side ``kv_export``/``kv_ingest`` programs are golden-pinned to
+  ZERO collectives);
+* **failover** — one decode replica is KILLED mid-stream; its in-flight
+  requests drain with visible ``"rerouted"`` terminals and recompute —
+  re-prefilled and re-handed-off — on the survivor;
+* **the oracle** — every request's final token stream is BIT-IDENTICAL
+  to a single engine of the same (1,2) mesh shape serving the same
+  queue: disaggregation, handoff, routing, and the kill change
+  throughput and placement, never results;
+* **fleet telemetry** — the per-replica registries merge into one
+  labeled Prometheus exposition; every routing/handoff/failover
+  decision is in the flight-recorder events dump.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case22``, else a
+temp dir): ``fleet_summary.json`` (latency + per-replica counters),
+``metrics.prom`` (labeled fleet exposition), ``events.json`` (the
+recorder ring's fleet.* / engine.* timeline).
+
+Run: ``python cases/case22_fleet_serving.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FleetRouter,
+    make_replicas,
+    replicated_params,
+)
+from learning_jax_sharding_tpu.models.serving import (  # noqa: E402
+    ContinuousEngine,
+    RequestFailure,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh  # noqa: E402
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    FlightRecorder,
+    artifact_dir,
+)
+
+NREQ, NEW = 12, 8
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case22")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(22)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(5, 14, size=NREQ)
+    ]
+
+    # The single-engine oracle, same (1,2) mesh shape as every replica.
+    mesh = build_mesh((1, 2), ("data", "model"), devices=jax.devices()[:2])
+    baseline = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=8,
+    )
+    ref = baseline.serve(replicated_params(params, mesh), prompts)
+
+    rec = FlightRecorder(max_events=65536)
+    pre = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="prefill", batch_size=2, max_new_tokens=1, refill_chunk=8,
+        recorder=rec,
+    )
+    dec = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="decode", offset=4, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=8, recorder=rec,
+    )
+    router = FleetRouter(pre + dec, recorder=rec)
+
+    print(f"case22: 2 prefill + 2 decode replicas, {NREQ} requests, "
+          f"killing decode1 mid-stream")
+    for i, p in enumerate(prompts):
+        router.add_request(p, rid=i)
+    results = {}
+    steps = 0
+    killed = False
+    while router.has_work():
+        router.step()
+        results.update(router.pop_finished())
+        steps += 1
+        if not killed and dec[1].engine.has_work():
+            # Mid-stream BY CONSTRUCTION: decode1 holds ingested
+            # in-flight requests right now — the kill must visibly
+            # reroute them, not land on an idle replica.
+            router.kill_replica("decode1", error="case22 induced kill")
+            killed = True
+            print("case22: decode1 killed with work in flight; "
+                  "failing over")
+        if steps > 2000:
+            raise RuntimeError("fleet wedged")
+    results.update(router.pop_finished())
+    assert killed, "decode1 never took work — topology bug"
+
+    failures = {
+        r: v for r, v in results.items() if isinstance(v, RequestFailure)
+    }
+    assert not failures, f"requests failed: {failures}"
+    mismatches = [
+        i for i in range(NREQ)
+        if not np.array_equal(results[i], ref[i])
+    ]
+    assert not mismatches, f"streams diverged from baseline: {mismatches}"
+    rerouted = int(
+        dec[1].engine.registry.counter("engine_rerouted_total").value
+    )
+    assert rerouted >= 1, "the kill must visibly reroute in-flight work"
+    lat = router.latency_stats()
+    reg = router.registry
+    summary = {
+        "requests": NREQ,
+        "bit_identical": True,
+        "killed": "decode1",
+        "rerouted_on_dead_replica": rerouted,
+        "failovers": reg.counter("fleet_failovers_total").value,
+        "reroutes": reg.counter("fleet_reroutes_total").value,
+        "handoffs": reg.counter("fleet_handoffs_total").value,
+        "kv_transfer_bytes": reg.counter(
+            "fleet_kv_transfer_bytes_total").value,
+        "kv_transfer_segments": reg.counter(
+            "fleet_kv_transfer_segments_total").value,
+        "latency": lat,
+        "replicas": router.fleet_snapshot()["replicas"],
+    }
+    (out / "fleet_summary.json").write_text(
+        json.dumps(summary, indent=2, default=str)
+    )
+    (out / "metrics.prom").write_text(router.prometheus_text())
+    (out / "events.json").write_text(
+        json.dumps(
+            [e for e in rec.events() if not e["kind"].startswith("span")]
+            [-2000:],
+            indent=2, default=str,
+        )
+    )
+    print(
+        f"case22: {NREQ}/{NREQ} requests bit-identical to the "
+        f"single-engine baseline across the kill "
+        f"({summary['handoffs']:.0f} handoffs, "
+        f"{summary['kv_transfer_bytes'] / 1e3:,.0f} kB streamed, "
+        f"{rerouted} rerouted off the dead replica); artifacts in {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

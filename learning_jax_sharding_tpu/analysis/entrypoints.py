@@ -263,6 +263,56 @@ def _serving_programs() -> list[EntryProgram]:
     ]
 
 
+def _kv_transfer_programs() -> list[EntryProgram]:
+    """The disaggregated-handoff device programs (round 11 —
+    ``fleet/kv_transfer.py`` rides between them): ``kv_export`` slices
+    one retired request's cache row, ``kv_ingest`` writes an externally
+    produced row into a free slot. Their goldens pin the handoff's
+    claim that the DEVICE side adds no surprise collectives — the
+    cross-replica byte movement lives entirely in the explicit,
+    counted host transfer plan. Built on a live tiny engine with
+    born-sharded params (the real TP serving layout): one short serve
+    retires a request, export + self-ingest populate the dispatch-arg
+    caches, then each program relowers AOT under its contract name."""
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.models.transformer import Transformer
+    from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
+
+    mesh = _mesh24()
+    built: dict = {}
+
+    def ensure():
+        if built:
+            return built["hlo"]
+        cfg = _tiny_cfg()
+        params = _sharded_serving_params(
+            Transformer(cfg), mesh, RULES_TP_SERVING
+        )
+        eng = ContinuousEngine(
+            cfg, mesh, RULES_TP_SERVING,
+            batch_size=2, max_new_tokens=4, refill_chunk=16,
+            decode_block_steps=4,
+        )
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(
+            1, cfg.vocab_size, size=(9,)
+        ).astype(np.int32)
+        (out,) = eng.serve(params, [prompt])
+        rows, _length = eng.export_kv(0)
+        eng.ingest_kv(
+            params, prompt, int(out[len(prompt)]), rows, rid=1,
+        )
+        built["hlo"] = {
+            eng.contract_name(k): v for k, v in eng.program_hlo().items()
+        }
+        return built["hlo"]
+
+    return [
+        EntryProgram(name, mesh, lambda name=name: ensure()[name])
+        for name in ("kv_export", "kv_ingest")
+    ]
+
+
 def _zero1_q8() -> EntryProgram:
     """The quantized-comm ZeRO-1 update (``training.zero.
     make_zero1_update(quantized_comm=True)``): its golden pins the int8
@@ -395,6 +445,7 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         _train_like("zero1_update", zero1_axis="data"),
         _zero1_q8(),
         *_serving_programs(),
+        *_kv_transfer_programs(),
         _moe_dispatch(),
         _seq_attention("ring_attention"),
         _seq_attention("ulysses_attention"),

@@ -1,0 +1,31 @@
+"""Fleet serving (round 11): a multi-replica router over engine
+replicas on sub-meshes, with disaggregated prefill/decode and a streamed,
+plan-checked KV handoff — ROADMAP item 2.
+
+Layers: :mod:`.policies` (placement + fleet shedding policy),
+:mod:`.replica` (one engine on its sub-mesh; builders), :mod:`.router`
+(admission, handoff, failover, fleet telemetry), :mod:`.kv_transfer`
+(the arXiv-2112.01075-style resharding transfer plan the KV handoff
+rides).
+"""
+
+from learning_jax_sharding_tpu.fleet.kv_transfer import (  # noqa: F401
+    DEFAULT_PAGE_TOKENS,
+    Segment,
+    TransferPlan,
+    execute_transfer,
+    plan_transfer,
+    transfer_tree,
+)
+from learning_jax_sharding_tpu.fleet.policies import (  # noqa: F401
+    FleetPolicy,
+)
+from learning_jax_sharding_tpu.fleet.replica import (  # noqa: F401
+    EngineReplica,
+    make_replicas,
+    replicated_params,
+    sub_meshes,
+)
+from learning_jax_sharding_tpu.fleet.router import (  # noqa: F401
+    FleetRouter,
+)

@@ -1,0 +1,90 @@
+"""Fleet routing and shedding policy: where a request goes, and when the
+FLEET (not just one replica) says no.
+
+Each replica already defends itself — bounded queue (``max_queue``),
+TTL deadlines, and the round-10 burn-rate :class:`~..robustness.policies.
+DegradationLadder` whose last level sheds that replica's admits. The
+fleet layer sits ABOVE those:
+
+* **placement** — :meth:`FleetPolicy.rank` orders eligible replicas by a
+  load score (queued + active work) plus the replica's worst SLO burn
+  rate, weighted: a replica burning error budget is avoided BEFORE its
+  own ladder has to degrade it, so burn-rate skew steers traffic instead
+  of tripping per-replica alarms;
+* **eligibility** — a dead replica, or one whose ladder reached its
+  shedding level, takes no new work (its own admits would raise
+  ``AdmissionError`` anyway; the router just doesn't bother it);
+* **fleet shedding** — ``max_inflight`` bounds the TOTAL unfinished
+  requests across the fleet: past it the router rejects the arrival
+  outright (``AdmissionError``, ``fleet_shed_total``), because K
+  replicas' queues all missing their SLO together is the same failure
+  the round-10 bounded queue prevents for one.
+
+Pure policy, no engine imports at module top — unit-testable like the
+degradation ladder it layers above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class FleetPolicy:
+    """Scoring + shedding knobs for :class:`~.router.FleetRouter`.
+
+    ``score = depth_weight · (queued + occupied) + burn_weight · burn``
+    — occupied counts every slot holding a request, mid-PREFILL
+    included (a prefill replica's load lives almost entirely in that
+    state; counting only decoding slots would make a just-filled
+    replica look idle). The default weights make one unit of burn rate
+    (consuming error budget exactly) as repellent as ``burn_weight``
+    queued requests, so a replica at burn 2–3× (a real incident) loses
+    ties decisively while healthy replicas are balanced purely by load.
+    Ties break on replica name: routing is deterministic, so a fleet
+    replay routes identically.
+    """
+
+    depth_weight: float = 1.0
+    burn_weight: float = 4.0
+    max_inflight: int | None = None
+
+    def __post_init__(self):
+        if self.max_inflight is not None and self.max_inflight < 1:
+            raise ValueError(
+                f"max_inflight must be >= 1, got {self.max_inflight}"
+            )
+
+    def burn_rate(self, replica) -> float:
+        """The replica's worst current SLO burn rate (0 when it has no
+        monitor — an unmonitored replica competes on load alone)."""
+        slo = replica.engine.slo
+        if slo is None or not slo.targets:
+            return 0.0
+        return max(slo.burn_rate(t.name) for t in slo.targets)
+
+    def eligible(self, replica) -> bool:
+        """Can this replica take NEW work right now?"""
+        return replica.alive and replica.engine.degradation_level < 3
+
+    def score(self, replica) -> float:
+        eng = replica.engine
+        depth = eng.queue_depth() + eng.occupied_slots()
+        return (
+            self.depth_weight * depth
+            + self.burn_weight * self.burn_rate(replica)
+        )
+
+    def rank(self, replicas) -> list:
+        """Eligible replicas, best placement first (deterministic)."""
+        return sorted(
+            (r for r in replicas if self.eligible(r)),
+            key=lambda r: (self.score(r), r.name),
+        )
+
+    def should_shed(self, inflight: int) -> bool:
+        """Fleet-level admission control: reject when the whole fleet
+        already carries ``max_inflight`` unfinished requests."""
+        return (
+            self.max_inflight is not None and inflight >= self.max_inflight
+        )

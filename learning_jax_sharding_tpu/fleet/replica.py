@@ -1,0 +1,149 @@
+"""One fleet replica: a ContinuousEngine on its sub-mesh, plus the
+boilerplate of standing K of them up.
+
+A replica is the unit the router reasons about — an engine, the params
+it serves (placed on ITS sub-mesh), a role, and liveness. Roles:
+
+* ``"unified"`` — the ordinary colocated engine: prefills and decodes
+  its own requests (the round-5..10 engine, unchanged);
+* ``"prefill"`` — disaggregated prefill: built with
+  ``max_new_tokens=1``, it runs prompts to their FIRST token and hands
+  the KV row off (``export_kv`` → ``fleet.kv_transfer`` →
+  a decode replica's ``ingest_kv``);
+* ``"decode"`` — disaggregated decode: receives ingested rows only (the
+  router never ``add_request``s to it) and streams the remaining
+  tokens.
+
+:func:`sub_meshes` carves the device list into disjoint consecutive
+groups (sub-meshes of the emulated 8-device mesh in tests/cases; slices
+of a pod in production) and :func:`make_replicas` builds K identical
+replicas over them. Params are placed FULLY REPLICATED on each sub-mesh
+by default (:func:`replicated_params`) — bit-identity across replicas
+and against a single-engine baseline needs every replica to run the
+same program on the same mesh SHAPE, and replicated weights keep that
+trivially true; serve TP-sharded weights by placing them yourself and
+passing ``place_params=False``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+from learning_jax_sharding_tpu.parallel import build_mesh
+
+ROLES = ("unified", "prefill", "decode")
+
+
+def replicated_params(params: Any, mesh: Mesh) -> Any:
+    """The served tree, fully replicated on ``mesh`` — every replica of
+    the same mesh shape then compiles the identical program, the
+    precondition for the fleet's bit-identity guarantees."""
+    return jax.device_put(params, NamedSharding(mesh, PartitionSpec()))
+
+
+@dataclasses.dataclass
+class EngineReplica:
+    """One engine + its served params under a fleet name/role."""
+
+    name: str
+    engine: ContinuousEngine
+    params: Any
+    draft_params: Any = None
+    role: str = "unified"
+    alive: bool = True
+
+    def __post_init__(self):
+        if self.role not in ROLES:
+            raise ValueError(
+                f"unknown replica role {self.role!r}; expected one of "
+                f"{ROLES}"
+            )
+        if self.role == "prefill" and self.engine._max_new != 1:
+            raise ValueError(
+                f"prefill replica {self.name!r} needs "
+                f"max_new_tokens=1 (it runs prompts to their first token "
+                f"and hands off), got {self.engine._max_new}"
+            )
+
+    def step(self):
+        return self.engine.step(self.params, self.draft_params)
+
+    def pop_finished(self):
+        return self.engine.pop_finished()
+
+    def has_work(self) -> bool:
+        return self.engine.has_work()
+
+
+def sub_meshes(
+    count: int,
+    shape: Sequence[int] = (1, 2),
+    axis_names: Sequence[str] = ("data", "model"),
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    offset: int = 0,
+) -> list[Mesh]:
+    """``count`` disjoint consecutive sub-meshes of ``shape`` carved out
+    of ``devices`` (default: all), starting ``offset`` devices in."""
+    import math
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    per = math.prod(int(s) for s in shape)
+    need = offset + count * per
+    if need > len(devices):
+        raise ValueError(
+            f"{count} sub-meshes of shape {tuple(shape)} from offset "
+            f"{offset} need {need} devices, have {len(devices)}"
+        )
+    return [
+        build_mesh(
+            shape, axis_names,
+            devices=devices[offset + i * per: offset + (i + 1) * per],
+        )
+        for i in range(count)
+    ]
+
+
+def make_replicas(
+    config: Any,
+    rules: Any,
+    params: Any,
+    *,
+    count: int,
+    mesh_shape: Sequence[int] = (1, 2),
+    role: str = "unified",
+    prefix: str | None = None,
+    offset: int = 0,
+    devices: Sequence[jax.Device] | None = None,
+    draft_params: Any = None,
+    place_params: bool = True,
+    **engine_kwargs: Any,
+) -> list[EngineReplica]:
+    """Build ``count`` identical replicas on disjoint sub-meshes.
+
+    ``engine_kwargs`` go to each :class:`ContinuousEngine` verbatim
+    (batch_size, max_new_tokens, refill_chunk, recorder, slo, ...).
+    ``place_params=True`` replicates ``params`` (and ``draft_params``)
+    onto each sub-mesh; pass ``False`` when the trees are already placed.
+    """
+    prefix = role if prefix is None else prefix
+    out = []
+    for i, mesh in enumerate(
+        sub_meshes(count, mesh_shape, devices=devices, offset=offset)
+    ):
+        p = replicated_params(params, mesh) if place_params else params
+        d = (
+            replicated_params(draft_params, mesh)
+            if (place_params and draft_params is not None) else draft_params
+        )
+        out.append(EngineReplica(
+            name=f"{prefix}{i}",
+            engine=ContinuousEngine(config, mesh, rules, **engine_kwargs),
+            params=p, draft_params=d, role=role,
+        ))
+    return out

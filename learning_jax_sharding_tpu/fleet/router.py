@@ -1,0 +1,657 @@
+"""The fleet router: one admission surface over K engine replicas.
+
+ROADMAP item 2 made concrete (round 11): the millions-of-users story
+needs more than one ``ContinuousEngine``, and this module is the layer
+that makes K of them ONE service —
+
+* **routing** — ``add_request`` places each arrival on the best replica
+  by load + SLO burn rate (:class:`~.policies.FleetPolicy`), shedding at
+  the FLEET level when the whole fleet is saturated; each replica keeps
+  its own round-10 defenses (bounded queue, deadlines, degradation
+  ladder) and the router simply routes around a replica that is
+  degraded to shedding;
+* **disaggregated prefill/decode** — with ``"prefill"`` and ``"decode"``
+  replicas, prompts prefill on dedicated engines (``max_new_tokens=1``),
+  and each finished prefill's KV row STREAMS to a decode replica through
+  the explicit resharding transfer plan (:mod:`.kv_transfer` — counted
+  host bytes, golden-pinned device programs) where decode continues
+  bit-identically to a single engine of the same mesh shape;
+* **failover** — a replica death (the ``fleet.step`` chaos seam, or
+  ``kill_replica``) drains its queued AND in-flight requests with
+  terminal status ``"rerouted"`` (visible in the dead replica's
+  ``pop_finished``/``latency_stats`` — never disguised as fresh
+  admissions) and requeues them on survivors, where they RECOMPUTE
+  BIT-IDENTICALLY (every sampling draw is keyed by (request id,
+  generated position), so a replica swap cannot change a token —
+  the round-10 ``_unadmit`` guarantee, now fleet-wide and exercised by
+  the ``replica_kill`` chaos-matrix cell);
+* **fleet telemetry** — per-replica registries merge through
+  ``parallel.multihost.merge_registry_snapshots(labels=...)`` into one
+  snapshot/Prometheus exposition with ``{replica="..."}`` labels, and
+  every routing/handoff/failover decision lands in the flight recorder.
+
+The router is a HOST-side scheduler like the engine's own loop: one
+``step()`` flushes pending handoffs, steps every live replica once, and
+collects retirements. Nothing here dispatches device code of its own —
+the engines (and the audited kv programs) do.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Sequence
+
+import numpy as np
+
+from learning_jax_sharding_tpu.fleet.kv_transfer import transfer_tree
+from learning_jax_sharding_tpu.fleet.policies import FleetPolicy
+from learning_jax_sharding_tpu.fleet.replica import EngineReplica
+from learning_jax_sharding_tpu.models.serving import (
+    AdmissionError,
+    RequestFailure,
+)
+from learning_jax_sharding_tpu.robustness.chaos import (
+    InjectedFault,
+    chaos_hook,
+)
+from learning_jax_sharding_tpu.telemetry import MetricsRegistry
+
+
+class _FleetRequest:
+    """Router-side bookkeeping for one request — the CANONICAL record
+    (rid, prompt, deadline, true arrival time) that survives replica
+    death, because the replica that held the engine-side copy may not."""
+
+    __slots__ = (
+        "rid", "prompt", "deadline_s", "arrival_t", "replica", "stage",
+        "reroutes",
+    )
+
+    def __init__(self, rid, prompt, deadline_s, arrival_t):
+        self.rid = rid
+        self.prompt = prompt
+        self.deadline_s = deadline_s
+        self.arrival_t = arrival_t
+        self.replica: str | None = None
+        self.stage = "queued"        # prefill|handoff|decode|done
+        self.reroutes = 0
+
+
+class FleetRouter:
+    """Admit, route, hand off, and fail over across engine replicas.
+
+    ``replicas``: :class:`~.replica.EngineReplica` records. All
+    ``"unified"`` → colocated fleet; any ``"prefill"``/``"decode"`` →
+    DISAGGREGATED (then at least one of each is required, prefill
+    engines carry ``max_new_tokens=1``, and every decode engine shares
+    one ``max_new_tokens`` — the fleet's generation budget). Handoff
+    uses ``export_kv``/``ingest_kv``, so disaggregated replicas must be
+    unpaged and non-speculative (the engines enforce it).
+
+    The router meters into its own ``registry`` (fleet_* counters) and
+    records every decision to ``recorder`` (default: the process flight
+    ring). ``kv_page_tokens`` sets the streaming granularity of the
+    transfer plans.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[EngineReplica],
+        *,
+        policy: FleetPolicy | None = None,
+        recorder: Any | None = None,
+        registry: MetricsRegistry | None = None,
+        kv_page_tokens: int = 64,
+        max_pending_handoffs: int | None = None,
+    ):
+        reps = list(replicas)
+        if not reps:
+            raise ValueError("a fleet needs at least one replica")
+        names = [r.name for r in reps]
+        if len(set(names)) != len(names):
+            raise ValueError(f"replica names must be unique: {names}")
+        self.replicas: dict[str, EngineReplica] = {r.name: r for r in reps}
+        self.policy = policy or FleetPolicy()
+        if recorder is None:
+            from learning_jax_sharding_tpu.telemetry import (
+                default_flight_recorder,
+            )
+
+            recorder = default_flight_recorder()
+        self.recorder = recorder
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.disaggregated = any(r.role != "unified" for r in reps)
+        if self.disaggregated:
+            if not self._by_role("prefill") or not self._by_role("decode"):
+                raise ValueError(
+                    "a disaggregated fleet needs >= 1 'prefill' AND >= 1 "
+                    "'decode' replica"
+                )
+            budgets = {
+                r.engine._max_new for r in self._by_role("decode")
+            }
+            if len(budgets) != 1:
+                raise ValueError(
+                    f"decode replicas disagree on max_new_tokens: {budgets}"
+                )
+            (self.max_new_tokens,) = budgets
+        else:
+            # Unified replicas are interchangeable under failover — the
+            # bit-identical requeue guarantee needs every one to finish
+            # a request at the same budget.
+            budgets = {r.engine._max_new for r in reps}
+            if len(budgets) != 1:
+                raise ValueError(
+                    f"replicas disagree on max_new_tokens: {budgets} — "
+                    "failover requeue could not recompute bit-identically"
+                )
+            (self.max_new_tokens,) = budgets
+        # EOS drives control flow fleet-wide (the handoff short-circuit,
+        # retirement) — replicas of one fleet must agree on it like they
+        # agree on the budget (build them from ONE engine config).
+        eos = {r.engine._eos for r in reps}
+        if len(eos) != 1:
+            raise ValueError(f"replicas disagree on eos_id: {eos}")
+        (self.eos_id,) = eos
+        self.kv_page_tokens = kv_page_tokens
+        # Backpressure on the handoff stage: each parked entry pins one
+        # exported KV-row tree, so the queue is bounded (default: two
+        # waves of the fleet's decode slots) — at the bound the router
+        # stops STEPPING prefill replicas, which stops new exports
+        # while their own queues keep holding the (cheap) prompts.
+        if max_pending_handoffs is None and self.disaggregated:
+            max_pending_handoffs = 2 * sum(
+                r.engine._b for r in self._by_role("decode")
+            )
+        self.max_pending_handoffs = max_pending_handoffs
+        r = self.registry
+        self._c_requests = r.counter(
+            "fleet_requests_total", "requests admitted to the fleet")
+        self._c_shed = r.counter(
+            "fleet_shed_total",
+            "arrivals rejected by fleet-level admission control")
+        self._c_failovers = r.counter(
+            "fleet_failovers_total", "replica deaths failed over")
+        self._c_reroutes = r.counter(
+            "fleet_reroutes_total",
+            "requests requeued onto a survivor after a replica death")
+        self._c_handoffs = r.counter(
+            "fleet_handoffs_total",
+            "prefill→decode KV handoffs completed")
+        self._c_kv_bytes = r.counter(
+            "fleet_kv_transfer_bytes_total",
+            "bytes moved by the KV resharding transfer plans")
+        self._c_kv_segments = r.counter(
+            "fleet_kv_transfer_segments_total",
+            "page-granular transfer-plan segments copied")
+        self._g_alive = r.gauge(
+            "fleet_replicas_alive", "replicas currently taking work")
+        self._g_inflight = r.gauge(
+            "fleet_inflight", "unfinished requests across the fleet")
+        self._g_alive.set(len(reps))
+        self._requests: dict[int, _FleetRequest] = {}
+        self._finished: dict[int, Any] = {}
+        self._next_rid = 0
+        self._handoffs: deque[dict] = deque()
+        self._plan_cache: dict = {}
+        # Destination row layout per decode replica — constant for an
+        # engine's lifetime, so two cache-tree traversals per handoff
+        # would be pure hot-path waste.
+        self._row_layouts: dict[str, tuple] = {}
+        self.reset_stats()
+
+    # --- introspection -----------------------------------------------------
+
+    def _by_role(self, role: str) -> list[EngineReplica]:
+        return [r for r in self.replicas.values() if r.role == role]
+
+    def _admission_pool(self) -> list[EngineReplica]:
+        # Where NEW prompts go: prefill replicas in a disaggregated
+        # fleet, unified replicas otherwise (decode replicas only ever
+        # receive ingested rows).
+        return self._by_role("prefill" if self.disaggregated else "unified")
+
+    def inflight(self) -> int:
+        """Unfinished requests across the fleet (the fleet-shedding
+        measure — includes requests parked in the handoff queue).
+        ``_requests`` holds ONLY live work (``_finish`` pops records),
+        so this is O(1)."""
+        return len(self._requests)
+
+    def has_work(self) -> bool:
+        return self.inflight() > 0
+
+    def reset_stats(self):
+        """Start a router-side latency window (``latency_stats``)."""
+        self._completed: list[dict] = []
+
+    # --- admission / routing ----------------------------------------------
+
+    def add_request(
+        self, prompt, *, rid: int | None = None,
+        deadline_s: float | None = None,
+    ) -> int:
+        """Admit one request to the fleet: fleet-level shedding first
+        (``FleetPolicy.max_inflight``), then placement on the
+        best-scoring eligible replica — a replica whose OWN admission
+        sheds (bounded queue, ladder) is skipped for the next-best; only
+        when every replica refuses does the arrival shed at fleet level.
+        Raises :class:`AdmissionError` with nothing enqueued either way.
+        """
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        elif rid in self._requests or rid in self._finished:
+            raise ValueError(f"request id {rid} already in use")
+        self._next_rid = max(self._next_rid, rid + 1)
+        if self.policy.should_shed(self.inflight()):
+            self._shed(rid, f"fleet at max_inflight "
+                            f"({self.policy.max_inflight})")
+        freq = _FleetRequest(rid, p, deadline_s, time.perf_counter())
+        self._route(freq)
+        self._requests[rid] = freq
+        self._c_requests.inc()
+        self._g_inflight.set(self.inflight())
+        return rid
+
+    def _shed(self, rid, why: str):
+        self._c_shed.inc()
+        self.recorder.record("fleet.shed", rid=rid, reason=why)
+        raise AdmissionError(f"fleet shed request {rid}: {why}")
+
+    def _route(self, freq: _FleetRequest, *, requeue: bool = False):
+        last_err = "no eligible replica"
+        for rep in self.policy.rank(self._admission_pool()):
+            try:
+                rep.engine.add_request(
+                    freq.prompt, rid=freq.rid,
+                    deadline_s=freq.deadline_s, arrival_t=freq.arrival_t,
+                )
+            except AdmissionError as e:   # replica-level shed: next best
+                last_err = str(e)
+                continue
+            freq.replica = rep.name
+            freq.stage = "prefill" if self.disaggregated else "decode"
+            self.recorder.record(
+                "fleet.route", rid=freq.rid, replica=rep.name,
+                requeue=requeue, queue_depth=rep.engine.queue_depth(),
+                burn_rate=self.policy.burn_rate(rep),
+            )
+            return
+        why = f"every replica refused (last: {last_err})"
+        if requeue:
+            # A failover requeue that finds no home is a LOST request
+            # (_fail_over terminalizes it as "failover_failed"), not an
+            # admission-control rejection — it must not inflate
+            # fleet_shed_total or a shed-rate dashboard.
+            raise AdmissionError(
+                f"failover requeue for request {freq.rid}: {why}"
+            )
+        self._shed(freq.rid, why)
+
+    # --- the fleet scheduler ------------------------------------------------
+
+    def step(self) -> list[int]:
+        """ONE fleet iteration: flush pending handoffs into free decode
+        slots, step every live replica that has work (each step is one
+        engine scheduler iteration), and collect retirements — handing
+        finished prefills off and surfacing final results. Returns the
+        rids that FINISHED during this step (``pop_finished`` holds
+        them). A replica whose ``fleet.step`` seam raises an
+        :class:`~..robustness.chaos.InjectedFault` is declared dead and
+        failed over; real infrastructure errors propagate — recovery
+        must never guess."""
+        before = set(self._finished)
+        self._flush_handoffs()
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if not rep.alive or not rep.has_work():
+                continue
+            if (
+                rep.role == "prefill"
+                and self.max_pending_handoffs is not None
+                and len(self._handoffs) >= self.max_pending_handoffs
+            ):
+                # Handoff backpressure: every new prefill retirement
+                # would pin another exported KV-row tree — hold this
+                # replica's (cheap, host-side) queue instead.
+                continue
+            try:
+                chaos_hook(
+                    "fleet.step", replica=name,
+                    rids=[q for q in rep.engine._req if q >= 0],
+                )
+                rep.step()
+            except InjectedFault as e:
+                self._fail_over(rep, e)
+                continue
+            self._collect(rep)
+        self._flush_handoffs()
+        # Collect from EVERY live replica, stepped or not: ingest_kv can
+        # retire a request immediately (handed-off first token == eos),
+        # leaving the result on an otherwise-idle engine a stepped-only
+        # sweep would never read.
+        for name in sorted(self.replicas):
+            if self.replicas[name].alive:
+                self._collect(self.replicas[name])
+        self._g_inflight.set(self.inflight())
+        return [rid for rid in self._finished if rid not in before]
+
+    def drain(self, max_steps: int = 10_000) -> dict[int, Any]:
+        """Step until the fleet is idle; returns every result collected
+        (``max_steps`` bounds the loop — a wedged fleet raises instead
+        of hanging the caller)."""
+        out: dict[int, Any] = {}
+        steps = 0
+        while self.has_work():
+            self.step()
+            out.update(self.pop_finished())
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"fleet wedged: {steps} steps, work remains"
+                )
+        out.update(self.pop_finished())
+        return out
+
+    def pop_finished(self) -> dict[int, Any]:
+        """Every request finished since the last pop: token arrays, or
+        :class:`RequestFailure` for terminal policy outcomes (deadline,
+        poisoned, ...) — per-replica ``"rerouted"`` failures are
+        internal (the request completes elsewhere) and never surface
+        here; a request NO survivor could take back surfaces as
+        ``"failover_failed"``, the fleet's own terminal status."""
+        fin, self._finished = self._finished, {}
+        return fin
+
+    def _collect(self, rep: EngineReplica):
+        for rid, res in rep.pop_finished().items():
+            freq = self._requests.get(rid)
+            if freq is None:      # finished records are popped at _finish
+                continue
+            if isinstance(res, RequestFailure):
+                if res.status == "rerouted":
+                    # Failover visibility ends at the dead replica's
+                    # stats; the router already requeued the request.
+                    continue
+                self._finish(freq, res)
+            elif rep.role == "prefill":
+                self._begin_handoff(rep, freq, np.asarray(res))
+            else:
+                self._finish(freq, np.asarray(res))
+
+    def _finish(self, freq: _FleetRequest, result: Any):
+        freq.stage = "done"
+        # Drop the canonical record NOW: _requests must hold only live
+        # work, or inflight() (scanned on every admission and step) and
+        # the retained prompt arrays grow with every request the fleet
+        # has ever served. A straggler engine retirement for a dropped
+        # rid is skipped by _collect's None-check; the rid becomes
+        # reusable once the caller pops the result (the engine's own
+        # convention).
+        self._requests.pop(freq.rid, None)
+        self._finished[freq.rid] = result
+        now = time.perf_counter()
+        ok = not isinstance(result, RequestFailure)
+        self._completed.append({
+            "rid": freq.rid,
+            "e2e": now - freq.arrival_t,
+            "generated": (
+                int(len(result) - freq.prompt.size) if ok else 0
+            ),
+            "ok": ok,
+            "reroutes": freq.reroutes,
+        })
+        self.recorder.record(
+            "fleet.finish", rid=freq.rid, replica=freq.replica, ok=ok,
+            reroutes=freq.reroutes,
+        )
+
+    # --- disaggregated handoff ----------------------------------------------
+
+    def _row_layout(self, rep: EngineReplica) -> tuple:
+        """(dst row shardings, seq dims) for one decode replica —
+        memoized: both are fixed by the engine's cache layout."""
+        cached = self._row_layouts.get(rep.name)
+        if cached is None:
+            rep.engine.ensure_cache(rep.params)
+            cached = self._row_layouts[rep.name] = (
+                rep.engine.kv_row_shardings(),
+                rep.engine.kv_row_seq_dims(),
+            )
+        return cached
+
+    def _begin_handoff(self, rep: EngineReplica, freq, tokens: np.ndarray):
+        first = int(tokens[-1])
+        eos = rep.engine._eos
+        if self.max_new_tokens <= 1 or (eos is not None and first == eos):
+            # The first token already ends the request: nothing to hand
+            # off, the prefill result IS the final stream.
+            self._finish(freq, tokens)
+            return
+        # Export NOW — the window closes when the prefill engine's next
+        # step() admits into the slot.
+        rows, length = rep.engine.export_kv(freq.rid)
+        freq.stage = "handoff"
+        self._handoffs.append(dict(
+            freq=freq, rows=rows, length=length, first=first,
+            src=rep.name,
+        ))
+        self.recorder.record(
+            "fleet.handoff_export", rid=freq.rid, src=rep.name,
+            length=length,
+        )
+
+    def _sweep_handoff_deadlines(self):
+        """The round-10 TTL holds in the HANDOFF stage too — for the
+        WHOLE queue, not just the head: an expired parked request must
+        stop pinning its exported KV-row tree (and its
+        ``max_pending_handoffs`` capacity) immediately, not after every
+        entry ahead of it found a decode slot."""
+        if not any(
+            h["freq"].deadline_s is not None for h in self._handoffs
+        ):
+            return
+        now = time.perf_counter()
+        keep: deque = deque()
+        for h in self._handoffs:
+            freq = h["freq"]
+            if (
+                freq.deadline_s is not None
+                and now - freq.arrival_t > freq.deadline_s
+            ):
+                self.recorder.record(
+                    "fleet.deadline", rid=freq.rid, stage="handoff",
+                )
+                self._finish(freq, RequestFailure(
+                    rid=freq.rid, status="deadline",
+                    error="deadline exceeded awaiting handoff",
+                ))
+            else:
+                keep.append(h)
+        self._handoffs = keep
+
+    def _flush_handoffs(self):
+        self._sweep_handoff_deadlines()
+        while self._handoffs:
+            decodes = [r for r in self._by_role("decode") if r.alive]
+            if not decodes:
+                # No decode replica can EVER take these (all DEAD):
+                # terminal under the fleet's own status, never a
+                # silently parked queue a drain() would spin on.
+                while self._handoffs:
+                    h = self._handoffs.popleft()
+                    freq = h["freq"]
+                    self._finish(freq, RequestFailure(
+                        rid=freq.rid, status="failover_failed",
+                        error="every decode replica is dead",
+                    ))
+                return
+            # Degradation does NOT gate a handoff: level 3 sheds NEW
+            # fleet admissions (the prefill pool's own add_request), not
+            # work the fleet already accepted and prefilled — and an
+            # idle degraded replica could never de-escalate anyway (no
+            # traffic means a frozen burn window), so waiting on it
+            # would wedge the fleet. Rank ALIVE free-slot replicas by
+            # the placement score only.
+            ranked = sorted(
+                (r for r in decodes if r.engine.free_slots() > 0),
+                key=lambda r: (self.policy.score(r), r.name),
+            )
+            if not ranked:
+                return               # every decode slot busy: try later
+            h = self._handoffs.popleft()
+            rep, freq = ranked[0], h["freq"]
+            now = time.perf_counter()
+            dst_shardings, seq_dims = self._row_layout(rep)
+            rows, stats = transfer_tree(
+                h["rows"], dst_shardings,
+                stop=h["length"], seq_dims=seq_dims,
+                page_tokens=self.kv_page_tokens,
+                plan_cache=self._plan_cache,
+            )
+            rep.engine.ingest_kv(
+                rep.params, freq.prompt, h["first"], rows, rid=freq.rid,
+                deadline_s=freq.deadline_s, arrival_t=freq.arrival_t,
+                admit_t=now, first_token_t=now,
+            )
+            freq.replica = rep.name
+            freq.stage = "decode"
+            self._c_handoffs.inc()
+            self._c_kv_bytes.inc(stats["bytes"])
+            self._c_kv_segments.inc(stats["segments"])
+            self.recorder.record(
+                "fleet.handoff", rid=freq.rid, src=h["src"],
+                dst=rep.name, length=h["length"], bytes=stats["bytes"],
+                segments=stats["segments"],
+            )
+
+    # --- failover ------------------------------------------------------------
+
+    def kill_replica(self, name: str, error: str = "replica killed"):
+        """Declare ``name`` dead and fail its work over to survivors —
+        the operator/chaos entry to the same path a ``fleet.step``
+        injection takes."""
+        self._fail_over(self.replicas[name], RuntimeError(error))
+
+    def _fail_over(self, rep: EngineReplica, error: BaseException):
+        if not rep.alive:
+            return
+        rep.alive = False
+        self._g_alive.set(
+            sum(1 for r in self.replicas.values() if r.alive)
+        )
+        # 1. Drain the dead replica: every queued/in-flight request gets
+        #    a visible "rerouted" terminal there and a requeueable record
+        #    here. Results that finished BEFORE the death still surface.
+        records = rep.engine.drain_requests(
+            status="rerouted", error=str(error)
+        )
+        for rid, res in rep.pop_finished().items():
+            freq = self._requests.get(rid)
+            if freq is None:      # already finished and popped
+                continue
+            if isinstance(res, RequestFailure):
+                if res.status != "rerouted":
+                    # Genuinely terminal on the dead replica (deadline,
+                    # poisoned, ...) — the verdict survives it.
+                    self._finish(freq, res)
+            elif rep.role == "prefill":
+                # An uncollected finished PREFILL is not a final stream
+                # — it is [prompt, first_token] whose exported KV died
+                # with the replica. Restart from the prompt like the
+                # drained work (recompute-exact), never surface the
+                # truncated array as the caller's result.
+                records.append(dict(rid=rid))
+            else:
+                self._finish(freq, res)
+        # 2. Pending handoffs sourced from the dead replica lose their
+        #    exported rows (a real death takes its HBM along) — those
+        #    requests restart from the prompt like the drained ones.
+        dead_handoffs = [
+            h for h in self._handoffs if h["src"] == rep.name
+        ]
+        self._handoffs = deque(
+            h for h in self._handoffs if h["src"] != rep.name
+        )
+        self._c_failovers.inc()
+        self.recorder.record(
+            "fleet.failover", replica=rep.name, error=str(error),
+            rerouted=[r["rid"] for r in records]
+            + [h["freq"].rid for h in dead_handoffs],
+        )
+        # 3. Requeue on survivors: same rid + original arrival clock, so
+        #    sampling streams, deadlines, and queue-wait telemetry are
+        #    those of the ORIGINAL request — survivors recompute it
+        #    bit-identically (the drain_requests guarantee).
+        for rec in records + [
+            dict(rid=h["freq"].rid) for h in dead_handoffs
+        ]:
+            freq = self._requests.get(rec["rid"])
+            if freq is None:      # already finished and popped
+                continue
+            freq.reroutes += 1
+            self._c_reroutes.inc()
+            try:
+                self._route(freq, requeue=True)
+            except AdmissionError as e:
+                # No survivor can take it: terminal, never silent — and
+                # under its OWN status: "rerouted" is the internal
+                # requeue marker pop_finished callers may ignore, so a
+                # request the fleet actually LOST must not wear it.
+                self._finish(freq, RequestFailure(
+                    rid=freq.rid, status="failover_failed", error=str(e),
+                ))
+
+    # --- telemetry ------------------------------------------------------------
+
+    def latency_stats(self) -> dict | None:
+        """Router-side end-to-end percentiles over the current window
+        (arrival at the ROUTER → final result, across handoffs and
+        failovers) plus fleet totals — the bench's aggregate line."""
+        comp = self._completed
+        if not comp:
+            return None
+        e2e = np.asarray([c["e2e"] for c in comp], np.float64)
+        return {
+            "requests": len(comp),
+            "ok": sum(1 for c in comp if c["ok"]),
+            "generated": int(sum(c["generated"] for c in comp)),
+            "reroutes": int(sum(c["reroutes"] for c in comp)),
+            "e2e_p50": float(np.percentile(e2e, 50)),
+            "e2e_p99": float(np.percentile(e2e, 99)),
+        }
+
+    def fleet_snapshot(self) -> dict:
+        """Per-replica registries merged into ONE fleet view: the
+        unlabeled sums (bit-compatible with the round-7 merge) plus
+        ``{replica="..."}``-labeled per-replica series, and the router's
+        own fleet_* counters."""
+        from learning_jax_sharding_tpu.parallel.multihost import (
+            merge_registry_snapshots,
+        )
+
+        labels = sorted(self.replicas)
+        snaps = [
+            self.replicas[n].engine.registry.snapshot() for n in labels
+        ]
+        return {
+            "replicas": labels,
+            "router": self.registry.snapshot(),
+            "merged": merge_registry_snapshots(snaps, labels=labels),
+        }
+
+    def prometheus_text(self) -> str:
+        """One Prometheus exposition for the whole fleet: router
+        counters plus every engine metric, summed AND per-replica
+        labeled."""
+        from learning_jax_sharding_tpu.telemetry.registry import (
+            snapshot_prometheus_text,
+        )
+
+        snap = self.fleet_snapshot()
+        return snapshot_prometheus_text(
+            {**snap["router"], **snap["merged"]}
+        )

@@ -132,10 +132,13 @@ class RequestFailure:
     ``pop_finished`` so failures are a terminal status, never a silent
     drop. ``tokens`` carries the partial ``[prompt, generated...]``
     output when the request had been admitted (None when it failed in
-    the queue)."""
+    the queue). Status ``"rerouted"`` is terminal only for THIS engine:
+    the fleet router drained the request for failover/handoff and will
+    recompute it bit-identically on another replica — visible here so a
+    failover never masquerades as a fresh admission."""
 
     rid: int
-    status: str                      # deadline|poisoned|malformed|shutdown
+    status: str              # deadline|poisoned|malformed|shutdown|rerouted
     error: str | None = None
     tokens: np.ndarray | None = None
 
@@ -175,7 +178,7 @@ class _Request:
     first_token_t: float | None = None
     finish_t: float | None = None
     tokens: np.ndarray | None = None      # final [prompt, generated...]
-    status: str = "ok"                    # or deadline|poisoned|malformed|shutdown
+    status: str = "ok"    # or deadline|poisoned|malformed|shutdown|rerouted
     error: str | None = None
     deadline_s: float | None = None       # per-request TTL override
     strikes: int = 0                      # dispatch faults while admitted
@@ -403,6 +406,26 @@ class ContinuousEngine:
     * ``close()`` drains: every in-flight/queued request gets terminal
       status ``"shutdown"`` before the device state drops — callers
       polling ``pop_finished`` always terminate. Idempotent.
+
+    FLEET (round 11): the engine is one REPLICA of a
+    :class:`~learning_jax_sharding_tpu.fleet.FleetRouter` fleet —
+
+    * ``drain_requests(status="rerouted")`` is the failover drain: every
+      queued/in-flight request retires here with a ``"rerouted"``
+      terminal status (``engine_rerouted_total``,
+      ``latency_stats()["rerouted"]`` — a failover is visible, never
+      disguised as fresh admissions) and returns requeueable records
+      that RECOMPUTE BIT-IDENTICALLY on a survivor (the ``_unadmit``
+      recompute guarantee: draws are keyed by (request id, position)).
+    * ``export_kv`` / ``ingest_kv`` are the DISAGGREGATED handoff: a
+      dedicated prefill engine (``max_new_tokens=1``) retires a request
+      at its first token, its cache row streams to a decode engine
+      through the explicit resharding transfer plan
+      (``fleet.kv_transfer`` — host-plan bytes, no hidden XLA
+      collectives: the ``kv_export``/``kv_ingest`` goldens pin both
+      device programs), and the decode engine continues the stream
+      bit-identically to a single engine of the same mesh shape.
+      Unpaged, non-speculative engines only.
     """
 
     def __init__(
@@ -958,6 +981,48 @@ class ContinuousEngine:
                 remaining, t_cache, d_cache,
             )
 
+        @jax.jit
+        def kv_export(cache, slot):
+            """One slot's cache ROW — every cache leaf indexed at ``slot``
+            on its batch dim, per-row counters included (fixed shapes, so
+            the export is one executable for the engine's lifetime). The
+            prefill half of the DISAGGREGATED handoff (round 11): a pure
+            per-device gather whose golden contract
+            (``analysis/golden/kv_export.json``) pins that extracting a
+            row adds no collectives — the cross-replica byte movement
+            rides the explicit host transfer plan
+            (``fleet.kv_transfer``), where it is counted, never hidden
+            in XLA resharding."""
+            return jax.tree.map(
+                lambda x: jax.lax.dynamic_index_in_dim(
+                    x, slot, 0, keepdims=False
+                ),
+                cache,
+            )
+
+        @jax.jit
+        def kv_ingest(cache, rows, slot, index):
+            """Write an externally produced cache row into ``slot`` and
+            set its per-row counters to ``index`` (the row's valid
+            length) — the decode half of the disaggregated handoff.
+            Bytes past ``index`` are invisible to the causal-at-index
+            masks (the ``_reset_rows`` invariant), so the transfer plan
+            only has to deliver the valid prefix; its own golden
+            (``analysis/golden/kv_ingest.json``) pins that the update
+            adds no collectives when the rows arrive in this cache's own
+            row layout (``kv_row_shardings``)."""
+
+            def leaf(path, x, row):
+                if getattr(path[-1], "key", None) in (
+                    "cache_index", "position"
+                ):
+                    row = jnp.asarray(index)
+                return jax.lax.dynamic_update_index_in_dim(
+                    x, row.astype(x.dtype), slot, 0
+                )
+
+            return jax.tree_util.tree_map_with_path(leaf, cache, rows)
+
         # --- engine configuration and compiled programs -------------------
         self._mesh, self._rules = mesh, rules
         self._cfg, self._d_cfg = cfg, d_cfg
@@ -1003,6 +1068,8 @@ class ContinuousEngine:
         self._decode_block_spec_fn = decode_block_spec
         self._mixed_step_fn = mixed_step
         self._spec_mixed_step_fn = spec_mixed_step
+        self._kv_export_fn = kv_export
+        self._kv_ingest_fn = kv_ingest
 
         # --- persistent state ---------------------------------------------
         self.rng = jax.random.key(0)
@@ -1028,6 +1095,8 @@ class ContinuousEngine:
         self._last_decode_args = None
         self._last_decode_plain_args = None   # degraded-spec decode_block
         self._last_mixed_args = None
+        self._last_kv_export_args = None      # disaggregated handoff
+        self._last_kv_ingest_args = None
         self._init_telemetry(registry, tracer, slo, recorder)
         self._init_slots()
         if paged:
@@ -1129,6 +1198,17 @@ class ContinuousEngine:
         self._c_req_failed = r.counter(
             "engine_requests_failed_total",
             "requests retired with a non-ok terminal status")
+        self._c_rerouted = r.counter(
+            "engine_rerouted_total",
+            "requests drained with status 'rerouted' — failover/handoff "
+            "requeue onto another fleet replica, never a lost request")
+        self._c_kv_exports = r.counter(
+            "engine_kv_exports_total",
+            "retired-request KV rows exported for disaggregated handoff")
+        self._c_kv_ingests = r.counter(
+            "engine_kv_ingests_total",
+            "externally prefilled requests ingested (disaggregated "
+            "handoff)")
         self._g_degraded = r.gauge(
             "engine_degradation_level",
             "current graceful-degradation ladder level (0 = normal)")
@@ -1176,6 +1256,10 @@ class ContinuousEngine:
         # lose a row's counter reset (review finding, round 5).
         self._needs_reset = np.zeros((b,), bool)
         self._reset_to = np.zeros((b,), np.int32)
+        # Retired-request → slot map while the slot's KV is still intact
+        # (export window for the disaggregated handoff); entries drop the
+        # moment the slot is reused by a later admission/ingestion.
+        self._export_ok: dict[int, int] = {}
 
     def _init_pool(self):
         # Host-owned page allocator: page 0 is scratch; a slot holds a
@@ -1216,7 +1300,7 @@ class ContinuousEngine:
                 self._c_spec_acc, self._c_spec_prop, self._c_refill_s,
                 self._c_decode_s, self._c_mixed_s, self._c_stall_s,
                 self._c_requests, self._c_finished, self._c_shed,
-                self._c_deadline, self._c_req_failed,
+                self._c_deadline, self._c_req_failed, self._c_rerouted,
             )
         }
         # Window high-water for the page-pool gauge (live value rides on).
@@ -1234,6 +1318,49 @@ class ContinuousEngine:
         if self._paged:
             self._init_pool()
 
+    def drain_requests(
+        self, *, status: str = "rerouted", error: str | None = None
+    ) -> list[dict]:
+        """DRAIN-AND-HANDOFF (round 11): retire EVERY queued and
+        in-flight request with terminal ``status`` (surfaced through
+        ``pop_finished`` — default ``"rerouted"``, the fleet router's
+        failover drain, counted by ``engine_rerouted_total`` and
+        ``latency_stats()["rerouted"]`` so a failover is visible instead
+        of looking like fresh admissions elsewhere) and return
+        requeueable records ``{rid, prompt, deadline_s, arrival_t}`` in
+        slot-then-queue order.
+
+        The drained requests RECOMPUTE EXACTLY on whatever engine
+        re-admits them — the same guarantee as ``_unadmit``'s recompute
+        preemption: greedy decoding is deterministic and every sampling
+        draw is keyed by (request id, generated position), never by
+        schedule or replica. Device state needs no repair (admission
+        resets per-row counters); the compiled programs and cache stay
+        for the next dispatch."""
+        now = time.perf_counter()
+        records: list[dict] = []
+
+        def rec(r):
+            records.append(dict(
+                rid=r.rid, prompt=r.prompt, deadline_s=r.deadline_s,
+                arrival_t=r.arrival_t,
+            ))
+
+        for slot in range(self._b):
+            if self._slot_req[slot] is not None:
+                rec(self._slot_req[slot])
+                self._fail_slot(slot, status, error, now)
+        while self._queue:
+            r = self._queue.popleft()
+            rec(r)
+            self._fail_request(r, status, error, now=now)
+        self._g_queue.set(0)
+        self._g_active.set(0)
+        self.recorder.record(
+            "engine.drain", status=status, n=len(records),
+        )
+        return records
+
     def close(self):
         """Shut the engine down to idle: every in-flight or queued
         request is DRAINED TO A TERMINAL STATUS (``"shutdown"`` — a
@@ -1245,23 +1372,16 @@ class ContinuousEngine:
         state drop. Completed-but-unpopped results are host-side and
         survive. The engine stays usable: the next dispatch re-creates
         the cache (``cache_creations`` increments)."""
-        now = time.perf_counter()
-        for slot in range(self._b):
-            if self._slot_req[slot] is not None:
-                self._fail_slot(slot, "shutdown", "engine closed", now)
-        while self._queue:
-            self._fail_request(
-                self._queue.popleft(), "shutdown",
-                "engine closed before admission", now=now,
-            )
-        self._g_queue.set(0)
-        self._g_active.set(0)
+        self.drain_requests(status="shutdown", error="engine closed")
         self._cache = None
         self._cast_src = self._cast_out = None
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
         self._last_decode_plain_args = None
         self._last_mixed_args = None
+        self._last_kv_export_args = None
+        self._last_kv_ingest_args = None
+        self._export_ok = {}
         if self._paged:
             self._init_pool()
         self.recorder.record("engine.close")
@@ -1475,11 +1595,14 @@ class ContinuousEngine:
         self._last_refill_args = self._last_decode_args = None
         self._last_decode_plain_args = None
         self._last_mixed_args = None
+        self._last_kv_export_args = None
+        self._last_kv_ingest_args = None
         return out
 
     def add_request(
         self, prompt, *, rid: int | None = None,
         deadline_s: float | None = None,
+        arrival_t: float | None = None,
     ) -> int:
         """Enqueue one request (the arrival process). Returns its id —
         the key ``pop_finished()`` will report it under, and (at
@@ -1492,6 +1615,11 @@ class ContinuousEngine:
         control sheds the arrival (queue at ``max_queue``, or the
         degradation ladder at its shedding level) — nothing is
         enqueued, so the caller can back off.
+
+        ``arrival_t`` (a ``time.perf_counter`` stamp) preserves the
+        ORIGINAL arrival clock when re-queuing after a failover drain
+        (``drain_requests``) — deadlines and queue-wait telemetry then
+        measure the request's true age, not its age on this replica.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_prompt(p)
@@ -1531,7 +1659,10 @@ class ContinuousEngine:
             self._next_rid = max(self._next_rid, rid + 1)
         self._queue.append(
             _Request(
-                rid=rid, prompt=p, arrival_t=time.perf_counter(),
+                rid=rid, prompt=p,
+                arrival_t=(
+                    time.perf_counter() if arrival_t is None else arrival_t
+                ),
                 deadline_s=deadline_s,
             )
         )
@@ -1548,6 +1679,24 @@ class ContinuousEngine:
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(r >= 0 for r in self._req)
+
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot — the fleet router's load probe."""
+        return len(self._queue)
+
+    def active_slots(self) -> int:
+        """Slots actively decoding right now."""
+        return int(self._active.sum())
+
+    def occupied_slots(self) -> int:
+        """Slots holding a request — decoding OR mid-prefill (a slot is
+        occupied from admission, before its first decode token; the
+        fleet placement score must see that load too)."""
+        return sum(1 for r in self._req if r >= 0)
+
+    def free_slots(self) -> int:
+        """Idle slots available for admission or external KV ingestion."""
+        return sum(1 for r in self._req if r < 0)
 
     def pop_finished(self) -> dict[int, Any]:
         """Collect every request RETIRED since the last pop. Completed
@@ -1567,6 +1716,223 @@ class ContinuousEngine:
         }
         self._finished = {}
         return fin
+
+    # --- disaggregated prefill/decode handoff (round 11) -------------------
+
+    def _check_handoff_supported(self, what: str):
+        if self._speculative:
+            raise ValueError(
+                f"{what}: speculative engines are not supported — the "
+                "draft cache would have to ride the handoff in lockstep"
+            )
+        if self._paged:
+            raise ValueError(
+                f"{what}: paged engines are not supported — rows live "
+                "behind host-owned block tables, not contiguous cache rows"
+            )
+
+    def ensure_cache(self, params, draft_params=None):
+        """Create the engine's (zeroed) KV cache WITHOUT admitting work —
+        the disaggregated-decode bring-up hook: ``ingest_kv`` and
+        ``kv_row_shardings`` need the cache arrays (and the shardings the
+        compiler gave them) to exist before the first external row lands.
+        Runs the one-shot cache-creating program with an all-zero-length
+        chunk (no writes, no advances — the same trick the paged path
+        uses), so ``cache_creations`` counts it like any other creation.
+        No-op when the cache already exists."""
+        self._check_draft_args(draft_params)
+        params, d_params = self._cast_params(params, draft_params)
+        if self._cache is not None:
+            return
+        with activate(self._mesh, self._rules):
+            first_args = (
+                params, d_params,
+                jnp.zeros((self._b, self._refill_chunk), jnp.int32),
+                jnp.zeros((self._b,), jnp.int32), self._rid_arr(),
+                self.rng,
+            )
+            _, self._cache = self._first_refill_fn(*first_args)
+            if self._paged:
+                self._cache = self._set_tables(self._cache)
+        self.cache_creations += 1
+        self._c_creations.inc()
+        self.recorder.record("engine.cache_create", n=self.cache_creations)
+        self._last_first_refill_args = lambda: first_args
+
+    def kv_row_shardings(self):
+        """Per-leaf :class:`~jax.sharding.NamedSharding` of ONE cache row
+        (the batch dim dropped) — the destination layout a KV transfer
+        plan reshards into (``fleet.kv_transfer.transfer_tree``). Rows
+        delivered in this layout make ``kv_ingest`` a purely local
+        update, which is exactly what its golden contract pins."""
+        if self._cache is None:
+            raise RuntimeError(
+                "kv_row_shardings: the engine holds no cache yet — call "
+                "ensure_cache(params) first"
+            )
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        def leaf(x):
+            spec = getattr(x.sharding, "spec", None)
+            if spec is None or len(tuple(spec)) == 0:
+                return NamedSharding(self._mesh, PartitionSpec())
+            return NamedSharding(self._mesh, PartitionSpec(*tuple(spec)[1:]))
+
+        return jax.tree.map(leaf, self._cache)
+
+    def kv_row_seq_dims(self):
+        """Per-leaf SEQUENCE dim of one cache row (``-1`` = no sequence
+        dim — transfer the leaf whole; a plain int, not None, so the
+        map stays a well-formed pytree), for the transfer plan's
+        valid-length clipping. Derived from the row SHAPES, not assumed:
+        the dense decode backend caches rows sequence-major
+        ``(S, n_kv, h)`` but the blocked backend (the TPU ``auto``
+        default) is HEAD-major ``(n_kv, S, h)`` — a hard-coded dim 0
+        would clip the KV-heads dim there and hand the decode replica
+        zeroed heads. A row dim is the sequence dim iff it is the ONE
+        dim sized ``max_seq_len``; ambiguous shapes fall back to -1
+        (whole-leaf transfer: always correct, just unclipped)."""
+        if self._cache is None:
+            raise RuntimeError(
+                "kv_row_seq_dims: the engine holds no cache yet — call "
+                "ensure_cache(params) first"
+            )
+        s = self._cfg.max_seq_len
+
+        def leaf(x):
+            if x.ndim < 2:
+                return -1
+            row_shape = tuple(x.shape[1:])
+            hits = [d for d, n in enumerate(row_shape) if n == s]
+            return hits[0] if len(hits) == 1 else -1
+
+        return jax.tree.map(leaf, self._cache)
+
+    def export_kv(self, rid: int):
+        """DISAGGREGATED-PREFILL hook: ``(rows, length)`` for a request
+        that RETIRED here — every cache leaf's row for the slot it
+        occupied (counters included; one fixed-shape executable), plus
+        the row's valid length (``prompt + generated − 1``: the last
+        emitted token was never written back). Valid until a later
+        admission reuses the slot, so export immediately after the
+        ``step()`` that retired the request — the fleet router does.
+        ``length`` bounds the transfer plan: bytes past it are invisible
+        to the causal-at-index masks and never cross the wire."""
+        self._check_handoff_supported("export_kv")
+        slot = self._export_ok.get(rid)
+        if slot is None:
+            raise KeyError(
+                f"request {rid} is not exportable: it never retired here, "
+                "or its slot was already reused by a later admission"
+            )
+        if self._cache is None:
+            raise RuntimeError("export_kv: the engine holds no cache")
+        slot_j = jnp.int32(slot)
+        with activate(self._mesh, self._rules):
+            rows = self._kv_export_fn(self._cache, slot_j)
+        # Read the LIVE cache at relower time (like _last_decode_args
+        # et al.) — capturing the tuple would pin this moment's cache
+        # tree in HBM after later dispatches replace it.
+        self._last_kv_export_args = lambda: (self._cache, slot_j)
+        length = max(0, self._plen[slot] + self._emitted[slot] - 1)
+        self._c_kv_exports.inc()
+        self.recorder.record(
+            "engine.kv_export", rid=rid, slot=slot, length=length,
+        )
+        return rows, length
+
+    def ingest_kv(
+        self, params, prompt, first_token, rows, *, rid: int,
+        deadline_s: float | None = None,
+        arrival_t: float | None = None,
+        admit_t: float | None = None,
+        first_token_t: float | None = None,
+    ) -> int:
+        """EXTERNAL KV INGESTION: occupy a free slot with a request whose
+        PREFILL RAN ON ANOTHER ENGINE — write its transferred cache
+        ``rows`` (an ``export_kv`` tree, resharded to this mesh by the
+        fleet transfer plan), set the row's counters to the prompt
+        length, and mark it decoding with ``first_token`` pending. The
+        request then advances through the normal ``step()`` path; greedy
+        AND sampled streams are bit-identical to serving the whole
+        request on one engine of the same mesh shape (the rows hold
+        exactly the bytes this engine's own prefill would have written,
+        and every sampling draw is keyed by (request id, generated
+        position) — test-pinned). The ``*_t`` stamps carry the request's
+        ORIGINAL clock across the handoff so deadlines and latency
+        percentiles stay honest. Returns the slot taken; raises
+        ``RuntimeError`` when no slot is free (the router holds the
+        handoff until one is)."""
+        self._check_handoff_supported("ingest_kv")
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        self._validate_prompt(p)
+        if (
+            rid in self._finished
+            or rid in self._req
+            or any(r.rid == rid for r in self._queue)
+        ):
+            raise ValueError(f"request id {rid} already in use")
+        self._next_rid = max(self._next_rid, rid + 1)
+        slot = next(
+            (s for s in range(self._b) if self._req[s] < 0), None
+        )
+        if slot is None:
+            raise RuntimeError(
+                "ingest_kv: no free slot — poll free_slots() before "
+                "transferring"
+            )
+        self.ensure_cache(params)
+        slot_j, idx_j = jnp.int32(slot), jnp.int32(int(p.size))
+        with activate(self._mesh, self._rules):
+            self._cache = self._kv_ingest_fn(
+                self._cache, rows, slot_j, idx_j
+            )
+        # Live-cache closure (see export_kv): only the one transferred
+        # row tree stays retained for relowering, never a stale copy of
+        # the whole pre-ingest cache.
+        self._last_kv_ingest_args = lambda: (
+            self._cache, rows, slot_j, idx_j,
+        )
+        now = time.perf_counter()
+        r = _Request(
+            rid=rid, prompt=p,
+            arrival_t=now if arrival_t is None else arrival_t,
+            deadline_s=deadline_s,
+        )
+        r.admit_t = now if admit_t is None else admit_t
+        r.first_token_t = now if first_token_t is None else first_token_t
+        if deadline_s is not None:
+            self._any_req_deadline = True
+        self._export_ok = {
+            k: v for k, v in self._export_ok.items() if v != slot
+        }
+        self._slot_req[slot] = r
+        self._req[slot] = rid
+        self._plen[slot] = int(p.size)
+        self._pending[slot] = np.zeros((0,), np.int32)
+        self._emitted[slot] = 1
+        self._out[slot] = list(p) + [int(first_token)]
+        self._ttimes[slot] = [r.first_token_t]
+        self._tok[slot] = int(first_token)
+        self._needs_reset[slot] = False
+        self._reset_to[slot] = 0
+        self._c_requests.inc()
+        self._c_kv_ingests.inc()
+        self.tracer.async_begin(
+            "request", rid, prompt_len=int(p.size), slot=slot,
+        )
+        self.recorder.record(
+            "engine.kv_ingest", rid=rid, slot=slot, length=int(p.size),
+        )
+        if (
+            self._eos is not None and int(first_token) == self._eos
+        ) or self._max_new <= 1:
+            # The handed-off first token already ends the request.
+            self._retire(slot, now, [])
+        else:
+            self._active[slot] = True
+            self._g_active.set(int(self._active.sum()))
+        return slot
 
     def _retire(self, slot, now, retired):
         r = self._slot_req[slot]
@@ -1619,6 +1985,9 @@ class ContinuousEngine:
                 self.slo.observe("itl", g)
         self._finished[r.rid] = r
         retired.append(r.rid)
+        # Open the export window (disaggregated handoff): the row's KV
+        # stays intact until a later admission reuses this slot.
+        self._export_ok[r.rid] = slot
         self._slot_req[slot] = None
         self._req[slot] = -1
         self._active[slot] = False
@@ -1637,6 +2006,8 @@ class ContinuousEngine:
         if tokens is not None:
             r.tokens = tokens
         self._c_req_failed.inc()
+        if status == "rerouted":
+            self._c_rerouted.inc()
         self.recorder.record(
             "engine.request_failed", rid=r.rid, status=status, error=error,
         )
@@ -1861,6 +2232,11 @@ class ContinuousEngine:
                     readmission=not first_admission,
                 )
                 prompt = r.prompt
+                # The slot is being reused: any retired request whose KV
+                # row lived here is no longer exportable.
+                self._export_ok = {
+                    k: v for k, v in self._export_ok.items() if v != slot
+                }
                 self._slot_req[slot] = r
                 self._req[slot] = r.rid
                 self._plen[slot] = prompt.size
@@ -2748,6 +3124,10 @@ class ContinuousEngine:
             shed_rate=(shed / offered) if offered else 0.0,
             deadline_miss_rate=(dl / done) if done else 0.0,
             failed=int(self._win_delta(self._c_req_failed)),
+            # Failover visibility (round 11): requests drained to another
+            # replica are counted apart from true failures, so a router
+            # kill shows up as rerouted work, not as fresh admissions.
+            rerouted=int(self._win_delta(self._c_rerouted)),
         )
         return out
 
@@ -2805,6 +3185,10 @@ class ContinuousEngine:
                 self._spec_mixed_step_fn if self._speculative
                 else self._mixed_step_fn
             )
+        if self._last_kv_export_args is not None:
+            fns["kv_export"] = self._kv_export_fn
+        if self._last_kv_ingest_args is not None:
+            fns["kv_ingest"] = self._kv_ingest_fn
         return {k: cache_size(f) for k, f in fns.items()}
 
     def _dispatched_programs(self):
@@ -2845,6 +3229,16 @@ class ContinuousEngine:
                 else self._mixed_step_fn
             )
             out.append(("mixed_step", fn, self._last_mixed_args()))
+        if self._last_kv_export_args is not None:
+            out.append((
+                "kv_export", self._kv_export_fn,
+                self._last_kv_export_args(),
+            ))
+        if self._last_kv_ingest_args is not None:
+            out.append((
+                "kv_ingest", self._kv_ingest_fn,
+                self._last_kv_ingest_args(),
+            ))
         return out
 
     def _program_reports(self) -> dict[str, dict]:
@@ -2895,10 +3289,16 @@ class ContinuousEngine:
         "decode_block": "decode_step",
         "decode_block_spec": "decode_step",
         "mixed_step": "mixed_step",
+        "kv_export": "kv_export",
+        "kv_ingest": "kv_ingest",
     }
 
     def contract_name(self, program: str) -> str:
         base = self.CONTRACT_NAMES.get(program, program)
+        if program in ("kv_export", "kv_ingest"):
+            # The handoff programs are only dispatchable on non-spec
+            # engines (export/ingest raise otherwise) — one golden each.
+            return base
         if program == "decode_block":
             # The plain decode program keeps its plain golden even on a
             # speculative engine: the degradation ladder dispatches it

@@ -198,22 +198,43 @@ def allgather_registry_snapshots(registry: Any) -> dict:
     }
 
 
-def merge_registry_snapshots(per_host: Sequence[dict]) -> dict:
+def merge_registry_snapshots(
+    per_host: Sequence[dict], *, labels: Sequence[str] | None = None
+) -> dict:
     """The fleet-merge rule for registry snapshots (see
-    :func:`allgather_registry_snapshots` for the semantics)."""
+    :func:`allgather_registry_snapshots` for the semantics).
+
+    ``labels`` (one per snapshot — process ranks, or fleet REPLICA names,
+    round 11) adds a per-source label dimension: alongside the unlabeled
+    merge (bit-compatible with the labels-free call — counters summed,
+    high-waters maxed, histograms bucket-wise), every metric also appears
+    under ``'name{replica="<label>"}'`` carrying that source's OWN value,
+    so a fleet dashboard can tell replicas apart while scrapes of the
+    summed series keep working unchanged.
+    ``telemetry.registry.snapshot_prometheus_text`` renders the labeled
+    keys as real Prometheus labels.
+    """
+    if labels is not None and len(labels) != len(per_host):
+        raise ValueError(
+            f"{len(labels)} labels for {len(per_host)} snapshots"
+        )
+
+    def copy_of(v):
+        return (
+            {
+                "buckets": list(v["buckets"]),
+                "counts": list(v["counts"]),
+                "sum": v["sum"],
+                "count": v["count"],
+            }
+            if isinstance(v, dict) else v
+        )
+
     merged: dict = {}
     for host_snap in per_host:
         for k, v in host_snap.items():
             if k not in merged:
-                merged[k] = (
-                    {
-                        "buckets": list(v["buckets"]),
-                        "counts": list(v["counts"]),
-                        "sum": v["sum"],
-                        "count": v["count"],
-                    }
-                    if isinstance(v, dict) else v
-                )
+                merged[k] = copy_of(v)
             elif isinstance(v, dict):
                 m = merged[k]
                 m["counts"] = [a + b for a, b in zip(m["counts"], v["counts"])]
@@ -223,6 +244,19 @@ def merge_registry_snapshots(per_host: Sequence[dict]) -> dict:
                 merged[k] = max(merged[k], v)
             else:
                 merged[k] += v
+    if labels is not None:
+        for label, host_snap in zip(labels, per_host):
+            # Prometheus label-value escaping (backslash first); keys
+            # that already carry labels must not be re-labeled — a
+            # fleet-of-fleets merge would nest malformed label sets.
+            esc = str(label).replace("\\", "\\\\").replace('"', '\\"')
+            for k, v in host_snap.items():
+                if "{" in k:
+                    raise ValueError(
+                        f"snapshot key {k!r} is already labeled — merge "
+                        "raw registry snapshots, not a labeled merge"
+                    )
+                merged[f'{k}{{replica="{esc}"}}'] = copy_of(v)
     return merged
 
 

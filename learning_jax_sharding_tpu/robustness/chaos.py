@@ -34,6 +34,12 @@ is one module-global ``None`` check when no injector is installed):
                           grad injection route: a poisoned batch produces
                           the NaN INSIDE the jitted step, so the skip
                           guard is exercised for real).
+``fleet.step``            before the fleet router steps one replica
+                          (``replica=`` names it, ``rids=`` its in-flight
+                          requests). Kind ``raise`` models the REPLICA
+                          dying mid-dispatch: the router declares it dead
+                          and fails its work over to survivors (the
+                          ``replica_kill`` matrix cell).
 ========================  ====================================================
 
 Checkpoint corruption does not need a hook — the files are host-visible;

@@ -26,6 +26,7 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | oom_preemption    | injected page-alloc OOM           | recompute preemption (exact)      |
 | malformed_request | corrupted queued prompt           | admission re-check → fail+isolate |
 | overload_shed     | offered load > queue bound        | bounded queue + degradation ladder|
+| replica_kill      | engine replica dies mid-stream    | router failover + rerouted requeue|
 """
 
 from __future__ import annotations
@@ -196,7 +197,7 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             "engine.preempt", "engine.dispatch_fault", "step_skipped",
             "loss_spike_rollback", "emergency_checkpoint",
             "checkpoint.fallback", "engine.shed", "engine.degrade",
-            "engine.malformed",
+            "engine.malformed", "fleet.failover", "fleet.route",
         )}
 
         def delta(kind):
@@ -314,6 +315,49 @@ def run_matrix(verbose: bool = False) -> list[dict]:
         return {"shed": len(shed), "ladder_level": ladder.level,
                 "degrades": count("engine.degrade")}
 
+    def replica_kill():
+        # Fleet failover (round 11): two unified replicas, one killed
+        # mid-stream at the fleet.step seam — its queued AND in-flight
+        # requests drain with a VISIBLE "rerouted" terminal status and
+        # requeue on the survivor, where they recompute bit-identically
+        # to the fault-free single-engine run (single-device sub-meshes,
+        # same shape as the clean engine's, so the programs are
+        # identical). The kill lands on the 3rd stepped replica
+        # dispatch, when work is admitted and mid-flight.
+        from learning_jax_sharding_tpu.fleet import (
+            FleetRouter,
+            make_replicas,
+        )
+
+        reps = make_replicas(
+            cfg, rules, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+            recorder=rec,
+        )
+        router = FleetRouter(reps, recorder=rec)
+        with ChaosInjector(
+            Fault("fleet.step", "raise", at=2, count=1), recorder=rec,
+        ):
+            for rid, p in reqs.items():
+                router.add_request(p, rid=rid)
+            out = router.drain(max_steps=400)
+        dead = [r for r in reps if not r.alive]
+        assert len(dead) == 1, "exactly one replica must die"
+        assert count("fleet.failover") >= 1
+        for rid, v in out.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, clean[rid])
+        rerouted = int(
+            dead[0].engine.registry.counter("engine_rerouted_total").value
+        )
+        assert rerouted >= 1, "the drain must be visible as rerouted"
+        return {
+            "dead": dead[0].name, "rerouted": rerouted,
+            "reroutes": int(
+                router.registry.counter("fleet_reroutes_total").value
+            ),
+        }
+
     # --- training cells ---------------------------------------------------
 
     model = Transformer(cfg)
@@ -427,6 +471,8 @@ def run_matrix(verbose: bool = False) -> list[dict]:
          "admission re-check", malformed)
     cell("overload_shed", "offered load > bound",
          "shed + degradation ladder", overload)
+    cell("replica_kill", "engine replica dies mid-stream",
+         "router failover + rerouted requeue", replica_kill)
     cell("nan_grad_skip", "NaN grad/loss in-step",
          "guarded skip", lambda: nan_grad(tmp))
     cell("spike_rollback", "loss spike x1000",

@@ -224,14 +224,7 @@ class MetricsRegistry:
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4."""
-
-        def fmt(v: float) -> str:
-            if v == math.inf:
-                return "+Inf"
-            if float(v).is_integer():
-                return str(int(v))
-            return repr(float(v))
-
+        fmt = _fmt_value
         lines = []
         for m in self.metrics():
             if m.help:
@@ -251,6 +244,61 @@ class MetricsRegistry:
     def dump_prometheus(self, path) -> None:
         with open(path, "w") as f:
             f.write(self.prometheus_text())
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def snapshot_prometheus_text(snapshot: dict) -> str:
+    """Prometheus text exposition (0.0.4) for a SNAPSHOT dict — the
+    registry-independent renderer (round 11).
+
+    Accepts what :meth:`MetricsRegistry.snapshot` and
+    ``parallel.multihost.merge_registry_snapshots`` produce, including
+    LABELED keys (``'name{replica="x"}'``) from a labeled fleet merge —
+    those render as real Prometheus labels, so one scrape carries the
+    fleet sums and the per-replica series side by side. A snapshot
+    cannot tell counters from gauges, so scalars render untyped;
+    histogram dicts render as ``_bucket``/``_sum``/``_count`` series
+    (the snapshot's counts are already cumulative, +Inf last); gauge
+    ``__high_water`` companions render as ``<name>_high_water``.
+    """
+    import re
+
+    def parsed(key):
+        m = re.match(r"([^{]+?)(\{.*\})?$", key)
+        name, labels = m.group(1), m.group(2) or ""
+        if name.endswith("__high_water"):
+            name = name[: -len("__high_water")] + "_high_water"
+        return name, labels
+
+    lines = []
+    # Group by the RENDERED family name (labels stripped, high-water
+    # normalized), not the raw key: the exposition format requires every
+    # series of one metric in ONE contiguous group, and a raw-key sort
+    # would split a family around its labeled variants ('{' sorts after
+    # '_') and interleave 'name_high_water' between them.
+    for key in sorted(snapshot, key=lambda k: parsed(k)):
+        v = snapshot[key]
+        name, labels = parsed(key)
+        if isinstance(v, dict):
+            inner = labels[1:-1] + "," if labels else ""
+            for ub, c in zip(
+                list(v["buckets"]) + [math.inf], v["counts"]
+            ):
+                lines.append(
+                    f'{name}_bucket{{{inner}le="{_fmt_value(ub)}"}} {c}'
+                )
+            lines.append(f"{name}_sum{labels} {_fmt_value(v['sum'])}")
+            lines.append(f"{name}_count{labels} {v['count']}")
+        else:
+            lines.append(f"{name}{labels} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n"
 
 
 _DEFAULT = MetricsRegistry()

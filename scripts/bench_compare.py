@@ -73,6 +73,13 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     (re.compile(r"shed ([\d.]+)%"), "shed_rate_pct", False),
     (re.compile(r"deadline miss ([\d.]+)%"), "deadline_miss_pct", False),
     (re.compile(r"agreement vs plain: ([\d.]+)%"), "agreement_pct", True),
+    # Round-11 fleet gates: the tracked fleet lines report AGGREGATE
+    # throughput and router-side end-to-end tail latency per replica
+    # count — both direction-aware (the generic tok/s pattern also
+    # matches the aggregate number; these keep the fleet-specific names
+    # stable even if the line's phrasing around them changes).
+    (re.compile(r"aggregate ([\d,.]+)\s*tok/s"), "aggregate_tok_s", True),
+    (re.compile(r"e2e p99 ([\d,.]+)\s*ms"), "e2e_p99_ms", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

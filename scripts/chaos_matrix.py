@@ -4,11 +4,14 @@ unrecovered cell.
 
 Every fault class the stack claims to survive (NaN grads/logits, hung
 dispatch, page-alloc OOM, corrupted checkpoint, SIGTERM preemption,
-malformed requests, overload) is INJECTED deterministically
+malformed requests, overload, and — round 11 — an engine REPLICA dying
+mid-stream under the fleet router) is INJECTED deterministically
 (``robustness.chaos``) and driven end to end against its recovery
 policy (``robustness.matrix``). A cell passes only when the fault was
 detected, the engine/trainer kept going, and surviving work is
-bit-identical to a fault-free run where the cell promises it.
+bit-identical to a fault-free run where the cell promises it — for the
+replica kill, that means the dead replica's requests reroute (visible
+``"rerouted"`` terminals) and recompute bit-identically on survivors.
 
 Usage:
     python scripts/chaos_matrix.py [--json]

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Fleet-serving ladder on the emulated 8-device mesh (PERF.md round 11).
+
+K = 1 / 2 / 4 unified replicas, each on its own (1,2) sub-mesh, serve
+the SAME offered queue; then the disaggregated split (2 prefill + 2
+decode) serves it through the streamed KV handoff. Per configuration:
+
+* **aggregate tok/s** — completed generated tokens / wall time across
+  the whole fleet (the scaling headline: does K double throughput?);
+* **router-side e2e p50/p99** — arrival at the ROUTER → final result,
+  across handoffs (the tail the fleet exists to hold down under load);
+* (disaggregated) **KV stream volume** — bytes/segments the transfer
+  plans moved, per handed-off request.
+
+Methodology matches the bench ladders: every fleet is WARMED on a small
+prefix of the queue first (compiles excluded — each replica carries its
+own executables), stats reset, then one timed drain of the full queue.
+Emulated-CPU numbers order configurations and price the router/handoff
+overhead; chip numbers land with the next bench round (bench.py runs
+this script in a subprocess and relays the [bench] lines —
+``--bench-lines`` prints exactly those).
+
+Usage:
+    python scripts/perf_fleet.py [--bench-lines] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+NREQ, NEW = 16, 16
+
+
+def _build():
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=NREQ)
+    ]
+    return cfg, params, prompts
+
+
+def _drive(router, prompts):
+    """Warm (compiles out), reset, then one timed drain.
+
+    The warm must reach EVERY replica AND every program class — each
+    replica carries its own executables (its own sub-mesh), and a
+    single admission wave only compiles the cache-creating
+    ``first_refill``: the steady-state ``refill_step`` first dispatches
+    when a SECOND wave admits into reused slots, so each replica warms
+    directly on batch+1 requests (two waves), then a short routed pass
+    warms the handoff path (kv export/ingest + the transfer plans)."""
+    for rep in router.replicas.values():
+        b = rep.engine._b
+        rep.engine.serve(
+            rep.params, [prompts[j % len(prompts)] for j in range(b + 1)]
+        )
+    for i in range(2 * len(router.replicas)):
+        router.add_request(prompts[i % len(prompts)])
+    router.drain(max_steps=2000)
+    router.reset_stats()
+    t0 = time.perf_counter()
+    for p in prompts:
+        router.add_request(p)
+    router.drain(max_steps=5000)
+    dt = time.perf_counter() - t0
+    lat = router.latency_stats()
+    return dt, lat
+
+
+def run_ladder():
+    from learning_jax_sharding_tpu.fleet import FleetRouter, make_replicas
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+    cfg, params, prompts = _build()
+    kw = dict(
+        batch_size=4, max_new_tokens=NEW, refill_chunk=16,
+        decode_block_steps=8,
+    )
+    lines, summary = [], []
+    for k in (1, 2, 4):
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=k, mesh_shape=(1, 2), **kw,
+        )
+        router = FleetRouter(reps)
+        dt, lat = _drive(router, prompts)
+        rate = lat["generated"] / dt
+        lines.append(
+            f"[bench] fleet serving K={k} (unified, (1,2) sub-meshes): "
+            f"aggregate {rate:,.0f} tok/s, "
+            f"e2e p50 {lat['e2e_p50'] * 1e3:,.0f} ms, "
+            f"e2e p99 {lat['e2e_p99'] * 1e3:,.0f} ms "
+            f"({lat['requests']} requests, {dt:.2f} s)"
+        )
+        summary.append(dict(
+            config=f"K={k}", tok_s=rate, e2e_p50=lat["e2e_p50"],
+            e2e_p99=lat["e2e_p99"], seconds=dt,
+        ))
+    # The disaggregated split: 2 prefill + 2 decode over the same 8
+    # devices — same aggregate device count as K=4 unified, so the
+    # delta prices the handoff (transfer plan + double prefill-side
+    # admission bookkeeping) against decode isolation.
+    pre = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="prefill", **{**kw, "max_new_tokens": 1},
+    )
+    dec = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="decode", offset=4, **kw,
+    )
+    router = FleetRouter(pre + dec)
+    dt, lat = _drive(router, prompts)
+    rate = lat["generated"] / dt
+    nbytes = router.registry.counter("fleet_kv_transfer_bytes_total").value
+    nseg = router.registry.counter(
+        "fleet_kv_transfer_segments_total"
+    ).value
+    nho = max(1, router.registry.counter("fleet_handoffs_total").value)
+    lines.append(
+        f"[bench] fleet serving disaggregated 2P+2D ((1,2) sub-meshes): "
+        f"aggregate {rate:,.0f} tok/s, "
+        f"e2e p50 {lat['e2e_p50'] * 1e3:,.0f} ms, "
+        f"e2e p99 {lat['e2e_p99'] * 1e3:,.0f} ms, "
+        f"kv stream {nbytes / nho / 1e3:,.0f} kB/req "
+        f"({nseg / nho:.0f} pages/req)"
+    )
+    summary.append(dict(
+        config="2P+2D", tok_s=rate, e2e_p50=lat["e2e_p50"],
+        e2e_p99=lat["e2e_p99"], seconds=dt,
+        kv_bytes_per_req=nbytes / nho, kv_segments_per_req=nseg / nho,
+    ))
+    return lines, summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench-lines", action="store_true",
+                    help="print only the [bench] lines (for bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    lines, summary = run_ladder()
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        for ln in lines:
+            print(ln)
+    if not args.bench_lines and not args.json:
+        print("perf_fleet: done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

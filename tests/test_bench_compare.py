@@ -108,6 +108,34 @@ class TestExtraction:
         assert by[f"{name}:shed_rate_pct"]["regressed"]
         assert by[f"{name}:deadline_miss_pct"]["regressed"]
 
+    def test_fleet_gates_direction_aware(self):
+        """The round-11 fleet gates: aggregate tok/s regresses DOWN,
+        router-side e2e p99 regresses UP — per replica-count line, so a
+        scaling regression at K=4 can't hide behind a healthy K=1."""
+        lines = [
+            "[bench] fleet serving K=2 (unified, (1,2) sub-meshes): "
+            "aggregate 1,240 tok/s, e2e p50 310 ms, e2e p99 820 ms",
+            "[bench] fleet serving K=4 (unified, (1,2) sub-meshes): "
+            "aggregate 2,105 tok/s, e2e p50 300 ms, e2e p99 790 ms",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        k2 = "fleet_serving_K=2_(unified,_(1,2)_sub-meshes)"
+        k4 = "fleet_serving_K=4_(unified,_(1,2)_sub-meshes)"
+        assert m[f"{k2}:aggregate_tok_s"] == (1240.0, True)
+        assert m[f"{k2}:e2e_p99_ms"] == (820.0, False)
+        assert m[f"{k4}:aggregate_tok_s"] == (2105.0, True)
+        worse = _doc([
+            lines[0],
+            lines[1]
+            .replace("aggregate 2,105 tok/s", "aggregate 1,400 tok/s")
+            .replace("e2e p99 790 ms", "e2e p99 1,900 ms"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{k4}:aggregate_tok_s"]["regressed"]
+        assert by[f"{k4}:e2e_p99_ms"]["regressed"]
+        assert not by[f"{k2}:aggregate_tok_s"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

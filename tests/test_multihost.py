@@ -59,6 +59,33 @@ class TestLocalBatchSlice:
             multihost.local_batch_slice(17)
 
 
+class TestRegistryMerge:
+    SNAPS = [
+        {"engine_tokens_total": 10.0, "engine_queue_depth": 2.0,
+         "engine_queue_depth__high_water": 4.0},
+        {"engine_tokens_total": 6.0, "engine_queue_depth": 1.0,
+         "engine_queue_depth__high_water": 7.0},
+    ]
+
+    def test_unlabeled_merge_sums_and_maxes(self):
+        m = multihost.merge_registry_snapshots(self.SNAPS)
+        assert m["engine_tokens_total"] == 16.0
+        assert m["engine_queue_depth"] == 3.0
+        assert m["engine_queue_depth__high_water"] == 7.0
+
+    def test_labels_preserve_replica_identity(self):
+        """The round-11 satellite: a labeled merge keeps the unlabeled
+        fleet sums BIT-COMPATIBLE while adding per-source series a
+        dashboard can tell replicas apart by."""
+        plain = multihost.merge_registry_snapshots(self.SNAPS)
+        labeled = multihost.merge_registry_snapshots(
+            self.SNAPS, labels=["r0", "r1"]
+        )
+        assert {k: labeled[k] for k in plain} == plain
+        assert labeled['engine_tokens_total{replica="r0"}'] == 10.0
+        assert labeled['engine_tokens_total{replica="r1"}'] == 6.0
+
+
 class TestHostLocalBatch:
     def test_matches_global_device_put(self, mesh24, rng):
         batch = {

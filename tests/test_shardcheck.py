@@ -564,6 +564,7 @@ class TestCheckedInGoldens:
         "zero1_update", "zero1_update_q8", "prefill",
         "decode_step", "mixed_step",
         "spec_prefill", "spec_decode_step", "spec_mixed_step",
+        "kv_export", "kv_ingest",
         "moe_dispatch", "ring_attention", "ulysses_attention",
     )
 
@@ -586,6 +587,19 @@ class TestCheckedInGoldens:
                      "spec_mixed_step", "moe_dispatch"):
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.collectives, f"{name} golden records no collectives"
+
+    def test_kv_handoff_goldens_pin_zero_collectives(self):
+        """The round-11 disaggregated-handoff claim, as checked-in
+        contract: BOTH device-side programs of the KV handoff (the
+        export gather, the ingest update) compile to ZERO collectives —
+        every cross-replica byte rides the explicit, counted
+        fleet/kv_transfer plan, never a hidden XLA reshard."""
+        from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
+
+        for name in ("kv_export", "kv_ingest"):
+            c = Contract.load(GOLDEN_DIR / f"{name}.json")
+            assert c.collectives == {}, (name, c.collectives)
+            assert c.while_collectives == 0
 
     def test_q8_golden_records_the_ring(self):
         """The quantized grad-sync golden must pin the int8 ring's

@@ -291,6 +291,47 @@ class TestEnginePolicies:
         assert eng.recorder.events("engine.quarantine")
         assert eng.recorder.events("engine.dispatch_fault")
 
+    def test_drain_requests_rerouted_visible_and_recomputes(
+        self, served, mesh22
+    ):
+        """The round-11 failover drain: queued AND in-flight requests
+        retire with a VISIBLE "rerouted" terminal status (counter +
+        latency_stats field — never disguised as fresh admissions), and
+        the returned records re-admit (original arrival clock kept) to
+        BIT-IDENTICAL outputs."""
+        cfg, params, prompts = served
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+            refill_chunk=4,
+        )
+        for p in prompts[:3]:
+            eng.add_request(p)
+        ref = _drain(eng, params)
+        for p in prompts[:3]:
+            eng.add_request(p)
+        eng.step(params)          # two admitted mid-flight, one queued
+        recs = eng.drain_requests()
+        assert [r["rid"] for r in recs] == [3, 4, 5]
+        fin = eng.pop_finished()
+        for rid, r in fin.items():
+            assert isinstance(r, RequestFailure)
+            assert r.status == "rerouted"
+        assert fin[3].tokens is not None    # admitted: partial kept
+        assert fin[5].tokens is None        # never left the queue
+        assert eng.registry.counter("engine_rerouted_total").value == 3
+        # Re-admission (what the fleet router does on a survivor):
+        # same rids, original arrival stamps — outputs bit-identical.
+        for r in recs:
+            eng.add_request(
+                r["prompt"], rid=r["rid"], arrival_t=r["arrival_t"],
+            )
+        out = _drain(eng, params)
+        for rid, want in ((3, 0), (4, 1), (5, 2)):
+            np.testing.assert_array_equal(out[rid], ref[want])
+        lat = eng.latency_stats()
+        assert lat["rerouted"] == 3
+        assert lat["failed"] >= 3
+
     def test_validation(self, served, mesh22):
         cfg, *_ = served
         kw = dict(batch_size=2, max_new_tokens=4)
@@ -478,7 +519,7 @@ class TestFaultMatrix:
         assert not bad, "unrecovered cells:\n" + "\n".join(
             f"  {r['cell']}: {r['error']}" for r in bad
         )
-        assert len(results) == 10
+        assert len(results) == 11
         # Every cell that injects through a chaos seam recorded it
         # (ckpt_corruption corrupts the filesystem directly; overload's
         # fault IS the offered load — neither crosses a seam).
