@@ -705,6 +705,117 @@ def bench_fleet():
             _log(line)
 
 
+def bench_tenancy():
+    """Tenancy (round 12): zero-downtime weight hot-swap under load at
+    125M, plus the multi-LoRA mixed-batch ladder.
+
+    The device part serves a saturated queue through the 125M MIXED
+    engine while drain-mode ``swap_weights`` rollouts land every few
+    dispatches — tracked numbers are the swap stall (the stage → commit
+    serve gap, from the engine's ``engine.swap_commit`` events) p50/p99
+    and throughput during the rollout vs undisturbed. The warm pass
+    commits one swap and serves through the swapped-in weights first:
+    the staged tree's layout differs from the born-init layout, and the
+    one-time post-commit recompile must not land in the timed rollout.
+
+    The multi-LoRA ladder (mixed-adapter vs solo tok/s at 1/4/16
+    adapters) prices host-side pool machinery, nothing chip-specific, so
+    it runs on the emulated 8-device mesh in a subprocess
+    (``scripts/perf_tenancy.py --bench-lines``) whose lines are relayed,
+    exactly like ``bench_fleet``.
+    """
+    import dataclasses
+    import os
+    import pathlib
+    import subprocess
+    import time as _time
+
+    import flax.linen as nn
+
+    from learning_jax_sharding_tpu.models.serving import make_continuous_engine
+
+    cfg = dataclasses.replace(CONFIG_125M, max_seq_len=1024)
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    rng = np.random.default_rng(5)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((8, 64), np.int32)
+        )["params"]
+    )
+    new_params = jax.jit(
+        lambda t: jax.tree.map(lambda x: x * (1.0 + 1e-3), t)
+    )(params)
+    NREQ, NEW = 16, 32
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(64,)).astype(np.int32)
+        for _ in range(NREQ)
+    ]
+    serve = make_continuous_engine(
+        cfg, mesh, RULES_DP_TP, batch_size=8, max_new_tokens=NEW,
+        refill_chunk=64, inference_dtype=jnp.bfloat16, mixed=True,
+        token_budget=128 + 8, decode_block_steps=NEW,
+    )
+    eng = serve.engine
+
+    def drive(reqs, swap_every=None, versions=()):
+        plen = {}
+        for p in reqs:
+            plen[eng.add_request(p)] = len(p)
+        vq = list(versions)
+        steps = 0
+        t0 = _time.perf_counter()
+        while eng.has_work():
+            if (
+                vq and swap_every and steps % swap_every == swap_every - 1
+                and not eng.swap_pending
+            ):
+                v = vq.pop(0)
+                eng.swap_weights(
+                    new_params if v % 2 else params, version=v,
+                )
+            eng.step(params)
+            steps += 1
+        dt = _time.perf_counter() - t0
+        gen = sum(
+            len(t) - plen[rid] for rid, t in eng.pop_finished().items()
+            if not hasattr(t, "status")
+        )
+        return dt, gen
+
+    drive(prompts[:9])                       # warm: first_refill + mixed step
+    eng.swap_weights(new_params, version=1)  # warm the stage + commit path
+    while eng.has_work():
+        eng.step(params)
+    drive(prompts[:9])                       # warm the post-commit layout
+    dt0, gen0 = drive(prompts)               # undisturbed baseline
+    eng.recorder.clear()
+    dt, gen = drive(prompts, swap_every=2, versions=[2, 3, 4, 5, 6])
+    stalls = np.asarray([
+        e["stall_s"] for e in eng.recorder.events("engine.swap_commit")
+    ])
+    _log(
+        f"[bench] 125M hot-swap under load: "
+        f"swap stall p50 {np.percentile(stalls, 50) * 1e3:,.0f} ms, "
+        f"swap stall p99 {np.percentile(stalls, 99) * 1e3:,.0f} ms "
+        f"({len(stalls)} swaps, {gen / dt:,.0f} tok/s during rollout vs "
+        f"{gen0 / dt0:,.0f} tok/s undisturbed)"
+    )
+
+    script = pathlib.Path(__file__).resolve().parent / "scripts" / "perf_tenancy.py"
+    proc = subprocess.run(
+        [sys.executable, str(script), "--bench-lines"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"perf_tenancy exited {proc.returncode}: {tail}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+
+
 def _device_ready(timeout_s: float = 600.0) -> bool:
     """Probe the device with a tiny op under a watchdog.
 
@@ -835,6 +946,10 @@ def main():
         bench_fleet()
     except Exception as e:
         _log(f"[bench] fleet bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_tenancy()
+    except Exception as e:
+        _log(f"[bench] tenancy bench skipped: {type(e).__name__}: {e}")
     try:
         bench_moe_125m()
     except Exception as e:

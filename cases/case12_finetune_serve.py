@@ -1,22 +1,32 @@
-"""Case 12 — the post-training lifecycle: LoRA fine-tune → quantize → serve.
+"""Case 12 — serve WHILE training: the tenancy loop on one model.
 
-Nothing in the reference goes past a jitted forward
-(`/root/reference/case6_attention.py:229-238`); this case composes the
-framework's post-training stack on one model, end to end:
+The pre-round-12 version of this case stopped the world to deploy —
+pretrain, fine-tune, merge, then start a fresh decoder on the folded
+weights. This rewrite runs the production loop the tenancy subsystem
+exists for:
 
-1. **pretrain** the tiny transformer on a base pattern (ascending mod-V);
-2. **LoRA fine-tune** (``training/lora.py``) on a SHIFTED pattern with the
-   base frozen — only rank-r adapters train, and merging them back yields a
-   plain param tree;
-3. **int8-quantize** the merged model (``models/quantize.py``) and serve it
-   with in-jit dequantization;
-4. **speculative decoding** (``models/speculative.py``): the PRETRAINED
-   model drafts for the fine-tuned target — exactness holds by construction,
-   and the acceptance rate shows how draft/target agreement pays.
+1. **pretrain** the tiny transformer on a base pattern (+1 mod V);
+2. **serve while fine-tuning** — a live multi-LoRA
+   :class:`~learning_jax_sharding_tpu.models.serving.ContinuousEngine`
+   answers base-tenant traffic on every training step while
+   ``training/lora.py`` fine-tunes a rank-8 adapter on the +SHIFT
+   pattern next to it (base frozen, same mesh, no drain);
+3. **hot-add** the trained adapter to the engine's
+   :class:`~learning_jax_sharding_tpu.tenancy.AdapterPool` — the NEXT
+   fused batch serves base rows and fine-tuned rows together, and every
+   adapter-routed stream is bit-identical to a solo engine on the
+   ``merge_lora``-folded weights;
+4. **rolling-swap the deployment** — the folded model becomes base
+   version 2 across a 2-replica fleet via
+   ``FleetRouter.rolling_swap``: replicas drain one at a time behind
+   the placement policy, zero requests drop, every response is
+   attributable to exactly one weight version, and post-swap traffic
+   continues the +SHIFT pattern with NO adapter attached.
 
-Everything runs under one (data, model) mesh: adapters inherit kernel
-shardings, int8 tensors inherit theirs, both decoders run the same GSPMD
-collectives as training.
+Everything runs under (data, model) meshes: adapters inherit kernel
+shardings, the staged swap tree is resharded into each replica's
+serving layout off the hot path, and both decode paths run the same
+GSPMD collectives as training.
 
 Run: ``python cases/case12_finetune_serve.py``
 """
@@ -26,38 +36,44 @@ from learning_jax_sharding_tpu.parallel import force_emulated_devices
 
 force_emulated_devices(8)
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
 
-from learning_jax_sharding_tpu.models.generate import make_generate_fn
-from learning_jax_sharding_tpu.models.quantize import (
-    quantize_tree,
-    quantized_bytes,
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FleetRouter,
+    make_replicas,
 )
-from learning_jax_sharding_tpu.models.speculative import (
-    make_speculative_generate_fn,
+from learning_jax_sharding_tpu.models.serving import (  # noqa: E402
+    ContinuousEngine,
+    RequestFailure,
 )
-from learning_jax_sharding_tpu.models.transformer import (
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
     CONFIG_TINY,
     Transformer,
     next_token_loss,
 )
-from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
-from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
-from learning_jax_sharding_tpu.training.lora import (
+from learning_jax_sharding_tpu.parallel import (  # noqa: E402
+    build_mesh,
+    mesh_sharding,
+    put,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.tenancy import AdapterPool  # noqa: E402
+from learning_jax_sharding_tpu.training.lora import (  # noqa: E402
     lora_train_state,
     make_lora_train_step,
     merge_lora,
 )
-from learning_jax_sharding_tpu.training.pipeline import (
+from learning_jax_sharding_tpu.training.pipeline import (  # noqa: E402
     make_train_step,
     sharded_train_state,
 )
 
 SEQ = 32
-SHIFT = 7  # fine-tune task: next token jumps by SHIFT instead of 1
+SHIFT = 7   # fine-tune task: next token jumps by SHIFT instead of 1
+NEW = 10    # generated tokens per served request
+PLEN = 8    # served prompt length
 
 
 def pattern_batch(mesh, vocab, step, batch_size=8, index=0):
@@ -69,13 +85,35 @@ def pattern_batch(mesh, vocab, step, batch_size=8, index=0):
     return {"inputs": put(toks[:, :-1], sh), "targets": put(toks[:, 1:], sh)}
 
 
+def pattern_prompt(vocab, step, start):
+    return ((start + step * np.arange(PLEN)) % vocab).astype(np.int32)
+
+
+def pattern_frac(tokens, step, vocab):
+    """Fraction of GENERATED transitions that advance by ``step``."""
+    diffs = np.diff(np.asarray(tokens)[PLEN - 1:]) % vocab
+    return float((diffs == step).mean())
+
+
+def drain(eng, params, out, max_steps=400):
+    steps = 0
+    while eng.has_work():
+        eng.step(params)
+        out.update(eng.pop_finished())
+        steps += 1
+        assert steps <= max_steps, "engine wedged"
+    out.update(eng.pop_finished())
+    return out
+
+
 def main():
     mesh = build_mesh((2, 2), ("data", "model"), devices=jax.devices()[:4])
     cfg = CONFIG_TINY
     model = Transformer(cfg)
+    vocab = cfg.vocab_size
 
     # 1. Pretrain on the +1 pattern.
-    batch = pattern_batch(mesh, cfg.vocab_size, step=1)
+    batch = pattern_batch(mesh, vocab, step=1)
     state, state_sh = sharded_train_state(
         model, optax.adamw(3e-3), batch["inputs"],
         {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
@@ -85,60 +123,132 @@ def main():
         RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
     )
     for i in range(60):
-        state, base_loss = step(state, pattern_batch(mesh, cfg.vocab_size, 1, index=i))
+        state, base_loss = step(state, pattern_batch(mesh, vocab, 1, index=i))
     base = state.params
     print(f"pretrain (+1 pattern): final loss {float(base_loss):.3f}")
 
-    # 2. LoRA fine-tune on the +SHIFT pattern, base frozen.
+    # 2. Serve WHILE fine-tuning: the live engine answers base-tenant
+    #    traffic on every optimizer step — no drain, no second process.
+    pool = AdapterPool(base, slots=2, rank=8, mesh=mesh)
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, adapter_pool=pool, batch_size=4,
+        max_new_tokens=NEW, refill_chunk=8, mixed=True,
+    )
+    bg_prompts = {i: pattern_prompt(vocab, 1, 11 * i + 3) for i in range(8)}
+    for rid, p in bg_prompts.items():
+        eng.add_request(p, rid=rid)
+
     ls = lora_train_state(
         jax.random.key(1), base, optax.adamw(1e-2), rank=8, mesh=mesh
     )
-    ft_batch = pattern_batch(mesh, cfg.vocab_size, step=SHIFT)
+    ft_batch = pattern_batch(mesh, vocab, step=SHIFT)
     lora_step = make_lora_train_step(
         model, state_sh.params, {k: v.sharding for k, v in ft_batch.items()},
         mesh, RULES_DP_TP, optax.adamw(1e-2), loss_fn=next_token_loss,
     )
     first = last = None
+    served_during = {}
     for i in range(80):
-        ls, loss = lora_step(base, ls, pattern_batch(mesh, cfg.vocab_size, SHIFT, index=i))
+        ls, loss = lora_step(
+            base, ls, pattern_batch(mesh, vocab, SHIFT, index=i)
+        )
         first = float(loss) if first is None else first
         last = float(loss)
-    print(f"LoRA fine-tune (+{SHIFT} pattern): loss {first:.3f} → {last:.3f}")
+        if eng.has_work():
+            eng.step(base)
+            served_during.update(eng.pop_finished())
+    print(f"LoRA fine-tune (+{SHIFT} pattern): loss {first:.3f} → {last:.3f}"
+          f" with {len(served_during)} requests served mid-training")
     assert last < first
-    n_lora = sum(x.size for x in jax.tree.leaves(ls.adapters))
-    n_base = sum(x.size for x in jax.tree.leaves(base))
-    print(f"trained params: {n_lora:,} adapters vs {n_base:,} base "
-          f"({n_lora / n_base:.1%})")
+    assert served_during, "the engine must serve WHILE training"
+    drain(eng, base, served_during)
+    assert not any(
+        isinstance(v, RequestFailure) for v in served_during.values()
+    )
+    base_frac = np.mean([
+        pattern_frac(served_during[r], 1, vocab) for r in bg_prompts
+    ])
+    print(f"  base tenant kept the +1 pattern throughout "
+          f"({base_frac:.0%} of transitions)")
+    assert base_frac > 0.5, base_frac
 
+    # 3. Hot-add the trained adapter: no restart, no folded copy of the
+    #    base — the next fused batch serves both tenants together.
+    pool.add("shift7", ls)   # LoraState: the trained alpha rides along
+    mix = {}
+    adapter_of = {}
+    for i in range(6):
+        name = "shift7" if i % 2 else None
+        p = pattern_prompt(vocab, SHIFT if name else 1, 17 * i + 5)
+        rid = 100 + i
+        eng.add_request(p, rid=rid, adapter=name)
+        mix[rid] = p
+        adapter_of[rid] = name
+    out = drain(eng, base, {})
+    tuned_rids = [r for r, n in adapter_of.items() if n == "shift7"]
+    tuned_frac = np.mean([pattern_frac(out[r], SHIFT, vocab)
+                          for r in tuned_rids])
+    print(f"hot-added adapter rows continue the +{SHIFT} pattern "
+          f"({tuned_frac:.0%}); base rows in the same batch stay +1")
+    assert tuned_frac > 0.6, tuned_frac
+
+    # The oracle: every adapter-routed stream equals a solo engine on
+    # the merge_lora-folded weights, bit for bit.
     merged = merge_lora(base, ls)
-
-    # 3. Quantize the merged model; serve int8 with in-jit dequant.
-    bf16 = jax.tree.map(lambda x: x.astype(jnp.bfloat16), merged)
-    qtree = quantize_tree(bf16)
-    print(f"serving bytes: bf16 {quantized_bytes(bf16):,} → int8 "
-          f"{quantized_bytes(qtree):,}")
-    prompt = np.stack([np.arange(10, 10 + 8), np.arange(40, 40 + 8)]).astype(np.int32)
-    prompt = put(prompt, mesh_sharding(mesh, "data", None))
-    gen_q = make_generate_fn(
-        cfg, mesh, RULES_DP_TP, max_new_tokens=10,
-        inference_dtype=jnp.bfloat16, dequantize=True,
+    solo = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+        refill_chunk=8, mixed=True,
     )
-    out_q = np.asarray(gen_q(qtree, prompt, jax.random.key(2)))
-    print("int8 serve, fine-tuned model continues the +7 pattern:")
-    print(" ", out_q[0])
-    # The fine-tuned model must continue with +SHIFT steps, not +1.
-    diffs = np.diff(out_q[0, 7:]) % cfg.vocab_size
-    assert (diffs == SHIFT).mean() > 0.6, diffs
+    ref = solo.serve(merged, [mix[r] for r in tuned_rids])
+    for r, want in zip(tuned_rids, ref):
+        np.testing.assert_array_equal(out[r], want)
+    solo.close()
+    eng.close()
+    print("  bit-identical to the merge_lora-folded solo engine ✓")
 
-    # 4. Speculative decoding: pretrained model drafts for the merged target.
-    spec = make_speculative_generate_fn(
-        cfg, cfg, mesh, RULES_DP_TP, max_new_tokens=10, num_draft=3,
+    # 4. Deploy: the folded model becomes base VERSION 2 across a
+    #    2-replica fleet — a rolling swap behind the placement policy,
+    #    zero dropped requests, per-version attribution.
+    host_base = jax.tree.map(np.asarray, base)
+    host_merged = jax.tree.map(np.asarray, merged)
+    reps = make_replicas(
+        cfg, RULES_DP_TP, host_base, count=2, mesh_shape=(1, 2),
+        batch_size=2, max_new_tokens=NEW, refill_chunk=8,
     )
-    plain = make_generate_fn(cfg, mesh, RULES_DP_TP, max_new_tokens=10)
-    out_spec = np.asarray(spec(merged, base, prompt))
-    out_plain = np.asarray(plain(merged, prompt, jax.random.key(0)))
-    assert (out_spec == out_plain).all(), "speculative must equal plain greedy"
-    print("speculative decode (pretrained drafts for fine-tuned): exact ✓")
+    router = FleetRouter(reps)
+    for i in range(6):
+        router.add_request(pattern_prompt(vocab, 1, 13 * i + 2), rid=i)
+    for _ in range(2):          # get work in flight before the rollout
+        router.step()
+    timeline = router.rolling_swap(host_merged, version=2)
+    assert all(t["committed"] for t in timeline), timeline
+    for i in range(6):          # post-swap traffic, NO adapter attached
+        router.add_request(
+            pattern_prompt(vocab, SHIFT, 19 * i + 4), rid=200 + i
+        )
+    results = {}
+    steps = 0
+    while router.has_work():
+        router.step()
+        results.update(router.pop_finished())
+        steps += 1
+        assert steps <= 2000, "fleet wedged"
+    results.update(router.pop_finished())
+    failures = {r: v for r, v in results.items()
+                if isinstance(v, RequestFailure)}
+    assert not failures, f"rolling swap dropped requests: {failures}"
+    versions = {}
+    for rep in reps:
+        versions.update(rep.engine.finished_versions)
+    assert all(versions[200 + i] == 2 for i in range(6)), versions
+    assert all(versions[i] in (0, 2) for i in range(6)), versions
+    post_frac = np.mean([
+        pattern_frac(results[200 + i], SHIFT, vocab) for i in range(6)
+    ])
+    print(f"rolling swap: {len(timeline)}/2 replicas committed v2, "
+          f"0 dropped; post-swap base traffic continues +{SHIFT} "
+          f"({post_frac:.0%}) with no adapter attached")
+    assert post_frac > 0.6, post_frac
     print("case12 PASS")
 
 

@@ -1,0 +1,279 @@
+"""Case 23 — tenancy: multi-LoRA fused serving + zero-downtime hot-swap.
+
+The round-12 subsystem, end to end on the emulated 8-device mesh:
+
+* **multi-LoRA** — three tenants' rank-4 adapters paged into one
+  :class:`~learning_jax_sharding_tpu.tenancy.AdapterPool`; base rows
+  and all three tenants share ONE fused ``adapter_mixed_step`` batch,
+  and every stream is BIT-IDENTICAL to a solo engine serving that
+  tenant's ``merge_lora``-folded weights;
+* **saturated hot-swap** — ``swap_weights`` staged mid-stream under a
+  full queue (drain mode): zero dropped/failed requests, in-flight
+  requests finish on v0, the post-commit backlog serves on v1, every
+  response attributable to exactly one version
+  (``finished_versions``), and the commit's serve gap lands in the
+  ``engine.swap_commit`` flight-recorder events as ``stall_s``;
+* **fleet rolling swap** — 2 unified replicas behind a
+  :class:`~learning_jax_sharding_tpu.fleet.FleetRouter`;
+  ``rolling_swap`` walks them one at a time (the fleet keeps serving
+  throughout), and each response matches the per-version single-engine
+  oracle: v0 responses equal a pure run on the old weights, v2
+  responses a pure run on the new ones.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case23``, else a
+temp dir): ``swap_timeline.json`` (the ``rolling_swap`` timeline via
+``tenancy.write_swap_timeline``), ``metrics.prom`` (labeled fleet
+exposition incl. ``engine_swap_*`` / ``engine_adapter_*`` counters),
+``events.json`` (the recorder ring's swap/adapter/fleet timeline), and
+``tenancy_summary.json``.
+
+Run: ``python cases/case23_tenancy.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FleetRouter,
+    make_replicas,
+    replicated_params,
+)
+from learning_jax_sharding_tpu.models.serving import (  # noqa: E402
+    ContinuousEngine,
+    RequestFailure,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh  # noqa: E402
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    FlightRecorder,
+    artifact_dir,
+)
+from learning_jax_sharding_tpu.tenancy import (  # noqa: E402
+    AdapterPool,
+    write_swap_timeline,
+)
+from learning_jax_sharding_tpu.training.lora import (  # noqa: E402
+    init_lora,
+    merge_lora,
+)
+
+NREQ, NEW, RANK = 10, 8, 4
+
+
+def drive(eng, params, reqs, *, adapters=None, max_steps=500):
+    for rid, p in reqs.items():
+        eng.add_request(p, rid=rid, adapter=(adapters or {}).get(rid))
+    out, steps = {}, 0
+    while eng.has_work():
+        eng.step(params)
+        out.update(eng.pop_finished())
+        steps += 1
+        assert steps <= max_steps, "engine wedged"
+    out.update(eng.pop_finished())
+    return out
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case23")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(23)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(5, 14, size=NREQ)
+    ]
+    rec = FlightRecorder(max_events=65536)
+    summary: dict = {}
+
+    # --- 1. multi-LoRA: one fused batch, three tenants + base --------
+    mesh = build_mesh((2, 4), ("data", "model"))
+    adapters = {
+        f"t{i}": jax.tree.map(
+            # B perturbed off zero — a fresh init's B=0 adapter IS the
+            # base model and the bit-identity oracle would be vacuous.
+            lambda x, i=i: x + 0.02 * (i + 1),
+            init_lora(jax.random.key(i + 1), params, RANK),
+        )
+        for i in range(3)
+    }
+    pool = AdapterPool(params, slots=4, rank=RANK, mesh=mesh)
+    for name, ad in adapters.items():
+        pool.add(name, ad)
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, adapter_pool=pool, batch_size=4,
+        max_new_tokens=NEW, refill_chunk=8, mixed=True, recorder=rec,
+    )
+    tenants = [None, "t0", "t1", "t2"]
+    names = {i: tenants[i % len(tenants)] for i in range(NREQ)}
+    mixed = drive(eng, params, dict(enumerate(prompts)), adapters=names)
+    assert eng.compile_counts().get("adapter_mixed_step", 0) >= 1
+    print(f"case23: {NREQ} requests across base + {len(adapters)} "
+          f"tenants in one fused batch")
+
+    for name in tenants:
+        rids = [r for r, n in names.items() if n == name]
+        merged = params if name is None else merge_lora(
+            params, adapters[name]
+        )
+        solo = ContinuousEngine(
+            cfg, mesh, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+            refill_chunk=8, mixed=True,
+        )
+        ref = drive(solo, merged, {r: prompts[r] for r in rids})
+        solo.close()
+        for r in rids:
+            np.testing.assert_array_equal(mixed[r], ref[r])
+    adapter_dispatches = int(
+        eng.registry.counter("engine_adapter_dispatches_total").value
+    )
+    assert adapter_dispatches >= 1
+    eng.close()
+    print(f"  every stream bit-identical to its tenant's merge_lora "
+          f"solo engine ✓ ({adapter_dispatches} adapter dispatches)")
+    summary["multi_lora"] = {
+        "tenants": len(adapters), "requests": NREQ,
+        "bit_identical_to_solo": True,
+        "adapter_dispatches": adapter_dispatches,
+    }
+
+    # --- 2. saturated hot-swap on one engine -------------------------
+    new_params = jax.tree.map(lambda x: np.asarray(x) * 1.05, params)
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+        refill_chunk=8, mixed=True, recorder=rec,
+    )
+    for i, p in enumerate(prompts):
+        eng.add_request(p, rid=i)
+    eng.step(params)             # work in flight — the queue is saturated
+    assert eng.swap_weights(new_params, version=1)
+    swapped = {}
+    steps = 0
+    while eng.has_work():
+        eng.step(params)         # stale tree: the commit overrides it
+        swapped.update(eng.pop_finished())
+        steps += 1
+        assert steps <= 500, "engine wedged"
+    swapped.update(eng.pop_finished())
+    assert not any(isinstance(v, RequestFailure) for v in swapped.values())
+    vers = dict(eng.finished_versions)
+    assert sorted(vers) == list(range(NREQ))
+    assert set(vers.values()) == {0, 1}, vers
+    stalls = [e["stall_s"] for e in rec.events("engine.swap_commit")]
+    assert len(stalls) == 1
+    eng.close()
+    n_old = sum(1 for v in vers.values() if v == 0)
+    print(f"  saturated swap: 0 dropped, {n_old} responses on v0 / "
+          f"{NREQ - n_old} on v1, commit stall "
+          f"{stalls[0] * 1e3:.0f} ms")
+    summary["hot_swap"] = {
+        "requests": NREQ, "dropped": 0,
+        "versions": {str(v): sum(1 for x in vers.values() if x == v)
+                     for v in sorted(set(vers.values()))},
+        "commit_stall_s": stalls[0],
+    }
+
+    # --- 3. fleet rolling swap, per-version oracle -------------------
+    host_old = jax.tree.map(np.asarray, params)
+    host_new = jax.tree.map(np.asarray, new_params)
+    fmesh = build_mesh((1, 2), ("data", "model"), devices=jax.devices()[:2])
+    oracle = ContinuousEngine(
+        cfg, fmesh, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=8,
+    )
+    ref_old = oracle.serve(replicated_params(host_old, fmesh), prompts)
+    ref_new = oracle.serve(replicated_params(host_new, fmesh), prompts)
+    oracle.close()
+
+    reps = make_replicas(
+        cfg, RULES_DP_TP, host_old, count=2, mesh_shape=(1, 2),
+        batch_size=2, max_new_tokens=NEW, refill_chunk=8, recorder=rec,
+    )
+    router = FleetRouter(reps, recorder=rec)
+    for i, p in enumerate(prompts):
+        router.add_request(p, rid=i)
+    for _ in range(2):           # in flight before the rollout begins
+        router.step()
+    timeline = router.rolling_swap(host_new, version=2)
+    assert all(t["committed"] for t in timeline), timeline
+    for i, p in enumerate(prompts):
+        router.add_request(p, rid=100 + i)
+    results = {}
+    steps = 0
+    while router.has_work():
+        router.step()
+        results.update(router.pop_finished())
+        steps += 1
+        assert steps <= 2000, "fleet wedged"
+    results.update(router.pop_finished())
+    failures = {r: v for r, v in results.items()
+                if isinstance(v, RequestFailure)}
+    assert not failures, f"rolling swap dropped requests: {failures}"
+    versions = {}
+    for rep in reps:
+        versions.update(rep.engine.finished_versions)
+    for i in range(NREQ):
+        assert versions[i] in (0, 2), versions
+        np.testing.assert_array_equal(
+            results[i], ref_old[i] if versions[i] == 0 else ref_new[i]
+        )
+        assert versions[100 + i] == 2, versions
+        np.testing.assert_array_equal(results[100 + i], ref_new[i])
+    n_v0 = sum(1 for i in range(NREQ) if versions[i] == 0)
+    print(f"  rolling swap: {len(timeline)}/2 replicas → v2, 0 dropped, "
+          f"{n_v0}+{2 * NREQ - n_v0} responses matched the "
+          f"v0/v2 single-engine oracles bit for bit")
+    summary["rolling_swap"] = {
+        "replicas": len(timeline),
+        "committed": sum(1 for t in timeline if t["committed"]),
+        "requests": 2 * NREQ, "dropped": 0,
+        "responses_on_v0": n_v0,
+        "per_version_bit_identical": True,
+        "drain_steps": [t["drain_steps"] for t in timeline],
+    }
+
+    # --- artifacts ---------------------------------------------------
+    write_swap_timeline(out / "swap_timeline.json", timeline)
+    (out / "metrics.prom").write_text(router.prometheus_text())
+    (out / "events.json").write_text(
+        json.dumps(
+            [e for e in rec.events() if not e["kind"].startswith("span")]
+            [-2000:],
+            indent=2, default=str,
+        )
+    )
+    (out / "tenancy_summary.json").write_text(
+        json.dumps(summary, indent=2, default=str)
+    )
+    print(f"case23: artifacts in {out}")
+    print("case23 PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

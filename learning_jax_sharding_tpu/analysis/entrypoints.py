@@ -189,7 +189,7 @@ def _sharded_serving_params(model, mesh, rules):
 
 
 def _engine_programs(
-    *, speculative: bool, mixed: bool = False
+    *, speculative: bool, mixed: bool = False, adapters: bool = False
 ) -> list[EntryProgram]:
     """Prefill + decode via a real (tiny) ContinuousEngine: one short
     serve populates the dispatch-arg caches, then each program relowers
@@ -200,7 +200,12 @@ def _engine_programs(
     contract-free. With ``mixed`` the engine runs the FUSED
     refill+decode scheduler and contributes only its ``mixed_step`` /
     ``spec_mixed_step`` golden (the refill/decode family is already
-    pinned by the split engines)."""
+    pinned by the split engines). With ``adapters`` (round 12) the
+    mixed engine carries an :class:`~learning_jax_sharding_tpu.tenancy.
+    AdapterPool` and the contract is ``adapter_mixed_step`` /
+    ``spec_adapter_mixed_step`` — the per-row LoRA gather + batch-1
+    merged apply must add NO collectives beyond the base mixed step
+    (adapter slices are co-sharded with the kernels they adapt)."""
     import dataclasses as dc
 
     from learning_jax_sharding_tpu.models.serving import ContinuousEngine
@@ -225,6 +230,21 @@ def _engine_programs(
                 Transformer(d_cfg), mesh, RULES_TP_SERVING
             )
             kwargs.update(draft_config=d_cfg, num_draft=2)
+        if adapters:
+            import jax
+
+            from learning_jax_sharding_tpu.tenancy import AdapterPool
+            from learning_jax_sharding_tpu.training.lora import init_lora
+
+            pool = AdapterPool(params, slots=2, rank=4, mesh=mesh)
+            # B must be nonzero or the adapted row computes the base
+            # function and XLA could fold the gather away.
+            pool.add(
+                "tenant", jax.tree.map(
+                    lambda x: x + 0.01, init_lora(jax.random.key(1), params, 4)
+                ),
+            )
+            kwargs["adapter_pool"] = pool
         eng = ContinuousEngine(
             cfg, mesh, RULES_TP_SERVING,
             batch_size=2, max_new_tokens=8, refill_chunk=16,
@@ -235,13 +255,27 @@ def _engine_programs(
             rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
             for n in (20, 5)
         ]
-        eng.serve(params, prompts, draft_params=d_params)
+        if adapters:
+            # serve() has no per-request adapter plumbing (adapters are a
+            # continuous-engine tenancy feature): drive the arrival +
+            # step loop directly, one base row and one adapted row.
+            for p, name in zip(prompts, (None, "tenant")):
+                eng.add_request(p, adapter=name)
+            while eng.has_work():
+                eng.step(params, d_params)
+        else:
+            eng.serve(params, prompts, draft_params=d_params)
         built["hlo"] = {
             eng.contract_name(k): v for k, v in eng.program_hlo().items()
         }
         return built["hlo"]
 
-    if mixed:
+    if adapters:
+        names = (
+            ("spec_adapter_mixed_step",) if speculative
+            else ("adapter_mixed_step",)
+        )
+    elif mixed:
         names = ("spec_mixed_step",) if speculative else ("mixed_step",)
     else:
         names = (
@@ -260,6 +294,8 @@ def _serving_programs() -> list[EntryProgram]:
         *_engine_programs(speculative=True),
         *_engine_programs(speculative=False, mixed=True),
         *_engine_programs(speculative=True, mixed=True),
+        *_engine_programs(speculative=False, mixed=True, adapters=True),
+        *_engine_programs(speculative=True, mixed=True, adapters=True),
     ]
 
 
@@ -310,6 +346,66 @@ def _kv_transfer_programs() -> list[EntryProgram]:
     return [
         EntryProgram(name, mesh, lambda name=name: ensure()[name])
         for name in ("kv_export", "kv_ingest")
+    ]
+
+
+def _swap_reshard_programs() -> list[EntryProgram]:
+    """The weight-hot-swap staging programs (round 12). When
+    ``ContinuousEngine.swap_weights`` stages a checkpoint that arrives in
+    a TRAINING layout into the engine's serving layout on the same
+    device set, ``parallel.resharding.device_reshard`` compiles ONE
+    jitted identity with ``out_shardings`` pinned. The source here is
+    the FSDP layout (``RULES_FSDP``: EMBED over 'data', VOCAB whole) —
+    the layout whose params tree actually DIFFERS from serving;
+    ``RULES_DP_TP`` kernels already match the serving placement
+    leaf-for-leaf, which would record a vacuous empty contract. The
+    golden (``swap_reshard``) pins the claim the zero-downtime story
+    rests on: the layout change is pure data movement — all-gathers
+    over 'data', slices onto 'model' — with no arithmetic that could
+    perturb the swapped weights. ``swap_reshard_quant`` is the same
+    program over a ``quantize_tree``'d checkpoint (a quantized serving
+    engine swaps {q:int8, scale:f32} leaves; the dtypes must survive
+    the move — a dequant/requant sneaking in would silently change the
+    model). Both lower the REAL ``device_reshard`` program via its
+    ``jit_cache`` rather than a lookalike jit, so drift in the swap
+    path itself trips the contract."""
+    from learning_jax_sharding_tpu.parallel.logical import (
+        RULES_FSDP,
+        RULES_TP_SERVING,
+    )
+    from learning_jax_sharding_tpu.parallel.resharding import device_reshard
+
+    mesh = _mesh24()
+
+    def hlo_for(quant: bool):
+        def hlo():
+            import jax
+
+            from learning_jax_sharding_tpu.models.quantize import quantize_tree
+            from learning_jax_sharding_tpu.models.transformer import Transformer
+
+            cfg = _tiny_cfg()
+            model = Transformer(cfg)
+            src = _sharded_serving_params(model, mesh, RULES_FSDP)
+            # Destination = the layout a serving engine's installed tree
+            # actually carries (born-sharded under the serving rules; for
+            # the quant variant, the shardings XLA propagates through
+            # quantize_tree — exactly what the engine's cast cache holds).
+            dst_tree = _sharded_serving_params(model, mesh, RULES_TP_SERVING)
+            if quant:
+                src = quantize_tree(src)
+                dst_tree = quantize_tree(dst_tree)
+            dst = jax.tree.map(lambda x: x.sharding, dst_tree)
+            cache: dict = {}
+            device_reshard(src, dst, jit_cache=cache)
+            (fn,) = cache.values()
+            return fn.lower(src).compile().as_text()
+
+        return hlo
+
+    return [
+        EntryProgram("swap_reshard", mesh, hlo_for(False)),
+        EntryProgram("swap_reshard_quant", mesh, hlo_for(True)),
     ]
 
 
@@ -446,6 +542,7 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         _zero1_q8(),
         *_serving_programs(),
         *_kv_transfer_programs(),
+        *_swap_reshard_programs(),
         _moe_dispatch(),
         _seq_attention("ring_attention"),
         _seq_attention("ulysses_attention"),

@@ -4,33 +4,21 @@ transfer plan.
 A disaggregated fleet moves a finished prefill's KV cache row from a
 prefill replica's sub-mesh to a decode replica's sub-mesh. Those are
 DIFFERENT device sets with (possibly) different shardings, so the move
-is an array REDISTRIBUTION — exactly the problem "Memory-efficient
-array redistribution" (arXiv 2112.01075) and "On Optimizing the
-Communication of Model Parallelism" (arXiv 2211.05322) treat: decompose
-the resharding into the minimal set of block-level copies between source
-and destination shards, and never materialize the full array anywhere.
+is an array REDISTRIBUTION — the shared decomposition now lives in
+:mod:`learning_jax_sharding_tpu.parallel.resharding` (it also powers
+the tenancy subsystem's weight hot-swap); this module re-exports it
+under its original fleet-facing names so the router and tests keep one
+import surface:
 
-This module is that decomposition, made a first-class checked object:
-
-* :func:`plan_transfer` intersects the source sharding's shard boxes
-  with the destination sharding's (``devices_indices_map`` on both — the
-  ``parallel.sharding`` introspection layer's view of who owns what) and
-  emits one :class:`Segment` per overlapping block, split at PAGE
-  granularity along the sequence dim — the "stream finished KV pages"
-  unit. Replicated source dims are deduplicated first (one owner per
-  distinct block), so replication never causes double copies; replicated
-  DESTINATION dims cost one copy per holding device, because that is the
-  honest wire price of replication.
-* :func:`execute_transfer` runs a plan: each destination shard is
-  assembled host-side from exactly its overlapping source-shard slices
-  (the DCN leg of a real cross-replica move — per-shard buffers only)
-  and the result is committed under the destination sharding via
-  ``jax.make_array_from_callback``. A ``stop`` bound skips/clips
-  segments past the row's valid length — bytes the causal-at-index
-  masks can never read don't cross the wire.
-* :func:`transfer_tree` maps both over a whole exported cache-row tree
-  and accumulates byte/segment telemetry (the fleet router's
-  ``fleet_kv_transfer_bytes_total``).
+* :func:`plan_transfer` / :class:`TransferPlan` / :class:`Segment` —
+  the page-granular block-copy decomposition (replicated sources
+  deduplicated, destination replication honestly priced).
+* :func:`execute_transfer` — host-side per-shard assembly committed via
+  ``jax.make_array_from_callback``, with ``stop`` clipping so bytes the
+  causal-at-index masks can never read don't cross the wire.
+* :func:`transfer_tree` — the whole exported cache-row tree, with the
+  summed bytes/segment telemetry the router's
+  ``fleet_kv_transfer_bytes_total`` counters feed on.
 
 The plan moves HOST-VISIBLE bytes on purpose: the two DEVICE-side
 programs of the handoff (``ContinuousEngine``'s ``kv_export`` gather and
@@ -42,277 +30,22 @@ resharding the operator can't see.
 
 from __future__ import annotations
 
-import dataclasses
-import math
-from typing import Any, Sequence
+from learning_jax_sharding_tpu.parallel.resharding import (
+    DEFAULT_PAGE_TOKENS,
+    Box,
+    Segment,
+    TransferPlan,
+    execute_transfer,
+    plan_transfer,
+    transfer_tree,
+)
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-#: Default streaming unit along the sequence dim — matches the serving
-#: engine's default KV page (``page_size=64``): a segment is "one page of
-#: one shard", the granularity a real transport would pipeline.
-DEFAULT_PAGE_TOKENS = 64
-
-Box = tuple[tuple[int, int], ...]   # per-dim half-open (start, stop)
-
-
-@dataclasses.dataclass(frozen=True)
-class Segment:
-    """One block copy: the intersection ``box`` (GLOBAL coordinates) of a
-    source shard and a destination shard, with the owning devices and
-    each shard's origin (for local-slice arithmetic at execution)."""
-
-    src_device: Any
-    dst_device: Any
-    box: Box
-    src_origin: tuple[int, ...]
-    dst_box: Box                       # the destination shard's full box
-
-    @property
-    def elements(self) -> int:
-        return math.prod(hi - lo for lo, hi in self.box)
-
-
-@dataclasses.dataclass(frozen=True)
-class TransferPlan:
-    """The checked, reusable decomposition of one leaf's redistribution.
-
-    Deterministic in its inputs (shape + the two shardings), so the
-    router computes it once per leaf layout and replays it per handoff.
-    ``bytes_total`` is the full-row wire volume; a ``stop``-clipped
-    execution reports its own (smaller) actuals.
-    """
-
-    shape: tuple[int, ...]
-    itemsize: int
-    src_sharding: Any
-    dst_sharding: Any
-    seq_dim: int | None
-    page_tokens: int | None
-    segments: tuple[Segment, ...]
-
-    @property
-    def bytes_total(self) -> int:
-        return sum(s.elements for s in self.segments) * self.itemsize
-
-    def describe(self) -> dict:
-        """JSON-able summary for artifacts/flight-recorder payloads."""
-        return {
-            "shape": list(self.shape),
-            "itemsize": self.itemsize,
-            "segments": len(self.segments),
-            "bytes_total": self.bytes_total,
-            "seq_dim": self.seq_dim,
-            "page_tokens": self.page_tokens,
-        }
-
-
-def _norm_box(idx: Sequence, shape: Sequence[int]) -> Box:
-    # devices_indices_map yields per-dim slices (possibly None-bounded);
-    # normalize to concrete half-open ranges.
-    return tuple(
-        tuple(sl.indices(d)[:2]) for sl, d in zip(idx, shape)
-    )
-
-
-def plan_transfer(
-    shape: Sequence[int],
-    itemsize: int,
-    src_sharding: Any,
-    dst_sharding: Any,
-    *,
-    seq_dim: int | None = None,
-    page_tokens: int | None = DEFAULT_PAGE_TOKENS,
-) -> TransferPlan:
-    """Decompose ``src_sharding → dst_sharding`` into block copies.
-
-    For every destination shard box, emit the intersections with the
-    DEDUPLICATED source shard boxes (replicated sources have one elected
-    owner — the blocks then tile the array exactly, so each destination
-    element is written exactly once). With ``seq_dim`` set, segments
-    split into ``page_tokens``-sized pages along it — the streaming
-    unit ``stop`` clipping operates on.
-    """
-    shape = tuple(int(s) for s in shape)
-    src_map = src_sharding.devices_indices_map(shape)
-    dst_map = dst_sharding.devices_indices_map(shape)
-    # One elected owner per distinct source block, preferring a device
-    # THIS process can read (execute_transfer assembles from
-    # addressable_shards): a block replicated across hosts must elect
-    # its local replica, not whichever host happens to come first in
-    # the device map.
-    me = jax.process_index()
-    blocks: dict[Box, Any] = {}
-    for dev, idx in src_map.items():
-        box = _norm_box(idx, shape)
-        cur = blocks.get(box)
-        if cur is None or (
-            getattr(cur, "process_index", me) != me
-            and getattr(dev, "process_index", me) == me
-        ):
-            blocks[box] = dev
-    segments: list[Segment] = []
-    for ddev, didx in dst_map.items():
-        dbox = _norm_box(didx, shape)
-        for sbox, sdev in blocks.items():
-            inter = tuple(
-                (max(a0, b0), min(a1, b1))
-                for (a0, a1), (b0, b1) in zip(sbox, dbox)
-            )
-            if any(lo >= hi for lo, hi in inter):
-                continue
-            src_origin = tuple(lo for lo, _ in sbox)
-            if seq_dim is not None and page_tokens:
-                lo, hi = inter[seq_dim]
-                # Page boundaries in GLOBAL coordinates, so the same
-                # token lands in the same page whichever shard carries it.
-                start = (lo // page_tokens) * page_tokens
-                for p0 in range(start, hi, page_tokens):
-                    plo, phi = max(lo, p0), min(hi, p0 + page_tokens)
-                    if plo >= phi:
-                        continue
-                    box = tuple(
-                        (plo, phi) if d == seq_dim else rng
-                        for d, rng in enumerate(inter)
-                    )
-                    segments.append(
-                        Segment(sdev, ddev, box, src_origin, dbox)
-                    )
-            else:
-                segments.append(Segment(sdev, ddev, inter, src_origin, dbox))
-    return TransferPlan(
-        shape=shape, itemsize=int(itemsize),
-        src_sharding=src_sharding, dst_sharding=dst_sharding,
-        seq_dim=seq_dim, page_tokens=page_tokens,
-        segments=tuple(segments),
-    )
-
-
-def execute_transfer(
-    plan: TransferPlan, x: jax.Array, *, stop: int | None = None
-) -> tuple[jax.Array, dict]:
-    """Run ``plan`` on ``x``: assemble every destination shard from its
-    source-shard slices and commit the result under the destination
-    sharding. ``stop`` (sequence positions ``< stop`` are valid) skips
-    whole pages past the bound and clips the straddling one — skipped
-    regions stay zero in the destination buffer, which the engine's
-    causal-at-index masks never read.
-
-    Returns ``(array, stats)`` with ``stats = {"bytes", "segments",
-    "segments_skipped"}`` — the actual wire volume of THIS handoff.
-    """
-    shape, dtype = plan.shape, x.dtype
-    if tuple(x.shape) != shape:
-        raise ValueError(f"plan is for shape {shape}, array is {x.shape}")
-    src_np: dict[Any, np.ndarray] = {}
-
-    def src_block(dev) -> np.ndarray:
-        buf = src_np.get(dev)
-        if buf is None:
-            for s in x.addressable_shards:
-                if s.device == dev:
-                    buf = src_np[dev] = np.asarray(s.data)
-                    break
-            else:
-                raise ValueError(f"no addressable shard on {dev}")
-        return buf
-
-    # Every destination shard box gets a buffer up front — a box fully
-    # past ``stop`` still needs its (zero) bytes to commit the array.
-    dst_bufs: dict[Box, np.ndarray] = {}
-    for didx in plan.dst_sharding.devices_indices_map(shape).values():
-        dbox = _norm_box(didx, shape)
-        if dbox not in dst_bufs:
-            dst_bufs[dbox] = np.zeros(
-                tuple(hi - lo for lo, hi in dbox), dtype
-            )
-    copied = skipped = nbytes = 0
-    for seg in plan.segments:
-        box = seg.box
-        if stop is not None and plan.seq_dim is not None:
-            lo, hi = box[plan.seq_dim]
-            hi = min(hi, int(stop))
-            if lo >= hi:
-                skipped += 1
-                continue
-            box = tuple(
-                (lo, hi) if d == plan.seq_dim else rng
-                for d, rng in enumerate(box)
-            )
-        src = src_block(seg.src_device)
-        src_sl = tuple(
-            slice(lo - o, hi - o)
-            for (lo, hi), o in zip(box, seg.src_origin)
-        )
-        dst_sl = tuple(
-            slice(lo - dlo, hi - dlo)
-            for (lo, hi), (dlo, _) in zip(box, seg.dst_box)
-        )
-        dst_bufs[seg.dst_box][dst_sl] = src[src_sl]
-        copied += 1
-        nbytes += math.prod(hi - lo for lo, hi in box) * plan.itemsize
-
-    out = jax.make_array_from_callback(
-        shape, plan.dst_sharding,
-        lambda idx: dst_bufs[_norm_box(idx, shape)],
-    )
-    return out, {
-        "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
-    }
-
-
-def transfer_tree(
-    rows: Any,
-    dst_shardings: Any,
-    *,
-    stop: int | None = None,
-    seq_dims: Any | None = None,
-    page_tokens: int | None = DEFAULT_PAGE_TOKENS,
-    plan_cache: dict | None = None,
-) -> tuple[Any, dict]:
-    """Redistribute a whole exported cache-row tree (``export_kv``) into
-    ``dst_shardings`` (``kv_row_shardings`` of the destination engine).
-
-    ``seq_dims`` names each leaf's SEQUENCE dim (a matching pytree of
-    ints, ``-1`` = no sequence dim — the destination engine's
-    ``kv_row_seq_dims``, which derives it from the actual row layout:
-    the dense decode backend is sequence-major, the blocked/TPU backend
-    head-major); ``stop`` (the row's valid length) clips those leaves'
-    plans, and ``-1`` leaves move whole. Without ``seq_dims`` every
-    rank ≥ 2 leaf is ASSUMED sequence-major on dim 0 — only safe for
-    dense-backend rows or plain arrays. ``plan_cache`` (any dict)
-    memoizes plans across handoffs of the same layout. Returns
-    ``(tree, stats)`` with the summed bytes/segments telemetry.
-    """
-    totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
-    if seq_dims is None:
-        seq_dims = jax.tree.map(
-            lambda x: 0 if getattr(x, "ndim", 0) >= 2 else -1, rows,
-        )
-
-    def one(x, dst, seq_dim):
-        x = x if isinstance(x, jax.Array) else jnp.asarray(x)
-        seq_dim = None if seq_dim is None or seq_dim < 0 else int(seq_dim)
-        key = (
-            tuple(x.shape), str(x.dtype), x.sharding, dst, seq_dim,
-            page_tokens,
-        )
-        plan = plan_cache.get(key) if plan_cache is not None else None
-        if plan is None:
-            plan = plan_transfer(
-                x.shape, x.dtype.itemsize, x.sharding, dst,
-                seq_dim=seq_dim, page_tokens=page_tokens,
-            )
-            if plan_cache is not None:
-                plan_cache[key] = plan
-        out, stats = execute_transfer(
-            plan, x, stop=stop if seq_dim is not None else None
-        )
-        for k in totals:
-            totals[k] += stats[k]
-        return out
-
-    out = jax.tree.map(one, rows, dst_shardings, seq_dims)
-    return out, totals
+__all__ = [
+    "DEFAULT_PAGE_TOKENS",
+    "Box",
+    "Segment",
+    "TransferPlan",
+    "execute_transfer",
+    "plan_transfer",
+    "transfer_tree",
+]

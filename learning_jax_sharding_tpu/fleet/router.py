@@ -185,11 +185,17 @@ class FleetRouter:
         self._c_kv_segments = r.counter(
             "fleet_kv_transfer_segments_total",
             "page-granular transfer-plan segments copied")
+        self._c_swaps = r.counter(
+            "fleet_swaps_total",
+            "replica weight swaps committed by rolling_swap")
         self._g_alive = r.gauge(
             "fleet_replicas_alive", "replicas currently taking work")
         self._g_inflight = r.gauge(
             "fleet_inflight", "unfinished requests across the fleet")
         self._g_alive.set(len(reps))
+        # Replicas mid-swap: excluded from placement (admission AND
+        # handoff destinations) so they drain — rolling_swap's lever.
+        self._swapping: set[str] = set()
         self._requests: dict[int, _FleetRequest] = {}
         self._finished: dict[int, Any] = {}
         self._next_rid = 0
@@ -209,8 +215,15 @@ class FleetRouter:
     def _admission_pool(self) -> list[EngineReplica]:
         # Where NEW prompts go: prefill replicas in a disaggregated
         # fleet, unified replicas otherwise (decode replicas only ever
-        # receive ingested rows).
-        return self._by_role("prefill" if self.disaggregated else "unified")
+        # receive ingested rows). A replica mid-rolling-swap takes no
+        # new placements — it is draining toward its commit.
+        return [
+            r
+            for r in self._by_role(
+                "prefill" if self.disaggregated else "unified"
+            )
+            if r.name not in self._swapping
+        ]
 
     def inflight(self) -> int:
         """Unfinished requests across the fleet (the fleet-shedding
@@ -476,7 +489,17 @@ class FleetRouter:
     def _flush_handoffs(self):
         self._sweep_handoff_deadlines()
         while self._handoffs:
-            decodes = [r for r in self._by_role("decode") if r.alive]
+            decodes = [
+                r for r in self._by_role("decode")
+                if r.alive and r.name not in self._swapping
+            ]
+            if not decodes and any(
+                r.alive for r in self._by_role("decode")
+            ):
+                # Every decode replica is mid-swap (K=1 decode fleets):
+                # park the handoffs — they flush when the swap commits,
+                # not a failover.
+                return
             if not decodes:
                 # No decode replica can EVER take these (all DEAD):
                 # terminal under the fleet's own status, never a
@@ -527,6 +550,90 @@ class FleetRouter:
                 dst=rep.name, length=h["length"], bytes=stats["bytes"],
                 segments=stats["segments"],
             )
+
+    # --- zero-downtime rolling weight swap (round 12) -----------------------
+
+    def rolling_swap(
+        self, new_params, *, version: int, draft_params=None,
+        max_steps: int = 10_000,
+    ) -> list[dict]:
+        """Update every live replica to ``new_params`` ONE AT A TIME —
+        the fleet-wide half of the zero-downtime swap. The replica under
+        swap is pulled out of placement (no new admissions, no handoff
+        ingests) while its engine stages the resharded tree off the hot
+        path and DRAINS (``engine.swap_weights`` drain mode: in-flight
+        requests finish on the old version); the rest of the fleet keeps
+        serving the whole time, so aggregate capacity never drops to
+        zero. Only after the replica's commit does the walk move on.
+
+        A replica whose staging aborts (the ``engine.swap_stage`` chaos
+        seam, a recoverable staging failure) STAYS on its old version
+        and keeps serving — the rollout continues past it and the
+        timeline says so; a fleet is allowed to run mixed versions
+        because every response is attributable to exactly one
+        (``engine.finished_versions``). Returns the swap timeline —
+        per-replica event dicts (``tenancy.write_swap_timeline``
+        persists them as the case artifact)."""
+        names = [
+            n for n in sorted(self.replicas) if self.replicas[n].alive
+        ]
+        self.recorder.record(
+            "fleet.swap_begin", version=version, replicas=names,
+        )
+        t_begin = time.perf_counter()
+        timeline: list[dict] = []
+        for name in names:
+            rep = self.replicas[name]
+            if not rep.alive:      # died earlier in this rollout
+                continue
+            self._swapping.add(name)
+            t0 = time.perf_counter()
+            steps = 0
+            try:
+                staged = rep.engine.swap_weights(
+                    new_params, version=version,
+                    draft_params=draft_params, mode="drain",
+                )
+                while staged and rep.engine.weights_version != version:
+                    # The staged swap counts as engine work (has_work),
+                    # so router.step keeps stepping this replica until
+                    # its top-of-step commit fires.
+                    self.step()
+                    steps += 1
+                    if steps > max_steps:
+                        raise RuntimeError(
+                            f"rolling swap wedged draining replica "
+                            f"{name!r} ({steps} steps)"
+                        )
+            finally:
+                self._swapping.discard(name)
+            if staged:
+                # The replica now OWNS its weights (the engine installs
+                # the staged tree); keep the record in sync so failover
+                # rebuilds and handoff ingests use the served version.
+                rep.params = new_params
+                if draft_params is not None:
+                    rep.draft_params = draft_params
+                self._c_swaps.inc()
+            self.recorder.record(
+                "fleet.swap_replica", replica=name, version=version,
+                committed=staged, drain_steps=steps,
+            )
+            timeline.append({
+                "replica": name,
+                "version": version,
+                "committed": bool(staged),
+                "drain_steps": steps,
+                "wall_s": time.perf_counter() - t0,
+                "t_offset_s": t0 - t_begin,
+            })
+        self.recorder.record(
+            "fleet.swap_end", version=version,
+            committed=sum(1 for t in timeline if t["committed"]),
+            replicas=len(timeline),
+            wall_s=time.perf_counter() - t_begin,
+        )
+        return timeline
 
     # --- failover ------------------------------------------------------------
 

@@ -182,6 +182,8 @@ class _Request:
     error: str | None = None
     deadline_s: float | None = None       # per-request TTL override
     strikes: int = 0                      # dispatch faults while admitted
+    version: int = 0                      # weights version pinned at admission
+    adapter: str | None = None            # AdapterPool tenant (None = base)
 
 
 class ContinuousEngine:
@@ -463,6 +465,7 @@ class ContinuousEngine:
         max_queue: int | None = None,
         degradation: Any | None = None,
         max_dispatch_strikes: int = 2,
+        adapter_pool: Any | None = None,
     ):
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -516,6 +519,31 @@ class ContinuousEngine:
                 "prefix_cache requires the paged KV cache (paged_pages=N): "
                 "sharing is expressed through block-table entries"
             )
+        if adapter_pool is not None:
+            # Multi-LoRA serving (round 12) composes with the FUSED
+            # engine only: the adapter gather lives inside
+            # ``adapter_mixed_step``, and every split-program fallback
+            # (refill_step / decode_block) would run adapter rows
+            # through the BASE weights.
+            if not mixed:
+                raise ValueError(
+                    "adapter_pool requires mixed=True: adapters are "
+                    "gathered per row inside the fused step"
+                )
+            if paged:
+                raise ValueError(
+                    "adapter_pool requires the unpaged cache: the per-row "
+                    "vmapped apply maps over batch-major cache rows, which "
+                    "the paged pool's page-major leaves do not have (the "
+                    "AdapterPool does its own page-granular residency "
+                    "accounting instead)"
+                )
+            if degradation is not None:
+                raise ValueError(
+                    "adapter_pool does not compose with degradation=: the "
+                    "ladder's split-program fallbacks would serve adapter "
+                    "rows with the base weights"
+                )
 
         def check_paged(name, c):
             # ONE copy of the paged preconditions, applied to the target and
@@ -701,7 +729,7 @@ class ContinuousEngine:
             )
             return toks.T, active, remaining, cache   # (B, K) tokens
 
-        def spec_round(carry, params, d_params, rid, rng):
+        def spec_round(carry, params, d_params, rid, rng, apply_fn=apply):
             """ONE draft-verify ROUND with PER-ROW acceptance and rollback —
             THE shared speculative core of the engine: ``decode_block_spec``
             scans it ``decode_block_steps`` times, ``spec_mixed_step`` runs
@@ -710,7 +738,14 @@ class ContinuousEngine:
             families. Frozen rows (``active == 0`` — idle, refilling, or
             retired) ride every sub-call with length 0 and ``n_emit`` 0, so
             the round's rollback broadcast re-asserts their current ``pos``
-            without moving it."""
+            without moving it.
+
+            ``apply_fn`` is the VERIFIER's apply (default: the target
+            model's). The multi-LoRA engine passes its per-row
+            adapter-gathered apply here — the draft always proposes with
+            the BASE weights (a proposal distribution never defines the
+            output; the verifier does), so one shared draft serves every
+            tenant in the batch."""
             idx = jnp.arange(num_draft + 1)
             (tok, active, pos, remaining, count, buffer, acc, prop,
              t_cache, d_cache) = carry
@@ -753,7 +788,7 @@ class ContinuousEngine:
 
             # 2. One chunked target verify.
             chunk = jnp.concatenate([tok[:, None], drafts], axis=1)
-            t_logits, t_cache = apply(
+            t_logits, t_cache = apply_fn(
                 params, t_cache, chunk, active * (num_draft + 1)
             )
 
@@ -903,6 +938,38 @@ class ContinuousEngine:
                 t_cache, d_cache,
             )
 
+        def _mixed_core(
+            apply_fn, params, cache, chunk, lengths, reset_mask, reset_to,
+            tok, active, remaining, rid, rng,
+        ):
+            # THE fused-iteration body, shared by ``mixed_step`` (plain
+            # apply) and ``adapter_mixed_step`` (per-row adapter-gathered
+            # apply) so the scheduling/sampling rules cannot drift between
+            # the single-tenant and multi-tenant program families.
+            cache = _reset_rows(cache, reset_mask, reset_to)
+            dec = active == 1   # decoding rows never hold pending tokens
+            eff_len = jnp.where(dec, 1, lengths)
+            chunk = chunk.at[:, 0].set(jnp.where(dec, tok, chunk[:, 0]))
+            logits, cache = apply_fn(params, cache, chunk, eff_len)
+            pick = jnp.take_along_axis(
+                logits, jnp.maximum(eff_len - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            # Refill rows sample their stream's position 0 (the refill
+            # pick); decode rows their current generated position — the
+            # same keys the split programs use.
+            pos = jnp.where(dec, max_new_tokens - remaining, 0)
+            nxt = sample_rows(pick, rng, rid, pos)
+            tok = jnp.where(dec, nxt, tok)
+            remaining = remaining - dec.astype(jnp.int32)
+            if eos_id is not None:
+                active = active * jnp.where(
+                    dec, (nxt != eos_id).astype(jnp.int32), 1
+                )
+            active = active * jnp.where(
+                dec, (remaining > 0).astype(jnp.int32), 1
+            )
+            return nxt, tok, active, remaining, cache
+
         @jax.jit
         def mixed_step(
             params, cache, chunk, lengths, reset_mask, reset_to, tok,
@@ -924,29 +991,101 @@ class ContinuousEngine:
             (test-pinned). Carries (tok/active/remaining) ride the return so
             ``decode_chain`` links can flow device-to-device with one host
             sync per chain."""
-            cache = _reset_rows(cache, reset_mask, reset_to)
-            dec = active == 1   # decoding rows never hold pending tokens
-            eff_len = jnp.where(dec, 1, lengths)
-            chunk = chunk.at[:, 0].set(jnp.where(dec, tok, chunk[:, 0]))
-            logits, cache = apply(params, cache, chunk, eff_len)
-            pick = jnp.take_along_axis(
-                logits, jnp.maximum(eff_len - 1, 0)[:, None, None], axis=1
-            )[:, 0]
-            # Refill rows sample their stream's position 0 (the refill
-            # pick); decode rows their current generated position — the
-            # same keys the split programs use.
-            pos = jnp.where(dec, max_new_tokens - remaining, 0)
-            nxt = sample_rows(pick, rng, rid, pos)
-            tok = jnp.where(dec, nxt, tok)
-            remaining = remaining - dec.astype(jnp.int32)
-            if eos_id is not None:
-                active = active * jnp.where(
-                    dec, (nxt != eos_id).astype(jnp.int32), 1
-                )
-            active = active * jnp.where(
-                dec, (remaining > 0).astype(jnp.int32), 1
+            return _mixed_core(
+                apply, params, cache, chunk, lengths, reset_mask, reset_to,
+                tok, active, remaining, rid, rng,
             )
-            return nxt, tok, active, remaining, cache
+
+        def _merge_row(p, a):
+            # One ROW's adapter folded into the base tree — the EXACT op
+            # order of ``training.lora.merge_lora`` (scale · A@B, then
+            # astype into the kernel dtype), with the python-float
+            # ``alpha/rank`` scale replaced by the pool's per-slot scale
+            # array cast to the A@B dtype (same promotion a weak-typed
+            # scalar takes), so a pooled tenant's merged weights are
+            # BIT-IDENTICAL to ``merge_lora``'s — the multi-tenant
+            # bit-identity oracle rests on this mirror.
+            if not isinstance(p, dict):
+                return p
+            out = {}
+            for k, v in p.items():
+                sub = a.get(k) if isinstance(a, dict) else None
+                if (
+                    sub is not None and isinstance(sub, dict)
+                    and set(sub) == {"lora_a", "lora_b", "scale"}
+                ):
+                    ab = sub["lora_a"] @ sub["lora_b"]
+                    out[k] = v + (sub["scale"].astype(ab.dtype) * ab).astype(
+                        v.dtype
+                    )
+                else:
+                    out[k] = _merge_row(v, sub if sub is not None else {})
+            return out
+
+        def _adapter_apply(sel):
+            # Per-row adapter-gathered apply: ``sel`` is the pool tree
+            # already GATHERED at each row's adapter slot (leaves
+            # (B, ...) — the gather runs once, outside the vmap). Each
+            # row folds its own adapter into the base and runs the model
+            # at batch 1; vmap stacks the rows back into one fused
+            # program, so heterogeneous tenants share a single dispatch.
+            def apply_rows(params, cache, chunk, lens):
+                cache_b = jax.tree.map(lambda x: x[:, None], cache)
+
+                def one(sel_row, cache_row, ch, ln):
+                    merged = _merge_row(params, sel_row)
+                    lg, c2 = apply(merged, cache_row, ch[None], ln[None])
+                    return lg[0], jax.tree.map(lambda x: x[0], c2)
+
+                return jax.vmap(one)(sel, cache_b, chunk, lens)
+
+            return apply_rows
+
+        @jax.jit
+        def adapter_mixed_step(
+            params, pool, aidx, cache, chunk, lengths, reset_mask,
+            reset_to, tok, active, remaining, rid, rng,
+        ):
+            """``mixed_step`` with a PER-ROW adapter gather (multi-LoRA
+            serving): ``pool`` is the stacked adapter tree
+            (``tenancy.AdapterPool.tree`` — leading slot dim), ``aidx``
+            each row's adapter slot (0 = the base/zero adapter). One
+            fused program serves requests for DIFFERENT tenants'
+            adapters in the same batch, bit-identical to each tenant
+            solo against ``merge_lora``-folded weights (test-pinned)."""
+            sel = jax.tree.map(lambda s: s[aidx], pool)
+            return _mixed_core(
+                _adapter_apply(sel), params, cache, chunk, lengths,
+                reset_mask, reset_to, tok, active, remaining, rid, rng,
+            )
+
+        def _spec_mixed_core(
+            apply_fn, params, d_params, t_cache, d_cache, chunk, lengths,
+            reset_mask, reset_to, tok, active, pos, remaining, rid, rng,
+        ):
+            # The speculative fused-iteration body (shared with the
+            # adapter-gathered variant, like ``_mixed_core``): the
+            # verifier AND the refill stream run through ``apply_fn``;
+            # the draft always proposes with the base weights.
+            t_cache = _reset_rows(t_cache, reset_mask, reset_to)
+            d_cache = _reset_rows(d_cache, reset_mask, reset_to)
+            r_logits, t_cache = apply_fn(params, t_cache, chunk, lengths)
+            _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
+            r_pick = jnp.take_along_axis(
+                r_logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            first_tok = sample_rows(r_pick, rng, rid, jnp.zeros_like(rid))
+            pos = pos + lengths
+            (tok, active, pos, remaining, count, buffer, acc, prop,
+             t_cache, d_cache) = spec_round(
+                _spec_carry_init(tok, active, pos, remaining, num_draft + 1)
+                + (t_cache, d_cache),
+                params, d_params, rid, rng, apply_fn=apply_fn,
+            )
+            return (
+                first_tok, buffer, count, acc, prop, tok, pos, active,
+                remaining, t_cache, d_cache,
+            )
 
         @jax.jit
         def spec_mixed_step(
@@ -961,24 +1100,27 @@ class ContinuousEngine:
             tracks every row's cache index: refill rows advance by their
             chunk length BEFORE the round, so the round's rollback
             broadcast re-asserts (never clobbers) their refill advance."""
-            t_cache = _reset_rows(t_cache, reset_mask, reset_to)
-            d_cache = _reset_rows(d_cache, reset_mask, reset_to)
-            r_logits, t_cache = apply(params, t_cache, chunk, lengths)
-            _, d_cache = d_apply(d_params, d_cache, chunk, lengths)
-            r_pick = jnp.take_along_axis(
-                r_logits, jnp.maximum(lengths - 1, 0)[:, None, None], axis=1
-            )[:, 0]
-            first_tok = sample_rows(r_pick, rng, rid, jnp.zeros_like(rid))
-            pos = pos + lengths
-            (tok, active, pos, remaining, count, buffer, acc, prop,
-             t_cache, d_cache) = spec_round(
-                _spec_carry_init(tok, active, pos, remaining, num_draft + 1)
-                + (t_cache, d_cache),
-                params, d_params, rid, rng,
+            return _spec_mixed_core(
+                apply, params, d_params, t_cache, d_cache, chunk, lengths,
+                reset_mask, reset_to, tok, active, pos, remaining, rid, rng,
             )
-            return (
-                first_tok, buffer, count, acc, prop, tok, pos, active,
-                remaining, t_cache, d_cache,
+
+        @jax.jit
+        def adapter_spec_mixed_step(
+            params, pool, aidx, d_params, t_cache, d_cache, chunk, lengths,
+            reset_mask, reset_to, tok, active, pos, remaining, rid, rng,
+        ):
+            """``spec_mixed_step`` with the per-row adapter gather: refill
+            and VERIFICATION run each row against its own merged weights
+            (so accepted tokens are exactly what the tenant's solo merged
+            model would emit — greedy exactness through the verifier);
+            the shared draft proposes with the base weights, which only
+            moves the acceptance rate, never the output distribution."""
+            sel = jax.tree.map(lambda s: s[aidx], pool)
+            return _spec_mixed_core(
+                _adapter_apply(sel), params, d_params, t_cache, d_cache,
+                chunk, lengths, reset_mask, reset_to, tok, active, pos,
+                remaining, rid, rng,
             )
 
         @jax.jit
@@ -1068,6 +1210,8 @@ class ContinuousEngine:
         self._decode_block_spec_fn = decode_block_spec
         self._mixed_step_fn = mixed_step
         self._spec_mixed_step_fn = spec_mixed_step
+        self._adapter_mixed_step_fn = adapter_mixed_step
+        self._adapter_spec_mixed_step_fn = adapter_spec_mixed_step
         self._kv_export_fn = kv_export
         self._kv_ingest_fn = kv_ingest
 
@@ -1097,7 +1241,22 @@ class ContinuousEngine:
         self._last_mixed_args = None
         self._last_kv_export_args = None      # disaggregated handoff
         self._last_kv_ingest_args = None
+        # Tenancy (round 12): zero-downtime weight hot-swap + multi-LoRA.
+        # ``weights_version`` is pinned onto every request AT ADMISSION —
+        # in-flight requests finish (or recompute bit-identically) on the
+        # version they were admitted under, never a silent mid-sequence
+        # weight change; ``finished_versions`` is the attribution log
+        # (rid → version) the zero-downtime oracle audits.
+        self.weights_version = 0
+        self.finished_versions: dict[int, int] = {}
+        self._staged_swap: dict | None = None
+        self._installed: tuple | None = None   # committed (params, draft)
+        self._swap_jit_cache: dict = {}        # device_reshard programs
+        self._swap_plan_cache: dict = {}       # host transfer plans
+        self._adapter_pool = adapter_pool
         self._init_telemetry(registry, tracer, slo, recorder)
+        if adapter_pool is not None:
+            adapter_pool.bind(self.registry, self.recorder)
         self._init_slots()
         if paged:
             self._init_pool()
@@ -1209,6 +1368,27 @@ class ContinuousEngine:
             "engine_kv_ingests_total",
             "externally prefilled requests ingested (disaggregated "
             "handoff)")
+        self._c_swap_staged = r.counter(
+            "engine_swap_staged_total",
+            "weight swaps staged (resharded into the serving layout off "
+            "the hot path)")
+        self._c_swap_commits = r.counter(
+            "engine_swap_commits_total",
+            "weight swaps atomically committed between dispatches")
+        self._c_swap_aborted = r.counter(
+            "engine_swap_aborted_total",
+            "weight swaps aborted during staging — the engine kept the "
+            "old version, in-flight requests unaffected")
+        self._c_swap_bytes = r.counter(
+            "engine_swap_bytes_total",
+            "bytes moved staging swapped weight trees into the serving "
+            "layout")
+        self._c_adapter_n = r.counter(
+            "engine_adapter_dispatches_total",
+            "fused dispatches that gathered per-row adapters")
+        self._c_adapter_rows = r.counter(
+            "engine_adapter_rows_total",
+            "occupied row-dispatches served under a non-base adapter")
         self._g_degraded = r.gauge(
             "engine_degradation_level",
             "current graceful-degradation ladder level (0 = normal)")
@@ -1231,6 +1411,9 @@ class ContinuousEngine:
             "engine_queue_wait_seconds", "arrival to slot admission")
         self._h_e2e = r.histogram(
             "engine_e2e_seconds", "arrival to retirement")
+        self._h_swap_stall = r.histogram(
+            "engine_swap_stall_seconds",
+            "stage-to-commit latency of weight swaps (drain or preempt)")
 
     def _win_delta(self, counter):
         # The stats window (reset_stats → snapshot) over a cumulative
@@ -1250,6 +1433,10 @@ class ContinuousEngine:
         self._slot_req: list[_Request | None] = [None] * b
         self._tok = np.zeros((b,), np.int32)
         self._active = np.zeros((b,), bool)
+        # Per-slot adapter slot index into the AdapterPool's stacked tree
+        # (0 = the base/zero adapter; always allocated — harmlessly all
+        # zero on engines without a pool).
+        self._aidx = np.zeros((b,), np.int32)
         # Admission reset flags live on the ENGINE, not in step() locals:
         # they are consumed by the first SUCCESSFUL refill dispatch, so a
         # raise between admission and dispatch (pool exhaustion) cannot
@@ -1375,12 +1562,7 @@ class ContinuousEngine:
         self.drain_requests(status="shutdown", error="engine closed")
         self._cache = None
         self._cast_src = self._cast_out = None
-        self._last_first_refill_args = None
-        self._last_refill_args = self._last_decode_args = None
-        self._last_decode_plain_args = None
-        self._last_mixed_args = None
-        self._last_kv_export_args = None
-        self._last_kv_ingest_args = None
+        self._clear_dispatch_args()
         self._export_ok = {}
         if self._paged:
             self._init_pool()
@@ -1400,6 +1582,14 @@ class ContinuousEngine:
                 "flush_prefix_cache() requires an idle engine: drain "
                 "in-flight work first (params must not change mid-request)"
             )
+        self._drop_prefix_registry()
+
+    def _drop_prefix_registry(self):
+        # The registry-dropping core of ``flush_prefix_cache``, minus its
+        # idle guard: a swap COMMIT calls this directly — commit requires
+        # empty SLOTS only (retained pages are reference-free then), and
+        # queued requests are fine: they admit after the commit, under
+        # the new version, and can never see old-params K/V.
         for pid in list(self._cached_lru):
             del self._cached_lru[pid]
             del self._prefix_registry[self._key_of_page.pop(pid)]
@@ -1591,18 +1781,170 @@ class ContinuousEngine:
         # trees — stale for collective_inventory(), and keeping them
         # would hold both parameter trees in HBM across a checkpoint
         # swap. Drop them; the next dispatch re-captures.
+        self._clear_dispatch_args()
+        return out
+
+    def _clear_dispatch_args(self):
         self._last_first_refill_args = None
         self._last_refill_args = self._last_decode_args = None
         self._last_decode_plain_args = None
         self._last_mixed_args = None
         self._last_kv_export_args = None
         self._last_kv_ingest_args = None
-        return out
+
+    # --- zero-downtime weight hot-swap (round 12) --------------------------
+
+    def swap_weights(
+        self, new_params, *, version: int, draft_params=None,
+        mode: str = "drain",
+    ) -> bool:
+        """Stage ``new_params`` for a ZERO-DOWNTIME weight swap and
+        commit it atomically between dispatches.
+
+        Staging happens NOW, off the dispatch hot path: the tree is run
+        through the engine's inference cast and RESHARDED into the
+        serving layout (``parallel.resharding.reshard_tree`` — the
+        single-program device path for an intra-mesh layout change, the
+        explicit counted host plan across device sets; plans and
+        compiled movers are cached across swaps). The engine keeps
+        serving the OLD version throughout; nothing the scheduler
+        touches changes until the commit.
+
+        The COMMIT flips ``weights_version`` to ``version`` and installs
+        the staged tree as the engine's own weights (later ``step()``
+        calls may omit ``params``; a stale caller-passed tree is
+        overridden). It fires only when ZERO slots are occupied:
+
+        * ``mode="drain"`` (default): admission pauses, in-flight
+          requests FINISH ON THE OLD WEIGHTS, and the first
+          ``step()`` that finds the slots empty commits — then re-admits
+          the queued backlog under the new version in that same step, so
+          a loaded engine swaps with zero dropped/failed requests.
+        * ``mode="preempt"``: every in-flight request is requeued
+          (recompute preemption — it RECOMPUTES BIT-IDENTICALLY under
+          the new version, the ``_unadmit`` guarantee) and the commit
+          happens immediately.
+
+        Every request is attributable to exactly one version: pinned at
+        admission (``_Request.version``), logged at retirement
+        (``finished_versions``), never changed mid-sequence. On paged
+        engines the commit drops the prefix registry (old-params K/V
+        must not seed new-params requests).
+
+        A fault injected at the ``engine.swap_stage`` chaos seam (or a
+        recoverable staging failure) ABORTS the swap: the engine stays
+        on the old version, in-flight requests are unaffected, and the
+        abort lands in ``engine_swap_aborted_total`` and the flight
+        recorder. Returns True when staged (the commit may still be
+        pending), False on an aborted staging."""
+        from learning_jax_sharding_tpu.parallel.resharding import (
+            reshard_tree,
+        )
+
+        if mode not in ("drain", "preempt"):
+            raise ValueError(
+                f"mode must be 'drain' or 'preempt', got {mode!r}"
+            )
+        self._check_draft_args(draft_params)
+        if self._staged_swap is not None:
+            raise RuntimeError(
+                f"a weight swap is already staged (version "
+                f"{self._staged_swap['version']}): it commits when the "
+                "slots drain — stage the next version after that"
+            )
+        ref = self._cast_out
+
+        def stage(tree, ref_tree):
+            if tree is None:
+                return None, 0
+            if ref_tree is None:
+                # Never dispatched: no serving layout to mirror yet —
+                # the cast tree is staged as-given and the first
+                # dispatch places it like any initial params.
+                return tree, 0
+            dst = jax.tree.map(lambda x: x.sharding, ref_tree)
+            with activate(self._mesh, self._rules):
+                out, stats = reshard_tree(
+                    tree, dst, plan_cache=self._swap_plan_cache,
+                    jit_cache=self._swap_jit_cache,
+                )
+            return out, int(stats["bytes"])
+
+        t0 = time.perf_counter()
+        try:
+            chaos_hook("engine.swap_stage", version=version, mode=mode)
+            cast = self._maybe_cast(new_params)
+            d_cast = (
+                self._d_cast(draft_params)
+                if draft_params is not None else None
+            )
+            cast, p_bytes = stage(cast, ref[0] if ref else None)
+            d_cast, d_bytes = stage(d_cast, ref[1] if ref else None)
+        except _RECOVERABLE_DISPATCH as e:
+            self._c_swap_aborted.inc()
+            self.recorder.record(
+                "engine.swap_abort", version=version, mode=mode,
+                error=str(e),
+            )
+            return False
+        moved = p_bytes + d_bytes
+        self._staged_swap = dict(
+            version=version, mode=mode,
+            raw=(new_params, draft_params), cast=(cast, d_cast),
+            staged_t=time.perf_counter(),
+        )
+        self._c_swap_staged.inc()
+        self._c_swap_bytes.inc(moved)
+        self.recorder.record(
+            "engine.swap_stage", version=version, mode=mode, bytes=moved,
+            stage_s=time.perf_counter() - t0,
+            occupied=sum(q >= 0 for q in self._req),
+            queue_depth=len(self._queue),
+        )
+        if mode == "preempt":
+            for slot in range(self._b):
+                if self._req[slot] >= 0:
+                    self._unadmit(slot)
+                    self._c_preempt.inc()
+        # An idle engine (and every preempt-mode swap) commits here and
+        # now; a draining engine commits in the step() that empties it.
+        self._try_commit_swap()
+        return True
+
+    def _try_commit_swap(self) -> bool:
+        # The atomic switch: between dispatches, only with EMPTY slots —
+        # no in-flight request can ever straddle two versions.
+        s = self._staged_swap
+        if s is None or any(q >= 0 for q in self._req):
+            return False
+        if self._paged:
+            # Old-params K/V must not seed new-params requests; slots
+            # are empty, so every retained page is reference-free.
+            self._drop_prefix_registry()
+        self._installed = s["raw"]
+        # Prime the identity-keyed cast cache with the STAGED trees: the
+        # next dispatch's _cast_params hits it, so the swap costs the
+        # hot path nothing (staging already cast and resharded).
+        self._cast_src = s["raw"]
+        self._cast_out = s["cast"]
+        self._clear_dispatch_args()
+        prev = self.weights_version
+        self.weights_version = s["version"]
+        self._staged_swap = None
+        stall = time.perf_counter() - s["staged_t"]
+        self._c_swap_commits.inc()
+        self._h_swap_stall.observe(stall)
+        self.recorder.record(
+            "engine.swap_commit", version=s["version"], previous=prev,
+            mode=s["mode"], stall_s=stall,
+        )
+        return True
 
     def add_request(
         self, prompt, *, rid: int | None = None,
         deadline_s: float | None = None,
         arrival_t: float | None = None,
+        adapter: str | None = None,
     ) -> int:
         """Enqueue one request (the arrival process). Returns its id —
         the key ``pop_finished()`` will report it under, and (at
@@ -1620,9 +1962,20 @@ class ContinuousEngine:
         ORIGINAL arrival clock when re-queuing after a failover drain
         (``drain_requests``) — deadlines and queue-wait telemetry then
         measure the request's true age, not its age on this replica.
+
+        ``adapter`` names an :class:`~learning_jax_sharding_tpu.tenancy.
+        AdapterPool` tenant (engine built with ``adapter_pool=``): every
+        token of this request is then generated against the BASE +
+        tenant-adapter merged weights inside the fused multi-LoRA step.
+        The adapter is ACQUIRED here (refcounted — it cannot be evicted
+        while this request is live) and released at retirement.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_prompt(p)
+        if adapter is not None and self._adapter_pool is None:
+            raise ValueError(
+                "adapter= requires an engine built with adapter_pool="
+            )
         if self._shed_all or (
             self._max_queue is not None
             and len(self._queue) >= self._max_queue
@@ -1657,6 +2010,11 @@ class ContinuousEngine:
             ):
                 raise ValueError(f"request id {rid} already in use")
             self._next_rid = max(self._next_rid, rid + 1)
+        if adapter is not None:
+            # Acquire BEFORE enqueueing: an unknown tenant raises here
+            # (nothing enqueued), and the refcount pins the adapter's
+            # pool slot for the request's whole lifetime.
+            self._adapter_pool.acquire(adapter)
         self._queue.append(
             _Request(
                 rid=rid, prompt=p,
@@ -1664,6 +2022,8 @@ class ContinuousEngine:
                     time.perf_counter() if arrival_t is None else arrival_t
                 ),
                 deadline_s=deadline_s,
+                version=self.weights_version,
+                adapter=adapter,
             )
         )
         self._c_requests.inc()
@@ -1678,7 +2038,21 @@ class ContinuousEngine:
         return rid
 
     def has_work(self) -> bool:
-        return bool(self._queue) or any(r >= 0 for r in self._req)
+        # A staged-but-uncommitted weight swap is work: it takes one
+        # more step() to commit, and a driver that stops stepping at
+        # "no requests left" must not strand the engine mid-swap.
+        return (
+            bool(self._queue)
+            or any(r >= 0 for r in self._req)
+            or self._staged_swap is not None
+        )
+
+    @property
+    def swap_pending(self) -> bool:
+        """True while a staged weight swap awaits its commit (drivers
+        that pace their own swap cadence poll this instead of staging
+        on top of a pending one, which raises)."""
+        return self._staged_swap is not None
 
     def queue_depth(self) -> int:
         """Requests waiting for a slot — the fleet router's load probe."""
@@ -1729,6 +2103,12 @@ class ContinuousEngine:
             raise ValueError(
                 f"{what}: paged engines are not supported — rows live "
                 "behind host-owned block tables, not contiguous cache rows"
+            )
+        if self._adapter_pool is not None:
+            raise ValueError(
+                f"{what}: multi-LoRA engines are not supported — a handed-"
+                "off row's K/V was computed under a tenant adapter the "
+                "receiving engine may not hold"
             )
 
     def ensure_cache(self, params, draft_params=None):
@@ -1898,6 +2278,7 @@ class ContinuousEngine:
             rid=rid, prompt=p,
             arrival_t=now if arrival_t is None else arrival_t,
             deadline_s=deadline_s,
+            version=self.weights_version,
         )
         r.admit_t = now if admit_t is None else admit_t
         r.first_token_t = now if first_token_t is None else first_token_t
@@ -1972,7 +2353,7 @@ class ContinuousEngine:
         self.tracer.async_end("request", r.rid, generated=n)
         self.recorder.record(
             "engine.retire", rid=r.rid, slot=slot, generated=n,
-            ttft=rec["ttft"], e2e=rec["e2e"],
+            ttft=rec["ttft"], e2e=rec["e2e"], version=r.version,
         )
         if self.slo is not None:
             self.slo.observe("queue_wait", rec["queue_wait"])
@@ -1984,13 +2365,20 @@ class ContinuousEngine:
             for g in gaps:
                 self.slo.observe("itl", g)
         self._finished[r.rid] = r
+        # Version attribution (round 12): every response is traceable to
+        # exactly ONE weights version — the one pinned at its (last)
+        # admission. The zero-downtime swap oracle audits this log.
+        self.finished_versions[r.rid] = r.version
         retired.append(r.rid)
+        if r.adapter is not None and self._adapter_pool is not None:
+            self._adapter_pool.release(r.adapter)
         # Open the export window (disaggregated handoff): the row's KV
         # stays intact until a later admission reuses this slot.
         self._export_ok[r.rid] = slot
         self._slot_req[slot] = None
         self._req[slot] = -1
         self._active[slot] = False
+        self._aidx[slot] = 0
         if self._paged:
             self._release(slot)
 
@@ -2015,7 +2403,10 @@ class ContinuousEngine:
             # async_begin was issued at first admission; close the span
             # so the trace shows the failed request's full lifetime.
             self.tracer.async_end("request", r.rid, status=status)
+        if r.adapter is not None and self._adapter_pool is not None:
+            self._adapter_pool.release(r.adapter)
         self._finished[r.rid] = r
+        self.finished_versions[r.rid] = r.version
 
     def _fail_slot(self, slot, status, error, now=None):
         """Fail the request occupying ``slot`` and free the slot — the
@@ -2033,6 +2424,7 @@ class ContinuousEngine:
         self._slot_req[slot] = None
         self._req[slot] = -1
         self._active[slot] = False
+        self._aidx[slot] = 0
         self._pending[slot] = np.zeros((0,), np.int32)
         self._needs_reset[slot] = False
         self._reset_to[slot] = 0
@@ -2153,6 +2545,7 @@ class ContinuousEngine:
         self._slot_req[slot] = None
         self._req[slot] = -1
         self._active[slot] = False
+        self._aidx[slot] = 0
         self._pending[slot] = np.zeros((0,), np.int32)
         self._needs_reset[slot] = False
         self._reset_to[slot] = 0
@@ -2196,6 +2589,14 @@ class ContinuousEngine:
         return r
 
     def _admit(self):
+        if self._staged_swap is not None:
+            # A staged swap DRAINS the engine: no new admissions until
+            # occupancy hits zero and the commit flips versions — an
+            # admission now would pin the OLD version onto a request that
+            # outlives it. Queued requests keep their place; the very
+            # step that commits re-runs admission under the new version.
+            self._g_queue.set(len(self._queue))
+            return
         b = self._b
         now = time.perf_counter()
         for slot in range(b):
@@ -2232,6 +2633,11 @@ class ContinuousEngine:
                     readmission=not first_admission,
                 )
                 prompt = r.prompt
+                # (Re-)pin the weights version at EVERY admission: a
+                # preempted/requeued request recomputes from scratch, so
+                # it recomputes UNDER — and is attributed to — whatever
+                # version is serving when it readmits.
+                r.version = self.weights_version
                 # The slot is being reused: any retired request whose KV
                 # row lived here is no longer exportable.
                 self._export_ok = {
@@ -2239,6 +2645,10 @@ class ContinuousEngine:
                 }
                 self._slot_req[slot] = r
                 self._req[slot] = r.rid
+                self._aidx[slot] = (
+                    self._adapter_pool.slot_of(r.adapter)
+                    if r.adapter is not None else 0
+                )
                 self._plen[slot] = prompt.size
                 self._pending[slot] = prompt
                 self._emitted[slot] = 0
@@ -2668,11 +3078,30 @@ class ContinuousEngine:
         # "decode" — step() books wall time per class) or False when
         # nothing dispatched.
         if self._cache is None:
-            return (
-                "refill"
-                if self._refill_dispatch(params, d_params, retired)
-                else False
+            if self._adapter_pool is None:
+                return (
+                    "refill"
+                    if self._refill_dispatch(params, d_params, retired)
+                    else False
+                )
+            # Adapter engines must never stream prompt CONTENT through
+            # the base-weights refill programs: create the cache with a
+            # ZERO-LENGTH first refill (no writes, no advances) and fall
+            # through to the fused adapter step below, which prefills
+            # every row through its own tenant's merged weights.
+            first_args = (
+                params, d_params,
+                jnp.zeros((self._b, self._refill_chunk), jnp.int32),
+                jnp.zeros((self._b,), jnp.int32), self._rid_arr(),
+                self.rng,
             )
+            _, self._cache = self._first_refill_fn(*first_args)
+            self.cache_creations += 1
+            self._c_creations.inc()
+            self.recorder.record(
+                "engine.cache_create", n=self.cache_creations
+            )
+            self._last_first_refill_args = lambda: first_args
         b = self._b
         if self._speculative and self._spec_disabled:
             # Degradation level >= 1 on a speculative MIXED engine: run
@@ -2693,20 +3122,28 @@ class ContinuousEngine:
                 if self._decode_dispatch(params, d_params, retired)
                 else False
             )
-        if not any(p.size for p in self._pending):
+        if (
+            not any(p.size for p in self._pending)
+            and self._adapter_pool is None
+        ):
             # PURE-DECODE phase: nothing to fuse — run the K-token decode
             # block (full decode throughput; a fused link costs one
             # dispatch per token and exists to overlap refill, absent
             # here). Admission is unaffected: _admit ran before this
             # dispatch, and a queued request only waits on a block when
             # every slot is busy — in which case it could not have been
-            # admitted under any granularity.
+            # admitted under any granularity. (Adapter-pool engines skip
+            # this: the split decode block applies BASE weights, so
+            # their pure-decode phase runs fused adapter links instead.)
             return (
                 "decode"
                 if self._decode_dispatch(params, d_params, retired)
                 else False
             )
-        if self._speculative and not self._active.any():
+        if (
+            self._speculative and not self._active.any()
+            and self._adapter_pool is None
+        ):
             # PURE-REFILL phase in speculative mode: a fused link would
             # pay a full draft-verify round with every row frozen (draft
             # applies, a verify apply, two rollback broadcasts — zero
@@ -2789,6 +3226,15 @@ class ContinuousEngine:
             "engine.dispatch", phase="mixed",
             rids=[r for r in self._req if r >= 0],
         )
+        if self._adapter_pool is not None:
+            # One fused program serves every tenant in the batch: the
+            # stacked pool rides in as an argument (stable treedef →
+            # stable compile) and the per-row adapter index gathers each
+            # row's slice on device. _aidx is fixed for the whole chain:
+            # admission ran before this dispatch and nothing re-admits
+            # mid-chain.
+            pool_t = self._adapter_pool.tree
+            aidx_d = jnp.asarray(self._aidx)
         segs = []
         starved_total = 0
         refill_scheduled = 0
@@ -2824,7 +3270,23 @@ class ContinuousEngine:
             lengths_d = jnp.asarray(lengths)
             reset_d = jnp.asarray(self._needs_reset.copy())
             reset_to_d = jnp.asarray(self._reset_to.copy())
-            if self._speculative:
+            if self._speculative and self._adapter_pool is not None:
+                with annotate("engine.adapter_spec_mixed_step"):
+                    (first_tok, buffer, counts, acc, prop, tok_d, pos_d,
+                     active_d, remaining_d, t_cache, d_cache) = (
+                        self._adapter_spec_mixed_step_fn(
+                            params, pool_t, aidx_d, d_params, t_cache,
+                            d_cache, chunk_d, lengths_d, reset_d,
+                            reset_to_d, tok_d, active_d, pos_d,
+                            remaining_d, rid, self.rng,
+                        )
+                    )
+                args = (
+                    params, pool_t, aidx_d, d_params, t_cache, d_cache,
+                    chunk_d, lengths_d, reset_d, reset_to_d, tok_d,
+                    active_d, pos_d, remaining_d, rid, self.rng,
+                )
+            elif self._speculative:
                 with annotate("engine.spec_mixed_step"):
                     (first_tok, buffer, counts, acc, prop, tok_d, pos_d,
                      active_d, remaining_d, t_cache, d_cache) = (
@@ -2838,6 +3300,21 @@ class ContinuousEngine:
                     params, d_params, t_cache, d_cache, chunk_d,
                     lengths_d, reset_d, reset_to_d, tok_d, active_d,
                     pos_d, remaining_d, rid, self.rng,
+                )
+            elif self._adapter_pool is not None:
+                with annotate("engine.adapter_mixed_step"):
+                    first_tok, tok_d, active_d, remaining_d, self._cache = (
+                        self._adapter_mixed_step_fn(
+                            params, pool_t, aidx_d, self._cache, chunk_d,
+                            lengths_d, reset_d, reset_to_d, tok_d,
+                            active_d, remaining_d, rid, self.rng,
+                        )
+                    )
+                buffer = counts = acc = prop = None
+                args = (
+                    params, pool_t, aidx_d, self._cache, chunk_d,
+                    lengths_d, reset_d, reset_to_d, tok_d, active_d,
+                    remaining_d, rid, self.rng,
                 )
             else:
                 with annotate("engine.mixed_step"):
@@ -2883,6 +3360,12 @@ class ContinuousEngine:
             starved=starved_total, budget=self.token_budget,
             queue_depth=len(self._queue),
         )
+        if self._adapter_pool is not None:
+            self._c_adapter_n.inc(len(segs))
+            occ = np.asarray([q >= 0 for q in self._req])
+            self._c_adapter_rows.inc(
+                int(((self._aidx > 0) & occ).sum()) * len(segs)
+            )
         for first_tok, buffer, counts, acc, prop, seg_completes in segs:
             first_np = np.asarray(first_tok)   # each link's own sync
             now = time.perf_counter()
@@ -2962,7 +3445,7 @@ class ContinuousEngine:
             token_budget=self.token_budget, shedding=self._shed_all,
         )
 
-    def step(self, params, draft_params=None) -> list[int]:
+    def step(self, params=None, draft_params=None) -> list[int]:
         """ONE scheduler iteration: admit queued requests into idle
         slots, then run exactly one dispatch — a refill chunk if any slot
         has pending prompt tokens, else a decode block if any row is
@@ -2972,7 +3455,24 @@ class ContinuousEngine:
         budgeted refill chunks, so decode never stalls behind refill and
         admission lands at every dispatch. Returns the ids of requests
         that finished during this step (their outputs await
-        ``pop_finished()``)."""
+        ``pop_finished()``).
+
+        A staged ``swap_weights`` commits HERE, at the top of the step,
+        before this step's admissions — so the backlog re-admitted in
+        the committing step is pinned to (and served by) the NEW
+        version. Once a swap has committed, the engine owns its weights:
+        the installed tree overrides whatever ``params`` the caller
+        still passes (a driver mid-rollout keeps handing in its stale
+        copy), and ``step()`` may be called with no params at all."""
+        if self._staged_swap is not None:
+            self._try_commit_swap()
+        if self._installed is not None:
+            params, draft_params = self._installed
+        elif params is None:
+            raise TypeError(
+                "step() without params: no swapped-in weights installed "
+                "— pass params, or swap_weights() first"
+            )
         self._check_draft_args(draft_params)
         params, d_params = self._cast_params(params, draft_params)
         retired: list[int] = []
@@ -3180,7 +3680,12 @@ class ContinuousEngine:
                 fns["decode_block"] = self._decode_block_fn
         else:
             fns["decode_block"] = self._decode_block_fn
-        if self._mixed:
+        if self._mixed and self._adapter_pool is not None:
+            fns["adapter_mixed_step"] = (
+                self._adapter_spec_mixed_step_fn if self._speculative
+                else self._adapter_mixed_step_fn
+            )
+        elif self._mixed:
             fns["mixed_step"] = (
                 self._spec_mixed_step_fn if self._speculative
                 else self._mixed_step_fn
@@ -3224,11 +3729,19 @@ class ContinuousEngine:
                 self._last_decode_plain_args(),
             ))
         if self._last_mixed_args is not None:
-            fn = (
-                self._spec_mixed_step_fn if self._speculative
-                else self._mixed_step_fn
-            )
-            out.append(("mixed_step", fn, self._last_mixed_args()))
+            if self._adapter_pool is not None:
+                fn = (
+                    self._adapter_spec_mixed_step_fn if self._speculative
+                    else self._adapter_mixed_step_fn
+                )
+                name = "adapter_mixed_step"
+            else:
+                fn = (
+                    self._spec_mixed_step_fn if self._speculative
+                    else self._mixed_step_fn
+                )
+                name = "mixed_step"
+            out.append((name, fn, self._last_mixed_args()))
         if self._last_kv_export_args is not None:
             out.append((
                 "kv_export", self._kv_export_fn,
@@ -3289,6 +3802,7 @@ class ContinuousEngine:
         "decode_block": "decode_step",
         "decode_block_spec": "decode_step",
         "mixed_step": "mixed_step",
+        "adapter_mixed_step": "adapter_mixed_step",
         "kv_export": "kv_export",
         "kv_ingest": "kv_ingest",
     }

@@ -61,6 +61,16 @@ from learning_jax_sharding_tpu.parallel.sharding import (  # noqa: F401
     unique_shard_count,
     visualize,
 )
+from learning_jax_sharding_tpu.parallel.resharding import (  # noqa: F401
+    DEFAULT_PAGE_TOKENS,
+    Segment,
+    TransferPlan,
+    device_reshard,
+    execute_transfer,
+    plan_transfer,
+    reshard_tree,
+    transfer_tree,
+)
 from learning_jax_sharding_tpu.parallel.hlo import (  # noqa: F401
     assert_collectives,
     collective_counts,

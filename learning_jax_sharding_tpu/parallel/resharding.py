@@ -1,0 +1,450 @@
+"""Array redistribution between arbitrary mesh layouts: the shared
+resharding core.
+
+Moving an array from one sharding to another — a trained parameter tree
+into a serving layout, a checkpoint restored on different hardware, a
+finished prefill's KV row onto a decode replica's sub-mesh — is the same
+problem everywhere: the source and destination device sets (possibly
+disjoint, possibly identical) each own shard boxes of the global array,
+and the move decomposes into the minimal set of block-level copies
+between them. That is exactly what "Memory-efficient array
+redistribution" (arXiv 2112.01075) and "On Optimizing the Communication
+of Model Parallelism" (arXiv 2211.05322) treat: never materialize the
+full array anywhere, copy only overlaps.
+
+This module is that decomposition, made a first-class checked object.
+It grew out of the fleet's streamed KV handoff (``fleet/kv_transfer.py``
+now delegates here verbatim) and generalizes it to WHOLE PARAMETER
+TREES for the tenancy subsystem's weight hot-swap:
+
+* :func:`plan_transfer` intersects the source sharding's shard boxes
+  with the destination sharding's (``devices_indices_map`` on both) and
+  emits one :class:`Segment` per overlapping block, optionally split at
+  PAGE granularity along a sequence dim. Replicated source dims are
+  deduplicated (one elected owner per distinct block, preferring a
+  locally-addressable device); replicated DESTINATION dims cost one copy
+  per holding device — the honest wire price of replication.
+* :func:`execute_transfer` runs a plan host-side: each destination shard
+  is assembled from exactly its overlapping source-shard slices and the
+  result committed under the destination sharding via
+  ``jax.make_array_from_callback``. A ``stop`` bound skips/clips
+  segments past a row's valid length.
+* :func:`transfer_tree` maps both over a tree with per-leaf sequence
+  dims and ``stop`` clipping — the KV-handoff shape of the problem.
+* :func:`reshard_tree` maps both over a tree of WHOLE leaves (no
+  sequence dim, no clipping) — the weight hot-swap shape: training
+  layout or checkpoint-on-disk → serving layout. Same plan cache, same
+  bytes/segments telemetry. Non-``jax.Array`` leaves (host numpy from a
+  checkpoint restore) are committed straight to the destination layout.
+* :func:`device_reshard` is the fast path when source and destination
+  live on the SAME device set: one jitted identity with
+  ``out_shardings`` pinned, so the layout change is a single compiled
+  program (XLA emits the collective permutes) instead of a host round
+  trip. This is the "swap program" the shardcheck golden pins — every
+  collective of an intra-mesh hot-swap is audited, every cross-mesh
+  byte is in the explicit, counted host plan. :func:`reshard_tree`
+  picks the path per-leaf unless told otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: Default streaming unit along the sequence dim — matches the serving
+#: engine's default KV page (``page_size=64``): a segment is "one page of
+#: one shard", the granularity a real transport would pipeline.
+DEFAULT_PAGE_TOKENS = 64
+
+Box = tuple[tuple[int, int], ...]   # per-dim half-open (start, stop)
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """One block copy: the intersection ``box`` (GLOBAL coordinates) of a
+    source shard and a destination shard, with the owning devices and
+    each shard's origin (for local-slice arithmetic at execution)."""
+
+    src_device: Any
+    dst_device: Any
+    box: Box
+    src_origin: tuple[int, ...]
+    dst_box: Box                       # the destination shard's full box
+
+    @property
+    def elements(self) -> int:
+        return math.prod(hi - lo for lo, hi in self.box)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransferPlan:
+    """The checked, reusable decomposition of one leaf's redistribution.
+
+    Deterministic in its inputs (shape + the two shardings), so callers
+    compute it once per leaf layout and replay it per transfer.
+    ``bytes_total`` is the full-array wire volume; a ``stop``-clipped
+    execution reports its own (smaller) actuals.
+    """
+
+    shape: tuple[int, ...]
+    itemsize: int
+    src_sharding: Any
+    dst_sharding: Any
+    seq_dim: int | None
+    page_tokens: int | None
+    segments: tuple[Segment, ...]
+
+    @property
+    def bytes_total(self) -> int:
+        return sum(s.elements for s in self.segments) * self.itemsize
+
+    def describe(self) -> dict:
+        """JSON-able summary for artifacts/flight-recorder payloads."""
+        return {
+            "shape": list(self.shape),
+            "itemsize": self.itemsize,
+            "segments": len(self.segments),
+            "bytes_total": self.bytes_total,
+            "seq_dim": self.seq_dim,
+            "page_tokens": self.page_tokens,
+        }
+
+
+def _norm_box(idx: Sequence, shape: Sequence[int]) -> Box:
+    # devices_indices_map yields per-dim slices (possibly None-bounded);
+    # normalize to concrete half-open ranges.
+    return tuple(
+        tuple(sl.indices(d)[:2]) for sl, d in zip(idx, shape)
+    )
+
+
+def plan_transfer(
+    shape: Sequence[int],
+    itemsize: int,
+    src_sharding: Any,
+    dst_sharding: Any,
+    *,
+    seq_dim: int | None = None,
+    page_tokens: int | None = DEFAULT_PAGE_TOKENS,
+) -> TransferPlan:
+    """Decompose ``src_sharding → dst_sharding`` into block copies.
+
+    For every destination shard box, emit the intersections with the
+    DEDUPLICATED source shard boxes (replicated sources have one elected
+    owner — the blocks then tile the array exactly, so each destination
+    element is written exactly once). With ``seq_dim`` set, segments
+    split into ``page_tokens``-sized pages along it — the streaming
+    unit ``stop`` clipping operates on.
+    """
+    shape = tuple(int(s) for s in shape)
+    src_map = src_sharding.devices_indices_map(shape)
+    dst_map = dst_sharding.devices_indices_map(shape)
+    # One elected owner per distinct source block, preferring a device
+    # THIS process can read (execute_transfer assembles from
+    # addressable_shards): a block replicated across hosts must elect
+    # its local replica, not whichever host happens to come first in
+    # the device map.
+    me = jax.process_index()
+    blocks: dict[Box, Any] = {}
+    for dev, idx in src_map.items():
+        box = _norm_box(idx, shape)
+        cur = blocks.get(box)
+        if cur is None or (
+            getattr(cur, "process_index", me) != me
+            and getattr(dev, "process_index", me) == me
+        ):
+            blocks[box] = dev
+    segments: list[Segment] = []
+    for ddev, didx in dst_map.items():
+        dbox = _norm_box(didx, shape)
+        for sbox, sdev in blocks.items():
+            inter = tuple(
+                (max(a0, b0), min(a1, b1))
+                for (a0, a1), (b0, b1) in zip(sbox, dbox)
+            )
+            if any(lo >= hi for lo, hi in inter):
+                continue
+            src_origin = tuple(lo for lo, _ in sbox)
+            if seq_dim is not None and page_tokens:
+                lo, hi = inter[seq_dim]
+                # Page boundaries in GLOBAL coordinates, so the same
+                # token lands in the same page whichever shard carries it.
+                start = (lo // page_tokens) * page_tokens
+                for p0 in range(start, hi, page_tokens):
+                    plo, phi = max(lo, p0), min(hi, p0 + page_tokens)
+                    if plo >= phi:
+                        continue
+                    box = tuple(
+                        (plo, phi) if d == seq_dim else rng
+                        for d, rng in enumerate(inter)
+                    )
+                    segments.append(
+                        Segment(sdev, ddev, box, src_origin, dbox)
+                    )
+            else:
+                segments.append(Segment(sdev, ddev, inter, src_origin, dbox))
+    return TransferPlan(
+        shape=shape, itemsize=int(itemsize),
+        src_sharding=src_sharding, dst_sharding=dst_sharding,
+        seq_dim=seq_dim, page_tokens=page_tokens,
+        segments=tuple(segments),
+    )
+
+
+def execute_transfer(
+    plan: TransferPlan, x: jax.Array, *, stop: int | None = None
+) -> tuple[jax.Array, dict]:
+    """Run ``plan`` on ``x``: assemble every destination shard from its
+    source-shard slices and commit the result under the destination
+    sharding. ``stop`` (sequence positions ``< stop`` are valid) skips
+    whole pages past the bound and clips the straddling one — skipped
+    regions stay zero in the destination buffer, which the engine's
+    causal-at-index masks never read.
+
+    Returns ``(array, stats)`` with ``stats = {"bytes", "segments",
+    "segments_skipped"}`` — the actual wire volume of THIS transfer.
+    """
+    shape, dtype = plan.shape, x.dtype
+    if tuple(x.shape) != shape:
+        raise ValueError(f"plan is for shape {shape}, array is {x.shape}")
+    src_np: dict[Any, np.ndarray] = {}
+
+    def src_block(dev) -> np.ndarray:
+        buf = src_np.get(dev)
+        if buf is None:
+            for s in x.addressable_shards:
+                if s.device == dev:
+                    buf = src_np[dev] = np.asarray(s.data)
+                    break
+            else:
+                raise ValueError(f"no addressable shard on {dev}")
+        return buf
+
+    # Every destination shard box gets a buffer up front — a box fully
+    # past ``stop`` still needs its (zero) bytes to commit the array.
+    dst_bufs: dict[Box, np.ndarray] = {}
+    for didx in plan.dst_sharding.devices_indices_map(shape).values():
+        dbox = _norm_box(didx, shape)
+        if dbox not in dst_bufs:
+            dst_bufs[dbox] = np.zeros(
+                tuple(hi - lo for lo, hi in dbox), dtype
+            )
+    copied = skipped = nbytes = 0
+    for seg in plan.segments:
+        box = seg.box
+        if stop is not None and plan.seq_dim is not None:
+            lo, hi = box[plan.seq_dim]
+            hi = min(hi, int(stop))
+            if lo >= hi:
+                skipped += 1
+                continue
+            box = tuple(
+                (lo, hi) if d == plan.seq_dim else rng
+                for d, rng in enumerate(box)
+            )
+        src = src_block(seg.src_device)
+        src_sl = tuple(
+            slice(lo - o, hi - o)
+            for (lo, hi), o in zip(box, seg.src_origin)
+        )
+        dst_sl = tuple(
+            slice(lo - dlo, hi - dlo)
+            for (lo, hi), (dlo, _) in zip(box, seg.dst_box)
+        )
+        dst_bufs[seg.dst_box][dst_sl] = src[src_sl]
+        copied += 1
+        nbytes += math.prod(hi - lo for lo, hi in box) * plan.itemsize
+
+    out = jax.make_array_from_callback(
+        shape, plan.dst_sharding,
+        lambda idx: dst_bufs[_norm_box(idx, shape)],
+    )
+    return out, {
+        "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
+    }
+
+
+def transfer_tree(
+    rows: Any,
+    dst_shardings: Any,
+    *,
+    stop: int | None = None,
+    seq_dims: Any | None = None,
+    page_tokens: int | None = DEFAULT_PAGE_TOKENS,
+    plan_cache: dict | None = None,
+) -> tuple[Any, dict]:
+    """Redistribute a whole exported cache-row tree (``export_kv``) into
+    ``dst_shardings`` (``kv_row_shardings`` of the destination engine).
+
+    ``seq_dims`` names each leaf's SEQUENCE dim (a matching pytree of
+    ints, ``-1`` = no sequence dim — the destination engine's
+    ``kv_row_seq_dims``, which derives it from the actual row layout:
+    the dense decode backend is sequence-major, the blocked/TPU backend
+    head-major); ``stop`` (the row's valid length) clips those leaves'
+    plans, and ``-1`` leaves move whole. Without ``seq_dims`` every
+    rank ≥ 2 leaf is ASSUMED sequence-major on dim 0 — only safe for
+    dense-backend rows or plain arrays. ``plan_cache`` (any dict)
+    memoizes plans across handoffs of the same layout. Returns
+    ``(tree, stats)`` with the summed bytes/segments telemetry.
+    """
+    totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
+    if seq_dims is None:
+        seq_dims = jax.tree.map(
+            lambda x: 0 if getattr(x, "ndim", 0) >= 2 else -1, rows,
+        )
+
+    def one(x, dst, seq_dim):
+        x = x if isinstance(x, jax.Array) else jnp.asarray(x)
+        seq_dim = None if seq_dim is None or seq_dim < 0 else int(seq_dim)
+        key = (
+            tuple(x.shape), str(x.dtype), x.sharding, dst, seq_dim,
+            page_tokens,
+        )
+        plan = plan_cache.get(key) if plan_cache is not None else None
+        if plan is None:
+            plan = plan_transfer(
+                x.shape, x.dtype.itemsize, x.sharding, dst,
+                seq_dim=seq_dim, page_tokens=page_tokens,
+            )
+            if plan_cache is not None:
+                plan_cache[key] = plan
+        out, stats = execute_transfer(
+            plan, x, stop=stop if seq_dim is not None else None
+        )
+        for k in totals:
+            totals[k] += stats[k]
+        return out
+
+    out = jax.tree.map(one, rows, dst_shardings, seq_dims)
+    return out, totals
+
+
+# --- whole-tree resharding (tenancy hot-swap) ---------------------------
+
+
+def _same_device_set(x: jax.Array, dst: Any) -> bool:
+    try:
+        return set(x.sharding.device_set) == set(dst.device_set)
+    except AttributeError:
+        return False
+
+
+def device_reshard(tree: Any, dst_shardings: Any, *, jit_cache: dict | None = None):
+    """Reshard a tree whose leaves already live on the DESTINATION device
+    set: one jitted identity with ``out_shardings`` pinned per (treedef,
+    layout) pair — XLA emits the minimal collective permutes and the
+    whole layout change is a single audited program (the ``swap_reshard``
+    shardcheck golden). ``jit_cache`` (any dict) memoizes the compiled
+    program across swaps of the same tree structure; without it every
+    call pays a fresh trace.
+
+    Returns ``(tree, stats)`` with ``stats["mode"] == "device"`` and
+    ``bytes``/``segments`` as the summed leaf sizes/count — the honest
+    upper bound of what moved (XLA may move less when a leaf's layout is
+    unchanged).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dst_leaves = treedef.flatten_up_to(dst_shardings)
+    key = (
+        treedef,
+        tuple(
+            (tuple(x.shape), str(x.dtype), x.sharding, d)
+            for x, d in zip(leaves, dst_leaves)
+        ),
+    )
+    fn = jit_cache.get(key) if jit_cache is not None else None
+    if fn is None:
+        fn = jax.jit(lambda t: t, out_shardings=dst_shardings)
+        if jit_cache is not None:
+            jit_cache[key] = fn
+    out = fn(tree)
+    stats = {
+        "bytes": sum(x.nbytes for x in leaves),
+        "segments": len(leaves),
+        "segments_skipped": 0,
+        "mode": "device",
+    }
+    return out, stats
+
+
+def reshard_tree(
+    tree: Any,
+    dst_shardings: Any,
+    *,
+    plan_cache: dict | None = None,
+    jit_cache: dict | None = None,
+    mode: str = "auto",
+) -> tuple[Any, dict]:
+    """Redistribute an arbitrary parameter tree into ``dst_shardings`` —
+    the weight-hot-swap shape of the problem: training layout or
+    checkpoint-on-disk → serving layout, leaves moved WHOLE (no sequence
+    dim, no clipping), dtypes preserved exactly (a quantized int8/int4
+    tree reshards bit-for-bit; nothing here casts).
+
+    ``mode``:
+
+    * ``"auto"`` (default) — the DEVICE fast path (:func:`device_reshard`,
+      one jitted identity) when every leaf is a committed ``jax.Array``
+      whose device set already equals its destination's; the HOST plan
+      path otherwise (cross-mesh moves, checkpoint numpy leaves).
+    * ``"host"`` — force the explicit segment-plan path (every byte
+      counted, nothing hidden in XLA).
+    * ``"device"`` — force the jitted path (raises if a leaf isn't on
+      the destination devices).
+
+    Host-path non-``jax.Array`` leaves (numpy from a checkpoint restore)
+    are committed straight under the destination sharding shard-by-shard
+    — still no full-array device materialization. Returns
+    ``(tree, stats)`` with summed ``bytes``/``segments`` telemetry and
+    ``stats["mode"]``.
+    """
+    if mode not in ("auto", "host", "device"):
+        raise ValueError(f"reshard_tree: unknown mode {mode!r}")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    dst_leaves = treedef.flatten_up_to(dst_shardings)
+    if mode == "device" or (
+        mode == "auto"
+        and leaves
+        and all(
+            isinstance(x, jax.Array) and _same_device_set(x, d)
+            for x, d in zip(leaves, dst_leaves)
+        )
+    ):
+        return device_reshard(tree, dst_shardings, jit_cache=jit_cache)
+
+    totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
+
+    def one(x, dst):
+        if not isinstance(x, jax.Array) or not hasattr(x, "sharding"):
+            # Host leaf (checkpoint numpy): commit shard-by-shard under
+            # the destination sharding — the full array never lands on
+            # any single device.
+            buf = np.asarray(x)
+            out = jax.make_array_from_callback(
+                buf.shape, dst, lambda idx, b=buf: b[idx]
+            )
+            totals["bytes"] += buf.nbytes
+            totals["segments"] += 1
+            return out
+        key = (tuple(x.shape), str(x.dtype), x.sharding, dst, None, None)
+        plan = plan_cache.get(key) if plan_cache is not None else None
+        if plan is None:
+            plan = plan_transfer(
+                x.shape, x.dtype.itemsize, x.sharding, dst,
+                seq_dim=None, page_tokens=None,
+            )
+            if plan_cache is not None:
+                plan_cache[key] = plan
+        out, stats = execute_transfer(plan, x)
+        for k in totals:
+            totals[k] += stats[k]
+        return out
+
+    out = jax.tree.map(one, tree, dst_shardings)
+    totals["mode"] = "host"
+    return out, totals

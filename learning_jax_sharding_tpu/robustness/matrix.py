@@ -27,6 +27,7 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | malformed_request | corrupted queued prompt           | admission re-check → fail+isolate |
 | overload_shed     | offered load > queue bound        | bounded queue + degradation ladder|
 | replica_kill      | engine replica dies mid-stream    | router failover + rerouted requeue|
+| swap_mid_stream   | weight-swap staging dies mid-serve| swap abort → stay on old version  |
 """
 
 from __future__ import annotations
@@ -358,6 +359,61 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             ),
         }
 
+    def swap_mid_stream():
+        # Zero-downtime weight swap (round 12) interrupted at the
+        # staging seam, mid-serve: the swap must ABORT — the engine
+        # stays on the old version, every in-flight/queued request
+        # completes bit-identically to the fault-free run, nothing is
+        # dropped — and the RETRY must commit, with every response
+        # attributable to exactly one version.
+        eng = ContinuousEngine(
+            cfg, mesh, rules, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=8, recorder=rec,
+        )
+        for rid, p in reqs.items():
+            eng.add_request(p, rid=rid)
+        eng.step(params)            # work admitted and mid-flight
+        new_params = jax.tree.map(lambda x: x * 1.01, params)
+        aborts0 = count("engine.swap_abort")
+        with ChaosInjector(
+            Fault("engine.swap_stage", "raise", count=1), recorder=rec,
+        ):
+            staged = eng.swap_weights(new_params, version=1)
+        assert staged is False, "the injected staging fault must abort"
+        assert eng.weights_version == 0, "an aborted swap must not flip"
+        assert count("engine.swap_abort") == aborts0 + 1
+        out: dict[int, Any] = {}
+        steps = 0
+        while eng.has_work():
+            eng.step(params)
+            out.update(eng.pop_finished())
+            steps += 1
+            assert steps <= 400, "engine wedged after swap abort"
+        out.update(eng.pop_finished())
+        assert sorted(out) == sorted(reqs), "zero drops after the abort"
+        for rid, v in out.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, clean[rid])
+        assert {eng.finished_versions[r] for r in reqs} == {0}
+        # The retry (no fault) commits — and the next request is served
+        # by, and attributed to, the new version.
+        assert eng.swap_weights(new_params, version=1)
+        assert eng.weights_version == 1
+        eng.add_request(prompts[0], rid=100)
+        steps = 0
+        while eng.has_work():
+            eng.step()              # installed weights drive the engine
+            steps += 1
+            assert steps <= 400
+        post = eng.pop_finished()
+        assert eng.finished_versions[100] == 1
+        assert not isinstance(post[100], RequestFailure)
+        return {
+            "aborted_version": 1,
+            "served_on_old": len(out),
+            "post_commit_version": eng.finished_versions[100],
+        }
+
     # --- training cells ---------------------------------------------------
 
     model = Transformer(cfg)
@@ -473,6 +529,8 @@ def run_matrix(verbose: bool = False) -> list[dict]:
          "shed + degradation ladder", overload)
     cell("replica_kill", "engine replica dies mid-stream",
          "router failover + rerouted requeue", replica_kill)
+    cell("swap_mid_stream", "weight-swap staging dies mid-serve",
+         "swap abort, stay on old version", swap_mid_stream)
     cell("nan_grad_skip", "NaN grad/loss in-step",
          "guarded skip", lambda: nan_grad(tmp))
     cell("spike_rollback", "loss spike x1000",

@@ -1,0 +1,31 @@
+"""Tenancy (round 12): serve while training — zero-downtime weight
+hot-swap and multi-LoRA multi-tenant serving.
+
+Two pillars, both riding the page-granular redistribution algebra in
+:mod:`parallel.resharding`:
+
+* **Hot-swap** — ``ContinuousEngine.swap_weights`` stages a new weight
+  version into the serving layout off the hot path and commits it
+  atomically between dispatches (in-flight requests finish on the old
+  version or recompute bit-identically under the new one — never a
+  silent mid-sequence change); ``FleetRouter.rolling_swap`` walks a
+  fleet one replica at a time so aggregate serving never drops to zero.
+* **Multi-LoRA** — :class:`.adapter_pool.AdapterPool` pages tenants'
+  LoRA adapters into one stacked tree; the engine's fused
+  ``adapter_mixed_step`` gathers each row's adapter by slot index on
+  device, so ONE program serves every tenant in a batch, bit-identical
+  to each tenant served solo against ``merge_lora``-folded weights.
+
+This module is the import surface: the pool, and the thin staging /
+artifact helpers in :mod:`.hot_swap`.
+"""
+
+from learning_jax_sharding_tpu.tenancy.adapter_pool import (  # noqa: F401
+    DEFAULT_PAGE_BYTES,
+    AdapterPool,
+)
+from learning_jax_sharding_tpu.tenancy.hot_swap import (  # noqa: F401
+    serving_shardings,
+    stage_params,
+    write_swap_timeline,
+)

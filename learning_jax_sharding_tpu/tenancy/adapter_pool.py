@@ -1,0 +1,327 @@
+"""Multi-LoRA adapter pool: a stacked, paged bank of tenant adapters the
+fused serving programs gather from per row.
+
+One :class:`AdapterPool` holds up to ``slots`` tenants' LoRA adapters as
+a SINGLE stacked pytree: every adapted kernel path carries
+``{"lora_a": (S, d_in, r), "lora_b": (S, r, d_out), "scale": (S,)}``.
+The engine passes the whole stack into ``adapter_mixed_step`` as an
+ordinary argument (stable treedef → stable compile) together with a
+per-row slot index; the program gathers each row's slice on device and
+applies that row through its own tenant's merged weights — one fused
+program serves every tenant in the batch, bit-identical to each tenant
+served solo against ``merge_lora``-folded weights.
+
+Slot 0 is RESERVED for the base model (the zero adapter,
+:func:`training.lora.zero_lora` semantics): rows with no adapter gather
+slot 0 and ``W + scale·(A@B)`` adds exact zero. Named tenants occupy
+slots 1..S-1.
+
+Paging here is an ACCOUNTING layer, deliberately unlike the engine's KV
+page pool: the stacked tree is preallocated at construction (stable
+shapes are what keep the fused program compile-stable), so "pages" are
+not dynamically allocated buffers — they are the capacity ledger
+(``ceil(per-slot bytes / page_bytes)`` pages per slot) that
+``capacity_pages`` caps and the ``engine_adapter_pool_pages_in_use``
+gauge reports. Admitting a tenant past the cap evicts the
+least-recently-used adapter with ZERO in-flight requests; tenants with
+live requests are never evicted (the engine holds a refcount per
+admitted request via :meth:`acquire`/:meth:`release`).
+
+Hot-add is functional: :meth:`add` writes the new tenant's factors with
+``.at[slot].set`` — a fresh stacked tree of identical shape, so a
+serving engine picks it up at its next dispatch with no recompile and
+no pause.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from learning_jax_sharding_tpu.training.lora import (
+    LoraState,
+    default_match,
+)
+
+DEFAULT_PAGE_BYTES = 1 << 20
+
+
+def _is_pool_node(node: Any) -> bool:
+    return (
+        isinstance(node, dict)
+        and set(node) == {"lora_a", "lora_b", "scale"}
+    )
+
+
+class AdapterPool:
+    """Stacked multi-tenant LoRA bank; see module docstring.
+
+    Built from the BASE param tree's structure: every leaf matched by
+    ``match`` (default: 2D kernels) gets a stacked factor pair. ``rank``
+    and ``slots`` fix the stack's shapes for the engine's lifetime.
+    When the base leaves carry :class:`NamedSharding`, the stack
+    inherits the adapters' serving placement (A row-sharded, B
+    col-sharded — ``training.lora.lora_shardings`` with a replicated
+    slot dim in front), so the on-device gather needs no resharding.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        *,
+        slots: int,
+        rank: int,
+        match: Callable = default_match,
+        dtype: Any = None,
+        mesh: Mesh | None = None,
+        page_bytes: int = DEFAULT_PAGE_BYTES,
+        capacity_pages: int | None = None,
+    ):
+        if slots < 2:
+            raise ValueError(
+                f"slots must be >= 2 (slot 0 is the reserved base "
+                f"tenant), got {slots}"
+            )
+        self.slots = int(slots)
+        self.rank = int(rank)
+        self.page_bytes = int(page_bytes)
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        tree: dict = {}
+        slot_bytes = 0
+        n_nodes = 0
+        for keypath, leaf in flat:
+            path = tuple(getattr(k, "key", str(k)) for k in keypath)
+            if not match(path, leaf):
+                continue
+            d_in, d_out = leaf.shape
+            dt = jnp.dtype(dtype or leaf.dtype)
+            sh = getattr(leaf, "sharding", None)
+            if mesh is not None and isinstance(sh, NamedSharding):
+                spec = tuple(sh.spec) + (None,) * (2 - len(sh.spec))
+                sh_a = NamedSharding(mesh, PartitionSpec(None, spec[0], None))
+                sh_b = NamedSharding(mesh, PartitionSpec(None, None, spec[1]))
+                sh_s = NamedSharding(mesh, PartitionSpec(None))
+            elif mesh is not None:
+                sh_a = sh_b = sh_s = NamedSharding(mesh, PartitionSpec())
+            else:
+                sh_a = sh_b = sh_s = None
+
+            def zeros(shape, d, s):
+                z = jnp.zeros(shape, d)
+                return jax.device_put(z, s) if s is not None else z
+
+            node = tree
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = {
+                "lora_a": zeros((slots, d_in, rank), dt, sh_a),
+                "lora_b": zeros((slots, rank, d_out), dt, sh_b),
+                "scale": zeros((slots,), jnp.float32, sh_s),
+            }
+            slot_bytes += (d_in + d_out) * rank * dt.itemsize + 4
+            n_nodes += 1
+        if not n_nodes:
+            raise ValueError("no parameters matched — nothing to adapt")
+        self._tree = tree
+        self.pages_per_slot = max(1, math.ceil(slot_bytes / page_bytes))
+        if capacity_pages is not None:
+            self.max_live = max(
+                1, min(slots - 1, capacity_pages // self.pages_per_slot)
+            )
+        else:
+            self.max_live = slots - 1
+
+        self._by_name: dict[str, int] = {}
+        self._refs: dict[str, int] = {}
+        self._last_used: dict[str, int] = {}
+        self._clock = 0
+        self._free = list(range(1, slots))
+        self._registry = None
+        self._recorder = None
+
+    # --- wiring ------------------------------------------------------------
+
+    def bind(self, registry, recorder=None) -> "AdapterPool":
+        """Attach the engine's metrics registry (and flight recorder):
+        pool adds/evictions become counters, residency becomes gauges.
+        The engine calls this from its constructor."""
+        self._registry = registry
+        self._recorder = recorder
+        self._c_adds = registry.counter(
+            "engine_adapter_pool_adds_total",
+            "adapter pool: tenants added (including hot updates)",
+        )
+        self._c_evict = registry.counter(
+            "engine_adapter_pool_evictions_total",
+            "adapter pool: refcount-0 tenants evicted for capacity",
+        )
+        self._g_pages = registry.gauge(
+            "engine_adapter_pool_pages_in_use",
+            "adapter pool: pages held by resident tenants",
+        )
+        self._g_live = registry.gauge(
+            "engine_adapter_pool_slots_live",
+            "adapter pool: resident named tenants",
+        )
+        self._update_gauges()
+        return self
+
+    def _update_gauges(self):
+        if self._registry is None:
+            return
+        live = len(self._by_name)
+        self._g_live.set(live)
+        self._g_pages.set(live * self.pages_per_slot)
+
+    def _record(self, event: str, **fields):
+        if self._recorder is not None:
+            self._recorder.record(event, **fields)
+
+    # --- tenant lifecycle --------------------------------------------------
+
+    @property
+    def tree(self) -> Any:
+        """The stacked pool pytree the fused program takes as an
+        argument. Replaced wholesale by :meth:`add` — never mutated."""
+        return self._tree
+
+    def names(self) -> list[str]:
+        return sorted(self._by_name)
+
+    def slot_of(self, name: str) -> int:
+        """Resident slot of ``name`` (KeyError if not resident); marks
+        it recently used."""
+        slot = self._by_name[name]
+        self._clock += 1
+        self._last_used[name] = self._clock
+        return slot
+
+    def refcount(self, name: str) -> int:
+        return self._refs.get(name, 0)
+
+    def add(self, name: str, adapters: Any, *, alpha: float = 16.0) -> int:
+        """Make ``name`` resident with the given adapter tree (an
+        ``init_lora``-shaped nested dict, or a :class:`LoraState` — then
+        its trained alpha wins). Re-adding a resident name HOT-UPDATES
+        its factors in place (same slot — in-flight requests of that
+        tenant keep gathering the slot and see the new weights at the
+        next dispatch, exactly like a weight hot-swap commit, so push
+        updates between a tenant's requests, not during them: refcount 0
+        is the safe window). Evicts an LRU refcount-0 tenant when past
+        capacity; raises RuntimeError when every resident tenant has
+        live requests."""
+        if isinstance(adapters, LoraState):
+            alpha = float(adapters.alpha)
+            adapters = adapters.adapters
+        update = name in self._by_name
+        if update:
+            slot = self._by_name[name]
+        else:
+            while not self._free or len(self._by_name) >= self.max_live:
+                self._evict_lru()
+            slot = self._free.pop(0)
+        self._write_slot(slot, adapters, alpha)
+        self._by_name[name] = slot
+        self._refs.setdefault(name, 0)
+        self._clock += 1
+        self._last_used[name] = self._clock
+        if self._registry is not None:
+            self._c_adds.inc()
+        self._update_gauges()
+        self._record(
+            "adapter.update" if update else "adapter.add",
+            name=name, slot=slot, alpha=alpha,
+            pages=self.pages_per_slot,
+        )
+        return slot
+
+    def _evict_lru(self):
+        victims = [
+            n for n in self._by_name if self._refs.get(n, 0) == 0
+        ]
+        if not victims:
+            raise RuntimeError(
+                "adapter pool full and every resident tenant has live "
+                "requests — nothing evictable"
+            )
+        victim = min(victims, key=lambda n: self._last_used.get(n, 0))
+        slot = self._by_name.pop(victim)
+        self._refs.pop(victim, None)
+        self._last_used.pop(victim, None)
+        self._free.append(slot)
+        # The stacked factors stay in place: the slot is unreachable
+        # (no name maps to it) until the next add overwrites it.
+        if self._registry is not None:
+            self._c_evict.inc()
+        self._update_gauges()
+        self._record("adapter.evict", name=victim, slot=slot)
+
+    def acquire(self, name: str) -> int:
+        """Refcount++ for one admitted request of ``name``; returns the
+        slot. KeyError when the tenant is not resident — the engine
+        rejects the request instead of silently serving base weights."""
+        slot = self.slot_of(name)   # KeyError on unknown; bumps LRU
+        self._refs[name] = self._refs.get(name, 0) + 1
+        return slot
+
+    def release(self, name: str) -> None:
+        """Refcount-- when a request of ``name`` retires or fails."""
+        if self._refs.get(name, 0) > 0:
+            self._refs[name] -= 1
+
+    def stats(self) -> dict:
+        """JSON-able residency snapshot (cases and dashboards)."""
+        return {
+            "slots": self.slots,
+            "max_live": self.max_live,
+            "pages_per_slot": self.pages_per_slot,
+            "pages_in_use": len(self._by_name) * self.pages_per_slot,
+            "tenants": {
+                n: {"slot": s, "refs": self._refs.get(n, 0)}
+                for n, s in sorted(self._by_name.items())
+            },
+        }
+
+    # --- stacked writes ----------------------------------------------------
+
+    def _write_slot(self, slot: int, adapters: Any, alpha: float):
+        scale = jnp.float32(alpha / self.rank)
+
+        def walk(pnode, anode, path):
+            if _is_pool_node(pnode):
+                if not (
+                    isinstance(anode, dict)
+                    and set(anode) == {"lora_a", "lora_b"}
+                ):
+                    raise KeyError(
+                        f"adapter tree missing factors at {'/'.join(path)}"
+                    )
+                a, b = anode["lora_a"], anode["lora_b"]
+                if a.shape != pnode["lora_a"].shape[1:]:
+                    raise ValueError(
+                        f"{'/'.join(path)}: lora_a {a.shape} does not "
+                        f"fit pool slice {pnode['lora_a'].shape[1:]} "
+                        f"(rank={self.rank})"
+                    )
+                return {
+                    "lora_a": pnode["lora_a"]
+                    .at[slot].set(a.astype(pnode["lora_a"].dtype)),
+                    "lora_b": pnode["lora_b"]
+                    .at[slot].set(b.astype(pnode["lora_b"].dtype)),
+                    "scale": pnode["scale"].at[slot].set(scale),
+                }
+            return {
+                k: walk(
+                    v,
+                    anode.get(k) if isinstance(anode, dict) else None,
+                    path + (k,),
+                )
+                for k, v in pnode.items()
+            }
+
+        self._tree = walk(self._tree, adapters, ())

@@ -1,0 +1,58 @@
+"""Weight hot-swap staging helpers: the thin operator-facing layer over
+:mod:`parallel.resharding` and the engine's swap state machine.
+
+The swap itself lives in :meth:`ContinuousEngine.swap_weights` /
+``FleetRouter.rolling_swap`` (staging, drain, atomic commit, version
+attribution). What belongs HERE is the part an operator script touches:
+pre-staging a checkpointed tree into the serving layout before handing
+it to the engine, and persisting the swap timeline artifact the cases
+and dashboards read.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+
+from learning_jax_sharding_tpu.parallel.resharding import reshard_tree
+
+
+def serving_shardings(tree: Any) -> Any:
+    """The sharding tree of live serving weights — the destination
+    layout ``stage_params`` reshards a trained/restored tree into."""
+    return jax.tree.map(lambda x: x.sharding, tree)
+
+
+def stage_params(
+    params: Any,
+    dst_shardings: Any,
+    *,
+    plan_cache: dict | None = None,
+    jit_cache: dict | None = None,
+    mode: str = "auto",
+) -> tuple[Any, dict]:
+    """Reshard ``params`` into the serving layout OFF the dispatch hot
+    path; returns ``(staged_tree, stats)`` with the moved bytes/segments
+    telemetry. A training loop that swaps every N steps passes the same
+    caches each time so the transfer plan (and the device path's
+    compiled mover) is built once. ``engine.swap_weights`` runs this
+    same resharding internally when handed an unstaged tree — calling
+    it here first just moves the cost to the trainer's thread."""
+    return reshard_tree(
+        params, dst_shardings,
+        plan_cache=plan_cache, jit_cache=jit_cache, mode=mode,
+    )
+
+
+def write_swap_timeline(path: str | Path, timeline: list[dict]) -> Path:
+    """Persist a swap/rollout timeline (list of JSON-able event dicts —
+    ``FleetRouter.rolling_swap`` returns one; a single-engine driver can
+    assemble its own from the flight recorder) as the case artifact
+    dashboards replay."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(json.dumps(timeline, indent=2, sort_keys=True) + "\n")
+    return p

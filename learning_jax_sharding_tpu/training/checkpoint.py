@@ -147,3 +147,46 @@ class CheckpointManager:
     def __exit__(self, *exc) -> None:
         self.wait()
         self.close()
+
+
+def restore_params_for_serving(
+    manager: CheckpointManager,
+    *,
+    like: Any,
+    dst_shardings: Any,
+    step: int | None = None,
+    strict: bool = False,
+    plan_cache: dict | None = None,
+    jit_cache: dict | None = None,
+) -> tuple[Any, dict] | None:
+    """Restore a checkpointed state's PARAMS straight into the serving
+    layout — the disk half of the weight hot-swap.
+
+    Restores ``step`` (or the newest restorable step, with
+    :meth:`CheckpointManager.restore_latest`'s corruption fallback) into
+    the shardings of ``like``, extracts ``.params`` when the tree is a
+    TrainState, and runs it through the same
+    :func:`~learning_jax_sharding_tpu.parallel.resharding.reshard_tree`
+    path ``engine.swap_weights`` stages with — so the caller hands the
+    engine an already-staged tree and the swap's staging step is a
+    no-op move. Pass the engine's live layout as ``dst_shardings``
+    (``tenancy.serving_shardings(engine_params)``) and keep
+    ``plan_cache``/``jit_cache`` across a training run's repeated
+    deploys so the transfer plan compiles once.
+
+    Returns ``(staged_params, transfer_stats)``, or ``None`` when the
+    directory is empty (callers fall through to their fresh init, same
+    contract as ``restore_latest``).
+    """
+    from learning_jax_sharding_tpu.parallel.resharding import reshard_tree
+
+    if step is not None:
+        restored = manager.restore(step, like=like)
+    else:
+        restored = manager.restore_latest(like=like, strict=strict)
+    if restored is None:
+        return None
+    params = getattr(restored, "params", restored)
+    return reshard_tree(
+        params, dst_shardings, plan_cache=plan_cache, jit_cache=jit_cache,
+    )

@@ -83,6 +83,15 @@ def _is_adapter(node: Any) -> bool:
     return isinstance(node, dict) and set(node) == {"lora_a", "lora_b"}
 
 
+def zero_lora(adapters: Any) -> Any:
+    """A structurally identical adapter tree with A = B = 0: the IDENTITY
+    adapter — ``merge_lora(params, zero_lora(a))`` returns the base
+    weights unchanged (0·(A@B) adds exact zero). This is the base tenant
+    an adapter pool's reserved slot 0 holds, and the reference a
+    mixed-tenant bit-identity oracle compares unadapted rows against."""
+    return jax.tree.map(jnp.zeros_like, adapters)
+
+
 def merge_lora(params: Any, adapters: Any, *, alpha: float = 16.0) -> Any:
     """``W + (alpha/r)·A@B`` at every adapted path; other leaves unchanged.
 

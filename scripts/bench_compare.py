@@ -80,6 +80,17 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     # stable even if the line's phrasing around them changes).
     (re.compile(r"aggregate ([\d,.]+)\s*tok/s"), "aggregate_tok_s", True),
     (re.compile(r"e2e p99 ([\d,.]+)\s*ms"), "e2e_p99_ms", False),
+    # Round-12 tenancy gates: the hot-swap lines track the stall the
+    # zero-downtime machinery exists to bound (stage → commit serve
+    # gap, regresses UPWARD); the multi-LoRA lines track the fused
+    # mixed-batch throughput, the serial solo baseline, and their ratio
+    # — all higher-is-better (the ratio regressing means the per-row
+    # adapter gather got more expensive relative to folded weights).
+    (re.compile(r"swap stall p99 ([\d,.]+)\s*ms"), "swap_stall_p99_ms",
+     False),
+    (re.compile(r"mixed ([\d,.]+)\s*tok/s"), "mixed_tok_s", True),
+    (re.compile(r"solo ([\d,.]+)\s*tok/s"), "solo_tok_s", True),
+    (re.compile(r"([\d.]+)x solo"), "vs_solo_ratio", True),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Tenancy ladder on the emulated 8-device mesh (PERF.md round 12).
+
+Two studies, one per tenancy pillar:
+
+* **Multi-LoRA mixed batches** — A = 1 / 4 / 16 tenants' adapters
+  served in ONE fused ``adapter_mixed_step`` batch (the AdapterPool's
+  per-row gather) vs the solo baseline: each tenant served serially on
+  its ``merge_lora``-folded weights through a plain mixed engine, times
+  summed. The mixed/solo ratio prices what multi-tenancy costs per
+  dispatch (the stacked-slot gather + batch-1 LoRA apply) against what
+  it saves (no per-tenant engine, no weight folding, one executable).
+
+* **Hot-swap stall** — drain-mode ``swap_weights`` rollouts under a
+  saturated queue: per-swap stall (stage → commit serve gap, from the
+  ``engine.swap_commit`` flight-recorder events) p50/p99, plus
+  throughput with the rollout vs undisturbed.
+
+Methodology matches the bench ladders: engines are WARMED on a queue
+prefix first (compiles excluded), then one timed drain. Emulated-CPU
+numbers order configurations and price the host-side machinery; chip
+numbers ride ``bench.py``'s 125M tenancy block (which relays this
+script's lines via ``--bench-lines``, like ``perf_fleet.py``).
+
+Usage:
+    python scripts/perf_tenancy.py [--bench-lines] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+A_LADDER = (1, 4, 16)
+NREQ, NEW, RANK = 16, 16, 4
+
+
+def _build():
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    mesh = build_mesh((2, 4), ("data", "model"))
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(12)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=NREQ)
+    ]
+    return cfg, mesh, params, prompts
+
+
+_ENGINE_KW = dict(
+    batch_size=4, max_new_tokens=NEW, refill_chunk=16,
+    decode_block_steps=8, mixed=True,
+)
+
+
+def _drive(eng, params, reqs):
+    """Admit (prompt, adapter) pairs, step to drain, return generated
+    token count (completed requests only — there are no failures here)."""
+    plen = {}
+    for p, name in reqs:
+        rid = eng.add_request(p, adapter=name)
+        plen[rid] = len(p)
+    while eng.has_work():
+        eng.step(params)
+    outs = eng.pop_finished()
+    return sum(len(v) - plen[rid] for rid, v in outs.items())
+
+
+def run_adapter_ladder():
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.tenancy import AdapterPool
+    from learning_jax_sharding_tpu.training.lora import init_lora, merge_lora
+
+    cfg, mesh, params, prompts = _build()
+    lines, summary = [], []
+    for a in A_LADDER:
+        # B perturbed off zero — a fresh init's B=0 adapter computes the
+        # base function and the comparison would price nothing.
+        adapters = {
+            f"t{i}": jax.tree.map(
+                lambda x, i=i: x + 0.01 * (i + 1),
+                init_lora(jax.random.key(i + 1), params, RANK),
+            )
+            for i in range(a)
+        }
+        pool = AdapterPool(params, slots=a + 1, rank=RANK, mesh=mesh)
+        for name, ad in adapters.items():
+            pool.add(name, ad)
+        eng = ContinuousEngine(
+            cfg, mesh, RULES_DP_TP, adapter_pool=pool, **_ENGINE_KW,
+        )
+        names = list(adapters)
+        reqs = [(prompts[i], names[i % a]) for i in range(NREQ)]
+        _drive(eng, params, reqs[: _ENGINE_KW["batch_size"] + 1])  # warm
+        t0 = time.perf_counter()
+        gen = _drive(eng, params, reqs)
+        dt = time.perf_counter() - t0
+        rate_mixed = gen / dt
+
+        # Solo baseline: ONE plain mixed engine, each tenant's queue
+        # served serially on merge_lora-folded weights (same shapes →
+        # same executable across tenants; only the first serve compiles,
+        # and the warm pass eats that).
+        solo = ContinuousEngine(cfg, mesh, RULES_DP_TP, **_ENGINE_KW)
+        merged = {n: merge_lora(params, ad) for n, ad in adapters.items()}
+        solo.serve(
+            merged[names[0]],
+            [p for p, _ in reqs[: _ENGINE_KW["batch_size"] + 1]],
+        )
+        t0 = time.perf_counter()
+        gen_solo = 0
+        for name in names:
+            ps = [p for p, n in reqs if n == name]
+            outs = solo.serve(merged[name], ps)
+            gen_solo += sum(len(o) - len(p) for o, p in zip(outs, ps))
+        dt_solo = time.perf_counter() - t0
+        rate_solo = gen_solo / dt_solo
+        ratio = rate_mixed / rate_solo
+        lines.append(
+            f"[bench] tenancy multi-LoRA A={a} (one fused batch, 8-dev "
+            f"emulated): mixed {rate_mixed:,.0f} tok/s, "
+            f"solo {rate_solo:,.0f} tok/s, {ratio:.2f}x solo "
+            f"({NREQ} requests, rank {RANK})"
+        )
+        summary.append(dict(
+            adapters=a, mixed_tok_s=rate_mixed, solo_tok_s=rate_solo,
+            ratio=ratio,
+        ))
+    return lines, summary
+
+
+def run_swap_study(swaps: int = 5):
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+    cfg, mesh, params, prompts = _build()
+    new_params = jax.jit(
+        lambda t: jax.tree.map(lambda x: x * (1.0 + 1e-3), t)
+    )(params)
+    eng = ContinuousEngine(cfg, mesh, RULES_DP_TP, **_ENGINE_KW)
+    # Warm through the SAME manual add+step drive both timed passes use
+    # (serve() is a different loop shape, and the first manual drive
+    # still compiles the cache-creating first_refill).
+    _drive(eng, params, [(p, None) for p in prompts[:5]])
+    t0 = time.perf_counter()
+    gen0 = _drive(eng, params, [(p, None) for p in prompts])
+    dt0 = time.perf_counter() - t0
+
+    # Warm the swap path too: the first stage compiles the reshard/cast
+    # program, and the first POST-COMMIT dispatch recompiles the mixed
+    # step against the staged tree's layout (born-init and staged
+    # layouts differ) — both one-time costs that must not land inside
+    # the timed rollout, so commit one swap and serve a short queue
+    # through the swapped-in weights before timing.
+    eng.swap_weights(new_params, version=1)
+    while eng.has_work():
+        eng.step(params)
+    _drive(eng, params, [(p, None) for p in prompts[:5]])
+    eng.recorder.clear()
+
+    # The rollout: saturate the queue, then stage a drain-mode swap
+    # every few steps — each commit's serve gap lands in the
+    # engine.swap_commit events as stall_s.
+    plen = {}
+    for p in prompts:
+        plen[eng.add_request(p)] = len(p)
+    version, steps = 0, 0
+    t0 = time.perf_counter()
+    while eng.has_work():
+        if version < swaps + 1 and steps % 4 == 3 and not eng.swap_pending:
+            version = max(2, version + 1)   # 1 was the warm swap
+            eng.swap_weights(
+                new_params if version % 2 else params, version=version,
+            )
+        eng.step(params)
+        steps += 1
+    dt = time.perf_counter() - t0
+    gen = sum(
+        len(v) - plen[rid] for rid, v in eng.pop_finished().items()
+        if not hasattr(v, "status")
+    )
+    stalls = np.asarray([
+        e["stall_s"] for e in eng.recorder.events("engine.swap_commit")
+    ])
+    line = (
+        f"[bench] tenancy hot-swap (drain, 8-dev emulated): "
+        f"swap stall p50 {np.percentile(stalls, 50) * 1e3:,.0f} ms, "
+        f"swap stall p99 {np.percentile(stalls, 99) * 1e3:,.0f} ms "
+        f"({len(stalls)} swaps, {gen / dt:,.0f} tok/s during rollout vs "
+        f"{gen0 / dt0:,.0f} tok/s undisturbed)"
+    )
+    return [line], dict(
+        swaps=int(len(stalls)),
+        stall_p50_s=float(np.percentile(stalls, 50)),
+        stall_p99_s=float(np.percentile(stalls, 99)),
+        tok_s_rollout=gen / dt, tok_s_undisturbed=gen0 / dt0,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench-lines", action="store_true",
+                    help="print only the [bench] lines (for bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    adapter_lines, adapter_summary = run_adapter_ladder()
+    swap_lines, swap_summary = run_swap_study()
+    if args.json:
+        print(json.dumps(
+            {"adapters": adapter_summary, "swap": swap_summary}, indent=2,
+        ))
+    else:
+        for ln in adapter_lines + swap_lines:
+            print(ln)
+    if not args.bench_lines and not args.json:
+        print("perf_tenancy: done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -136,6 +136,59 @@ class TestExtraction:
         assert by[f"{k4}:e2e_p99_ms"]["regressed"]
         assert not by[f"{k2}:aggregate_tok_s"]["regressed"]
 
+    def test_tenancy_swap_gates_direction_aware(self):
+        """The round-12 hot-swap gate: the stall p99 (the serve gap the
+        drain-mode commit costs) regresses UP; rollout throughput (the
+        line's first tok/s) regresses DOWN."""
+        line = (
+            "[bench] 125M hot-swap under load: swap stall p50 12 ms, "
+            "swap stall p99 45 ms (5 swaps, 2,900 tok/s during rollout "
+            "vs 3,100 tok/s undisturbed)"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        name = "125M_hot-swap_under_load"
+        assert m[f"{name}:swap_stall_p99_ms"] == (45.0, False)
+        assert m[f"{name}:tok_s"] == (2900.0, True)
+        worse = _doc([
+            line.replace("swap stall p99 45 ms", "swap stall p99 450 ms")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{name}:swap_stall_p99_ms"]["regressed"]
+        assert not by[f"{name}:tok_s"]["regressed"]
+
+    def test_tenancy_adapter_gates_direction_aware(self):
+        """The round-12 multi-LoRA gates, per adapter-count line: mixed
+        tok/s, solo tok/s, and the mixed/solo ratio all regress DOWN —
+        the ratio falling means the per-row adapter gather got more
+        expensive relative to merge_lora-folded weights."""
+        lines = [
+            "[bench] tenancy multi-LoRA A=4 (one fused batch, 8-dev "
+            "emulated): mixed 230 tok/s, solo 6,900 tok/s, 0.03x solo "
+            "(16 requests, rank 4)",
+            "[bench] tenancy multi-LoRA A=16 (one fused batch, 8-dev "
+            "emulated): mixed 220 tok/s, solo 1,900 tok/s, 0.12x solo "
+            "(16 requests, rank 4)",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        a4 = "tenancy_multi-LoRA_A=4_(one_fused_batch,_8-dev_emulated)"
+        a16 = "tenancy_multi-LoRA_A=16_(one_fused_batch,_8-dev_emulated)"
+        assert m[f"{a4}:mixed_tok_s"] == (230.0, True)
+        assert m[f"{a4}:solo_tok_s"] == (6900.0, True)
+        assert m[f"{a4}:vs_solo_ratio"] == (0.03, True)
+        assert m[f"{a16}:vs_solo_ratio"] == (0.12, True)
+        worse = _doc([
+            lines[0].replace("mixed 230 tok/s", "mixed 110 tok/s")
+            .replace("0.03x solo", "0.01x solo"),
+            lines[1],
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{a4}:mixed_tok_s"]["regressed"]
+        assert by[f"{a4}:vs_solo_ratio"]["regressed"]
+        assert not by[f"{a16}:mixed_tok_s"]["regressed"]
+        assert not by[f"{a4}:solo_tok_s"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

@@ -112,6 +112,48 @@ class TestCheckpoint:
         with CheckpointManager(tmp_path / "empty") as ckpt:
             assert ckpt.restore_latest(like=as_abstract(state)) is None
 
+    def test_restore_params_for_serving_lands_in_dst_layout(
+        self, mesh22, tmp_path
+    ):
+        """The deploy half of the hot-swap: a trained checkpoint's
+        params restore + reshard into the requested serving layout in
+        one motion — values bit-identical, every leaf under its
+        destination sharding, empty-directory contract preserved."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from learning_jax_sharding_tpu.training.checkpoint import (
+            restore_params_for_serving,
+        )
+
+        batch, state, step = _setup(mesh22)
+        state, _ = step(state, batch)
+        dst = jax.tree.map(
+            lambda x: NamedSharding(mesh22, P()), state.params
+        )
+        with CheckpointManager(tmp_path / "ckpt") as ckpt:
+            ckpt.save(1, state)
+            ckpt.wait()
+            _, fresh, _ = _setup(mesh22)
+            out = restore_params_for_serving(
+                ckpt, like=fresh, dst_shardings=dst
+            )
+            assert out is not None
+            staged, stats = out
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            state.params, staged,
+        )
+        for leaf, d in zip(jax.tree.leaves(staged), jax.tree.leaves(dst)):
+            assert leaf.sharding == d
+        assert stats["mode"] in ("device", "host") and stats["bytes"] > 0
+        _, fresh, _ = _setup(mesh22)
+        with CheckpointManager(tmp_path / "empty") as ckpt:
+            assert restore_params_for_serving(
+                ckpt, like=as_abstract(fresh), dst_shardings=dst
+            ) is None
+
     def test_corrupted_latest_falls_back_to_previous(self, mesh22, tmp_path):
         """A truncated newest checkpoint (a preemption mid-write, bit
         rot) must not kill the resume: restore_latest FALLS BACK to the

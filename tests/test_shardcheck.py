@@ -564,7 +564,9 @@ class TestCheckedInGoldens:
         "zero1_update", "zero1_update_q8", "prefill",
         "decode_step", "mixed_step",
         "spec_prefill", "spec_decode_step", "spec_mixed_step",
+        "adapter_mixed_step", "spec_adapter_mixed_step",
         "kv_export", "kv_ingest",
+        "swap_reshard", "swap_reshard_quant",
         "moe_dispatch", "ring_attention", "ulysses_attention",
     )
 
@@ -584,7 +586,8 @@ class TestCheckedInGoldens:
         # provably communicates on its mesh.
         for name in ("train_step", "zero1_update", "zero1_update_q8",
                      "prefill", "decode_step", "mixed_step",
-                     "spec_mixed_step", "moe_dispatch"):
+                     "spec_mixed_step", "adapter_mixed_step",
+                     "spec_adapter_mixed_step", "moe_dispatch"):
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.collectives, f"{name} golden records no collectives"
 
@@ -599,6 +602,24 @@ class TestCheckedInGoldens:
         for name in ("kv_export", "kv_ingest"):
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.collectives == {}, (name, c.collectives)
+            assert c.while_collectives == 0
+
+    def test_swap_reshard_goldens_pin_pure_data_movement(self):
+        """The round-12 hot-swap staging claim, as checked-in contract:
+        resharding an FSDP-layout checkpoint into the serving layout
+        MOVES weights (the goldens record real collectives — a vacuous
+        no-comms contract would mean the source layout silently matched
+        serving and the program pins nothing), but never COMBINES them —
+        an all-reduce appearing here would mean XLA is summing shards,
+        arithmetic that could perturb the swapped weights."""
+        from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
+
+        for name in ("swap_reshard", "swap_reshard_quant"):
+            c = Contract.load(GOLDEN_DIR / f"{name}.json")
+            assert c.collectives, f"{name} golden records no collectives"
+            assert not any(
+                k.startswith("all-reduce") for k in c.collectives
+            ), (name, c.collectives)
             assert c.while_collectives == 0
 
     def test_q8_golden_records_the_ring(self):

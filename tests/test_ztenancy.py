@@ -1,0 +1,425 @@
+"""Tenancy (round 12): zero-downtime weight hot-swap + multi-LoRA.
+
+THE two acceptance oracles of the tenancy subsystem:
+
+* **Mixed-tenant bit-identity** — one fused ``adapter_mixed_step``
+  batch serving different tenants' adapters (and base rows) produces,
+  for every request, EXACTLY the tokens a solo engine produces against
+  that tenant's ``merge_lora``-folded weights — greedy and sampled.
+* **Zero-downtime swap** — ``swap_weights`` under a saturated queue
+  drops and fails NOTHING, every response is attributable to exactly
+  one weight version, and each response is bit-identical to a pure run
+  under its attributed version's weights.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.models.serving import (
+    ContinuousEngine,
+    RequestFailure,
+)
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.tenancy import AdapterPool
+from learning_jax_sharding_tpu.training.lora import (
+    init_lora,
+    merge_lora,
+    zero_lora,
+)
+
+NEW = 5
+RANK = 4
+
+
+@pytest.fixture(scope="module")
+def setup(mesh22):
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    model = Transformer(cfg)
+    probe = np.zeros((2, 8), np.int32)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(3), probe
+        )["params"]
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in (3, 9, 5, 7, 4, 6)
+    ]
+    # Two tenants with deliberately NONZERO B (init_lora's B=0 would
+    # make every tenant the base model and the oracle vacuous).
+    ad1 = jax.tree.map(
+        lambda x: x + 0.02, init_lora(jax.random.key(1), params, RANK)
+    )
+    ad2 = jax.tree.map(
+        lambda x: x - 0.03, init_lora(jax.random.key(2), params, RANK)
+    )
+    return cfg, params, prompts, ad1, ad2
+
+
+def _drive(eng, params, reqs, *, adapters=None, max_steps=400):
+    for rid, p in reqs.items():
+        eng.add_request(
+            p, rid=rid,
+            adapter=(adapters or {}).get(rid),
+        )
+    out, steps = {}, 0
+    while eng.has_work():
+        eng.step(params)
+        out.update(eng.pop_finished())
+        steps += 1
+        assert steps <= max_steps, "engine wedged"
+    out.update(eng.pop_finished())
+    return out
+
+
+def _solo(cfg, mesh, merged, prompts_by_rid, **kw):
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=4, mixed=True, **kw,
+    )
+    out = _drive(eng, merged, prompts_by_rid)
+    eng.close()
+    return out
+
+
+class TestMultiLora:
+    @pytest.mark.parametrize(
+        "sample_kw",
+        [{}, {"temperature": 0.7, "top_k": 8}],
+        ids=["greedy", "sampled"],
+    )
+    def test_mixed_tenants_bit_identical_to_solo(
+        self, setup, mesh22, sample_kw
+    ):
+        """6 requests across base + two tenants through 2 slots in ONE
+        fused multi-LoRA engine: every stream equals the stream a solo
+        engine produces against that tenant's merge_lora-folded weights,
+        bit for bit — greedy AND sampled (draws are keyed by (rid,
+        position), so multi-tenant batching cannot change a token)."""
+        cfg, params, prompts, ad1, ad2 = setup
+        pool = AdapterPool(params, slots=4, rank=RANK)
+        pool.add("t1", ad1, alpha=16.0)
+        pool.add("t2", ad2, alpha=8.0)
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, adapter_pool=pool, **sample_kw,
+        )
+        names = {0: None, 1: "t1", 2: "t2", 3: "t1", 4: None, 5: "t2"}
+        out = _drive(
+            eng, params, dict(enumerate(prompts)), adapters=names
+        )
+        assert eng.compile_counts().get("adapter_mixed_step", 0) >= 1
+
+        ref_base = _solo(
+            cfg, mesh22, params,
+            {r: prompts[r] for r, n in names.items() if n is None},
+            **sample_kw,
+        )
+        ref_t1 = _solo(
+            cfg, mesh22, merge_lora(params, ad1, alpha=16.0),
+            {r: prompts[r] for r, n in names.items() if n == "t1"},
+            **sample_kw,
+        )
+        ref_t2 = _solo(
+            cfg, mesh22, merge_lora(params, ad2, alpha=8.0),
+            {r: prompts[r] for r, n in names.items() if n == "t2"},
+            **sample_kw,
+        )
+        ref = {**ref_base, **ref_t1, **ref_t2}
+        assert sorted(out) == sorted(ref)
+        for rid in out:
+            np.testing.assert_array_equal(out[rid], ref[rid])
+        eng.close()
+
+    def test_zero_adapter_is_identity(self, setup, mesh22):
+        """merge_lora with zero_lora returns the base tree unchanged —
+        the slot-0 semantics the base rows of the fused batch rely on."""
+        cfg, params, _, ad1, _ = setup
+        merged = merge_lora(params, zero_lora(ad1), alpha=16.0)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params, merged,
+        )
+
+    def test_speculative_adapter_engine_lossless(self, setup, mesh22):
+        """Speculative decoding composes with the adapter pool: the
+        draft proposes on BASE weights, the verifier applies each row's
+        merged weights — outputs identical to the plain adapter engine
+        (the speculative-is-lossless invariant, now per tenant)."""
+        cfg, params, prompts, ad1, ad2 = setup
+        d_cfg = dataclasses.replace(
+            cfg, num_layers=1, hidden=64, dtype=jnp.float32
+        )
+        d_model = Transformer(d_cfg)
+        d_params = nn.meta.unbox(
+            d_model.init(
+                {"params": jax.random.key(7)}, np.zeros((2, 8), np.int32)
+            )["params"]
+        )
+        names = {0: None, 1: "t1", 2: "t2", 3: "t1"}
+        reqs = {r: prompts[r] for r in names}
+
+        def build(**kw):
+            pool = AdapterPool(params, slots=4, rank=RANK)
+            pool.add("t1", ad1, alpha=16.0)
+            pool.add("t2", ad2, alpha=8.0)
+            return ContinuousEngine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, refill_chunk=4, mixed=True,
+                adapter_pool=pool, **kw,
+            )
+
+        plain = build()
+        ref = _drive(plain, params, dict(reqs), adapters=names)
+        plain.close()
+        spec = build(draft_config=d_cfg, num_draft=2)
+        eng_out = {}
+        for rid, p in reqs.items():
+            spec.add_request(p, rid=rid, adapter=names[rid])
+        steps = 0
+        while spec.has_work():
+            spec.step(params, d_params)
+            eng_out.update(spec.pop_finished())
+            steps += 1
+            assert steps <= 400
+        eng_out.update(spec.pop_finished())
+        assert (
+            spec.compile_counts().get("adapter_mixed_step", 0) >= 1
+        )
+        for rid in ref:
+            np.testing.assert_array_equal(eng_out[rid], ref[rid])
+        spec.close()
+
+    def test_pool_lifecycle(self, setup, mesh22):
+        """Residency mechanics: unknown tenants are rejected at
+        admission (nothing enqueued), LRU eviction only takes
+        refcount-0 tenants, hot-update keeps the slot, and a full pool
+        of live tenants refuses instead of evicting."""
+        cfg, params, prompts, ad1, ad2 = setup
+        pool = AdapterPool(params, slots=3, rank=RANK)  # 2 named slots
+        s1 = pool.add("t1", ad1)
+        assert pool.add("t1", ad2) == s1          # hot-update, same slot
+        pool.add("t2", ad2)
+        pool.acquire("t1")
+        pool.add("t3", ad1)                        # evicts LRU refcount-0: t2
+        assert pool.names() == ["t1", "t3"]
+        pool.acquire("t3")
+        with pytest.raises(RuntimeError):
+            pool.add("t4", ad2)                    # everyone live: refuse
+        pool.release("t3")
+        pool.add("t4", ad2)                        # t3 now evictable
+        assert pool.names() == ["t1", "t4"]
+        assert pool.stats()["pages_in_use"] == 2 * pool.pages_per_slot
+
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, adapter_pool=pool,
+        )
+        with pytest.raises(KeyError):
+            eng.add_request(prompts[0], adapter="nope")
+        assert not eng.has_work()
+        # Engine config guards: the pool requires the fused path and a
+        # contiguous cache, and refuses per-request adapters without a
+        # pool.
+        with pytest.raises(ValueError):
+            ContinuousEngine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, adapter_pool=pool,
+            )
+        plain = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        with pytest.raises(ValueError):
+            plain.add_request(prompts[0], adapter="t1")
+        eng.close()
+        plain.close()
+
+
+class TestHotSwap:
+    def test_saturated_swap_zero_drops_exact_versions(
+        self, setup, mesh22
+    ):
+        """THE swap acceptance oracle: a drain-mode swap under a
+        SATURATED queue (6 requests, 2 slots, staged mid-stream) drops
+        and fails nothing; every response carries exactly one version in
+        ``finished_versions``; in-flight requests finish on the OLD
+        version and post-commit admissions on the NEW one; and each
+        response is bit-identical to a pure run under its attributed
+        version's weights."""
+        cfg, params, prompts, _, _ = setup
+        new_params = jax.tree.map(lambda x: x * 1.01, params)
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        reqs = dict(enumerate(prompts))
+        for rid, p in reqs.items():
+            eng.add_request(p, rid=rid)
+        eng.step(params)                      # slots full, queue deep
+        occupied = [r for r in eng._req if r >= 0]
+        assert len(occupied) == 2 and eng.queue_depth() == 4
+        assert eng.swap_weights(new_params, version=3)
+        assert eng.weights_version == 0       # occupied → still draining
+        out, steps = {}, 0
+        while eng.has_work():
+            eng.step(params)                  # stale caller params:
+            out.update(eng.pop_finished())    # installed tree overrides
+            steps += 1
+            assert steps <= 400
+        out.update(eng.pop_finished())
+        assert sorted(out) == sorted(reqs), "zero drops"
+        assert not any(isinstance(v, RequestFailure) for v in out.values())
+        versions = {rid: eng.finished_versions[rid] for rid in reqs}
+        assert set(versions.values()) == {0, 3}
+        # The two requests in flight at staging time finished old; the
+        # queue behind them (admission paused while draining) new.
+        assert all(versions[r] == 0 for r in occupied)
+        assert all(
+            versions[r] == 3 for r in reqs if r not in occupied
+        )
+        assert eng.weights_version == 3
+
+        ref_old = _solo(
+            cfg, mesh22, params,
+            {r: reqs[r] for r, v in versions.items() if v == 0},
+        )
+        ref_new = _solo(
+            cfg, mesh22, new_params,
+            {r: reqs[r] for r, v in versions.items() if v == 3},
+        )
+        for rid, v in {**ref_old, **ref_new}.items():
+            np.testing.assert_array_equal(out[rid], v)
+
+        snap = eng.registry.snapshot()
+        assert snap["engine_swap_commits_total"] == 1
+        assert snap["engine_swap_staged_total"] == 1
+        eng.close()
+
+    def test_preempt_swap_recomputes_on_new_version(self, setup, mesh22):
+        """Preempt mode: in-flight requests are requeued and RECOMPUTE
+        under the new version — every response attributed to (and
+        bit-identical under) the new weights, none dropped."""
+        cfg, params, prompts, _, _ = setup
+        new_params = jax.tree.map(lambda x: x * 0.99, params)
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        reqs = {r: prompts[r] for r in range(4)}
+        for rid, p in reqs.items():
+            eng.add_request(p, rid=rid)
+        eng.step(params)
+        assert eng.swap_weights(new_params, version=9, mode="preempt")
+        assert eng.weights_version == 9       # immediate commit
+        out, steps = {}, 0
+        while eng.has_work():
+            eng.step()                        # installed weights only
+            out.update(eng.pop_finished())
+            steps += 1
+            assert steps <= 400
+        out.update(eng.pop_finished())
+        assert sorted(out) == sorted(reqs)
+        assert {eng.finished_versions[r] for r in reqs} == {9}
+        ref = _solo(cfg, mesh22, new_params, dict(reqs))
+        for rid in reqs:
+            np.testing.assert_array_equal(out[rid], ref[rid])
+        eng.close()
+
+    def test_double_stage_refused_and_stats(self, setup, mesh22):
+        """One staged swap at a time; stall telemetry lands in the
+        histogram; step() without params before any swap raises."""
+        cfg, params, prompts, _, _ = setup
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        with pytest.raises(TypeError):
+            eng.step()
+        eng.add_request(prompts[0], rid=0)
+        eng.step(params)
+        assert eng.swap_weights(params, version=1)
+        with pytest.raises(RuntimeError):
+            eng.swap_weights(params, version=2)
+        while eng.has_work():
+            eng.step(params)
+        assert eng.weights_version == 1
+        h = eng.registry.get("engine_swap_stall_seconds")
+        assert h is not None and h.count == 1
+        eng.close()
+
+
+class TestRollingSwap:
+    def test_fleet_rolls_with_zero_drops(self, setup, mesh22):
+        """rolling_swap walks a 2-replica unified fleet one replica at a
+        time under load: nothing drops or fails, both replicas commit,
+        every response is attributable to exactly one version, and at no
+        point is the whole fleet out of placement (the replica under
+        swap is excluded while the other serves)."""
+        from learning_jax_sharding_tpu.fleet import (
+            FleetRouter,
+            make_replicas,
+        )
+
+        cfg, params, prompts, _, _ = setup
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=NEW, refill_chunk=4,
+        )
+        router = FleetRouter(reps)
+        for rid, p in enumerate(prompts):
+            router.add_request(p, rid=rid)
+        router.step()
+        new_params = jax.tree.map(lambda x: x * 1.02, params)
+        timeline = router.rolling_swap(new_params, version=5)
+        assert [t["committed"] for t in timeline] == [True, True]
+        assert all(r.engine.weights_version == 5 for r in reps)
+        assert all(r.params is new_params for r in reps)
+        assert router._swapping == set()
+        out = router.drain(max_steps=400)
+        out_all = {**out}
+        assert sorted(out_all) == list(range(len(prompts)))
+        assert not any(
+            isinstance(v, RequestFailure) for v in out_all.values()
+        )
+        versions = {}
+        for rep in reps:
+            versions.update(rep.engine.finished_versions)
+        assert set(versions) >= set(range(len(prompts)))
+        assert all(v in (0, 5) for v in versions.values())
+        assert (
+            int(router.registry.counter("fleet_swaps_total").value) == 2
+        )
+        # Each response is bit-identical to a pure run under its
+        # attributed version (single-device replica sub-meshes run the
+        # same programs as a solo (1,1) engine).
+        from learning_jax_sharding_tpu.parallel import build_mesh
+
+        m11 = build_mesh(
+            (1, 1), ("data", "model"), devices=jax.devices()[:1]
+        )
+        old_rids = [r for r in out_all if versions[r] == 0]
+        new_rids = [r for r in out_all if versions[r] == 5]
+        ref = {}
+        if old_rids:
+            ref.update(_solo(
+                cfg, m11, params, {r: prompts[r] for r in old_rids},
+            ))
+        if new_rids:
+            ref.update(_solo(
+                cfg, m11, new_params, {r: prompts[r] for r in new_rids},
+            ))
+        for rid in out_all:
+            np.testing.assert_array_equal(out_all[rid], ref[rid])
