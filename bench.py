@@ -388,6 +388,120 @@ def bench_reference_configs():
     )
 
 
+def bench_shardflow():
+    """Analyzer self-check (round 13): price the tracked program shapes
+    with ``analysis.shardflow`` + ``analysis.costmodel`` BEFORE running
+    them, then measure the same jitted programs and report the model
+    error — the number ``scripts/bench_compare.py`` gates direction-aware
+    (``predicted_vs_measured_pct``; a growing error means the propagation
+    rules or the platform profile drifted from the real machine).
+
+    On the TPU host the lines price the 125M tracked shapes; on the
+    emulated-CPU host a scaled-down same-architecture configuration keeps
+    the measured side inside the tier-1 window (PERF.md round 13 records
+    the error for both). One-chip degenerate mesh, like every other
+    tracked line: the roofline terms (compute/HBM) carry the prediction;
+    the multi-chip collective term is reconciled against goldens by
+    ``scripts/shardcheck.py`` on the emulated mesh instead, where
+    emulated "wire time" would be fiction.
+    """
+    import dataclasses
+
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.shardflow import trace_shardflow
+    from learning_jax_sharding_tpu.models.generate import make_generate_fn
+    from learning_jax_sharding_tpu.models.transformer import next_token_loss
+    from learning_jax_sharding_tpu.parallel.logical import activate
+    from learning_jax_sharding_tpu.utils.bench import time_fn
+
+    import flax.linen as nn
+
+    profile = costmodel.current_profile()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = CONFIG_125M
+        b, s = 8, 1024
+        db, dprompt, dnew = 8, 128, 128
+    else:
+        cfg = dataclasses.replace(
+            CONFIG_125M, vocab_size=8192, num_layers=2, features=256,
+            num_heads=4, head_dim=64, hidden=1024, max_seq_len=512,
+        )
+        b, s = 4, 256
+        db, dprompt, dnew = 4, 64, 32
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    block: dict = {"profile": profile.to_dict()}
+
+    def line(label, rep, measured_s, unit_scale, unit):
+        cost = costmodel.price(rep, profile)
+        cmp = costmodel.compare(cost.predicted_s, measured_s)
+        _log(
+            f"[bench] shardflow {label}: predicted "
+            f"{cost.predicted_s * unit_scale:.2f} vs measured "
+            f"{measured_s * unit_scale:.2f} {unit} "
+            f"({cost.bound}-bound), model err {cmp['err_pct']:.1f}%"
+        )
+        return {**cmp, "bound": cost.bound, "flops": cost.flops,
+                "hbm_bytes": cost.hbm_bytes}
+
+    # Train step: same builders as the tracked 125M line (single-call
+    # timing here — the prediction is also single-step).
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh), "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), optax.adamw(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+    )
+    with activate(mesh, RULES_DP_TP):
+        rep = trace_shardflow("bench_train_step", step.jitted, state, batch,
+                              mesh=mesh)
+    measured = time_fn(step, state, batch, min_time=1.0, repeats=2)
+    block["train_step"] = line(
+        f"train step (b={b}, s={s})", rep, measured, 1e3, "ms/step"
+    )
+
+    # Decode: whole greedy generation in one jitted program — the token
+    # loop is a scan, so the analyzer's trip multiplier prices the
+    # weight re-streaming that makes decode bandwidth-bound.
+    model = Transformer(cfg)
+    prompt = put(
+        np.random.default_rng(1).integers(
+            0, cfg.vocab_size, size=(db, dprompt)
+        ).astype(np.int32),
+        mesh_sharding(mesh, "data", None),
+    )
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), prompt
+        )["params"]
+    )
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )
+    gen = make_generate_fn(
+        cfg, mesh, RULES_DP_TP, max_new_tokens=dnew,
+        inference_dtype=jnp.bfloat16,
+    )
+    with activate(mesh, RULES_DP_TP):
+        rep = trace_shardflow("bench_decode", gen, params, prompt,
+                              jax.random.key(1), mesh=mesh)
+    measured = time_fn(gen, params, prompt, jax.random.key(1),
+                       min_time=1.0, repeats=2)
+    block["decode"] = line(
+        f"decode (b={db}, prompt {dprompt}, +{dnew} new)",
+        rep, measured, 1e3 / dnew, "ms/token-step",
+    )
+    return block
+
+
 def bench_moe_125m():
     """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
     capacity routing, fp32 router — models/moe.py), same harness as the
@@ -962,6 +1076,11 @@ def main():
         bench_reference_configs()
     except Exception as e:
         _log(f"[bench] reference-config bench skipped: {type(e).__name__}: {e}")
+    try:
+        shardflow_block = bench_shardflow()
+    except Exception as e:
+        _log(f"[bench] shardflow bench skipped: {type(e).__name__}: {e}")
+        shardflow_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1001,6 +1120,10 @@ def main():
         # Round-7 diagnosis: predicted-vs-actual memory + per-axis
         # collective bytes (telemetry.devview).
         "diagnosis": diagnosis,
+        # Round-13 analyzer self-check: the cost model's predicted step
+        # time vs the measured one for the tracked shapes
+        # (analysis.shardflow + costmodel; gated by bench_compare).
+        "shardflow": shardflow_block,
     }), flush=True)
 
 

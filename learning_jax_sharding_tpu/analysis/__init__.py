@@ -11,8 +11,15 @@ Three levels, one finding type, one CLI (``scripts/shardcheck.py``):
    requested-but-dropped or eligible-but-never-requested, cross-checked
    against ``utils.memory.memory_plan``.
 3. **AST source lint** (:mod:`.source_lint`) — jit-in-loop, non-hashable
-   static args, closure-captured device arrays, raw unsynced clocks;
-   pre-existing findings ride ``analysis/baseline.json``.
+   static args, closure-captured device arrays, raw unsynced clocks,
+   host syncs inside engine hot loops; pre-existing findings ride
+   ``analysis/baseline.json``.
+4. **shardflow** (:mod:`.shardflow` + :mod:`.costmodel`) — the
+   pre-compile layer: a GSPMD propagation simulator over the jaxpr
+   predicts the collective multiset with per-source-line attribution
+   and a roofline-priced step time, reconciled against the SAME golden
+   contracts level 1 checks (an actual collective no predicted event
+   explains is a gated ``unexplained-collective`` finding).
 
 Static verdicts land in the PR-2 flight recorder / registry
 (:func:`~.findings.report_findings`), so a post-mortem bundle shows what
@@ -124,6 +131,64 @@ def run_jaxpr_pass(
     return findings
 
 
+def run_shardflow_pass(
+    golden_dir: str | pathlib.Path = GOLDEN_DIR,
+    *,
+    names: list[str] | None = None,
+    programs: list | None = None,
+    explain: bool = False,
+    profile=None,
+) -> tuple[list[Finding], list[dict]]:
+    """The pre-compile pass: simulate GSPMD propagation over every entry
+    point's jaxpr (:mod:`.shardflow`), reconcile the predicted collective
+    multiset against the checked-in golden contract, and price the
+    prediction (:mod:`.costmodel`). Returns ``(findings, reports)``:
+    findings are the gated ``unexplained-collective`` diffs (a compiled
+    collective no predicted event explains — the simulator's rules
+    drifted from the real partitioner, or new communication appeared
+    that static analysis cannot attribute); reports are per-entry-point
+    dicts with the reconciliation, the priced roofline, the top cost
+    lines, and (``explain=True``) the rendered per-source-line
+    attribution text. Entry points without a golden are skipped — the
+    contract pass owns the no-golden finding."""
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_entry_programs,
+    )
+    from learning_jax_sharding_tpu.analysis.shardflow import (
+        reconcile,
+        reconcile_findings,
+        render_explanation,
+    )
+
+    golden_dir = pathlib.Path(golden_dir)
+    if profile is None:
+        profile = costmodel.current_profile()
+    findings: list[Finding] = []
+    reports: list[dict] = []
+    for prog in (programs if programs is not None
+                 else build_entry_programs(names)):
+        if prog.shardflow is None:
+            continue
+        path = golden_dir / f"{prog.name}.json"
+        if not path.exists():
+            continue
+        rep = prog.shardflow()
+        result = reconcile(rep, Contract.load(path))
+        findings.extend(reconcile_findings(result))
+        cost = costmodel.price(rep, profile)
+        entry = {
+            "name": prog.name,
+            "reconcile": result,
+            "cost": cost.to_dict(),
+            "top_events": costmodel.rank_events(rep, profile),
+        }
+        if explain:
+            entry["explanation"] = render_explanation(rep)
+        reports.append(entry)
+    return findings, reports
+
+
 def run_ast_pass(
     root: str | pathlib.Path,
     *,
@@ -158,4 +223,5 @@ __all__ = [
     "run_ast_pass",
     "run_contract_pass",
     "run_jaxpr_pass",
+    "run_shardflow_pass",
 ]

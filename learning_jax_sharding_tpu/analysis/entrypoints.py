@@ -33,7 +33,13 @@ class EntryProgram:
     (``analysis.donation.donation_report``-shaped dict); ``jaxpr``
     optionally lints the program's trace
     (``analysis.jaxpr_lint.lint_jaxpr`` findings, where-prefixed with
-    the entry-point name so per-program budgets can key on it).
+    the entry-point name so per-program budgets can key on it);
+    ``shardflow`` runs the pre-compile GSPMD propagation simulator over
+    the same program (``analysis.shardflow.trace_shardflow`` — trace
+    only, no compile) and returns its
+    :class:`~learning_jax_sharding_tpu.analysis.shardflow.
+    ShardflowReport`, which the ``--explain`` pass reconciles against
+    this entry point's golden contract.
     """
 
     name: str
@@ -41,6 +47,7 @@ class EntryProgram:
     hlo: Callable[[], str]
     donation: Callable[[], dict] | None = None
     jaxpr: Callable[[], list] | None = None
+    shardflow: Callable[[], Any] | None = None
 
 
 def _mesh24():
@@ -154,12 +161,21 @@ def _train_like(
             dc.replace(f, where=f"{name}:{f.where}") for f in findings
         ]
 
+    def shardflow():
+        from learning_jax_sharding_tpu.analysis.shardflow import (
+            trace_shardflow,
+        )
+
+        cfg, state, batch, step, rules = ensure()
+        with activate(mesh, rules):
+            return trace_shardflow(name, step.jitted, state, batch, mesh=mesh)
+
     if not audit:
         # Contract-golden-only variants (e.g. train_step_gn): skip the
         # donation/jaxpr hooks so the jaxpr pass doesn't pay a duplicate
         # compile for a program that differs only in its epilogue.
-        return EntryProgram(name, mesh, hlo)
-    return EntryProgram(name, mesh, hlo, donation, jaxpr)
+        return EntryProgram(name, mesh, hlo, shardflow=shardflow)
+    return EntryProgram(name, mesh, hlo, donation, jaxpr, shardflow)
 
 
 def _sharded_serving_params(model, mesh, rules):
@@ -265,10 +281,17 @@ def _engine_programs(
                 eng.step(params, d_params)
         else:
             eng.serve(params, prompts, draft_params=d_params)
+        built["eng"] = eng
         built["hlo"] = {
             eng.contract_name(k): v for k, v in eng.program_hlo().items()
         }
         return built["hlo"]
+
+    def explain():
+        if "sf" not in built:
+            ensure()
+            built["sf"] = built["eng"].explain_collectives()
+        return built["sf"]
 
     if adapters:
         names = (
@@ -283,7 +306,10 @@ def _engine_programs(
             if speculative else ("first_prefill", "prefill", "decode_step")
         )
     return [
-        EntryProgram(name, mesh, lambda name=name: ensure()[name])
+        EntryProgram(
+            name, mesh, lambda name=name: ensure()[name],
+            shardflow=lambda name=name: explain()[name],
+        )
         for name in names
     ]
 
@@ -338,13 +364,23 @@ def _kv_transfer_programs() -> list[EntryProgram]:
         eng.ingest_kv(
             params, prompt, int(out[len(prompt)]), rows, rid=1,
         )
+        built["eng"] = eng
         built["hlo"] = {
             eng.contract_name(k): v for k, v in eng.program_hlo().items()
         }
         return built["hlo"]
 
+    def explain():
+        if "sf" not in built:
+            ensure()
+            built["sf"] = built["eng"].explain_collectives()
+        return built["sf"]
+
     return [
-        EntryProgram(name, mesh, lambda name=name: ensure()[name])
+        EntryProgram(
+            name, mesh, lambda name=name: ensure()[name],
+            shardflow=lambda name=name: explain()[name],
+        )
         for name in ("kv_export", "kv_ingest")
     ]
 
@@ -377,8 +413,12 @@ def _swap_reshard_programs() -> list[EntryProgram]:
 
     mesh = _mesh24()
 
-    def hlo_for(quant: bool):
-        def hlo():
+    def builders_for(quant: bool):
+        built: dict = {}
+
+        def ensure():
+            if built:
+                return built
             import jax
 
             from learning_jax_sharding_tpu.models.quantize import quantize_tree
@@ -399,14 +439,35 @@ def _swap_reshard_programs() -> list[EntryProgram]:
             cache: dict = {}
             device_reshard(src, dst, jit_cache=cache)
             (fn,) = cache.values()
-            return fn.lower(src).compile().as_text()
+            built.update(src=src, dst=dst, fn=fn)
+            return built
 
-        return hlo
+        def hlo():
+            b = ensure()
+            return b["fn"].lower(b["src"]).compile().as_text()
 
-    return [
-        EntryProgram("swap_reshard", mesh, hlo_for(False)),
-        EntryProgram("swap_reshard_quant", mesh, hlo_for(True)),
-    ]
+        def shardflow(name):
+            from learning_jax_sharding_tpu.analysis.shardflow import (
+                trace_shardflow,
+            )
+
+            b = ensure()
+            return trace_shardflow(
+                name, b["fn"], b["src"], mesh=mesh, out_shardings=b["dst"],
+            )
+
+        return hlo, shardflow
+
+    out = []
+    for name, quant in (
+        ("swap_reshard", False), ("swap_reshard_quant", True)
+    ):
+        hlo, shardflow = builders_for(quant)
+        out.append(EntryProgram(
+            name, mesh, hlo,
+            shardflow=lambda name=name, sf=shardflow: sf(name),
+        ))
+    return out
 
 
 def _zero1_q8() -> EntryProgram:
@@ -420,8 +481,11 @@ def _zero1_q8() -> EntryProgram:
     from learning_jax_sharding_tpu.parallel.logical import activate
 
     mesh = _mesh24()
+    built: dict = {}
 
-    def hlo():
+    def ensure():
+        if built:
+            return built
         from learning_jax_sharding_tpu.models.transformer import (
             next_token_loss,
         )
@@ -437,10 +501,29 @@ def _zero1_q8() -> EntryProgram:
             {k: v.sharding for k, v in batch.items()}, mesh, rules,
             loss_fn=next_token_loss, quantized_comm=True,
         )
-        with activate(mesh, rules):
-            return step.jitted.lower(state, batch).compile().as_text()
+        built.update(state=state, batch=batch, step=step, rules=rules)
+        return built
 
-    return EntryProgram("zero1_update_q8", mesh, hlo)
+    def hlo():
+        b = ensure()
+        with activate(mesh, b["rules"]):
+            return b["step"].jitted.lower(
+                b["state"], b["batch"]
+            ).compile().as_text()
+
+    def shardflow():
+        from learning_jax_sharding_tpu.analysis.shardflow import (
+            trace_shardflow,
+        )
+
+        b = ensure()
+        with activate(mesh, b["rules"]):
+            return trace_shardflow(
+                "zero1_update_q8", b["step"].jitted, b["state"], b["batch"],
+                mesh=mesh,
+            )
+
+    return EntryProgram("zero1_update_q8", mesh, hlo, shardflow=shardflow)
 
 
 def _moe_dispatch() -> EntryProgram:
@@ -451,8 +534,11 @@ def _moe_dispatch() -> EntryProgram:
     from learning_jax_sharding_tpu.ops.moe_dispatch import moe_a2a_ff
 
     mesh = _mesh24()
+    built: dict = {}
 
-    def hlo():
+    def ensure():
+        if built:
+            return built
         e, t, m, h = 4, 16, 32, 64
         rng = np.random.default_rng(0)
         sh = NamedSharding(mesh, P("data", None))
@@ -478,9 +564,24 @@ def _moe_dispatch() -> EntryProgram:
                 top_k=2, capacity_factor=1.25, dtype=jnp.float32,
             )
 
-        return compiled_hlo(fn, x, probs, w_up, w_down)
+        built.update(fn=fn, args=(x, probs, w_up, w_down))
+        return built
 
-    return EntryProgram("moe_dispatch", mesh, hlo)
+    def hlo():
+        b = ensure()
+        return compiled_hlo(b["fn"], *b["args"])
+
+    def shardflow():
+        from learning_jax_sharding_tpu.analysis.shardflow import (
+            trace_shardflow,
+        )
+
+        b = ensure()
+        return trace_shardflow(
+            "moe_dispatch", b["fn"], *b["args"], mesh=mesh
+        )
+
+    return EntryProgram("moe_dispatch", mesh, hlo, shardflow=shardflow)
 
 
 def _seq_attention(name: str) -> EntryProgram:
@@ -488,8 +589,11 @@ def _seq_attention(name: str) -> EntryProgram:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = _mesh24()
+    built: dict = {}
 
-    def hlo():
+    def ensure():
+        if built:
+            return built
         from learning_jax_sharding_tpu.ops.ring_attention import (
             ring_attention,
         )
@@ -512,9 +616,22 @@ def _seq_attention(name: str) -> EntryProgram:
                 batch_axis="data",
             )
 
-        return compiled_hlo(fn, q, k, v)
+        built.update(fn=fn, args=(q, k, v))
+        return built
 
-    return EntryProgram(name, mesh, hlo)
+    def hlo():
+        b = ensure()
+        return compiled_hlo(b["fn"], *b["args"])
+
+    def shardflow():
+        from learning_jax_sharding_tpu.analysis.shardflow import (
+            trace_shardflow,
+        )
+
+        b = ensure()
+        return trace_shardflow(name, b["fn"], *b["args"], mesh=mesh)
+
+    return EntryProgram(name, mesh, hlo, shardflow=shardflow)
 
 
 def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:

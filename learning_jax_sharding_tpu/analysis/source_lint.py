@@ -24,6 +24,14 @@ structure matters:
   ``perf_counter`` call) with no honest sync idiom within ±10 lines:
   times dispatch, not execution (the reference's original flaw,
   case6_attention.py:234-238).
+* ``host-sync-in-hot-loop`` — a blocking host↔device sync
+  (``.block_until_ready()``, ``np.asarray(...)``, ``.item()``,
+  ``jax.device_get``) inside a ``for``/``while`` body of an
+  ``*Engine`` class (``ContinuousEngine``'s dispatch/step loops): each
+  iteration stalls the dispatch queue for a device round-trip, the
+  host-loop overhead ROADMAP item 1 tracks. Batch the readback after
+  the loop or keep the value on device; the engine's deliberate
+  result-materialization points ride the baseline with reasons.
 * ``swallowed-exception`` — a bare ``except:`` that does not re-raise,
   or an ``except Exception/BaseException:`` whose body is only
   ``pass``/``...``: the failure vanishes without a record — in a
@@ -100,6 +108,29 @@ _DEVICE_MAKERS = re.compile(
     r"^(jnp|jax\.numpy)\.|^jax\.device_put$|^jax\.random\.|device_put$"
 )
 
+#: Dotted call names that force a blocking host↔device transfer.
+_HOST_SYNC_CALLS = {
+    "np.asarray", "numpy.asarray", "jax.device_get", "device_get",
+    "jax.block_until_ready",
+}
+#: Method names that do the same as attribute calls on an array.
+_HOST_SYNC_METHODS = {"block_until_ready", "item"}
+#: Classes whose loops are the serving hot path.
+_HOT_CLASS_RE = re.compile(r"Engine")
+
+
+def _host_sync_name(node: ast.Call) -> str | None:
+    """The sync idiom a call spells, or None."""
+    name = _dotted(node.func)
+    if name in _HOST_SYNC_CALLS:
+        return name
+    if (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in _HOST_SYNC_METHODS
+    ):
+        return f".{node.func.attr}()"
+    return None
+
 
 def _flat_targets(t: ast.AST):
     """Names bound by one assignment target (handles Tuple/List/Starred)."""
@@ -156,6 +187,7 @@ class _Visitor(ast.NodeVisitor):
         self.findings: list[Finding] = []
         self.loop_depth = 0
         self.func_depth = 0
+        self.class_stack: list[str] = []
         # Names bound at MODULE scope to device-array-producing calls —
         # function-local `x = jnp...` bindings must not poison the set
         # (a jitted function elsewhere reading an unrelated global `x`
@@ -170,6 +202,11 @@ class _Visitor(ast.NodeVisitor):
 
     visit_For = visit_While = visit_AsyncFor = _loop
 
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
     def visit_Call(self, node: ast.Call):
         if _is_jit_call(node) and self.loop_depth > 0:
             self.findings.append(Finding(
@@ -177,6 +214,21 @@ class _Visitor(ast.NodeVisitor):
                 "jax.jit called inside a loop body — each iteration "
                 "builds a fresh wrapper with its own compile cache, so "
                 "every pass recompiles; hoist the jit out of the loop",
+            ))
+        sync = _host_sync_name(node)
+        if (
+            sync is not None
+            and self.loop_depth > 0
+            and any(_HOT_CLASS_RE.search(c) for c in self.class_stack)
+        ):
+            self.findings.append(Finding(
+                "ast", "host-sync-in-hot-loop",
+                f"{self.path}:{node.lineno}",
+                f"`{sync}` inside a loop on the engine hot path — each "
+                "iteration blocks the dispatch queue on a host-device "
+                "round-trip; batch the readback outside the loop or "
+                "keep the value on device (ROADMAP item 1 host-loop "
+                "overhead)",
             ))
         self.generic_visit(node)
 

@@ -3849,6 +3849,32 @@ class ContinuousEngine:
         )
         return findings
 
+    def explain_collectives(self) -> dict[str, "object"]:
+        """Pre-compile collective attribution for every dispatched engine
+        program: run the GSPMD propagation simulator
+        (``analysis.shardflow``) over each program's jaxpr and return a
+        :class:`~learning_jax_sharding_tpu.analysis.shardflow.
+        ShardflowReport` per contract name — each predicted collective
+        carries the SOURCE LINE that causes it, which the compiled-HLO
+        inventory (:meth:`collective_inventory`) can never recover.
+        Trace-only (``jax.make_jaxpr``): no compiles, so this is cheap
+        enough to run on a live engine. Decode-family programs advance
+        ``decode_block_steps`` tokens per dispatch inside their device
+        loop; that trip count prices the in-loop collectives."""
+        from learning_jax_sharding_tpu.analysis.shardflow import (
+            trace_shardflow,
+        )
+
+        out = {}
+        with activate(self._mesh, self._rules):
+            for name, fn, args in self._dispatched_programs():
+                cname = self.contract_name(name)
+                out[cname] = trace_shardflow(
+                    cname, fn, *args, mesh=self._mesh,
+                    while_trip_hint=int(self._block_steps),
+                )
+        return out
+
     def collective_axis_volume(self) -> dict[str, dict]:
         """Per-MESH-AXIS collective byte volume for each engine program:
         what one refill/decode dispatch puts on the wire, attributed to

@@ -46,6 +46,18 @@ _GROUPS_RE = re.compile(
     r"replica_groups=(\{\{[0-9,{} ]*\}\}|\[[0-9,]+\]<=\[[0-9,]+\](?:T\([0-9,]+\))?)"
 )
 
+# Collective-permute routing: `source_target_pairs={{0,1},{1,2},...}`.
+# Permutes print NO replica_groups (or an empty `{}` when channel-lowered)
+# — the pair list IS the communication pattern, so the parser surfaces it
+# as its own record field instead of leaving the permute unroutable.
+_PAIRS_RE = re.compile(r"source_target_pairs=(\{\{[0-9,{} ]*\}\})")
+
+# Cross-module channel tag: `channel_id=7`. When XLA lowers a collective
+# through channels it may print `replica_groups={}` (empty) — the grouping
+# then lives entirely in the channel, so the id is recorded alongside the
+# (None) groups rather than being dropped.
+_CHANNEL_RE = re.compile(r"channel_id=([0-9]+)")
+
 # A computation header: `%name (params...) -> result {` — optionally prefixed
 # by `ENTRY`. Params may nest parens (tuple-typed args), so the param match is
 # greedy to the last `)` before `->`. The `^` anchor excludes instruction
@@ -175,18 +187,24 @@ def collective_instructions(hlo_text: str) -> list[dict]:
     """Per-instruction collective records from optimized HLO text.
 
     Each record is ``{"op", "bytes", "replica_groups", "computation",
-    "in_while"}``: ``bytes`` is the LARGEST typed operand/result buffer in
-    the instruction's result type (for async ``-start`` pairs the tuple
-    holds operand AND result, so the max is the post-collective buffer —
-    the honest wire-volume proxy for a grown all-gather);
-    ``replica_groups`` is a list of partition-id lists (ids are positions
-    in the mesh's flattened device order under SPMD partitioning), or None
-    when XLA printed none; ``computation`` is the enclosing computation's
-    name (None for headerless snippets); ``in_while`` marks instructions
-    whose computation executes inside a ``while`` loop
+    "in_while", "source_target_pairs", "channel_id"}``: ``bytes`` is the
+    LARGEST typed operand/result buffer in the instruction's result type
+    (for async ``-start`` pairs the tuple holds operand AND result, so
+    the max is the post-collective buffer — the honest wire-volume proxy
+    for a grown all-gather); ``replica_groups`` is a list of
+    partition-id lists (ids are positions in the mesh's flattened device
+    order under SPMD partitioning), or None when XLA printed none —
+    including the channel-lowered empty ``replica_groups={}`` form,
+    where the grouping lives in ``channel_id`` instead;
+    ``computation`` is the enclosing computation's name (None for
+    headerless snippets); ``in_while`` marks instructions whose
+    computation executes inside a ``while`` loop
     (:func:`while_scoped_computations` — per-iteration cost, the
-    contract pass's highest-signal flag). ``-done`` halves are excluded,
-    so an async pair contributes once — same convention as
+    contract pass's highest-signal flag); ``source_target_pairs`` is a
+    list of ``[src, tgt]`` partition-id pairs for collective-permutes
+    (None when the attribute is absent) and ``channel_id`` the integer
+    channel tag (None likewise). ``-done`` halves are excluded, so an
+    async pair contributes once — same convention as
     :func:`collective_counts`.
     """
     scoped = while_scoped_computations(hlo_text)
@@ -202,9 +220,17 @@ def collective_instructions(hlo_text: str) -> list[dict]:
             nbytes = max(nbytes, (numel * _dtype_bits(dt) + 7) // 8)
         gm = _GROUPS_RE.search(line)
         groups = _parse_replica_groups(gm.group(1)) if gm else None
+        pm = _PAIRS_RE.search(line)
+        # The pairs attribute shares the `{{a,b},{c,d}}` spelling with
+        # explicit replica groups, so the same materializer parses it;
+        # each inner group is one [src, tgt] pair.
+        pairs = _parse_replica_groups(pm.group(1)) if pm else None
+        cm = _CHANNEL_RE.search(line)
         out.append({
             "op": op, "bytes": nbytes, "replica_groups": groups,
             "computation": comp, "in_while": comp in scoped,
+            "source_target_pairs": pairs,
+            "channel_id": int(cm.group(1)) if cm else None,
         })
     return out
 

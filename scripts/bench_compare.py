@@ -88,6 +88,13 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     # adapter gather got more expensive relative to folded weights).
     (re.compile(r"swap stall p99 ([\d,.]+)\s*ms"), "swap_stall_p99_ms",
      False),
+    # Round-13 shardflow gate: the cost model's predicted-vs-measured
+    # step-time error per tracked line (bench.py's `[bench] shardflow
+    # ...` lines). Lower is better — the error growing means the
+    # propagation rules or the platform profile drifted from the real
+    # machine, the analyzer's own regression signal.
+    (re.compile(r"model err ([\d,.]+)%"), "predicted_vs_measured_pct",
+     False),
     (re.compile(r"mixed ([\d,.]+)\s*tok/s"), "mixed_tok_s", True),
     (re.compile(r"solo ([\d,.]+)\s*tok/s"), "solo_tok_s", True),
     (re.compile(r"([\d.]+)x solo"), "vs_solo_ratio", True),

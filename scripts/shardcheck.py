@@ -17,8 +17,16 @@ emulated mesh, the AST pass only reads source):
   donations requested-but-dropped / eligible-but-never-requested
   (annotated with ``utils.memory.memory_plan`` bytes at stake).
 * ``ast``       — repo-wide source lint (jit-in-loop, non-hashable
-  static args, closure-captured device arrays, raw unsynced clocks)
-  under the ``analysis/baseline.json`` suppression budget.
+  static args, closure-captured device arrays, raw unsynced clocks,
+  host syncs inside engine hot loops) under the
+  ``analysis/baseline.json`` suppression budget.
+* ``shardflow`` — the PRE-COMPILE pass: simulate GSPMD propagation over
+  every entry point's jaxpr (``analysis/shardflow.py``), reconcile the
+  predicted collective multiset against the same goldens the contract
+  pass diffs, and price a roofline step time (``analysis/costmodel.py``).
+  A compiled collective no predicted event explains is a gated
+  ``unexplained-collective`` finding; ``--explain`` renders the
+  per-source-line "why this collective exists" report.
 
 Regenerating goldens after an INTENDED sharding change::
 
@@ -27,6 +35,12 @@ Regenerating goldens after an INTENDED sharding change::
 
 then review the JSON diff like any other code change — the diff IS the
 communication-pattern review.
+
+The full run carries a WALL-TIME BUDGET (``--budget-seconds``, default
+150): PERF.md shows pass creep of 38 s (round 8) -> 67 s (round 9) ->
+117 s (round 13, entry points having grown 12 -> 22); the budget is
+re-justified against the measured wall each time it moves (PERF.md
+round 13) and CI fails before shardcheck can eat the tier-1 window.
 
 Exit codes: 0 clean, 1 findings, 2 infrastructure error. Findings also
 land in the process flight recorder / a fresh registry and are written
@@ -49,7 +63,7 @@ if str(_REPO) not in sys.path:
 
 from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
 
-PASSES = ("contracts", "jaxpr", "ast")
+PASSES = ("contracts", "jaxpr", "ast", "shardflow")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -75,10 +89,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--devices", type=int, default=8,
                     help="emulated device count for the compile passes")
     ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument(
+        "--explain", action="store_true",
+        help="run the shardflow pass and print the per-source-line "
+        "collective attribution + priced roofline per entry point",
+    )
+    ap.add_argument(
+        "--budget-seconds", type=float, default=150.0,
+        help="wall-time budget for the full multi-pass run; exceeding "
+        "it is itself a gated finding (0 disables)",
+    )
     args = ap.parse_args(argv)
 
     passes = tuple(dict.fromkeys(args.passes)) if args.passes else PASSES
-    needs_mesh = args.update_golden or {"contracts", "jaxpr"} & set(passes)
+    if args.explain and "shardflow" not in passes:
+        passes = passes + ("shardflow",)
+    needs_mesh = args.update_golden or (
+        {"contracts", "jaxpr", "shardflow"} & set(passes)
+    )
     if needs_mesh:
         try:
             force_emulated_devices(args.devices)
@@ -93,7 +121,9 @@ def main(argv: list[str] | None = None) -> int:
         run_ast_pass,
         run_contract_pass,
         run_jaxpr_pass,
+        run_shardflow_pass,
     )
+    from learning_jax_sharding_tpu.analysis.findings import Finding
     from learning_jax_sharding_tpu.telemetry import MetricsRegistry
     from learning_jax_sharding_tpu.telemetry.flight_recorder import (
         artifact_dir,
@@ -125,7 +155,7 @@ def main(argv: list[str] | None = None) -> int:
     # per-program caches hold each built state/step and its single AOT
     # compile, so contracts + jaxpr don't pay the compiles twice.
     programs = None
-    if {"contracts", "jaxpr"} & set(passes):
+    if {"contracts", "jaxpr", "shardflow"} & set(passes):
         from learning_jax_sharding_tpu.analysis.entrypoints import (
             build_entry_programs,
         )
@@ -135,6 +165,7 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.perf_counter()
     findings = []
     timings: dict[str, float] = {}
+    shardflow_reports: list[dict] = []
     for name in passes:
         tp = time.perf_counter()
         if name == "contracts":
@@ -145,10 +176,30 @@ def main(argv: list[str] | None = None) -> int:
             findings += run_jaxpr_pass(
                 names=args.only, baseline=baseline, programs=programs
             )
+        elif name == "shardflow":
+            sf_findings, shardflow_reports = run_shardflow_pass(
+                golden_dir, names=args.only, programs=programs,
+                explain=args.explain,
+            )
+            findings += sf_findings
         else:
             findings += run_ast_pass(_REPO, baseline=baseline)
         timings[name] = time.perf_counter() - tp
     wall = time.perf_counter() - t0
+
+    # Satellite: the CI wall-time budget. Only a FULL run is comparable
+    # to the budget (a --pass/--only subset is always under it).
+    full_run = set(PASSES) <= set(passes) and not args.only
+    if full_run and args.budget_seconds and wall > args.budget_seconds:
+        findings.append(Finding(
+            "perf", "shardcheck-budget", "scripts/shardcheck.py",
+            f"full shardcheck run took {wall:.1f}s, over the "
+            f"{args.budget_seconds:.0f}s CI budget — the compile passes "
+            "crept past the tier-1 window (trim entry points, share "
+            "more compiles, or re-justify the budget in PERF.md)",
+            data={"wall_seconds": round(wall, 2),
+                  "budget_seconds": args.budget_seconds},
+        ))
 
     registry = MetricsRegistry()
     report_findings(
@@ -160,6 +211,8 @@ def main(argv: list[str] | None = None) -> int:
         "pass_seconds": {k: round(v, 2) for k, v in timings.items()},
         "findings": [f.to_dict() for f in findings],
     }
+    if shardflow_reports:
+        doc["shardflow"] = shardflow_reports
     import os
 
     if os.environ.get("LJST_ARTIFACT_DIR"):
@@ -168,6 +221,23 @@ def main(argv: list[str] | None = None) -> int:
     if args.json:
         print(json.dumps(doc, indent=2))
     else:
+        if args.explain:
+            for rep in shardflow_reports:
+                cost = rep["cost"]
+                rec = rep["reconcile"]
+                print(f"== {rep['name']} — predicted "
+                      f"{cost['predicted_s'] * 1e3:.3f} ms "
+                      f"({cost['bound']}-bound, "
+                      f"{cost['flops'] / 1e9:.2f} GFLOP, "
+                      f"{cost['hbm_bytes'] / 1e6:.1f} MB HBM, "
+                      f"{cost['wire_bytes'] / 1e6:.2f} MB wire) — "
+                      f"{rec['matched']}/{rec['actual_total']} compiled "
+                      f"collectives explained, "
+                      f"{sum(rec['elided'].values())} predicted elided "
+                      "by XLA")
+                text = rep.get("explanation")
+                if text:
+                    print(text)
         for f in findings:
             print(f)
         print(f"shardcheck: {len(findings)} finding(s) across "

@@ -242,6 +242,133 @@ ENTRY %main (p0: f32[4]) -> f32[4] {
         assert "add" not in while_scoped_computations(hlo)
 
 
+class TestRoutingAttributes:
+    """``source_target_pairs`` / ``channel_id`` as record fields (round
+    13): a collective-permute prints NO replica_groups — its routing
+    lives entirely in the pair list — and channel-lowered collectives
+    print an EMPTY ``replica_groups={}`` with the grouping carried by
+    the channel. Both used to parse as bare ``replica_groups=None``
+    records, indistinguishable from a groups-less snippet."""
+
+    def test_permute_source_target_pairs_parse(self):
+        hlo = """
+  %cp = bf16[4,8]{1,0} collective-permute(bf16[4,8]{1,0} %x), channel_id=3, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["op"] == "collective-permute"
+        assert ins["source_target_pairs"] == [[0, 1], [1, 2], [2, 3], [3, 0]]
+        assert ins["channel_id"] == 3
+        assert ins["replica_groups"] is None
+
+    def test_async_permute_pair_counts_once_with_pairs(self):
+        hlo = """
+  %cp-start = (u32[2]{0}, u32[2]{0}) collective-permute-start(u32[2]{0} %x), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %cp-done = u32[2]{0} collective-permute-done((u32[2]{0}, u32[2]{0}) %cp-start)
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["source_target_pairs"] == [[0, 1], [1, 0]]
+        assert collective_counts(hlo)["collective-permute"] == 1
+
+    def test_channel_only_empty_replica_groups(self):
+        # Channel-lowered spelling: `replica_groups={}` is empty on the
+        # instruction — the grouping is the channel's. The empty form
+        # must neither crash the groups parser nor masquerade as
+        # explicit groups; the channel id is the surviving routing fact.
+        hlo = """
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %x), channel_id=7, replica_groups={}, use_global_device_ids=true, to_apply=%add
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["channel_id"] == 7
+        assert ins["replica_groups"] is None
+        assert ins["source_target_pairs"] is None
+
+    def test_variadic_all_gather_tuple_result(self):
+        # Variadic all-gather: several operands gathered in one
+        # instruction, tuple-typed result holding EVERY output buffer.
+        # bytes is the largest (bf16[8,16] = 256 > f32[8,4] = 128).
+        hlo = """
+  %ag = (f32[8,4]{1,0}, bf16[8,16]{1,0}) all-gather(f32[4,4]{1,0} %a, bf16[4,16]{1,0} %b), channel_id=2, replica_groups={{0,1}}, dimensions={0}
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["op"] == "all-gather"
+        assert ins["bytes"] == 8 * 16 * 2
+        assert ins["replica_groups"] == [[0, 1]]
+        assert ins["channel_id"] == 2
+        assert ins["source_target_pairs"] is None
+
+    def test_fields_default_none_for_plain_collectives(self):
+        hlo = """
+  %ar = f32[64]{0} all-reduce(f32[64]{0} %x), replica_groups={{0,1}}, to_apply=%add
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["channel_id"] is None
+        assert ins["source_target_pairs"] is None
+        assert ins["replica_groups"] == [[0, 1]]
+
+    def test_compiled_ppermute_pairs_are_a_permutation(self, mesh24):
+        """Against REAL compiler output: a shard_map ppermute ring over
+        'y' must lower to collective-permutes whose parsed pairs form a
+        permutation of the 8 flattened partition ids (each x-row its own
+        y-ring)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from learning_jax_sharding_tpu.parallel.hlo import compiled_hlo
+
+        n = mesh24.shape["y"]
+
+        def shift(x):
+            return lax.ppermute(
+                x, "y", [(i, (i + 1) % n) for i in range(n)]
+            )
+
+        f = jax.shard_map(
+            shift, mesh=mesh24, in_specs=P(None, "y"), out_specs=P(None, "y")
+        )
+        text = compiled_hlo(f, jnp.arange(32, dtype=jnp.float32).reshape(4, 8))
+        recs = [
+            r for r in collective_instructions(text)
+            if r["op"] == "collective-permute"
+        ]
+        assert recs, "no collective-permute in compiled ring"
+        ndev = mesh24.devices.size
+        pairs = [p for r in recs for p in r["source_target_pairs"]]
+        srcs = [p[0] for p in pairs]
+        tgts = [p[1] for p in pairs]
+        assert sorted(srcs) == list(range(ndev))
+        assert sorted(tgts) == list(range(ndev))
+        # Every hop stays inside its x-row's y-ring.
+        for s, t in pairs:
+            assert s // n == t // n and t % n == (s + 1) % n, (s, t)
+
+    def test_record_schema_stable_on_real_compiler_output(self, mesh24, rng):
+        """Schema pin over live lowered output: every record — whatever
+        the op — carries exactly the published keys, so downstream
+        consumers can index without guards."""
+        from functools import partial
+
+        from learning_jax_sharding_tpu.parallel.collectives import (
+            psum_matmul,
+        )
+        from learning_jax_sharding_tpu.parallel.hlo import compiled_hlo
+        from tests.conftest import matmul_operands
+
+        a, b = matmul_operands(rng)
+        text = compiled_hlo(partial(psum_matmul, mesh=mesh24, axis="y"), a, b)
+        recs = collective_instructions(text)
+        assert recs
+        keys = {
+            "op", "bytes", "replica_groups", "computation", "in_while",
+            "source_target_pairs", "channel_id",
+        }
+        for r in recs:
+            assert set(r) == keys
+            if r["channel_id"] is not None:
+                assert r["channel_id"] > 0
+
+
 class TestConstantInstructions:
     def test_sizes_and_threshold(self):
         hlo = """

@@ -59,3 +59,42 @@ def test_baseline_has_no_dead_budget():
     assert not loose, (
         f"baseline budgets looser than reality (tighten counts): {loose}"
     )
+
+
+def test_jaxpr_budgets_reference_live_entry_points_and_rules():
+    """The symmetric audit for the OTHER budget section (round 13):
+    ``jaxpr_budgets`` keys on (entry-point name → rule → count), and a
+    renamed entry point or a retired rule would leave its ceiling
+    silently dead — the exact staleness class the suppressions audit
+    above catches. Trace-free: building the entry-point list is lazy
+    (no compiles), and the rule ids are pinned against the lint module's
+    published set."""
+    import json
+
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_entry_programs,
+    )
+
+    known_rules = {"dead-eqn", "f32-promotion", "f32-dot-in-bf16-graph"}
+    programs = {p.name: p for p in build_entry_programs()}
+    doc = json.loads(BASELINE_PATH.read_text())
+    budgets = doc.get("jaxpr_budgets", {})
+    for name, rules in budgets.items():
+        if name.startswith("_"):
+            continue  # the section's _comment
+        assert name in programs, (
+            f"jaxpr_budgets entry {name!r} matches no entry point — "
+            "prune it or fix the name"
+        )
+        assert programs[name].jaxpr is not None, (
+            f"jaxpr_budgets entry {name!r} budgets an entry point that "
+            "runs no jaxpr pass (audit=False) — the ceiling is dead"
+        )
+        for rule, count in rules.items():
+            assert rule in known_rules, (
+                f"jaxpr_budgets[{name!r}] budgets unknown rule {rule!r}"
+            )
+            assert int(count) > 0, (
+                f"jaxpr_budgets[{name!r}][{rule!r}] is {count} — a zero "
+                "budget is the default; delete the entry"
+            )

@@ -539,6 +539,61 @@ class TestSourceLint:
         """
         assert self._rules(src) == []
 
+    def test_host_sync_in_engine_loop_flags(self):
+        src = """
+        import numpy as np
+
+        class ContinuousEngine:
+            def step(self, params):
+                for slot in self.slots:
+                    tok = np.asarray(self.buf[slot])
+                    n = self.counts[slot].item()
+                    self.out[slot].block_until_ready()
+        """
+        assert self._rules(src) == ["host-sync-in-hot-loop"] * 3
+
+    def test_host_sync_outside_loop_clean(self):
+        # The engine's single designed sync point per dispatch — after
+        # the loop — is the pattern the rule steers toward.
+        src = """
+        import numpy as np
+
+        class ContinuousEngine:
+            def step(self, params):
+                tok = np.asarray(self.dispatch(params))
+                for slot in self.slots:
+                    self.retire(slot, tok[slot])
+        """
+        assert self._rules(src) == []
+
+    def test_host_sync_outside_engine_class_clean(self):
+        # Loops elsewhere legitimately read results back (bench timing,
+        # data loading) — only the serving hot path gates.
+        src = """
+        import numpy as np
+
+        def drain(streams):
+            for s in streams:
+                yield np.asarray(s)
+
+        class ShardedBatchLoader:
+            def batches(self):
+                for b in self.source:
+                    yield np.asarray(b)
+        """
+        assert self._rules(src) == []
+
+    def test_jax_device_get_in_engine_loop_flags(self):
+        src = """
+        import jax
+
+        class SpecEngine:
+            def _drain(self):
+                while self.has_work():
+                    stats = jax.device_get(self.counters)
+        """
+        assert self._rules(src) == ["host-sync-in-hot-loop"]
+
     def test_baseline_budget(self):
         fs = [
             Finding("ast", "raw-clock", "a.py:10", "m"),
@@ -577,6 +632,33 @@ class TestCheckedInGoldens:
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.name == name
             assert c.mesh_shape and c.mesh_axes
+
+    def test_goldens_and_entry_points_are_a_bijection(self):
+        """Round-13 coverage audit: every entry point has a golden AND
+        every golden names a live entry point — an orphaned golden (its
+        program renamed or deleted) previously passed silently, pinning
+        nothing. ``bench_headline.json`` is exempt: it is bench.py's
+        collective contract, not an entry-point golden. Building the
+        entry-point list is lazy (no compiles), so this stays cheap."""
+        from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
+        from learning_jax_sharding_tpu.analysis.entrypoints import (
+            build_entry_programs,
+        )
+
+        entry_names = {p.name for p in build_entry_programs()}
+        golden_names = {
+            f.stem for f in GOLDEN_DIR.glob("*.json")
+        } - {"bench_headline"}
+        missing = entry_names - golden_names
+        assert not missing, (
+            f"entry points without goldens (run scripts/shardcheck.py "
+            f"--update-golden): {sorted(missing)}"
+        )
+        orphaned = golden_names - entry_names
+        assert not orphaned, (
+            f"goldens naming no live entry point (stale — delete or "
+            f"re-wire): {sorted(orphaned)}"
+        )
 
     def test_goldens_record_real_communication(self):
         from learning_jax_sharding_tpu.analysis import GOLDEN_DIR

@@ -1,0 +1,316 @@
+"""The GSPMD propagation simulator + roofline cost model (round 13).
+
+Pins the three layers shardcheck's ``--explain`` pass and the bench
+``shardflow`` block stand on:
+
+* PROPAGATION — trace-only specs through dots (matched contracting →
+  pending partial → all-reduce attributed to the CAUSING line;
+  mismatched → reshard all-gather), transposes (spec permuted, zero
+  events), scanned shard_map collectives (in-loop, trip-multiplied) and
+  ``while_trip_hint`` for loops whose trip the trace can't see;
+* RECONCILIATION — every actual collective must be claimed by a
+  predicted event (exact, axis-wildcard, or the RS+AG split form);
+  leftovers gate (``unexplained-collective``) while elided predictions
+  only report — including against the REAL partitioner, where a
+  deliberately mis-sharded weight is caught pre-compile at the exact
+  source line in THIS file and the compiled HLO confirms it;
+* PRICING — the roofline terms (thin-dot bucket at its own achieved
+  rate, ring wire factors, loop trips), ``table_profile`` access, and
+  the ``compare`` record the bench gate consumes.
+"""
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from learning_jax_sharding_tpu.analysis import costmodel
+from learning_jax_sharding_tpu.analysis.contracts import Contract, contract_of
+from learning_jax_sharding_tpu.analysis.shardflow import (
+    CommEvent,
+    ShardflowReport,
+    Spec,
+    reconcile,
+    reconcile_findings,
+    render_explanation,
+    spec_of_sharding,
+    trace_shardflow,
+)
+
+THIS_FILE = "test_shardflow.py"
+
+
+def _put(mesh, x, *axes):
+    return jax.device_put(x, NamedSharding(mesh, P(*axes)))
+
+
+def _events(report):
+    return [e for e in report.events if e.kind != "slice"]
+
+
+def megatron_pair(x, w1, w2):
+    h = jax.nn.relu(x @ w1)
+    return h @ w2  # SECOND-DOT: partials materialize / reshard lands here
+
+
+def _second_dot_tag():
+    src, first = inspect.getsourcelines(megatron_pair)
+    line = first + next(i for i, l in enumerate(src) if "SECOND-DOT" in l)
+    return f"{THIS_FILE}:{line}"
+
+
+class TestPropagation:
+    B, D, H = 8, 16, 64
+
+    def _operands(self, mesh, *, bad=False):
+        x = _put(mesh, np.ones((self.B, self.D), np.float32), "x", None)
+        w1 = _put(mesh, np.ones((self.D, self.H), np.float32), None, "y")
+        w2 = _put(
+            mesh, np.ones((self.H, self.D), np.float32),
+            *((None, "y") if bad else ("y", None)),
+        )
+        return x, w1, w2
+
+    def test_matched_contracting_predicts_all_reduce_at_causing_line(
+        self, mesh24
+    ):
+        rep = trace_shardflow(
+            "mm", megatron_pair, *self._operands(mesh24), mesh=mesh24
+        )
+        [ev] = _events(rep)
+        assert ev.kind == "reduce"
+        assert ev.realizations[0] == ("all-reduce", "y")
+        assert ev.where.endswith(_second_dot_tag())
+        # Per-device payload: the (B, D) f32 output, batch-sharded on x.
+        assert ev.bytes == self.B * self.D * 4 // 2
+
+    def test_mis_sharded_weight_predicts_gather_same_line(self, mesh24):
+        rep = trace_shardflow(
+            "mm_bad", megatron_pair, *self._operands(mesh24, bad=True),
+            mesh=mesh24,
+        )
+        ops = {e.realizations[0][0] for e in _events(rep)}
+        assert "all-gather" in ops and "all-reduce" not in ops
+        assert any(
+            e.where.endswith(_second_dot_tag()) for e in _events(rep)
+        )
+
+    def test_transpose_rewrites_spec_without_events(self, mesh24):
+        x = _put(mesh24, np.ones((8, 16), np.float32), "x", "y")
+        rep = trace_shardflow(
+            "t", lambda a: jnp.transpose(a), x, mesh=mesh24
+        )
+        assert _events(rep) == []
+        [out] = rep.out_specs
+        assert out.dims == (("y",), ("x",))
+
+    def test_flops_and_thin_bucket(self, mesh24):
+        x = _put(mesh24, np.ones((4, 256), np.float32))
+        w = _put(mesh24, np.ones((256, 256), np.float32))
+        rep = trace_shardflow("thin", lambda a, b: a @ b, x, w, mesh=mesh24)
+        assert rep.flops == pytest.approx(2 * 4 * 256 * 256)
+        assert rep.flops_thin == pytest.approx(rep.flops)  # m=4 < 64: GEMV
+        big = _put(mesh24, np.ones((128, 256), np.float32))
+        rep2 = trace_shardflow("sq", lambda a, b: a @ b, big, w, mesh=mesh24)
+        assert rep2.flops_thin == 0.0
+
+    def test_scanned_explicit_collective_is_trip_multiplied(self, mesh24):
+        def scanned(x):
+            def body(c, _):
+                return jax.lax.psum(c, "y"), None
+
+            r, _ = jax.lax.scan(body, x, None, length=4)
+            return r
+
+        f = jax.shard_map(
+            scanned, mesh=mesh24, in_specs=P(None, "y"),
+            out_specs=P(None, "y"), check_vma=False,
+        )
+        x = _put(mesh24, np.ones((4, 16), np.float32), None, "y")
+        # Wrapped in a plain lambda: shard_map objects expose the
+        # UNMAPPED body via __wrapped__, which trace_shardflow prefers
+        # (it is how it unwraps jax.jit).
+        rep = trace_shardflow("scanned", lambda a: f(a), x, mesh=mesh24)
+        evs = [e for e in _events(rep) if e.kind == "explicit"]
+        assert evs and all(e.in_loop and e.trip == 4 for e in evs)
+
+    def test_while_trip_hint_prices_opaque_loops(self, mesh24):
+        # w rides into the while eqn as a body const WITH its spec (a
+        # fully closed-over array would be a spec-less jaxpr constant).
+        def loop(x, w):
+            def body(c):
+                i, v = c
+                return i + 1, jax.nn.relu(v @ w)
+
+            def cond(c):
+                return c[0] < 3
+
+            return jax.lax.while_loop(cond, body, (0, x))[1]
+
+        x = _put(mesh24, np.ones((8, 16), np.float32), None, "y")
+        w = _put(mesh24, np.ones((16, 16), np.float32), "y", None)
+        rep = trace_shardflow(
+            "loop", loop, x, w, mesh=mesh24, while_trip_hint=7
+        )
+        evs = [e for e in _events(rep) if e.in_loop]
+        assert evs and all(e.trip == 7 for e in evs)
+
+    def test_spec_of_sharding_and_helpers(self, mesh24):
+        s = spec_of_sharding(NamedSharding(mesh24, P(("x", "y"), None)), 2)
+        assert s.dims == (("x", "y"), ())
+        assert s.sharded_axes() == {"x", "y"}
+        assert s.shard_factor({"x": 2, "y": 4}) == 8
+        assert Spec.replicated(2).dims == ((), ())
+
+
+def _report(events, *, flops=0.0, thin=0.0, hbm=0.0):
+    return ShardflowReport(
+        name="r", mesh_axes=["x", "y"], mesh_shape=[2, 4],
+        events=events, flops=flops, hbm_bytes=hbm, flops_thin=thin,
+    )
+
+
+def _ar_event(**kw):
+    base = dict(
+        kind="reduce", axes=("y",), bytes=1_000_000, where="f.py:1",
+        primitive="dot_general", reason="partial",
+        realizations=(("all-reduce", "y"),),
+    )
+    base.update(kw)
+    return CommEvent(**base)
+
+
+def _contract(collectives):
+    return Contract(
+        name="r", mesh_shape=[2, 4], mesh_axes=["x", "y"],
+        collectives={
+            k: {"count": n, "max_bytes": 1} for k, n in collectives.items()
+        },
+        while_collectives=0, max_constant_bytes=0,
+    )
+
+
+class TestReconcile:
+    def test_exact_claim(self):
+        rec = reconcile(_report([_ar_event()]), _contract({"all-reduce@y": 1}))
+        assert rec["matched"] == 1
+        assert rec["unexplained"] == {} and rec["elided"] == {}
+
+    def test_wildcard_axis_claim(self):
+        rec = reconcile(
+            _report([_ar_event()]), _contract({"all-reduce@unattributed": 1})
+        )
+        assert rec["unexplained"] == {}
+
+    def test_rs_ag_split_claimed_by_one_reduce(self):
+        rec = reconcile(
+            _report([_ar_event(realizations=(
+                ("all-reduce", "y"), ("reduce-scatter", "y"),
+            ))]),
+            _contract({"reduce-scatter@y": 1, "all-gather@y": 1}),
+        )
+        assert rec["unexplained"] == {}
+
+    def test_leftover_actual_gates(self):
+        rec = reconcile(_report([]), _contract({"all-to-all@x": 2}))
+        assert rec["unexplained"] == {"all-to-all@x": 2}
+        [f] = reconcile_findings(rec)
+        assert f.rule == "unexplained-collective"
+        assert f.data["unexplained"] == 2
+
+    def test_leftover_prediction_is_elided_not_gated(self):
+        rec = reconcile(_report([_ar_event()]), _contract({}))
+        assert rec["elided"] == {"all-reduce@y": 1}
+        assert reconcile_findings(rec) == []
+
+    def test_slice_events_are_free(self):
+        ev = _ar_event(kind="slice", realizations=(("slice", "y"),))
+        rec = reconcile(_report([ev]), _contract({}))
+        assert rec["elided"] == {} and rec["unexplained"] == {}
+
+    def test_against_real_partitioner_both_layouts(self, mesh24):
+        """case24's micro demo, held in CI: trace-only predictions for
+        the correctly- and the mis-sharded layout BOTH reconcile with
+        zero unexplained against the compiled HLO, and the bad layout's
+        compiled contract really does grow the predicted all-gather."""
+        t = TestPropagation()
+        for bad in (False, True):
+            args = t._operands(mesh24, bad=bad)
+            rep = trace_shardflow("mm", megatron_pair, *args, mesh=mesh24)
+            con = contract_of(
+                "mm", jax.jit(megatron_pair), *args, mesh=mesh24
+            )
+            rec = reconcile(rep, con)
+            assert rec["unexplained"] == {}, (bad, rec)
+            grouped = {k.split("@")[0] for k in con.collectives}
+            assert ("all-gather" in grouped) == bad, (bad, con.collectives)
+
+    def test_render_explanation_names_lines(self):
+        text = render_explanation(_report([_ar_event()]))
+        assert "f.py:1" in text and "all-reduce@y" in text
+
+
+class TestCostModel:
+    PROFILE = costmodel.Profile(
+        "test", peak_flops=1e12, hbm_bw=1e12, link_bw=1e11,
+        mfu_eff=0.5, mbu_eff=0.5, thin_flops=1e10,
+    )
+
+    def test_roofline_terms(self):
+        cost = costmodel.price(
+            _report([_ar_event()], flops=1e9, hbm=1e6), self.PROFILE
+        )
+        # compute: (1e9/8 dev) / (1e12 * 0.5);  memory: 1e6 / (1e12 * 0.5)
+        assert cost.compute_s == pytest.approx(2.5e-4)
+        assert cost.memory_s == pytest.approx(2e-6)
+        # wire: 1 MB * ring 2(n-1)/n on y (n=4) / 1e11
+        assert cost.collective_s == pytest.approx(1.5e-5)
+        assert cost.bound == "compute"
+        assert cost.predicted_s == cost.compute_s
+
+    def test_thin_flops_priced_at_thin_rate(self):
+        dense = costmodel.price(_report([], flops=1e9), self.PROFILE)
+        thin = costmodel.price(
+            _report([], flops=1e9, thin=1e9), self.PROFILE
+        )
+        # 1e10 thin rate vs 5e11 effective dense rate: 50x slower.
+        assert thin.compute_s == pytest.approx(dense.compute_s * 50)
+
+    def test_loop_events_multiply_trip(self):
+        sizes = {"x": 2, "y": 4}
+        once = costmodel.price_event(_ar_event(), self.PROFILE, sizes)
+        looped = costmodel.price_event(
+            _ar_event(in_loop=True, trip=5), self.PROFILE, sizes
+        )
+        assert looped == pytest.approx(once * 5)
+
+    def test_ring_factors(self):
+        n = 4
+        assert costmodel._ring_factor("all-reduce", n) == pytest.approx(1.5)
+        assert costmodel._ring_factor("all-gather", n) == pytest.approx(0.75)
+        assert costmodel._ring_factor("slice", n) == 0.0
+        assert costmodel._ring_factor("all-reduce", 1) == 0.0
+
+    def test_compare_record(self):
+        rec = costmodel.compare(0.9e-3, 1.0e-3)
+        assert rec["predicted_ms"] == pytest.approx(0.9)
+        assert rec["measured_ms"] == pytest.approx(1.0)
+        assert rec["err_pct"] == pytest.approx(10.0)
+        assert rec["signed_err_pct"] == pytest.approx(-10.0)
+
+    def test_table_profile_access(self):
+        p = costmodel.table_profile("TPU v5 lite")
+        assert p.link_bw == pytest.approx(45e9)
+        assert p.mfu_eff == pytest.approx(0.50)
+        with pytest.raises(KeyError):
+            costmodel.table_profile("Abacus 9000")
+
+    def test_predicted_mfu_is_per_chip(self):
+        cost = costmodel.price(_report([], flops=4e9), self.PROFILE)
+        # compute-bound at 50% effective rate: per-chip MFU is exactly
+        # the efficiency factor, regardless of device count (n_dev=8).
+        assert cost.n_dev == 8
+        assert cost.predicted_mfu == pytest.approx(0.5)
