@@ -738,6 +738,7 @@ def bench_serving_125m():
         dt = _time.perf_counter() - t0
         outs = eng.pop_finished()
         toks = sum(len(o) - 544 for o in outs.values())
+        generated = toks
         lat = eng.latency_stats()
         extras = f", {toks / dt:,.0f} tok/s"
         if lat.get("refill_frac") is not None:
@@ -760,6 +761,7 @@ def bench_serving_125m():
             f"{lat['itl_p99'] * 1e3:.0f} ms, queue wait p50 "
             f"{lat['queue_wait_p50'] * 1e3:.0f} ms{extras}"
         )
+        return generated
 
     # The latency engine re-tunes the two mixed knobs (perf_mixed.py
     # ladder): budget 128+B bounds each fused dispatch (the ITL gap a
@@ -783,8 +785,57 @@ def bench_serving_125m():
     # compile outside the measured window — staggered() resets stats, so
     # the warm pass leaves no trace in the gated percentiles.
     mixed_lat(params, prompts[:8])
-    staggered(mixed_lat.engine, "")
+    # Goodput accounting rides the tracked staggered run (round 14): the
+    # engine's ledger windows with reset_stats, a TraceStore collects
+    # every request's critical path, and the decode roofline (each
+    # generation wave streams the bf16 weights once per batch) prices
+    # what an ideally-scheduled device would have needed — host_share /
+    # goodput_ratio / telemetry overhead become gated bench facts.
+    from learning_jax_sharding_tpu.analysis.costmodel import current_profile
+    from learning_jax_sharding_tpu.telemetry import TraceStore
+
+    eng = mixed_lat.engine
+    eng.trace_sink = TraceStore(registry=eng.registry)
+    generated = staggered(eng, "")
+    prof = current_profile()
+    wbytes = sum(x.size for x in jax.tree.leaves(params)) * 2  # bf16
+    roofline = (
+        (generated / common["batch_size"]) * wbytes
+        / max(prof.hbm_bw * prof.mbu_eff, 1.0)
+    )
+    rep = eng.ledger.window_report(roofline_device_s=roofline)
+    rec = eng.ledger.reconcile()
+    cps = eng.trace_sink.completed()
+    ttfts = [cp["ttft_s"] for cp in cps if cp["ttft_s"] is not None]
+    cp50 = float(np.percentile(ttfts, 50)) * 1e3 if ttfts else None
+    cp99 = float(np.percentile(ttfts, 99)) * 1e3 if ttfts else None
+    _log(
+        f"[bench] goodput: host_share {(rep['host_share'] or 0) * 100:.1f}%, "
+        f"goodput_ratio {rep['goodput_ratio'] * 100:.2f}%, "
+        f"top contributor {rep['top_contributor']} "
+        f"({rep['top_contributor_s']:.2f} s of {rep['wall_s']:.2f} s), "
+        f"telemetry overhead {rep['telemetry_share'] * 100:.2f}%, "
+        f"TTFT critical path p50 {cp50:.0f} ms / p99 {cp99:.0f} ms, "
+        f"reconcile {'ok' if rec['ok'] else 'FAILED'} "
+        f"(residual {rec['residual_s'] * 1e3:.2f} ms)"
+    )
+    goodput_block = {
+        "host_share": rep["host_share"],
+        "goodput_ratio": rep["goodput_ratio"],
+        "roofline_device_s": roofline,
+        "top_contributor": rep["top_contributor"],
+        "top_contributor_s": rep["top_contributor_s"],
+        "telemetry_share": rep["telemetry_share"],
+        "buckets": rep["buckets"],
+        "wall_s": rep["wall_s"],
+        "reconcile_ok": rec["ok"],
+        "reconcile_residual_s": rec["residual_s"],
+        "ttft_critical_path_p50_ms": cp50,
+        "ttft_critical_path_p99_ms": cp99,
+        "traced_requests": len(cps),
+    }
     staggered(plain.engine, " split-engine baseline")
+    return goodput_block
 
 
 def bench_fleet():
@@ -1053,9 +1104,10 @@ def main():
     except Exception as e:
         _log(f"[bench] 1.4B decode bench skipped: {type(e).__name__}: {e}")
     try:
-        bench_serving_125m()
+        goodput_block = bench_serving_125m()
     except Exception as e:
         _log(f"[bench] serving bench skipped: {type(e).__name__}: {e}")
+        goodput_block = None
     try:
         bench_fleet()
     except Exception as e:
@@ -1124,6 +1176,12 @@ def main():
         # time vs the measured one for the tracked shapes
         # (analysis.shardflow + costmodel; gated by bench_compare).
         "shardflow": shardflow_block,
+        # Round-14 goodput ledger: where the tracked serving window's
+        # wall-clock went (exclusive buckets, Σ == wall reconciled),
+        # host_share / goodput_ratio vs the decode roofline, and the
+        # trace-derived TTFT critical-path tails — the measured
+        # anatomy of ROADMAP item 1's host-vs-device gap.
+        "goodput": goodput_block,
     }), flush=True)
 
 

@@ -1,0 +1,177 @@
+"""Case 25 — the goodput ledger + fleet tracing, end to end.
+
+The round-14 observability layers on a SATURATED disaggregated fleet
+(2 prefill + 2 decode replicas, (1,2) sub-meshes on the emulated 8-dev
+mesh), every request admitted up front so the window prices the
+machinery, not arrival gaps:
+
+* **100% wall-clock accounting** — every replica engine's goodput
+  ledger must RECONCILE (Σ exclusive buckets == window wall within ε);
+  the fleet report rolls the four ledgers up into one bucket breakdown
+  with ``host_share`` (1 − device/busy) and the NAMED top gap
+  contributor — the "where did the 16× go" answer as data;
+* **fleet-wide request tracing** — one trace id per request minted at
+  router admission and carried across the prefill replica, the KV
+  handoff, and the decode replica; every retired request yields a
+  complete critical path (queue → prefill → handoff → decode → stall)
+  with TTFT, printed here as a table;
+* **one merged Perfetto timeline** — per-replica engine dispatch tracks
+  and per-request journey tracks on a single clock
+  (https://ui.perfetto.dev).
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case25``, else a
+temp dir): ``goodput.json`` (the fleet ledger roll-up + per-replica
+reconciliation), ``critical_paths.json`` (per-request decompositions),
+``trace.json`` (the merged Perfetto timeline), ``metrics.prom`` (the
+labeled exposition carrying ``ledger_seconds_total`` and
+``trace_stage_seconds`` series per replica).
+
+Run: ``python cases/case25_goodput.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FleetRouter,
+    make_replicas,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    artifact_dir,
+)
+
+NREQ, NEW = 16, 8
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case25")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(25)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(5, 14, size=NREQ)
+    ]
+
+    pre = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="prefill", batch_size=2, max_new_tokens=1, refill_chunk=8,
+    )
+    dec = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+        role="decode", offset=4, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=8,
+    )
+    router = FleetRouter(pre + dec)
+
+    # Warm pass: compiles (prefill, ingest-decode, the handoff programs)
+    # stay out of the measured window — the window prices SERVING.
+    for i, p in enumerate(prompts[:4]):
+        router.add_request(p, rid=1000 + i)
+    while router.has_work():
+        router.step()
+    router.pop_finished()
+
+    print(f"case25: saturating 2 prefill + 2 decode replicas with "
+          f"{NREQ} requests, goodput window armed")
+    router.reset_stats()                 # begins every replica's window
+    for i, p in enumerate(prompts):
+        router.add_request(p, rid=i)
+    results, steps = {}, 0
+    while router.has_work():
+        router.step()
+        results.update(router.pop_finished())
+        steps += 1
+        if steps > 2000:
+            raise RuntimeError("fleet wedged")
+    results.update(router.pop_finished())
+    assert len(results) == NREQ, sorted(results)
+
+    # --- the ledger verdict -------------------------------------------------
+    rep = router.goodput_report()
+    assert rep["reconcile_ok"], {
+        n: r["reconcile"] for n, r in rep["replicas"].items()
+    }
+    (out / "goodput.json").write_text(
+        json.dumps(rep, indent=2, default=str)
+    )
+
+    # --- per-request critical paths -----------------------------------------
+    cps = [
+        cp for cp in router.traces.completed() if isinstance(cp["rid"], int)
+        and cp["rid"] < 1000
+    ]
+    assert len(cps) == NREQ, f"traced {len(cps)} of {NREQ}"
+    hdr = (f"{'trace':<12}{'rid':>4}{'queue':>9}{'prefill':>9}"
+           f"{'handoff':>9}{'decode':>9}{'stall':>9}{'ttft':>9}"
+           f"{'e2e':>9}")
+    print(hdr)
+    print("-" * len(hdr))
+    for cp in cps:
+        st = cp["stages"]
+        ttft = f"{cp['ttft_s'] * 1e3:8.1f}" if cp["ttft_s"] else "     n/a"
+        print(
+            f"{cp['trace_id']:<12}{cp['rid']:>4}"
+            f"{st.get('queue', 0) * 1e3:8.1f} {st.get('prefill', 0) * 1e3:8.1f} "
+            f"{st.get('handoff', 0) * 1e3:8.1f} {st.get('decode', 0) * 1e3:8.1f} "
+            f"{st.get('stall', 0) * 1e3:8.1f} {ttft} "
+            f"{cp['e2e_s'] * 1e3:8.1f}"
+        )
+        # The completeness contract: a disaggregated request must show
+        # all four named stages — a zero handoff/prefill would mean a
+        # hop escaped the trace.
+        for stage in ("queue", "prefill", "handoff", "decode"):
+            assert st.get(stage, 0.0) > 0.0, (cp["trace_id"], stage, st)
+        assert cp["ttft_s"] is not None and cp["ttft_s"] > 0.0
+    (out / "critical_paths.json").write_text(
+        json.dumps(cps, indent=2, default=str)
+    )
+
+    # --- the merged timeline + labeled exposition ---------------------------
+    router.dump_merged_chrome_trace(out / "trace.json")
+    prom = router.prometheus_text()
+    assert 'ledger_seconds_total{bucket="device",replica="' in prom
+    assert 'trace_stage_seconds_bucket{stage="handoff"' in prom
+    (out / "metrics.prom").write_text(prom)
+
+    buckets = rep["fleet_buckets"]
+    top3 = sorted(buckets.items(), key=lambda kv: -kv[1])[:3]
+    print(
+        f"case25: {NREQ}/{NREQ} requests traced end-to-end; all 4 "
+        f"replica ledgers reconcile; fleet host_share "
+        f"{rep['host_share'] * 100:.1f}%, top buckets "
+        + ", ".join(f"{b} {s * 1e3:,.0f} ms" for b, s in top3)
+        + f"; artifacts in {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,17 @@ structure matters:
   host-loop overhead ROADMAP item 1 tracks. Batch the readback after
   the loop or keep the value on device; the engine's deliberate
   result-materialization points ride the baseline with reasons.
+* ``untimed-engine-phase`` — a wall-clock-taking call (a compiled-fn
+  dispatch ``self._*_fn(...)``, a blocking host sync, a ``chaos_hook``
+  seam) inside an ``*Engine`` class's ledger-covered phase methods
+  (``step`` / ``*dispatch*`` / ``_admit`` / ``_sweep_deadlines`` /
+  ``_try_commit_swap`` / ``export_kv`` / ``ingest_kv``) that is NOT
+  lexically inside a goodput-ledger frame (``with ...measure(...)`` /
+  ``with ..._led_device(...)``): time it spends escapes the
+  Σ buckets == wall reconciliation invariant
+  (``telemetry/ledger.py``) — the static face of the accounting
+  identity tier-1 gates at runtime. New engine code paths must open (or
+  sit inside) a bucket frame.
 * ``swallowed-exception`` — a bare ``except:`` that does not re-raise,
   or an ``except Exception/BaseException:`` whose body is only
   ``pass``/``...``: the failure vanishes without a record — in a
@@ -118,6 +129,30 @@ _HOST_SYNC_METHODS = {"block_until_ready", "item"}
 #: Classes whose loops are the serving hot path.
 _HOT_CLASS_RE = re.compile(r"Engine")
 
+#: Engine methods whose ENTIRE wall-clock the goodput ledger must
+#: account for (telemetry/ledger.py's Σ buckets == wall invariant).
+_LEDGER_PHASE_RE = re.compile(
+    r"^(step|_admit|_sweep_deadlines|_try_commit_swap|export_kv|"
+    r"ingest_kv)$|dispatch"
+)
+
+#: Compiled-executable dispatch: the engine's jitted callables are all
+#: ``self._<name>_fn`` attributes by convention.
+_COMPILED_FN_RE = re.compile(r"^self\._\w+_fn$")
+
+
+def _is_ledger_frame(item: ast.withitem) -> bool:
+    """Does one ``with`` item open a goodput-ledger bucket frame?
+    Matches ``<anything>.measure(...)`` (GoodputLedger.measure — the
+    lint deliberately also accepts utils.bench.measure, which times a
+    region and is never an engine phase) and the engine's
+    ``self._led_device(...)`` compile-steal helper."""
+    expr = item.context_expr
+    if not isinstance(expr, ast.Call):
+        return False
+    name = _dotted(expr.func)
+    return name.endswith(".measure") or name.endswith("_led_device")
+
 
 def _host_sync_name(node: ast.Call) -> str | None:
     """The sync idiom a call spells, or None."""
@@ -188,6 +223,10 @@ class _Visitor(ast.NodeVisitor):
         self.loop_depth = 0
         self.func_depth = 0
         self.class_stack: list[str] = []
+        # untimed-engine-phase state: are we inside an Engine phase
+        # method, and how many ledger frames enclose the current node?
+        self.phase_stack: list[bool] = []
+        self.ledger_depth = 0
         # Names bound at MODULE scope to device-array-producing calls —
         # function-local `x = jnp...` bindings must not poison the set
         # (a jitted function elsewhere reading an unrelated global `x`
@@ -206,6 +245,44 @@ class _Visitor(ast.NodeVisitor):
         self.class_stack.append(node.name)
         self.generic_visit(node)
         self.class_stack.pop()
+
+    def _with(self, node):
+        opened = sum(1 for item in node.items if _is_ledger_frame(item))
+        self.ledger_depth += opened
+        self.generic_visit(node)
+        self.ledger_depth -= opened
+
+    visit_With = visit_AsyncWith = _with
+
+    def _in_engine_phase(self) -> bool:
+        return bool(self.phase_stack) and self.phase_stack[-1]
+
+    def _check_untimed(self, node: ast.Call):
+        """untimed-engine-phase: a wall-clock taker in a ledger-covered
+        engine phase with NO enclosing bucket frame leaks time out of
+        the Σ buckets == wall identity."""
+        if not self._in_engine_phase() or self.ledger_depth > 0:
+            return
+        name = _dotted(node.func)
+        what = None
+        if _COMPILED_FN_RE.match(name):
+            what = f"compiled dispatch `{name}(...)`"
+        elif name.endswith("chaos_hook"):
+            what = "chaos seam `chaos_hook(...)`"
+        else:
+            sync = _host_sync_name(node)
+            if sync is not None:
+                what = f"host sync `{sync}`"
+        if what is not None:
+            self.findings.append(Finding(
+                "ast", "untimed-engine-phase",
+                f"{self.path}:{node.lineno}",
+                f"{what} in an engine phase method outside any "
+                "goodput-ledger frame — its wall-clock escapes the "
+                "ledger's Σ buckets == wall reconciliation (gated in "
+                "tier-1); wrap it in `with self.ledger.measure(...)`"
+                " or `with self._led_device(...)`",
+            ))
 
     def visit_Call(self, node: ast.Call):
         if _is_jit_call(node) and self.loop_depth > 0:
@@ -230,6 +307,7 @@ class _Visitor(ast.NodeVisitor):
                 "keep the value on device (ROADMAP item 1 host-loop "
                 "overhead)",
             ))
+        self._check_untimed(node)
         self.generic_visit(node)
 
     # --- module-scope device arrays + jitted functions that read them ---
@@ -255,9 +333,21 @@ class _Visitor(ast.NodeVisitor):
         if jit_decos:
             self._check_static_defaults(node, jit_decos)
             self._check_captures(node)
+        # A DIRECT method of an *Engine class whose name marks it a
+        # ledger-covered phase; nested closures inherit the flag (their
+        # bodies run inside the phase), unrelated nested defs don't
+        # clear it — they are part of the phase's wall too.
+        is_phase = (
+            self.func_depth == 0
+            and bool(self.class_stack)
+            and bool(_HOT_CLASS_RE.search(self.class_stack[-1]))
+            and bool(_LEDGER_PHASE_RE.search(node.name))
+        )
+        self.phase_stack.append(is_phase or self._in_engine_phase())
         self.func_depth += 1
         self.generic_visit(node)
         self.func_depth -= 1
+        self.phase_stack.pop()
 
     visit_FunctionDef = visit_AsyncFunctionDef = _check_function
 

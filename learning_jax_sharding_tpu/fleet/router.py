@@ -55,7 +55,11 @@ from learning_jax_sharding_tpu.robustness.chaos import (
     InjectedFault,
     chaos_hook,
 )
-from learning_jax_sharding_tpu.telemetry import MetricsRegistry
+from learning_jax_sharding_tpu.telemetry import (
+    MetricsRegistry,
+    TraceStore,
+    merge_tracers,
+)
 
 
 class _FleetRequest:
@@ -193,6 +197,19 @@ class FleetRouter:
         self._g_inflight = r.gauge(
             "fleet_inflight", "unfinished requests across the fleet")
         self._g_alive.set(len(reps))
+        # Request-scoped fleet tracing (round 14): ONE TraceStore for the
+        # whole routing domain. The trace id is minted at admission and
+        # every replica engine appends its legs to the same record
+        # (engine.trace_sink below). auto_complete=False — in a
+        # disaggregated fleet a prefill replica also "retires" its
+        # one-token pass, which must append legs, not close the trace;
+        # only the router's _finish does.
+        self.traces = TraceStore(
+            registry=self.registry, auto_complete=False,
+        )
+        for rep in reps:
+            rep.engine.trace_sink = self.traces
+            rep.engine.trace_replica = rep.name
         # Replicas mid-swap: excluded from placement (admission AND
         # handoff destinations) so they drain — rolling_swap's lever.
         self._swapping: set[str] = set()
@@ -236,8 +253,13 @@ class FleetRouter:
         return self.inflight() > 0
 
     def reset_stats(self):
-        """Start a router-side latency window (``latency_stats``)."""
+        """Start a router-side latency window (``latency_stats``) and a
+        fresh goodput-ledger window on every replica engine, so
+        ``goodput_report`` covers the same interval the latency numbers
+        do."""
         self._completed: list[dict] = []
+        for rep in self.replicas.values():
+            rep.engine.ledger.begin_window()
 
     # --- admission / routing ----------------------------------------------
 
@@ -265,6 +287,11 @@ class FleetRouter:
         freq = _FleetRequest(rid, p, deadline_s, time.perf_counter())
         self._route(freq)
         self._requests[rid] = freq
+        # The trace id is born HERE — router admission — and every hop
+        # (placement, handoff, reroute, swap pin, retirement) appends to
+        # it. _route's instant may have minted implicitly; this backfills
+        # the canonical arrival stamp either way.
+        self.traces.mint(rid, arrival_t=freq.arrival_t)
         self._c_requests.inc()
         self._g_inflight.set(self.inflight())
         return rid
@@ -287,6 +314,9 @@ class FleetRouter:
                 continue
             freq.replica = rep.name
             freq.stage = "prefill" if self.disaggregated else "decode"
+            self.traces.instant(
+                freq.rid, "route", replica=rep.name, requeue=requeue,
+            )
             self.recorder.record(
                 "fleet.route", rid=freq.rid, replica=rep.name,
                 requeue=requeue, queue_depth=rep.engine.queue_depth(),
@@ -408,6 +438,11 @@ class FleetRouter:
         self._finished[freq.rid] = result
         now = time.perf_counter()
         ok = not isinstance(result, RequestFailure)
+        # Close the trace at the ROUTER — the one place that knows the
+        # request's final verdict across every hop it took.
+        self.traces.complete(
+            freq.rid, status="ok" if ok else result.status, finish_t=now,
+        )
         self._completed.append({
             "rid": freq.rid,
             "e2e": now - freq.arrival_t,
@@ -450,7 +485,7 @@ class FleetRouter:
         freq.stage = "handoff"
         self._handoffs.append(dict(
             freq=freq, rows=rows, length=length, first=first,
-            src=rep.name,
+            src=rep.name, t_export=time.perf_counter(),
         ))
         self.recorder.record(
             "fleet.handoff_export", rid=freq.rid, src=rep.name,
@@ -545,6 +580,15 @@ class FleetRouter:
             self._c_handoffs.inc()
             self._c_kv_bytes.inc(stats["bytes"])
             self._c_kv_segments.inc(stats["segments"])
+            # The handoff leg is the ROUTER's span: it alone saw both
+            # ends — export on the prefill replica through ingest on the
+            # decode replica (park time in the queue included: that wait
+            # is handoff latency as the request experienced it).
+            self.traces.leg(
+                freq.rid, "handoff", h["t_export"], time.perf_counter(),
+                src=h["src"], dst=rep.name, bytes=stats["bytes"],
+                segments=stats["segments"], length=h["length"],
+            )
             self.recorder.record(
                 "fleet.handoff", rid=freq.rid, src=h["src"],
                 dst=rep.name, length=h["length"], bytes=stats["bytes"],
@@ -701,6 +745,10 @@ class FleetRouter:
                 continue
             freq.reroutes += 1
             self._c_reroutes.inc()
+            self.traces.instant(
+                freq.rid, "reroute", replica=rep.name,
+                error=str(error), reroutes=freq.reroutes,
+            )
             try:
                 self._route(freq, requeue=True)
             except AdmissionError as e:
@@ -762,3 +810,89 @@ class FleetRouter:
         return snapshot_prometheus_text(
             {**snap["router"], **snap["merged"]}
         )
+
+    def goodput_report(self) -> dict:
+        """Fleet-wide goodput: every replica's ledger window (since
+        ``reset_stats``) plus the fleet roll-up.
+
+        Fleet buckets are SUMMED replica-seconds (2 replicas idling one
+        wall-second cost two replica-seconds of capacity), so
+        ``host_share`` = 1 − Σdevice/Σbusy is capacity-weighted, and
+        ``reconcile_ok`` is the AND of every replica's own Σ buckets ==
+        wall invariant — one flag tier-1 can gate the whole fleet on."""
+        per_replica: dict[str, dict] = {}
+        fleet: dict[str, float] = {}
+        ok = True
+        for name in sorted(self.replicas):
+            led = self.replicas[name].engine.ledger
+            rep_report = led.window_report()
+            rec = led.reconcile()
+            ok = ok and rec["ok"]
+            per_replica[name] = {
+                "report": rep_report, "reconcile": rec,
+            }
+            for b, s in rep_report["buckets"].items():
+                fleet[b] = fleet.get(b, 0.0) + s
+        device = fleet.get("device", 0.0)
+        busy = sum(
+            r["report"]["busy_s"] for r in per_replica.values()
+        )
+        gaps = {b: s for b, s in fleet.items() if b != "device"}
+        top = max(gaps, key=gaps.get) if gaps else None
+        wall = sum(
+            r["report"]["wall_s"] for r in per_replica.values()
+        )
+        return {
+            "replicas": per_replica,
+            "fleet_buckets": fleet,
+            "fleet_wall_s": wall,
+            "fleet_busy_s": busy,
+            "fleet_device_s": device,
+            "host_share": 1.0 - device / busy if busy > 0 else None,
+            "top_contributor": top,
+            "top_contributor_s": gaps.get(top, 0.0) if top else 0.0,
+            "telemetry_share": (
+                fleet.get("telemetry", 0.0) / wall if wall > 0 else 0.0
+            ),
+            "reconcile_ok": ok,
+        }
+
+    def merged_chrome_trace(self) -> dict:
+        """One Perfetto timeline for the whole fleet: each replica
+        engine's dispatch-level ``Tracer`` ring becomes a named process
+        track, and the :class:`TraceStore`'s request journeys (queue /
+        prefill / handoff / decode legs, reroute + swap-pin markers)
+        land on additional tracks alongside — every engine stamp and
+        every trace leg came off the same ``perf_counter`` clock, so
+        rebasing onto the earliest tracer epoch lines them all up."""
+        tracers = {
+            name: self.replicas[name].engine.tracer
+            for name in sorted(self.replicas)
+        }
+        base = min(
+            (getattr(tr, "_t0", 0.0) for tr in tracers.values()),
+            default=self.traces._t0,
+        )
+        # TraceStore events are µs since ITS epoch; shift them onto the
+        # merged (earliest-tracer) epoch and move their pids past the
+        # tracer pids so the two track families never collide.
+        off_us = (self.traces._t0 - base) * 1e6
+        shift = len(tracers)
+        extra = []
+        for ev in self.traces.chrome_trace()["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = ev["pid"] + shift
+            if ev.get("ph") == "M":
+                ev = {**ev, "args": {
+                    "name": f"requests: {ev['args']['name']}",
+                }}
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + off_us
+            extra.append(ev)
+        return merge_tracers(tracers, extra_events=extra)
+
+    def dump_merged_chrome_trace(self, path) -> None:
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.merged_chrome_trace(), f)

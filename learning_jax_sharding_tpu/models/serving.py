@@ -73,6 +73,7 @@ per request (given the same ``rng``).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from collections import deque
@@ -108,7 +109,11 @@ from learning_jax_sharding_tpu.models.transformer import (
 )
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 from learning_jax_sharding_tpu.robustness.chaos import InjectedFault, chaos_hook
-from learning_jax_sharding_tpu.telemetry import MetricsRegistry, Tracer
+from learning_jax_sharding_tpu.telemetry import (
+    GoodputLedger,
+    MetricsRegistry,
+    Tracer,
+)
 from learning_jax_sharding_tpu.telemetry.compile_watch import cache_size
 from learning_jax_sharding_tpu.utils.profiling import annotate
 
@@ -184,6 +189,11 @@ class _Request:
     strikes: int = 0                      # dispatch faults while admitted
     version: int = 0                      # weights version pinned at admission
     adapter: str | None = None            # AdapterPool tenant (None = base)
+    enqueue_t: float | None = None        # when THIS engine queued it (a
+    #                                       rerouted request keeps its fleet
+    #                                       arrival_t but re-enqueues here)
+    ingested: bool = False                # admitted via kv_ingest: the prefill
+    #                                       happened on another replica
 
 
 class ContinuousEngine:
@@ -1414,6 +1424,37 @@ class ContinuousEngine:
         self._h_swap_stall = r.histogram(
             "engine_swap_stall_seconds",
             "stage-to-commit latency of weight swaps (drain or preempt)")
+        # Goodput ledger (round 14): exhaustive wall-clock attribution
+        # for the engine loop. step() is the top-level frame (its
+        # unclaimed remainder is host scheduling, bucket "sched");
+        # dispatch/sync regions book "device" (re-bucketed to "compile"
+        # when the executable cache grew), admission/page/handoff/swap/
+        # recovery/telemetry paths open their own frames, and idle is
+        # derived — reconcile() must hold after any run (tier-1 gated).
+        # Meters into this registry as ledger_seconds_total{bucket=...}.
+        self.ledger = GoodputLedger(registry=r)
+        # Request-scoped trace sink (telemetry.tracecontext.TraceStore).
+        # The fleet router attaches its store (and the replica name) to
+        # every replica; a solo driver may attach its own — legs are
+        # recorded at retirement from the stamps _Request already
+        # carries, so the sink costs nothing when absent.
+        self.trace_sink = None
+        self.trace_replica = "engine"
+
+    @contextlib.contextmanager
+    def _led_device(self, fn=None):
+        """Ledger frame for a dispatch or blocking readback: books to
+        the ``device`` bucket, unless ``fn``'s executable cache GREW
+        inside the region — then the call paid a trace+compile, not a
+        device step, and the whole frame re-buckets to ``compile`` (the
+        compile-steal idiom; ``cache_size`` probes the jit cache)."""
+        before = cache_size(fn) if fn is not None else None
+        with self.ledger.measure("device") as f:
+            yield f
+            if before is not None:
+                after = cache_size(fn)
+                if after is not None and (before is None or after > before):
+                    f.rebucket("compile")
 
     def _win_delta(self, counter):
         # The stats window (reset_stats → snapshot) over a cumulative
@@ -1492,6 +1533,7 @@ class ContinuousEngine:
         }
         # Window high-water for the page-pool gauge (live value rides on).
         self._g_pages.reset_high_water()
+        self.ledger.begin_window()
 
     def reset(self):
         """Abandon all in-flight work and return the engine to idle.
@@ -1642,12 +1684,15 @@ class ContinuousEngine:
         # Allocate pages so positions [0, tokens_through) are mapped
         # before the dispatch that writes them.
         need = -(-int(tokens_through) // self._page_size)
-        while len(self._held[slot]) < need:
-            p = self._take_page()
-            self._table_np[slot, len(self._held[slot])] = p
-            self._held[slot].append(p)
-            self._tables_dirty = True
-        self._update_high_water()
+        if len(self._held[slot]) >= need:
+            return   # steady-state decode mostly allocates nothing
+        with self.ledger.measure("page_alloc"):
+            while len(self._held[slot]) < need:
+                p = self._take_page()
+                self._table_np[slot, len(self._held[slot])] = p
+                self._held[slot].append(p)
+                self._tables_dirty = True
+            self._update_high_water()
 
     def _release(self, slot, register=True):
         # ``register=False``: the slot is being UN-admitted (backpressure),
@@ -1871,44 +1916,46 @@ class ContinuousEngine:
             return out, int(stats["bytes"])
 
         t0 = time.perf_counter()
-        try:
-            chaos_hook("engine.swap_stage", version=version, mode=mode)
-            cast = self._maybe_cast(new_params)
-            d_cast = (
-                self._d_cast(draft_params)
-                if draft_params is not None else None
+        with self.ledger.measure("swap"):
+            try:
+                chaos_hook("engine.swap_stage", version=version, mode=mode)
+                cast = self._maybe_cast(new_params)
+                d_cast = (
+                    self._d_cast(draft_params)
+                    if draft_params is not None else None
+                )
+                cast, p_bytes = stage(cast, ref[0] if ref else None)
+                d_cast, d_bytes = stage(d_cast, ref[1] if ref else None)
+            except _RECOVERABLE_DISPATCH as e:
+                self._c_swap_aborted.inc()
+                self.recorder.record(
+                    "engine.swap_abort", version=version, mode=mode,
+                    error=str(e),
+                )
+                return False
+            moved = p_bytes + d_bytes
+            self._staged_swap = dict(
+                version=version, mode=mode,
+                raw=(new_params, draft_params), cast=(cast, d_cast),
+                staged_t=time.perf_counter(),
             )
-            cast, p_bytes = stage(cast, ref[0] if ref else None)
-            d_cast, d_bytes = stage(d_cast, ref[1] if ref else None)
-        except _RECOVERABLE_DISPATCH as e:
-            self._c_swap_aborted.inc()
+            self._c_swap_staged.inc()
+            self._c_swap_bytes.inc(moved)
             self.recorder.record(
-                "engine.swap_abort", version=version, mode=mode,
-                error=str(e),
+                "engine.swap_stage", version=version, mode=mode, bytes=moved,
+                stage_s=time.perf_counter() - t0,
+                occupied=sum(q >= 0 for q in self._req),
+                queue_depth=len(self._queue),
             )
-            return False
-        moved = p_bytes + d_bytes
-        self._staged_swap = dict(
-            version=version, mode=mode,
-            raw=(new_params, draft_params), cast=(cast, d_cast),
-            staged_t=time.perf_counter(),
-        )
-        self._c_swap_staged.inc()
-        self._c_swap_bytes.inc(moved)
-        self.recorder.record(
-            "engine.swap_stage", version=version, mode=mode, bytes=moved,
-            stage_s=time.perf_counter() - t0,
-            occupied=sum(q >= 0 for q in self._req),
-            queue_depth=len(self._queue),
-        )
-        if mode == "preempt":
-            for slot in range(self._b):
-                if self._req[slot] >= 0:
-                    self._unadmit(slot)
-                    self._c_preempt.inc()
-        # An idle engine (and every preempt-mode swap) commits here and
-        # now; a draining engine commits in the step() that empties it.
-        self._try_commit_swap()
+            if mode == "preempt":
+                for slot in range(self._b):
+                    if self._req[slot] >= 0:
+                        self._unadmit(slot)
+                        self._c_preempt.inc()
+            # An idle engine (and every preempt-mode swap) commits here
+            # and now; a draining engine commits in the step() that
+            # empties it.
+            self._try_commit_swap()
         return True
 
     def _try_commit_swap(self) -> bool:
@@ -1917,27 +1964,39 @@ class ContinuousEngine:
         s = self._staged_swap
         if s is None or any(q >= 0 for q in self._req):
             return False
-        if self._paged:
-            # Old-params K/V must not seed new-params requests; slots
-            # are empty, so every retained page is reference-free.
-            self._drop_prefix_registry()
-        self._installed = s["raw"]
-        # Prime the identity-keyed cast cache with the STAGED trees: the
-        # next dispatch's _cast_params hits it, so the swap costs the
-        # hot path nothing (staging already cast and resharded).
-        self._cast_src = s["raw"]
-        self._cast_out = s["cast"]
-        self._clear_dispatch_args()
-        prev = self.weights_version
-        self.weights_version = s["version"]
-        self._staged_swap = None
-        stall = time.perf_counter() - s["staged_t"]
-        self._c_swap_commits.inc()
-        self._h_swap_stall.observe(stall)
-        self.recorder.record(
-            "engine.swap_commit", version=s["version"], previous=prev,
-            mode=s["mode"], stall_s=stall,
-        )
+        with self.ledger.measure("swap"):
+            if self._paged:
+                # Old-params K/V must not seed new-params requests; slots
+                # are empty, so every retained page is reference-free.
+                self._drop_prefix_registry()
+            self._installed = s["raw"]
+            # Prime the identity-keyed cast cache with the STAGED trees:
+            # the next dispatch's _cast_params hits it, so the swap costs
+            # the hot path nothing (staging already cast and resharded).
+            self._cast_src = s["raw"]
+            self._cast_out = s["cast"]
+            self._clear_dispatch_args()
+            prev = self.weights_version
+            self.weights_version = s["version"]
+            self._staged_swap = None
+            stall = time.perf_counter() - s["staged_t"]
+            self._c_swap_commits.inc()
+            self._h_swap_stall.observe(stall)
+            self.recorder.record(
+                "engine.swap_commit", version=s["version"], previous=prev,
+                mode=s["mode"], stall_s=stall,
+            )
+            if self.trace_sink is not None:
+                # Version-pin attribution: every request still queued
+                # here will (re-)admit under the NEW version — the pin
+                # lands on its trace, so a swap-preempt recompute's
+                # before/after legs are tell-apart-able by version.
+                for r in self._queue:
+                    self.trace_sink.instant(
+                        r.rid, "swap_pin", replica=self.trace_replica,
+                        version=s["version"], previous=prev,
+                        stall_s=stall,
+                    )
         return True
 
     def add_request(
@@ -2015,19 +2074,25 @@ class ContinuousEngine:
             # (nothing enqueued), and the refcount pins the adapter's
             # pool slot for the request's whole lifetime.
             self._adapter_pool.acquire(adapter)
+        now = time.perf_counter()
         self._queue.append(
             _Request(
                 rid=rid, prompt=p,
-                arrival_t=(
-                    time.perf_counter() if arrival_t is None else arrival_t
-                ),
+                arrival_t=now if arrival_t is None else arrival_t,
                 deadline_s=deadline_s,
                 version=self.weights_version,
                 adapter=adapter,
+                enqueue_t=now,
             )
         )
         self._c_requests.inc()
         self._g_queue.set(len(self._queue))
+        if self.trace_sink is not None:
+            # Solo engines mint here; under a fleet router the id was
+            # minted at ROUTER admission and this is an idempotent
+            # lookup (reroutes re-enqueue under the same rid → same
+            # trace id, the continuity the tracecontext tests pin).
+            self.trace_sink.mint(rid, arrival_t=self._queue[-1].arrival_t)
         self.tracer.instant(
             "request.arrival", rid=rid, prompt_len=int(p.size)
         )
@@ -2207,18 +2272,19 @@ class ContinuousEngine:
             )
         if self._cache is None:
             raise RuntimeError("export_kv: the engine holds no cache")
-        slot_j = jnp.int32(slot)
-        with activate(self._mesh, self._rules):
-            rows = self._kv_export_fn(self._cache, slot_j)
-        # Read the LIVE cache at relower time (like _last_decode_args
-        # et al.) — capturing the tuple would pin this moment's cache
-        # tree in HBM after later dispatches replace it.
-        self._last_kv_export_args = lambda: (self._cache, slot_j)
-        length = max(0, self._plen[slot] + self._emitted[slot] - 1)
-        self._c_kv_exports.inc()
-        self.recorder.record(
-            "engine.kv_export", rid=rid, slot=slot, length=length,
-        )
+        with self.ledger.measure("kv_handoff"):
+            slot_j = jnp.int32(slot)
+            with activate(self._mesh, self._rules):
+                rows = self._kv_export_fn(self._cache, slot_j)
+            # Read the LIVE cache at relower time (like _last_decode_args
+            # et al.) — capturing the tuple would pin this moment's cache
+            # tree in HBM after later dispatches replace it.
+            self._last_kv_export_args = lambda: (self._cache, slot_j)
+            length = max(0, self._plen[slot] + self._emitted[slot] - 1)
+            self._c_kv_exports.inc()
+            self.recorder.record(
+                "engine.kv_export", rid=rid, slot=slot, length=length,
+            )
         return rows, length
 
     def ingest_kv(
@@ -2244,75 +2310,81 @@ class ContinuousEngine:
         ``RuntimeError`` when no slot is free (the router holds the
         handoff until one is)."""
         self._check_handoff_supported("ingest_kv")
-        p = np.asarray(prompt, np.int32).reshape(-1)
-        self._validate_prompt(p)
-        if (
-            rid in self._finished
-            or rid in self._req
-            or any(r.rid == rid for r in self._queue)
-        ):
-            raise ValueError(f"request id {rid} already in use")
-        self._next_rid = max(self._next_rid, rid + 1)
-        slot = next(
-            (s for s in range(self._b) if self._req[s] < 0), None
-        )
-        if slot is None:
-            raise RuntimeError(
-                "ingest_kv: no free slot — poll free_slots() before "
-                "transferring"
+        with self.ledger.measure("kv_handoff"):
+            p = np.asarray(prompt, np.int32).reshape(-1)
+            self._validate_prompt(p)
+            if (
+                rid in self._finished
+                or rid in self._req
+                or any(r.rid == rid for r in self._queue)
+            ):
+                raise ValueError(f"request id {rid} already in use")
+            self._next_rid = max(self._next_rid, rid + 1)
+            slot = next(
+                (s for s in range(self._b) if self._req[s] < 0), None
             )
-        self.ensure_cache(params)
-        slot_j, idx_j = jnp.int32(slot), jnp.int32(int(p.size))
-        with activate(self._mesh, self._rules):
-            self._cache = self._kv_ingest_fn(
-                self._cache, rows, slot_j, idx_j
+            if slot is None:
+                raise RuntimeError(
+                    "ingest_kv: no free slot — poll free_slots() before "
+                    "transferring"
+                )
+            self.ensure_cache(params)
+            slot_j, idx_j = jnp.int32(slot), jnp.int32(int(p.size))
+            with activate(self._mesh, self._rules):
+                self._cache = self._kv_ingest_fn(
+                    self._cache, rows, slot_j, idx_j
+                )
+            # Live-cache closure (see export_kv): only the one transferred
+            # row tree stays retained for relowering, never a stale copy of
+            # the whole pre-ingest cache.
+            self._last_kv_ingest_args = lambda: (
+                self._cache, rows, slot_j, idx_j,
             )
-        # Live-cache closure (see export_kv): only the one transferred
-        # row tree stays retained for relowering, never a stale copy of
-        # the whole pre-ingest cache.
-        self._last_kv_ingest_args = lambda: (
-            self._cache, rows, slot_j, idx_j,
-        )
-        now = time.perf_counter()
-        r = _Request(
-            rid=rid, prompt=p,
-            arrival_t=now if arrival_t is None else arrival_t,
-            deadline_s=deadline_s,
-            version=self.weights_version,
-        )
-        r.admit_t = now if admit_t is None else admit_t
-        r.first_token_t = now if first_token_t is None else first_token_t
-        if deadline_s is not None:
-            self._any_req_deadline = True
-        self._export_ok = {
-            k: v for k, v in self._export_ok.items() if v != slot
-        }
-        self._slot_req[slot] = r
-        self._req[slot] = rid
-        self._plen[slot] = int(p.size)
-        self._pending[slot] = np.zeros((0,), np.int32)
-        self._emitted[slot] = 1
-        self._out[slot] = list(p) + [int(first_token)]
-        self._ttimes[slot] = [r.first_token_t]
-        self._tok[slot] = int(first_token)
-        self._needs_reset[slot] = False
-        self._reset_to[slot] = 0
-        self._c_requests.inc()
-        self._c_kv_ingests.inc()
-        self.tracer.async_begin(
-            "request", rid, prompt_len=int(p.size), slot=slot,
-        )
-        self.recorder.record(
-            "engine.kv_ingest", rid=rid, slot=slot, length=int(p.size),
-        )
-        if (
-            self._eos is not None and int(first_token) == self._eos
-        ) or self._max_new <= 1:
-            # The handed-off first token already ends the request.
-            self._retire(slot, now, [])
-        else:
-            self._active[slot] = True
-            self._g_active.set(int(self._active.sum()))
+            now = time.perf_counter()
+            r = _Request(
+                rid=rid, prompt=p,
+                arrival_t=now if arrival_t is None else arrival_t,
+                deadline_s=deadline_s,
+                version=self.weights_version,
+            )
+            r.admit_t = now if admit_t is None else admit_t
+            r.first_token_t = now if first_token_t is None else first_token_t
+            r.enqueue_t = now
+            # Prefill ran on ANOTHER engine: this engine's trace legs
+            # must cover only its own decode work (the handoff leg is the
+            # router's to record — it saw both ends of the transfer).
+            r.ingested = True
+            if deadline_s is not None:
+                self._any_req_deadline = True
+            self._export_ok = {
+                k: v for k, v in self._export_ok.items() if v != slot
+            }
+            self._slot_req[slot] = r
+            self._req[slot] = rid
+            self._plen[slot] = int(p.size)
+            self._pending[slot] = np.zeros((0,), np.int32)
+            self._emitted[slot] = 1
+            self._out[slot] = list(p) + [int(first_token)]
+            self._ttimes[slot] = [r.first_token_t]
+            self._tok[slot] = int(first_token)
+            self._needs_reset[slot] = False
+            self._reset_to[slot] = 0
+            self._c_requests.inc()
+            self._c_kv_ingests.inc()
+            self.tracer.async_begin(
+                "request", rid, prompt_len=int(p.size), slot=slot,
+            )
+            self.recorder.record(
+                "engine.kv_ingest", rid=rid, slot=slot, length=int(p.size),
+            )
+            if (
+                self._eos is not None and int(first_token) == self._eos
+            ) or self._max_new <= 1:
+                # The handed-off first token already ends the request.
+                self._retire(slot, now, [])
+            else:
+                self._active[slot] = True
+                self._g_active.set(int(self._active.sum()))
         return slot
 
     def _retire(self, slot, now, retired):
@@ -2341,29 +2413,38 @@ class ContinuousEngine:
         )
         self._completed.append(rec)
         # Histograms carry the same observations for export; the exact
-        # percentiles in latency_stats() stay sample-based (pinned).
-        self._c_finished.inc()
-        self._c_tokens.inc(n)
-        self._h_wait.observe(rec["queue_wait"])
-        self._h_e2e.observe(rec["e2e"])
-        if rec["ttft"] is not None:
-            self._h_ttft.observe(rec["ttft"])
-        if rec["tpot"] is not None:
-            self._h_tpot.observe(rec["tpot"])
-        self.tracer.async_end("request", r.rid, generated=n)
-        self.recorder.record(
-            "engine.retire", rid=r.rid, slot=slot, generated=n,
-            ttft=rec["ttft"], e2e=rec["e2e"], version=r.version,
-        )
-        if self.slo is not None:
-            self.slo.observe("queue_wait", rec["queue_wait"])
-            self.slo.observe("e2e", rec["e2e"])
+        # percentiles in latency_stats() stay sample-based (pinned). All
+        # of this booking is the observability tax — it lands in the
+        # ledger's telemetry bucket so perf_goodput.py can pin it.
+        with self.ledger.measure("telemetry"):
+            self._c_finished.inc()
+            self._c_tokens.inc(n)
+            self._h_wait.observe(rec["queue_wait"])
+            self._h_e2e.observe(rec["e2e"])
             if rec["ttft"] is not None:
-                self.slo.observe("ttft", rec["ttft"])
+                self._h_ttft.observe(rec["ttft"])
             if rec["tpot"] is not None:
-                self.slo.observe("tpot", rec["tpot"])
-            for g in gaps:
-                self.slo.observe("itl", g)
+                self._h_tpot.observe(rec["tpot"])
+            self.tracer.async_end("request", r.rid, generated=n)
+            self.recorder.record(
+                "engine.retire", rid=r.rid, slot=slot, generated=n,
+                ttft=rec["ttft"], e2e=rec["e2e"], version=r.version,
+            )
+            if self.slo is not None:
+                self.slo.observe("queue_wait", rec["queue_wait"])
+                self.slo.observe("e2e", rec["e2e"])
+                if rec["ttft"] is not None:
+                    self.slo.observe("ttft", rec["ttft"])
+                if rec["tpot"] is not None:
+                    self.slo.observe("tpot", rec["tpot"])
+                for g in gaps:
+                    self.slo.observe("itl", g)
+            if self.trace_sink is not None:
+                self._record_trace_legs(r, now, generated=n)
+                if self.trace_sink.auto_complete:
+                    self.trace_sink.complete(
+                        r.rid, status="ok", finish_t=now,
+                    )
         self._finished[r.rid] = r
         # Version attribution (round 12): every response is traceable to
         # exactly ONE weights version — the one pinned at its (last)
@@ -2382,6 +2463,54 @@ class ContinuousEngine:
         if self._paged:
             self._release(slot)
 
+    def _record_trace_legs(
+        self, r, now, *, generated=0, wasted=False, status="ok",
+    ):
+        """Append THIS engine's spans of ``r``'s journey to the trace
+        sink, from the request's own stamps. The queue leg opens at
+        ``enqueue_t`` (not the fleet ``arrival_t``): a rerouted request
+        keeps its original arrival for deadlines and latency honesty,
+        but it only waited HERE from its re-enqueue — the requeue gap
+        shows up as the trace's ``stall``, which is the truth.
+        ``wasted=True`` marks compute legs thrown away by a failover
+        (they sum separately in the critical path). Ingested rows emit
+        only a decode leg — their queue/prefill ran on the prefill
+        replica and the handoff leg is the router's to record (it alone
+        saw both ends of the transfer)."""
+        ts = self.trace_sink
+        rep = self.trace_replica
+        q0 = r.enqueue_t if r.enqueue_t is not None else r.arrival_t
+        if r.admit_t is None:
+            # Never admitted here: all wait, no compute to waste.
+            ts.leg(r.rid, "queue", q0, now, replica=rep, status=status)
+            return
+        if r.ingested:
+            ts.leg(
+                r.rid, "decode", q0, now, replica=rep,
+                generated=generated, version=r.version,
+                wasted=wasted, status=status,
+            )
+            return
+        ts.leg(r.rid, "queue", q0, r.admit_t, replica=rep)
+        ft = r.first_token_t
+        if ft is None:
+            # Died mid-prefill (chaos kill before the first token).
+            ts.leg(
+                r.rid, "prefill", r.admit_t, now, replica=rep,
+                version=r.version, wasted=wasted, status=status,
+            )
+            return
+        ts.leg(
+            r.rid, "prefill", r.admit_t, ft, replica=rep,
+            first_token_t=ft, version=r.version, wasted=wasted,
+        )
+        if now > ft:
+            ts.leg(
+                r.rid, "decode", ft, now, replica=rep,
+                generated=generated, version=r.version,
+                wasted=wasted, status=status,
+            )
+
     def _fail_request(self, r, status, error, *, now=None, tokens=None):
         """Retire ``r`` with a terminal non-ok status: surfaced through
         ``pop_finished`` as a :class:`RequestFailure` — the recovery
@@ -2393,16 +2522,32 @@ class ContinuousEngine:
         r.finish_t = now
         if tokens is not None:
             r.tokens = tokens
-        self._c_req_failed.inc()
-        if status == "rerouted":
-            self._c_rerouted.inc()
-        self.recorder.record(
-            "engine.request_failed", rid=r.rid, status=status, error=error,
-        )
-        if r.admit_t is not None:
-            # async_begin was issued at first admission; close the span
-            # so the trace shows the failed request's full lifetime.
-            self.tracer.async_end("request", r.rid, status=status)
+        with self.ledger.measure("telemetry"):
+            self._c_req_failed.inc()
+            if status == "rerouted":
+                self._c_rerouted.inc()
+            self.recorder.record(
+                "engine.request_failed", rid=r.rid, status=status,
+                error=error,
+            )
+            if r.admit_t is not None:
+                # async_begin was issued at first admission; close the
+                # span so the trace shows the failed request's full
+                # lifetime.
+                self.tracer.async_end("request", r.rid, status=status)
+            if self.trace_sink is not None:
+                # A reroute throws this engine's partial compute away —
+                # the next engine recomputes it. Mark those legs wasted
+                # so the fleet critical path separates real progress
+                # from failover churn.
+                self._record_trace_legs(
+                    r, now,
+                    wasted=(status == "rerouted"), status=status,
+                )
+                if self.trace_sink.auto_complete and status != "rerouted":
+                    self.trace_sink.complete(
+                        r.rid, status=status, finish_t=now,
+                    )
         if r.adapter is not None and self._adapter_pool is not None:
             self._adapter_pool.release(r.adapter)
         self._finished[r.rid] = r
@@ -2449,31 +2594,37 @@ class ContinuousEngine:
             ):
                 self._any_req_deadline = False
                 return
-        now = time.perf_counter()
+        with self.ledger.measure("admission"):
+            now = time.perf_counter()
 
-        def expired(r):
-            dl = r.deadline_s if r.deadline_s is not None else self._deadline_s
-            return dl is not None and now - r.arrival_t > dl
-
-        if any(expired(r) for r in self._queue):
-            keep = deque()
-            for r in self._queue:
-                if expired(r):
-                    self._c_deadline.inc()
-                    self._fail_request(
-                        r, "deadline", "deadline exceeded in queue", now=now,
-                    )
-                else:
-                    keep.append(r)
-            self._queue = keep
-            self._g_queue.set(len(self._queue))
-        for slot in range(self._b):
-            r = self._slot_req[slot]
-            if r is not None and expired(r):
-                self._c_deadline.inc()
-                self._fail_slot(
-                    slot, "deadline", "deadline exceeded in flight", now,
+            def expired(r):
+                dl = (
+                    r.deadline_s if r.deadline_s is not None
+                    else self._deadline_s
                 )
+                return dl is not None and now - r.arrival_t > dl
+
+            if any(expired(r) for r in self._queue):
+                keep = deque()
+                for r in self._queue:
+                    if expired(r):
+                        self._c_deadline.inc()
+                        self._fail_request(
+                            r, "deadline", "deadline exceeded in queue",
+                            now=now,
+                        )
+                    else:
+                        keep.append(r)
+                self._queue = keep
+                self._g_queue.set(len(self._queue))
+            for slot in range(self._b):
+                r = self._slot_req[slot]
+                if r is not None and expired(r):
+                    self._c_deadline.inc()
+                    self._fail_slot(
+                        slot, "deadline", "deadline exceeded in flight",
+                        now,
+                    )
 
     def _on_dispatch_fault(self, e):
         """A dispatch raised a RECOVERABLE fault (injected NaN-trap /
@@ -2484,26 +2635,27 @@ class ContinuousEngine:
         ``_admit``) so the poison trips alone instead of striking its
         batchmates to death. The engine's device state needs no repair:
         re-admission resets every per-row counter."""
-        self._c_dispatch_faults.inc()
-        self.recorder.record(
-            "engine.dispatch_fault",
-            error=type(e).__name__, message=str(e),
-            rids=[r for r in self._req if r >= 0],
-        )
-        now = time.perf_counter()
-        for slot in range(self._b):
-            r = self._slot_req[slot]
-            if r is None:
-                continue
-            r.strikes += 1
-            if r.strikes >= self._max_strikes:
-                self._c_quarantined.inc()
-                self.recorder.record(
-                    "engine.quarantine", rid=r.rid, strikes=r.strikes,
-                )
-                self._fail_slot(slot, "poisoned", str(e), now)
-            else:
-                self._unadmit(slot)
+        with self.ledger.measure("recovery"):
+            self._c_dispatch_faults.inc()
+            self.recorder.record(
+                "engine.dispatch_fault",
+                error=type(e).__name__, message=str(e),
+                rids=[r for r in self._req if r >= 0],
+            )
+            now = time.perf_counter()
+            for slot in range(self._b):
+                r = self._slot_req[slot]
+                if r is None:
+                    continue
+                r.strikes += 1
+                if r.strikes >= self._max_strikes:
+                    self._c_quarantined.inc()
+                    self.recorder.record(
+                        "engine.quarantine", rid=r.rid, strikes=r.strikes,
+                    )
+                    self._fail_slot(slot, "poisoned", str(e), now)
+                else:
+                    self._unadmit(slot)
 
     def _consume(self, slot, tokens, now, retired):
         # Append a decode dispatch's tokens for one slot; retire at
@@ -2598,94 +2750,96 @@ class ContinuousEngine:
             self._g_queue.set(len(self._queue))
             return
         b = self._b
-        now = time.perf_counter()
-        for slot in range(b):
-            if self._req[slot] < 0 and self._queue:
-                r = self._pop_admittable()
-                if r is None:
-                    break
-                r.prompt = np.asarray(
-                    chaos_hook("engine.admit", value=r.prompt, rid=r.rid)
-                )
-                bad = self._admission_ok(r.prompt)
-                if bad is not None:
-                    self.recorder.record(
-                        "engine.malformed", rid=r.rid, error=bad,
+        with self.ledger.measure("admission"):
+            now = time.perf_counter()
+            for slot in range(b):
+                if self._req[slot] < 0 and self._queue:
+                    r = self._pop_admittable()
+                    if r is None:
+                        break
+                    r.prompt = np.asarray(
+                        chaos_hook("engine.admit", value=r.prompt, rid=r.rid)
                     )
-                    self._fail_request(r, "malformed", bad, now=now)
-                    continue
-                # A preempted request keeps its first admission time (and
-                # counts its prefix hit once — re-admission re-maps the
-                # same pages, not new savings).
-                first_admission = r.admit_t is None
-                if first_admission:
-                    r.admit_t = now
-                    self.tracer.async_begin(
-                        "request", r.rid,
-                        prompt_len=int(r.prompt.size), slot=slot,
-                    )
-                self.tracer.instant(
-                    "request.admit", rid=r.rid, slot=slot
-                )
-                self.recorder.record(
-                    "engine.admit", rid=r.rid, slot=slot,
-                    prompt_len=int(r.prompt.size),
-                    readmission=not first_admission,
-                )
-                prompt = r.prompt
-                # (Re-)pin the weights version at EVERY admission: a
-                # preempted/requeued request recomputes from scratch, so
-                # it recomputes UNDER — and is attributed to — whatever
-                # version is serving when it readmits.
-                r.version = self.weights_version
-                # The slot is being reused: any retired request whose KV
-                # row lived here is no longer exportable.
-                self._export_ok = {
-                    k: v for k, v in self._export_ok.items() if v != slot
-                }
-                self._slot_req[slot] = r
-                self._req[slot] = r.rid
-                self._aidx[slot] = (
-                    self._adapter_pool.slot_of(r.adapter)
-                    if r.adapter is not None else 0
-                )
-                self._plen[slot] = prompt.size
-                self._pending[slot] = prompt
-                self._emitted[slot] = 0
-                self._out[slot] = list(prompt)
-                self._ttimes[slot] = []
-                self._needs_reset[slot] = True
-                self._reset_to[slot] = 0
-                if self._paged and self._prefix:
-                    # Longest chain of retained pages whose token prefix
-                    # matches; the last prompt token always recomputes
-                    # (its logits seed generation).
-                    shared = []
-                    for k in range(
-                        1, (prompt.size - 1) // self._page_size + 1
-                    ):
-                        pid = self._prefix_registry.get(
-                            prompt[: k * self._page_size].tobytes()
+                    bad = self._admission_ok(r.prompt)
+                    if bad is not None:
+                        self.recorder.record(
+                            "engine.malformed", rid=r.rid, error=bad,
                         )
-                        if pid is None:
-                            break
-                        shared.append(pid)
-                    for j, pid in enumerate(shared):
-                        self._refcnt[pid] = self._refcnt.get(pid, 0) + 1
-                        self._cached_lru.pop(pid, None)
-                        self._table_np[slot, j] = pid
-                        self._held[slot].append(pid)
-                        self._tables_dirty = True
-                    self._shared_count[slot] = len(shared)
-                    if shared:
-                        s_len = len(shared) * self._page_size
-                        self._pending[slot] = prompt[s_len:]
-                        self._reset_to[slot] = s_len
-                        if first_admission:
-                            self._c_pfx_hits.inc()
-                            self._c_pfx_pages.inc(len(shared))
-                        self._update_high_water()
-        self._g_queue.set(len(self._queue))
+                        self._fail_request(r, "malformed", bad, now=now)
+                        continue
+                    # A preempted request keeps its first admission time
+                    # (and counts its prefix hit once — re-admission
+                    # re-maps the same pages, not new savings).
+                    first_admission = r.admit_t is None
+                    if first_admission:
+                        r.admit_t = now
+                        self.tracer.async_begin(
+                            "request", r.rid,
+                            prompt_len=int(r.prompt.size), slot=slot,
+                        )
+                    self.tracer.instant(
+                        "request.admit", rid=r.rid, slot=slot
+                    )
+                    self.recorder.record(
+                        "engine.admit", rid=r.rid, slot=slot,
+                        prompt_len=int(r.prompt.size),
+                        readmission=not first_admission,
+                    )
+                    prompt = r.prompt
+                    # (Re-)pin the weights version at EVERY admission: a
+                    # preempted/requeued request recomputes from scratch,
+                    # so it recomputes UNDER — and is attributed to —
+                    # whatever version is serving when it readmits.
+                    r.version = self.weights_version
+                    # The slot is being reused: any retired request whose
+                    # KV row lived here is no longer exportable.
+                    self._export_ok = {
+                        k: v for k, v in self._export_ok.items()
+                        if v != slot
+                    }
+                    self._slot_req[slot] = r
+                    self._req[slot] = r.rid
+                    self._aidx[slot] = (
+                        self._adapter_pool.slot_of(r.adapter)
+                        if r.adapter is not None else 0
+                    )
+                    self._plen[slot] = prompt.size
+                    self._pending[slot] = prompt
+                    self._emitted[slot] = 0
+                    self._out[slot] = list(prompt)
+                    self._ttimes[slot] = []
+                    self._needs_reset[slot] = True
+                    self._reset_to[slot] = 0
+                    if self._paged and self._prefix:
+                        # Longest chain of retained pages whose token
+                        # prefix matches; the last prompt token always
+                        # recomputes (its logits seed generation).
+                        shared = []
+                        for k in range(
+                            1, (prompt.size - 1) // self._page_size + 1
+                        ):
+                            pid = self._prefix_registry.get(
+                                prompt[: k * self._page_size].tobytes()
+                            )
+                            if pid is None:
+                                break
+                            shared.append(pid)
+                        for j, pid in enumerate(shared):
+                            self._refcnt[pid] = self._refcnt.get(pid, 0) + 1
+                            self._cached_lru.pop(pid, None)
+                            self._table_np[slot, j] = pid
+                            self._held[slot].append(pid)
+                            self._tables_dirty = True
+                        self._shared_count[slot] = len(shared)
+                        if shared:
+                            s_len = len(shared) * self._page_size
+                            self._pending[slot] = prompt[s_len:]
+                            self._reset_to[slot] = s_len
+                            if first_admission:
+                                self._c_pfx_hits.inc()
+                                self._c_pfx_pages.inc(len(shared))
+                            self._update_high_water()
+            self._g_queue.set(len(self._queue))
 
     def _refill_dispatch(self, params, d_params, retired):
         # One refill chunk for every slot with pending prompt tokens
@@ -2707,10 +2861,13 @@ class ContinuousEngine:
                     lengths[slot] = n
             if not lengths.any():
                 break
-            chaos_hook(
-                "engine.dispatch", phase="refill",
-                rids=[r for r in self._req if r >= 0],
-            )
+            with self.ledger.measure("recovery"):
+                # An armed chaos seam spends its injected delay (hang,
+                # slow) HERE — fault time is recovery, never device.
+                chaos_hook(
+                    "engine.dispatch", phase="refill",
+                    rids=[r for r in self._req if r >= 0],
+                )
             if self._paged:
                 for slot in range(b):
                     if lengths[slot]:
@@ -2749,7 +2906,8 @@ class ContinuousEngine:
                         jnp.zeros((b,), jnp.int32), self._rid_arr(),
                         self.rng,
                     )
-                    _, self._cache = self._first_refill_fn(*first_args)
+                    with self._led_device(self._first_refill_fn):
+                        _, self._cache = self._first_refill_fn(*first_args)
                     self.cache_creations += 1
                     self._c_creations.inc()
                     self.recorder.record(
@@ -2762,7 +2920,9 @@ class ContinuousEngine:
                     params, d_params, jnp.asarray(chunk),
                     jnp.asarray(lengths), self._rid_arr(), self.rng,
                 )
-                with annotate("engine.first_refill"):
+                with self._led_device(self._first_refill_fn), annotate(
+                    "engine.first_refill"
+                ):
                     tok_new, self._cache = self._first_refill_fn(*first_args)
                 self.cache_creations += 1
                 self._c_creations.inc()
@@ -2783,7 +2943,9 @@ class ContinuousEngine:
                 reset_d = jnp.asarray(self._needs_reset.copy())
                 reset_to_d = jnp.asarray(self._reset_to.copy())
                 rid_d = self._rid_arr()
-                with annotate("engine.refill_step"):
+                with self._led_device(self._refill_step_fn), annotate(
+                    "engine.refill_step"
+                ):
                     tok_new, self._cache = self._refill_step_fn(
                         params, d_params, self._cache, chunk_d, lengths_d,
                         reset_d, reset_to_d, rid_d, self.rng,
@@ -2815,7 +2977,8 @@ class ContinuousEngine:
         if not segs:
             return False
         for tok_new, seg_completes in segs:
-            tok_new = np.asarray(tok_new)   # each segment's own sync
+            with self._led_device():
+                tok_new = np.asarray(tok_new)   # each segment's own sync
             now = time.perf_counter()       # its host-visibility time
             for slot in seg_completes:
                 # Prompt complete: its first token came from this
@@ -2876,10 +3039,13 @@ class ContinuousEngine:
             (self._num_draft + 1) if spec else 1
         )
         chain = min(self.decode_chain, -(-worst // per_block))
-        chaos_hook(
-            "engine.dispatch", phase="decode",
-            rids=[r for r in self._req if r >= 0],
-        )
+        with self.ledger.measure("recovery"):
+            # Armed chaos delay (hang/slow) books as recovery, not
+            # device — the attribution the chaos tests pin.
+            chaos_hook(
+                "engine.dispatch", phase="decode",
+                rids=[r for r in self._req if r >= 0],
+            )
         if self._paged:
             # Cover every position this chain can write: chain·K new
             # tokens per row (plain), or chain·K rounds of up to
@@ -2941,7 +3107,9 @@ class ContinuousEngine:
             t_cache, d_cache = self._cache
             segs = []
             for _ in range(chain):
-                with annotate("engine.decode_block_spec"):
+                with self._led_device(self._decode_block_spec_fn), annotate(
+                    "engine.decode_block_spec"
+                ):
                     (buffer, counts, acc, prop, tok_d, pos_d, active_d,
                      remaining_d, t_cache, d_cache) = (
                         self._decode_block_spec_fn(
@@ -2956,9 +3124,10 @@ class ContinuousEngine:
                 active_d, pos_d, remaining_d, rid, self.rng,
             )
             # ONE sync for the whole chain.
-            segs = [
-                tuple(np.asarray(x) for x in seg) for seg in segs
-            ]
+            with self._led_device():
+                segs = [
+                    tuple(np.asarray(x) for x in seg) for seg in segs
+                ]
             now = time.perf_counter()
             was_active = self._active.copy()
             for buffer, counts, acc, prop in segs:
@@ -2982,7 +3151,9 @@ class ContinuousEngine:
                 cache, d_cache = self._cache, None
             segs = []
             for _ in range(chain):
-                with annotate("engine.decode_block"):
+                with self._led_device(self._decode_block_fn), annotate(
+                    "engine.decode_block"
+                ):
                     toks, active_d, remaining_d, cache = (
                         self._decode_block_fn(
                             params, cache, tok_d, active_d,
@@ -3005,7 +3176,8 @@ class ContinuousEngine:
                     params, self._cache, tok_d, active_d, remaining_d,
                     rid, self.rng,
                 )
-            segs = [np.asarray(t) for t in segs]   # ONE sync
+            with self._led_device():
+                segs = [np.asarray(t) for t in segs]   # ONE sync
             now = time.perf_counter()
             was_active = self._active.copy()
             for toks in segs:
@@ -3095,7 +3267,8 @@ class ContinuousEngine:
                 jnp.zeros((self._b,), jnp.int32), self._rid_arr(),
                 self.rng,
             )
-            _, self._cache = self._first_refill_fn(*first_args)
+            with self._led_device(self._first_refill_fn):
+                _, self._cache = self._first_refill_fn(*first_args)
             self.cache_creations += 1
             self._c_creations.inc()
             self.recorder.record(
@@ -3222,10 +3395,12 @@ class ContinuousEngine:
                 )
             )
             t_cache, d_cache = self._cache
-        chaos_hook(
-            "engine.dispatch", phase="mixed",
-            rids=[r for r in self._req if r >= 0],
-        )
+        with self.ledger.measure("recovery"):
+            # Armed chaos delay books as recovery, never device.
+            chaos_hook(
+                "engine.dispatch", phase="mixed",
+                rids=[r for r in self._req if r >= 0],
+            )
         if self._adapter_pool is not None:
             # One fused program serves every tenant in the batch: the
             # stacked pool rides in as an argument (stable treedef →
@@ -3271,7 +3446,9 @@ class ContinuousEngine:
             reset_d = jnp.asarray(self._needs_reset.copy())
             reset_to_d = jnp.asarray(self._reset_to.copy())
             if self._speculative and self._adapter_pool is not None:
-                with annotate("engine.adapter_spec_mixed_step"):
+                with self._led_device(
+                    self._adapter_spec_mixed_step_fn
+                ), annotate("engine.adapter_spec_mixed_step"):
                     (first_tok, buffer, counts, acc, prop, tok_d, pos_d,
                      active_d, remaining_d, t_cache, d_cache) = (
                         self._adapter_spec_mixed_step_fn(
@@ -3287,7 +3464,9 @@ class ContinuousEngine:
                     active_d, pos_d, remaining_d, rid, self.rng,
                 )
             elif self._speculative:
-                with annotate("engine.spec_mixed_step"):
+                with self._led_device(
+                    self._spec_mixed_step_fn
+                ), annotate("engine.spec_mixed_step"):
                     (first_tok, buffer, counts, acc, prop, tok_d, pos_d,
                      active_d, remaining_d, t_cache, d_cache) = (
                         self._spec_mixed_step_fn(
@@ -3302,7 +3481,9 @@ class ContinuousEngine:
                     pos_d, remaining_d, rid, self.rng,
                 )
             elif self._adapter_pool is not None:
-                with annotate("engine.adapter_mixed_step"):
+                with self._led_device(
+                    self._adapter_mixed_step_fn
+                ), annotate("engine.adapter_mixed_step"):
                     first_tok, tok_d, active_d, remaining_d, self._cache = (
                         self._adapter_mixed_step_fn(
                             params, pool_t, aidx_d, self._cache, chunk_d,
@@ -3317,7 +3498,9 @@ class ContinuousEngine:
                     remaining_d, rid, self.rng,
                 )
             else:
-                with annotate("engine.mixed_step"):
+                with self._led_device(
+                    self._mixed_step_fn
+                ), annotate("engine.mixed_step"):
                     first_tok, tok_d, active_d, remaining_d, self._cache = (
                         self._mixed_step_fn(
                             params, self._cache, chunk_d, lengths_d,
@@ -3367,7 +3550,8 @@ class ContinuousEngine:
                 int(((self._aidx > 0) & occ).sum()) * len(segs)
             )
         for first_tok, buffer, counts, acc, prop, seg_completes in segs:
-            first_np = np.asarray(first_tok)   # each link's own sync
+            with self._led_device():
+                first_np = np.asarray(first_tok)   # each link's own sync
             now = time.perf_counter()
             for slot in seg_completes:
                 # Prompt complete: its first token came from this link's
@@ -3388,10 +3572,13 @@ class ContinuousEngine:
                 else:
                     self._active[slot] = True
             if self._speculative:
-                counts_np = np.asarray(counts)
-                buffer_np = np.asarray(buffer)
-                self._c_spec_acc.inc(int(np.asarray(acc).sum()))
-                self._c_spec_prop.inc(int(np.asarray(prop).sum()))
+                with self._led_device():
+                    counts_np = np.asarray(counts)
+                    buffer_np = np.asarray(buffer)
+                    acc_np = np.asarray(acc)
+                    prop_np = np.asarray(prop)
+                self._c_spec_acc.inc(int(acc_np.sum()))
+                self._c_spec_prop.inc(int(prop_np.sum()))
             for slot in range(b):
                 # Decode consumption: rows decoding at CHAIN START that
                 # are still live (a row retired while processing an
@@ -3464,50 +3651,99 @@ class ContinuousEngine:
         the installed tree overrides whatever ``params`` the caller
         still passes (a driver mid-rollout keeps handing in its stale
         copy), and ``step()`` may be called with no params at all."""
-        if self._staged_swap is not None:
-            self._try_commit_swap()
-        if self._installed is not None:
-            params, draft_params = self._installed
-        elif params is None:
-            raise TypeError(
-                "step() without params: no swapped-in weights installed "
-                "— pass params, or swap_weights() first"
-            )
-        self._check_draft_args(draft_params)
-        params, d_params = self._cast_params(params, draft_params)
-        retired: list[int] = []
-        with activate(self._mesh, self._rules):
-            # TTL eviction before admission: an expired queued request
-            # must not take a slot, and an expired in-flight one frees
-            # its slot for this step's admission.
-            self._sweep_deadlines()
-            self._admit()
-            # Decode-stall accounting: a dispatch "stalls decode" when
-            # rows were actively decoding but the dispatch advanced none
-            # of them (the split engine's refill). The SLO feed sees a
-            # 0/1 stall indicator per dispatch-with-active-rows, so a
-            # ``decode_stall_share`` target reads as the fraction of such
-            # dispatches that parked decode behind refill.
-            had_active = bool(self._active.any())
-            t0 = time.perf_counter()
-            try:
-                if self._mixed:
-                    # Wall time accrues to the program class that actually
-                    # ran: _mixed_dispatch's fallthroughs (cache creation and
-                    # speculative pure-refill → "refill", pure-decode block →
-                    # "decode") must land in refill_s/decode_s, not mixed_s,
-                    # or refill_frac understates refill serialization. A
-                    # "refill" here CAN hold active decode rows in exactly
-                    # one regime — the degradation ladder's split fallback
-                    # on a speculative engine — and then it stalls decode
-                    # like the split engine's refill does, so it books
-                    # stall time and the SLO stream sees it: the ladder is
-                    # driven by that monitor, and a degraded engine must
-                    # not blind the very telemetry that degraded it.
-                    kind = self._mixed_dispatch(params, d_params, retired)
-                    if kind:
+        # GOODPUT LEDGER: step() is the top-level frame — the whole
+        # iteration is COVERED wall, bucketed "sched" by default, and
+        # every specialized region inside (dispatch → device/compile,
+        # admission, page_alloc, kv_handoff, swap, recovery, telemetry)
+        # claims its own exclusive slice via nested frames. Time between
+        # step() calls is nobody's and derives as "idle". That is the
+        # whole reconciliation argument: Σ buckets == wall, gated.
+        with self.ledger.measure("sched"):
+            if self._staged_swap is not None:
+                self._try_commit_swap()
+            if self._installed is not None:
+                params, draft_params = self._installed
+            elif params is None:
+                raise TypeError(
+                    "step() without params: no swapped-in weights "
+                    "installed — pass params, or swap_weights() first"
+                )
+            self._check_draft_args(draft_params)
+            params, d_params = self._cast_params(params, draft_params)
+            retired: list[int] = []
+            with activate(self._mesh, self._rules):
+                # TTL eviction before admission: an expired queued request
+                # must not take a slot, and an expired in-flight one frees
+                # its slot for this step's admission.
+                self._sweep_deadlines()
+                self._admit()
+                # Decode-stall accounting: a dispatch "stalls decode" when
+                # rows were actively decoding but the dispatch advanced
+                # none of them (the split engine's refill). The SLO feed
+                # sees a 0/1 stall indicator per dispatch-with-active-
+                # rows, so a ``decode_stall_share`` target reads as the
+                # fraction of such dispatches that parked decode behind
+                # refill.
+                had_active = bool(self._active.any())
+                t0 = time.perf_counter()
+                try:
+                    if self._mixed:
+                        # Wall time accrues to the program class that
+                        # actually ran: _mixed_dispatch's fallthroughs
+                        # (cache creation and speculative pure-refill →
+                        # "refill", pure-decode block → "decode") must
+                        # land in refill_s/decode_s, not mixed_s, or
+                        # refill_frac understates refill serialization. A
+                        # "refill" here CAN hold active decode rows in
+                        # exactly one regime — the degradation ladder's
+                        # split fallback on a speculative engine — and
+                        # then it stalls decode like the split engine's
+                        # refill does, so it books stall time and the SLO
+                        # stream sees it: the ladder is driven by that
+                        # monitor, and a degraded engine must not blind
+                        # the very telemetry that degraded it.
+                        kind = self._mixed_dispatch(params, d_params, retired)
+                        if kind:
+                            dt = time.perf_counter() - t0
+                            with self.ledger.measure("telemetry"):
+                                if kind == "refill":
+                                    self._c_refill_s.inc(dt)
+                                    self._c_refill_n.inc()
+                                    if had_active:
+                                        self._c_stall_s.inc(dt)
+                                        if self.slo is not None:
+                                            self.slo.observe(
+                                                "decode_stall_share", 1.0
+                                            )
+                                    self.tracer.complete(
+                                        "engine.refill", t0, dt,
+                                        retired=len(retired),
+                                    )
+                                elif kind == "decode":
+                                    self._c_decode_s.inc(dt)
+                                    self._c_decode_n.inc()
+                                    self.tracer.complete(
+                                        "engine.decode", t0, dt,
+                                        retired=len(retired),
+                                    )
+                                    if had_active and self.slo is not None:
+                                        self.slo.observe(
+                                            "decode_stall_share", 0.0
+                                        )
+                                else:
+                                    self._c_mixed_s.inc(dt)
+                                    self._c_mixed_n.inc()
+                                    self.tracer.complete(
+                                        "engine.mixed", t0, dt,
+                                        retired=len(retired),
+                                    )
+                                    if had_active and self.slo is not None:
+                                        self.slo.observe(
+                                            "decode_stall_share", 0.0
+                                        )
+                    elif self._refill_dispatch(params, d_params, retired):
                         dt = time.perf_counter() - t0
-                        if kind == "refill":
+                        with self.ledger.measure("telemetry"):
                             self._c_refill_s.inc(dt)
                             self._c_refill_n.inc()
                             if had_active:
@@ -3517,56 +3753,33 @@ class ContinuousEngine:
                                         "decode_stall_share", 1.0
                                     )
                             self.tracer.complete(
-                                "engine.refill", t0, dt, retired=len(retired)
+                                "engine.refill", t0, dt,
+                                retired=len(retired),
                             )
-                        elif kind == "decode":
+                    elif self._decode_dispatch(params, d_params, retired):
+                        # Only DISPATCHED time accrues: an idle poll
+                        # (streaming drivers spin step() between
+                        # arrivals) must not drown the refill/decode
+                        # split.
+                        dt = time.perf_counter() - t0
+                        with self.ledger.measure("telemetry"):
                             self._c_decode_s.inc(dt)
                             self._c_decode_n.inc()
-                            self.tracer.complete(
-                                "engine.decode", t0, dt, retired=len(retired)
-                            )
                             if had_active and self.slo is not None:
                                 self.slo.observe("decode_stall_share", 0.0)
-                        else:
-                            self._c_mixed_s.inc(dt)
-                            self._c_mixed_n.inc()
                             self.tracer.complete(
-                                "engine.mixed", t0, dt, retired=len(retired)
+                                "engine.decode", t0, dt,
+                                retired=len(retired),
                             )
-                            if had_active and self.slo is not None:
-                                self.slo.observe("decode_stall_share", 0.0)
-                elif self._refill_dispatch(params, d_params, retired):
-                    dt = time.perf_counter() - t0
-                    self._c_refill_s.inc(dt)
-                    self._c_refill_n.inc()
-                    if had_active:
-                        self._c_stall_s.inc(dt)
-                        if self.slo is not None:
-                            self.slo.observe("decode_stall_share", 1.0)
-                    self.tracer.complete(
-                        "engine.refill", t0, dt, retired=len(retired)
-                    )
-                elif self._decode_dispatch(params, d_params, retired):
-                    # Only DISPATCHED time accrues: an idle poll (streaming
-                    # drivers spin step() between arrivals) must not drown
-                    # the refill/decode split.
-                    dt = time.perf_counter() - t0
-                    self._c_decode_s.inc(dt)
-                    self._c_decode_n.inc()
-                    if had_active and self.slo is not None:
-                        self.slo.observe("decode_stall_share", 0.0)
-                    self.tracer.complete(
-                        "engine.decode", t0, dt, retired=len(retired)
-                    )
-            except _RECOVERABLE_DISPATCH as e:
-                # Poison-request quarantine: strike every involved
-                # request, fail the repeat offenders, requeue the rest
-                # for probationary (solo) recompute — see
-                # _on_dispatch_fault. Infrastructure errors propagate.
-                self._on_dispatch_fault(e)
-            self._apply_degradation()
-        self._g_active.set(int(self._active.sum()))
-        self._g_queue.set(len(self._queue))
+                except _RECOVERABLE_DISPATCH as e:
+                    # Poison-request quarantine: strike every involved
+                    # request, fail the repeat offenders, requeue the rest
+                    # for probationary (solo) recompute — see
+                    # _on_dispatch_fault. Infrastructure errors propagate.
+                    self._on_dispatch_fault(e)
+                self._apply_degradation()
+            self._g_active.set(int(self._active.sum()))
+            self._g_queue.set(len(self._queue))
         return retired
 
     # --- stats -------------------------------------------------------------

@@ -246,17 +246,27 @@ def merge_registry_snapshots(
                 merged[k] += v
     if labels is not None:
         for label, host_snap in zip(labels, per_host):
-            # Prometheus label-value escaping (backslash first); keys
-            # that already carry labels must not be re-labeled — a
-            # fleet-of-fleets merge would nest malformed label sets.
+            # Prometheus label-value escaping (backslash first). Keys
+            # that already carry labels (the goodput ledger's
+            # 'name{bucket="..."}' series, per-stage trace histograms)
+            # get the replica label SPLICED into the existing set —
+            # 'name{bucket="x",replica="r0"}', one well-formed label
+            # set. A key already carrying replica= is the output of a
+            # previous labeled merge: re-labeling it would nest label
+            # dimensions, so that still raises.
             esc = str(label).replace("\\", "\\\\").replace('"', '\\"')
             for k, v in host_snap.items():
                 if "{" in k:
-                    raise ValueError(
-                        f"snapshot key {k!r} is already labeled — merge "
-                        "raw registry snapshots, not a labeled merge"
-                    )
-                merged[f'{k}{{replica="{esc}"}}'] = copy_of(v)
+                    if 'replica="' in k:
+                        raise ValueError(
+                            f"snapshot key {k!r} already carries a "
+                            "replica label — merge raw registry "
+                            "snapshots, not a labeled merge"
+                        )
+                    key = f'{k[:-1]},replica="{esc}"}}'
+                else:
+                    key = f'{k}{{replica="{esc}"}}'
+                merged[key] = copy_of(v)
     return merged
 
 

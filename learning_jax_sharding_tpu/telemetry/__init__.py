@@ -52,6 +52,10 @@ from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: F401
     artifact_dir,
     default_flight_recorder,
 )
+from learning_jax_sharding_tpu.telemetry.ledger import (  # noqa: F401
+    BUCKETS,
+    GoodputLedger,
+)
 from learning_jax_sharding_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,
@@ -69,6 +73,11 @@ from learning_jax_sharding_tpu.telemetry.spans import (  # noqa: F401
     Tracer,
     default_tracer,
     device_sync,
+)
+from learning_jax_sharding_tpu.telemetry.tracecontext import (  # noqa: F401
+    STAGES,
+    TraceStore,
+    merge_tracers,
 )
 from learning_jax_sharding_tpu.telemetry.watchdog import (  # noqa: F401
     Heartbeat,

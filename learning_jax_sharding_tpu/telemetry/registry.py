@@ -223,22 +223,50 @@ class MetricsRegistry:
             json.dump(self.snapshot(), f, indent=2, sort_keys=True)
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+        """Prometheus text exposition format 0.0.4.
+
+        Metric names may carry an inline label set
+        (``'ledger_seconds_total{bucket="device"}'`` — the goodput
+        ledger and per-stage trace histograms register one metric per
+        label value): every such series is grouped under its FAMILY name
+        (labels stripped) with ONE ``# TYPE``/``# HELP`` header, as the
+        exposition format requires — a per-series header with braces in
+        the metric name would be malformed.
+        """
+        import re
+
         fmt = _fmt_value
-        lines = []
+
+        def parsed(name):
+            m = re.match(r"([^{]+?)(\{.*\})?$", name)
+            return m.group(1), m.group(2) or ""
+
+        fams: dict[str, list] = {}
+        order: list[str] = []
         for m in self.metrics():
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            if m.kind in ("counter", "gauge"):
-                lines.append(f"{m.name} {fmt(m.value)}")
-            else:
-                for ub, c in m.cumulative():
-                    lines.append(
-                        f'{m.name}_bucket{{le="{fmt(ub)}"}} {c}'
-                    )
-                lines.append(f"{m.name}_sum {fmt(m.sum)}")
-                lines.append(f"{m.name}_count {m.count}")
+            fam, labels = parsed(m.name)
+            if fam not in fams:
+                fams[fam] = []
+                order.append(fam)
+            fams[fam].append((m, labels))
+        lines = []
+        for fam in order:
+            members = fams[fam]
+            help_text = next((m.help for m, _ in members if m.help), "")
+            if help_text:
+                lines.append(f"# HELP {fam} {help_text}")
+            lines.append(f"# TYPE {fam} {members[0][0].kind}")
+            for m, labels in members:
+                if m.kind in ("counter", "gauge"):
+                    lines.append(f"{fam}{labels} {fmt(m.value)}")
+                else:
+                    inner = labels[1:-1] + "," if labels else ""
+                    for ub, c in m.cumulative():
+                        lines.append(
+                            f'{fam}_bucket{{{inner}le="{fmt(ub)}"}} {c}'
+                        )
+                    lines.append(f"{fam}_sum{labels} {fmt(m.sum)}")
+                    lines.append(f"{fam}_count{labels} {m.count}")
         return "\n".join(lines) + "\n"
 
     def dump_prometheus(self, path) -> None:

@@ -136,6 +136,7 @@ def fit(
     recorder: Any | None = None,
     contract: Any | None = None,
     resilience: Any | None = None,
+    ledger: Any | None = None,
 ) -> tuple[Any, list[dict]]:
     """Train ``model`` on ``dataset`` for ``cfg.steps`` steps.
 
@@ -211,6 +212,19 @@ def fit(
             ``tests/test_zero_downtime.py``), and a watchdog escalation
             saves before it raises. Every action lands in the flight
             recorder.
+        ledger: optional
+            :class:`~learning_jax_sharding_tpu.telemetry.GoodputLedger`
+            — ``fit`` buckets its ENTIRE wall-clock: setup/contract/cost
+            analysis as ``compile``, checkpoint restore and every
+            resilience action (guarded skips, rollbacks, emergency
+            saves, chaos seams) as ``recovery``, the train-step dispatch
+            + loss sync as ``device`` (re-bucketed to ``compile`` when
+            the executable cache grew under the call), watchdog probes
+            and recorder/metrics bookkeeping as ``telemetry``, the
+            iteration's own host remainder as ``sched``. One created
+            against ``registry`` when omitted;
+            ``ledger.reconcile()["ok"]`` holds after fit returns (gated
+            in tier-1).
     """
     import math
     import signal
@@ -220,7 +234,9 @@ def fit(
     from learning_jax_sharding_tpu.robustness.recovery import PreemptionError
     from learning_jax_sharding_tpu.telemetry import (
         CompileWatch,
+        GoodputLedger,
         Tracer,
+        cache_size,
         default_flight_recorder,
     )
     from learning_jax_sharding_tpu.telemetry.watchdog import (
@@ -230,6 +246,8 @@ def fit(
 
     tr = tracer if tracer is not None else Tracer(enabled=False)
     rec = recorder if recorder is not None else default_flight_recorder()
+    led = ledger if ledger is not None else GoodputLedger(registry=registry)
+    led.begin_window()
     if tracer is not None:
         # Span closures (setup/restore/train_step, with durations) ride
         # the ring next to the step records — same feed the engine gives.
@@ -251,7 +269,10 @@ def fit(
     compile_watch = CompileWatch(registry=registry, recorder=rec)
     hb_owned = heartbeat is not None and not heartbeat.running
     optimizer = default_optimizer(cfg) if optimizer is None else optimizer
-    with tr.span("fit.setup"):
+    # Setup is compile-dominated wall (sharded init traces + compiles,
+    # make_train_step lowers, the contract check AOT-compiles) — one
+    # ledger frame buckets the whole launch cost as ``compile``.
+    with led.measure("compile"), tr.span("fit.setup"):
         loader = ShardedBatchLoader(
             dataset, mesh, cfg.global_batch_size, spec=("data",)
         )
@@ -315,7 +336,9 @@ def fit(
     ckpt = None
     start_step = 0
     if cfg.checkpoint_dir is not None:
-        with tr.span("fit.restore"):
+        # Restore is the recovery path by definition — resuming past a
+        # crash/preemption is time spent because something failed.
+        with led.measure("recovery"), tr.span("fit.restore"):
             ckpt = CheckpointManager(
                 cfg.checkpoint_dir,
                 max_to_keep=cfg.max_checkpoints,
@@ -332,7 +355,8 @@ def fit(
                 start_step = int(state.step)
                 rec.record("train_restore", step=start_step)
 
-    with tr.span("fit.cost_analysis"), activate(mesh, rules):
+    with led.measure("compile"), tr.span("fit.cost_analysis"), \
+            activate(mesh, rules):
         flops = compiled_flops(step_fn.jitted, state, sample)
     tokens_per_step = int(
         sample["inputs"].shape[0] * sample["inputs"].shape[1]
@@ -433,103 +457,156 @@ def fit(
     try:
         i = start_step
         while i < cfg.steps:
-            if sig["tripped"]:
-                saved = emergency_save("sigterm")
-                rec.record(
-                    "preemption", step=int(state.step), checkpointed=saved,
-                )
-                raise PreemptionError(int(state.step), cfg.checkpoint_dir)
-            chaos_hook("train.step", step=i + 1)
-            batch = next(batches) if batches is not None else loader.batch_at(i)
-            batch = chaos_hook("train.batch", value=batch, step=i + 1)
-            if watchdog is not None:
-                # Keep the async-probe window's batches for escalation.
-                recent[i + 1] = batch
-                for old in [s for s in recent if s <= i + 1 - (watchdog.lag + 2)]:
-                    del recent[old]
-            hb = (
-                heartbeat.expect(f"train_step {i + 1}")
-                if heartbeat is not None else contextlib.nullcontext()
-            )
-            with tr.span("train_step", step=i + 1), hb:
-                state, loss = step_fn(state, batch)
-                loss, gnorm = (
-                    (loss["loss"], loss.get("grad_norm"))
-                    if isinstance(loss, dict) else (loss, None)
-                )
-                # metrics.log's float(loss) is the step's honest sync
-                # point — inside the span (and the heartbeat's armed
-                # window), so the span measures the step, not its
-                # dispatch — and a wedged sync is flagged.
-                metrics.log(i + 1, loss=loss)
-            # The OBSERVED loss: the chaos seam can corrupt the host
-            # reading (the spike drill) without touching device state.
-            loss_f = chaos_hook("train.loss", value=float(loss), step=i + 1)
-            rec.record("train_step", step=i + 1, loss=loss_f)
-            if resilience is not None:
-                nonfinite = not math.isfinite(loss_f) or (
-                    gnorm is not None and not math.isfinite(float(gnorm))
-                )
-                if nonfinite:
-                    # The guarded step already refused the update; the
-                    # host books the skip and moves to the next batch.
-                    skips += 1
-                    if c_skips is not None:
-                        c_skips.inc()
-                    rec.record(
-                        "step_skipped", step=i + 1, loss=loss_f,
-                        consecutive=skips,
-                    )
-                    if skips > resilience.max_skips:
-                        emergency_save("skip_budget_exhausted")
-                        err = NonFiniteError(i + 1, "loss/grad_norm")
-                        bundle = rec.dump(
-                            registry=registry, tracer=tr, error=err
-                        )
-                        raise NonFiniteError(
-                            i + 1, "loss/grad_norm", bundle=bundle
-                        )
-                    i += 1
-                    continue
-                skips = 0
-                spiking = (
-                    resilience.rollback_on_spike
-                    and ema is not None
-                    and ema_seen >= resilience.spike_min_steps
-                    and abs(loss_f)
-                    > resilience.spike_factor * max(abs(ema), 1e-12)
-                )
-                if spiking:
-                    if (
-                        ckpt is not None
-                        and ckpt.latest_step() is not None
-                        and rollbacks < resilience.max_rollbacks
-                    ):
-                        rollbacks += 1
-                        ckpt.wait()   # the restore target may be in flight
-                        state = ckpt.restore_latest(like=state)
-                        i = int(state.step)
+            # The iteration's TOP-LEVEL ledger frame: everything the loop
+            # body spends lands in a bucket (nested frames claim their
+            # exclusive slices; the unclaimed remainder — batch fetch,
+            # checkpoint dispatch, loop bookkeeping — is the host
+            # scheduling tax itself). Gaps between iterations (a stalled
+            # loader upstream, the caller's own work) derive as idle, so
+            # Σ buckets == wall holds for the whole fit() window.
+            with led.measure("sched"):
+                if sig["tripped"]:
+                    with led.measure("recovery"):
+                        saved = emergency_save("sigterm")
                         rec.record(
-                            "loss_spike_rollback", step=i, loss=loss_f,
-                            ema=ema, rollbacks=rollbacks,
+                            "preemption", step=int(state.step),
+                            checkpointed=saved,
                         )
-                        reseek(i)
-                        ema = None
-                        ema_seen = 0
-                        continue
-                    rec.record(
-                        "loss_spike", step=i + 1, loss=loss_f, ema=ema,
+                        raise PreemptionError(
+                            int(state.step), cfg.checkpoint_dir
+                        )
+                with led.measure("recovery"):
+                    # An armed chaos seam spends its injected delay HERE
+                    # — fault time is recovery, never device/sched.
+                    chaos_hook("train.step", step=i + 1)
+                batch = (
+                    next(batches) if batches is not None
+                    else loader.batch_at(i)
+                )
+                with led.measure("recovery"):
+                    batch = chaos_hook(
+                        "train.batch", value=batch, step=i + 1
                     )
-                a = resilience.spike_ema_alpha
-                ema = loss_f if ema is None else (1 - a) * ema + a * loss_f
-                ema_seen += 1
-            if watchdog is not None:
-                watchdog.probe(i + 1, loss, gnorm)
-                if watchdog.tripped:
-                    escalate()
-            if ckpt is not None:
-                ckpt.save(i + 1, state)
-            i += 1
+                if watchdog is not None:
+                    # Keep the async-probe window's batches for escalation.
+                    with led.measure("telemetry"):
+                        recent[i + 1] = batch
+                        for old in [
+                            s for s in recent
+                            if s <= i + 1 - (watchdog.lag + 2)
+                        ]:
+                            del recent[old]
+                hb = (
+                    heartbeat.expect(f"train_step {i + 1}")
+                    if heartbeat is not None else contextlib.nullcontext()
+                )
+                # Compile-steal: opened as device, re-bucketed to compile
+                # when the step's executable cache grew under the call —
+                # the first iteration (and any mid-run recompile) paid a
+                # trace+compile, not a device step.
+                cache_before = cache_size(step_fn.jitted)
+                with led.measure("device") as frame, \
+                        tr.span("train_step", step=i + 1), hb:
+                    state, loss = step_fn(state, batch)
+                    loss, gnorm = (
+                        (loss["loss"], loss.get("grad_norm"))
+                        if isinstance(loss, dict) else (loss, None)
+                    )
+                    # metrics.log's float(loss) is the step's honest sync
+                    # point — inside the span (and the heartbeat's armed
+                    # window), so the span measures the step, not its
+                    # dispatch — and a wedged sync is flagged.
+                    metrics.log(i + 1, loss=loss)
+                    cache_after = cache_size(step_fn.jitted)
+                    if cache_after is not None and (
+                        cache_before is None or cache_after > cache_before
+                    ):
+                        frame.rebucket("compile")
+                # The OBSERVED loss: the chaos seam can corrupt the host
+                # reading (the spike drill) without touching device state.
+                with led.measure("recovery"):
+                    loss_f = chaos_hook(
+                        "train.loss", value=float(loss), step=i + 1
+                    )
+                with led.measure("telemetry"):
+                    rec.record("train_step", step=i + 1, loss=loss_f)
+                if resilience is not None:
+                    nonfinite = not math.isfinite(loss_f) or (
+                        gnorm is not None
+                        and not math.isfinite(float(gnorm))
+                    )
+                    if nonfinite:
+                        # The guarded step already refused the update; the
+                        # host books the skip and moves to the next batch.
+                        with led.measure("recovery"):
+                            skips += 1
+                            if c_skips is not None:
+                                c_skips.inc()
+                            rec.record(
+                                "step_skipped", step=i + 1, loss=loss_f,
+                                consecutive=skips,
+                            )
+                            if skips > resilience.max_skips:
+                                emergency_save("skip_budget_exhausted")
+                                err = NonFiniteError(
+                                    i + 1, "loss/grad_norm"
+                                )
+                                bundle = rec.dump(
+                                    registry=registry, tracer=tr,
+                                    error=err,
+                                )
+                                raise NonFiniteError(
+                                    i + 1, "loss/grad_norm", bundle=bundle
+                                )
+                            i += 1
+                            continue
+                    skips = 0
+                    spiking = (
+                        resilience.rollback_on_spike
+                        and ema is not None
+                        and ema_seen >= resilience.spike_min_steps
+                        and abs(loss_f)
+                        > resilience.spike_factor * max(abs(ema), 1e-12)
+                    )
+                    if spiking:
+                        if (
+                            ckpt is not None
+                            and ckpt.latest_step() is not None
+                            and rollbacks < resilience.max_rollbacks
+                        ):
+                            with led.measure("recovery"):
+                                rollbacks += 1
+                                # the restore target may be in flight
+                                ckpt.wait()
+                                state = ckpt.restore_latest(like=state)
+                                i = int(state.step)
+                                rec.record(
+                                    "loss_spike_rollback", step=i,
+                                    loss=loss_f, ema=ema,
+                                    rollbacks=rollbacks,
+                                )
+                                reseek(i)
+                                ema = None
+                                ema_seen = 0
+                                continue
+                        rec.record(
+                            "loss_spike", step=i + 1, loss=loss_f, ema=ema,
+                        )
+                    a = resilience.spike_ema_alpha
+                    ema = (
+                        loss_f if ema is None
+                        else (1 - a) * ema + a * loss_f
+                    )
+                    ema_seen += 1
+                if watchdog is not None:
+                    with led.measure("telemetry"):
+                        watchdog.probe(i + 1, loss, gnorm)
+                    if watchdog.tripped:
+                        with led.measure("recovery"):
+                            escalate()
+                if ckpt is not None:
+                    ckpt.save(i + 1, state)
+                i += 1
         if watchdog is not None:
             watchdog.flush()
             if watchdog.tripped:

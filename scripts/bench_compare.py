@@ -98,6 +98,20 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     (re.compile(r"mixed ([\d,.]+)\s*tok/s"), "mixed_tok_s", True),
     (re.compile(r"solo ([\d,.]+)\s*tok/s"), "solo_tok_s", True),
     (re.compile(r"([\d.]+)x solo"), "vs_solo_ratio", True),
+    # Round-14 goodput-ledger gates (bench.py's `[bench] goodput:` line):
+    # host_share is the fraction of the engine's busy wall spent outside
+    # the device bucket — THE number ROADMAP item 1 pushes down, so it
+    # regresses UPWARD; goodput_ratio (roofline seconds over window
+    # wall) regresses DOWNWARD; the telemetry self-overhead share must
+    # stay pinned near zero (perf_goodput.py's <2% budget); the
+    # trace-derived TTFT critical-path tails regress upward like every
+    # latency metric.
+    (re.compile(r"host_share ([\d,.]+)%"), "host_share_pct", False),
+    (re.compile(r"goodput_ratio ([\d,.]+)%"), "goodput_ratio_pct", True),
+    (re.compile(r"telemetry overhead ([\d,.]+)%"),
+     "telemetry_overhead_pct", False),
+    (re.compile(r"critical path p50 ([\d,.]+)\s*ms"), "ttft_cp_p50_ms",
+     False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

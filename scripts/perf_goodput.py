@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Telemetry self-overhead gate for the goodput ledger (PERF.md round 14).
+
+The round-14 observability layers (the goodput ledger's exclusive frame
+accounting + the request TraceStore) run INSIDE the serving hot loop, so
+they must price themselves: this script drives one saturated serving
+window with tracing fully armed and reads the ledger's own ``telemetry``
+bucket — the bookkeeping seconds the observability stack charged itself
+(recorder/SLO/span booking, trace-leg appends ride the same frames). The
+budget is **< 2% of window wall-clock**, asserted here and gated on the
+bench trajectory via the ``telemetry overhead X%`` pattern in
+``scripts/bench_compare.py``.
+
+Two drains of the same queue price the marginal cost too:
+
+* **untraced** — stock engine, no ``trace_sink`` (the ledger itself is
+  always on; it IS part of the product being priced);
+* **traced** — ``trace_sink`` armed with a registry-backed
+  :class:`~learning_jax_sharding_tpu.telemetry.TraceStore`, so every
+  retire folds a critical path into histograms.
+
+Both windows must reconcile (Σ buckets == wall within ε) — an overhead
+number from a leaking ledger would be meaningless.
+
+Usage:
+    python scripts/perf_goodput.py [--bench-lines] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+NREQ, NEW = 48, 32
+BUDGET = 0.02                       # telemetry bucket < 2% of wall
+
+
+def _build():
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+
+    # Wider than CONFIG_TINY on purpose: the overhead RATIO is the
+    # product here, and pricing fixed per-retire bookkeeping against a
+    # toy matmul would overstate the tax by an order of magnitude vs any
+    # real deployment. 256-wide keeps per-dispatch device work honest on
+    # the emulated mesh while the whole ladder stays sub-minute.
+    cfg = dataclasses.replace(
+        CONFIG_TINY, dtype=jnp.float32, features=256, hidden=1024,
+        num_layers=4, head_dim=64,
+    )
+    mesh = build_mesh((2, 4), ("data", "model"))
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(14)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=NREQ)
+    ]
+    return cfg, mesh, params, prompts
+
+
+def _drive(eng, params, prompts):
+    plen = {}
+    for p in prompts:
+        plen[eng.add_request(p)] = len(p)
+    while eng.has_work():
+        eng.step(params)
+    return sum(len(v) - plen[r] for r, v in eng.pop_finished().items())
+
+
+def run(traced: bool):
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.telemetry import TraceStore
+
+    cfg, mesh, params, prompts = _build()
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+        refill_chunk=16, decode_block_steps=16, mixed=True,
+    )
+    if traced:
+        eng.trace_sink = TraceStore(registry=eng.registry)
+    _drive(eng, params, prompts[:5])            # warm: compiles excluded
+    eng.ledger.begin_window()
+    t0 = time.perf_counter()
+    gen = _drive(eng, params, prompts)
+    dt = time.perf_counter() - t0
+    rep = eng.ledger.window_report()
+    rec = eng.ledger.reconcile()
+    assert rec["ok"], (
+        f"ledger failed to reconcile (traced={traced}): {rec}"
+    )
+    return dict(
+        traced=traced, tok_s=gen / dt, wall_s=rep["wall_s"],
+        telemetry_share=rep["telemetry_share"],
+        telemetry_s=rep["buckets"]["telemetry"],
+        host_share=rep["host_share"], reconcile_residual_s=rec["residual_s"],
+        traces=len(eng.trace_sink.completed()) if traced else 0,
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench-lines", action="store_true",
+                    help="print only the [bench] lines (for bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    plain = run(traced=False)
+    armed = run(traced=True)
+    ratio = armed["tok_s"] / plain["tok_s"]
+    line = (
+        f"[bench] goodput self-overhead (8-dev emulated, tracing armed): "
+        f"telemetry overhead {armed['telemetry_share'] * 100:.2f}% of wall "
+        f"({armed['telemetry_s'] * 1e3:.1f} ms of {armed['wall_s']:.2f} s, "
+        f"{armed['traces']} traces), traced {armed['tok_s']:,.0f} tok/s vs "
+        f"untraced {plain['tok_s']:,.0f} tok/s ({ratio:.2f}x)"
+    )
+    if args.json:
+        print(json.dumps({"untraced": plain, "traced": armed}, indent=2))
+    else:
+        print(line)
+    # The gate: the observability tax must stay inside its budget with
+    # everything armed. The untraced window rides the same assert — the
+    # ledger is always-on product code, not an opt-in probe.
+    for r in (plain, armed):
+        assert r["telemetry_share"] < BUDGET, (
+            f"telemetry self-overhead {r['telemetry_share']:.2%} breaches "
+            f"the {BUDGET:.0%} budget (traced={r['traced']})"
+        )
+    if not args.bench_lines and not args.json:
+        print(f"perf_goodput: telemetry share within {BUDGET:.0%} budget "
+              f"on both windows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

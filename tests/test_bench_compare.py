@@ -189,6 +189,38 @@ class TestExtraction:
         assert not by[f"{a16}:mixed_tok_s"]["regressed"]
         assert not by[f"{a4}:solo_tok_s"]["regressed"]
 
+    def test_goodput_gates_direction_aware(self):
+        """The round-14 ledger gates: host_share (the fraction of busy
+        wall spent OFF the device — the number ROADMAP item 1 pushes
+        down) and the telemetry self-overhead regress UP; goodput_ratio
+        regresses DOWN; the trace-derived TTFT critical-path p50 and p99
+        tails regress UP like every latency metric."""
+        line = (
+            "[bench] goodput: host_share 82.0%, goodput_ratio 6.25%, "
+            "top contributor sched (1.20 s of 5.00 s), telemetry "
+            "overhead 0.45%, TTFT critical path p50 220 ms / p99 410 "
+            "ms, reconcile ok (residual 0.12 ms)"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        assert m["goodput:host_share_pct"] == (82.0, False)
+        assert m["goodput:goodput_ratio_pct"] == (6.25, True)
+        assert m["goodput:telemetry_overhead_pct"] == (0.45, False)
+        assert m["goodput:ttft_cp_p50_ms"] == (220.0, False)
+        # The generic `p99 X ms` pattern picks up the tail too.
+        assert m["goodput:p99_ms"] == (410.0, False)
+        worse = _doc([
+            line.replace("host_share 82.0%", "host_share 99.1%")
+            .replace("goodput_ratio 6.25%", "goodput_ratio 3.00%")
+            .replace("telemetry overhead 0.45%", "telemetry overhead 1.90%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["goodput:host_share_pct"]["regressed"]
+        assert by["goodput:goodput_ratio_pct"]["regressed"]
+        assert by["goodput:telemetry_overhead_pct"]["regressed"]
+        assert not by["goodput:ttft_cp_p50_ms"]["regressed"]
+        assert not by["goodput:p99_ms"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

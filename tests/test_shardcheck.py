@@ -550,17 +550,23 @@ class TestSourceLint:
                     n = self.counts[slot].item()
                     self.out[slot].block_until_ready()
         """
-        assert self._rules(src) == ["host-sync-in-hot-loop"] * 3
+        # Each sync draws BOTH rules: it sits in a loop (hot-loop rule)
+        # and in an untimed engine phase (ledger-coverage rule).
+        assert sorted(self._rules(src)) == (
+            ["host-sync-in-hot-loop"] * 3 + ["untimed-engine-phase"] * 3
+        )
 
     def test_host_sync_outside_loop_clean(self):
         # The engine's single designed sync point per dispatch — after
-        # the loop — is the pattern the rule steers toward.
+        # the loop, inside a ledger frame — is the pattern both rules
+        # steer toward.
         src = """
         import numpy as np
 
         class ContinuousEngine:
             def step(self, params):
-                tok = np.asarray(self.dispatch(params))
+                with self._led_device(self._decode_fn):
+                    tok = np.asarray(self.dispatch(params))
                 for slot in self.slots:
                     self.retire(slot, tok[slot])
         """
@@ -593,6 +599,57 @@ class TestSourceLint:
                     stats = jax.device_get(self.counters)
         """
         assert self._rules(src) == ["host-sync-in-hot-loop"]
+
+    def test_untimed_engine_phase_flags_the_three_escape_hatches(self):
+        # The ledger's 100%-accounting invariant (round 14) dies the
+        # moment real work runs outside a frame. The rule names the
+        # three ways seconds escape a phase method: a compiled dispatch,
+        # a chaos seam, and a host sync.
+        src = """
+        import numpy as np
+
+        class ContinuousEngine:
+            def step(self, params):
+                chaos_hook("engine.dispatch", phase="decode")
+                out = self._decode_fn(params, self.state)
+                return np.asarray(out)
+        """
+        assert self._rules(src) == ["untimed-engine-phase"] * 3
+
+    def test_untimed_engine_phase_silent_inside_ledger_frames(self):
+        # The same three calls, each under a frame (`measure(...)` or
+        # the `_led_device` dispatch helper): every second lands in a
+        # bucket, nothing to flag.
+        src = """
+        import numpy as np
+
+        class ContinuousEngine:
+            def step(self, params):
+                with self.ledger.measure("recovery"):
+                    chaos_hook("engine.dispatch", phase="decode")
+                with self._led_device(self._decode_fn):
+                    out = self._decode_fn(params, self.state)
+                with self.ledger.measure("sched"):
+                    return np.asarray(out)
+        """
+        assert self._rules(src) == []
+
+    def test_untimed_engine_phase_only_gates_phase_methods(self):
+        # Helpers and non-Engine classes dispatch freely — only the
+        # named phases (step/_admit/..._dispatch) carry the ledger
+        # contract, and only on classes matching `Engine`.
+        src = """
+        import numpy as np
+
+        class ContinuousEngine:
+            def debug_dump(self):
+                return np.asarray(self._decode_fn(self.state))
+
+        class FleetRouter:
+            def step(self):
+                return self._route_fn(self.pending)
+        """
+        assert self._rules(src) == []
 
     def test_baseline_budget(self):
         fs = [

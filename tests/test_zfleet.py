@@ -632,3 +632,130 @@ class TestFailover:
             router.drain(max_steps=200)
         assert router._requests == {}
         assert router.inflight() == 0
+
+
+class TestGoodputTracing:
+    """Round 14: the fleet's wall-clock ledgers and request traces.
+
+    Every replica ledger must RECONCILE over a served window; every
+    retired request must carry a COMPLETE critical path whose trace id
+    was minted once at router admission and survived every hop — the KV
+    handoff, a mid-stream replica kill's reroute, and a rolling weight
+    swap's version pin."""
+
+    def test_disagg_ledgers_reconcile_and_paths_complete(self, served):
+        cfg, params, prompts = served
+        pre, dec, router = _disagg_fleet(cfg, params)
+        minted = {}
+        for i, p in enumerate(prompts):
+            router.add_request(p, rid=i)
+            minted[i] = router.traces.trace_of(i)
+        assert len(set(minted.values())) == len(prompts)
+        out = router.drain(max_steps=400)
+        assert sorted(out) == list(range(len(prompts)))
+
+        rep = router.goodput_report()
+        assert rep["reconcile_ok"], {
+            n: r["reconcile"] for n, r in rep["replicas"].items()
+        }
+        assert rep["fleet_buckets"]["device"] > 0.0
+        assert rep["fleet_buckets"]["kv_handoff"] > 0.0
+        assert rep["host_share"] is not None and 0 < rep["host_share"] <= 1
+
+        cps = {cp["rid"]: cp for cp in router.traces.completed()}
+        assert sorted(cps) == list(range(len(prompts)))
+        for i, cp in cps.items():
+            assert cp["trace_id"] == minted[i]      # the id never changed
+            assert cp["status"] == "ok"
+            for stage in ("queue", "prefill", "handoff", "decode"):
+                assert cp["stages"].get(stage, 0.0) > 0.0, (i, stage, cp)
+            assert cp["ttft_s"] is not None and cp["ttft_s"] > 0.0
+        # The merged Perfetto timeline carries both engine-dispatch
+        # tracks and the request tracks on one clock.
+        doc = router.merged_chrome_trace()
+        names = {
+            e["args"]["name"] for e in doc["traceEvents"]
+            if e["ph"] == "M"
+        }
+        assert {"replica prefill0", "replica decode0"} <= names
+        assert any(n.startswith("requests: ") for n in names)
+        prom = router.prometheus_text()
+        assert 'ledger_seconds_total{bucket="device",replica="' in prom
+        assert 'trace_stage_seconds_bucket{stage="handoff"' in prom
+
+    def test_trace_id_survives_a_mid_stream_reroute(self, served):
+        cfg, params, prompts = served
+        rec = FlightRecorder()
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 2),
+            batch_size=2, max_new_tokens=4, refill_chunk=8, recorder=rec,
+        )
+        router = FleetRouter(reps, recorder=rec)
+        with ChaosInjector(
+            Fault("fleet.step", "raise", at=2, count=1), recorder=rec,
+        ):
+            minted = {}
+            for i, p in enumerate(prompts):
+                router.add_request(p, rid=i)
+                minted[i] = router.traces.trace_of(i)
+            out = router.drain(max_steps=400)
+        dead = [r for r in reps if not r.alive]
+        assert len(dead) == 1
+        assert not any(
+            isinstance(v, RequestFailure) for v in out.values()
+        )
+        cps = {cp["rid"]: cp for cp in router.traces.completed()}
+        assert sorted(cps) == list(range(len(prompts)))
+        rerouted = [cp for cp in cps.values() if cp["reroutes"] >= 1]
+        assert rerouted, "the kill must mark at least one trace rerouted"
+        for cp in cps.values():
+            # SAME trace id end to end: the reroute appended spans and a
+            # marker to the existing trace, it minted nothing new.
+            assert cp["trace_id"] == minted[cp["rid"]]
+            assert cp["status"] == "ok"
+        for cp in rerouted:
+            r = router.traces.record(cp["rid"])
+            replicas = {s["replica"] for s in r["spans"]}
+            assert dead[0].name in replicas          # the wasted legs
+            assert len(replicas - {dead[0].name}) >= 1   # the survivor's
+            assert any(s["attrs"].get("wasted") for s in r["spans"])
+            assert cp["wasted_s"] >= 0.0
+            (ev,) = [e for e in r["events"] if e["name"] == "reroute"]
+            assert ev["replica"] == dead[0].name
+        # The fleet still accounts 100% of its (surviving) wall.
+        rep = router.goodput_report()
+        assert rep["reconcile_ok"]
+
+    def test_trace_pins_rolling_swap_versions(self, served):
+        cfg, params, prompts = served
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=4, refill_chunk=4,
+        )
+        router = FleetRouter(reps)
+        # Oversubscribe on purpose: the version pin lands on requests
+        # QUEUED at commit time (in-flight rows finish on the old
+        # version in drain mode), so the queues must outlast the slots.
+        queue = list(prompts) * 3
+        minted = {}
+        for i, p in enumerate(queue):
+            router.add_request(p, rid=i)
+            minted[i] = router.traces.trace_of(i)
+        router.step()
+        new_params = jax.tree.map(lambda x: x * 1.02, params)
+        timeline = router.rolling_swap(new_params, version=5)
+        assert [t["committed"] for t in timeline] == [True, True]
+        out = router.drain(max_steps=600)
+        assert sorted(out) == list(range(len(queue)))
+        cps = {cp["rid"]: cp for cp in router.traces.completed()}
+        assert sorted(cps) == list(range(len(queue)))
+        pinned = [cp for cp in cps.values() if cp["swap_pins"]]
+        assert pinned, "a queued request must carry the commit's pin"
+        for cp in pinned:
+            assert set(cp["swap_pins"]) == {5}
+            assert cp["trace_id"] == minted[cp["rid"]]
+        versions = {}
+        for rep in reps:
+            versions.update(rep.engine.finished_versions)
+        # Pinned traces really were served on the new weights.
+        assert all(versions[cp["rid"]] == 5 for cp in pinned)
