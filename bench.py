@@ -870,6 +870,42 @@ def bench_fleet():
             _log(line)
 
 
+def bench_kv_economy():
+    """KV economy A/B (round 15): the SAME 80%-prefix-overlap traffic
+    mix through K=4 paged replicas, prefix-aware (``KvEconomy`` wired:
+    placement scores predicted prefix-hit tokens, cold chains demote
+    HBM → host RAM, placed requests promote back on admission) vs
+    prefix-blind (round-11 load + burn score only).
+
+    Placement quality and the tier ladder are host/router machinery
+    over replica MULTIPLICITY, nothing chip-specific, so the A/B runs
+    on the emulated 8-device mesh in a subprocess
+    (``scripts/perf_kv_economy.py --bench-lines``) whose lines are
+    relayed, exactly like ``bench_fleet``. Tracked per config:
+    aggregate tok/s and fleet TTFT p99, plus the aware side's realized
+    prefix-hit rate, tier-miss rate, and kv bytes moved per request —
+    all gated direction-aware by ``scripts/bench_compare.py``."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent
+        / "scripts" / "perf_kv_economy.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--bench-lines"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"perf_kv_economy exited {proc.returncode}: {tail}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+
+
 def bench_tenancy():
     """Tenancy (round 12): zero-downtime weight hot-swap under load at
     125M, plus the multi-LoRA mixed-batch ladder.
@@ -1112,6 +1148,10 @@ def main():
         bench_fleet()
     except Exception as e:
         _log(f"[bench] fleet bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_kv_economy()
+    except Exception as e:
+        _log(f"[bench] kv economy bench skipped: {type(e).__name__}: {e}")
     try:
         bench_tenancy()
     except Exception as e:

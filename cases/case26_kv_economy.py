@@ -1,0 +1,157 @@
+"""Case 26 — the KV economy: prefix-aware placement + tier ladder.
+
+The round-15 subsystem on a 2-replica paged fleet ((1,1) sub-meshes on
+the emulated mesh) serving a shared-prefix traffic mix (four "tenant"
+system prompts, random tails):
+
+* **prefix-aware placement** — the router scores each replica by
+  ``depth + burn − prefix_weight × predicted-hit tokens``, where the
+  prediction walks the prompt's page-aligned chain against every
+  replica's HBM digest and host tier: same-tenant requests converge on
+  the tenant's home replica and realize their predicted tokens;
+* **the tier ladder** — ``maintain()`` write-backs cold retained
+  chains to the per-replica host ``TierStore`` (LRU + SLO-burn
+  demotion), ``promote()`` restores them (host first, then peer) on
+  placement, and every moved byte flows through the counted transfer
+  plans into the ledger's ``kv_handoff`` bucket;
+* **economics in the books** — ``latency_stats()`` carries
+  prefix_hit_rate / tier_miss_rate, ``tier_report()`` the per-tier
+  occupancy and byte flows, and the fleet ledger still reconciles.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case26``, else a
+temp dir): ``tier_report.json`` (per-replica tier occupancy + fleet
+demotion/promotion/byte totals + hit rates), ``metrics.prom`` (the
+labeled exposition carrying the ``fleet_tier_*`` and
+``fleet_prefix_*`` series).
+
+Run: ``python cases/case26_kv_economy.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FleetPolicy,
+    FleetRouter,
+    KvEconomy,
+    make_replicas,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    artifact_dir,
+)
+
+K, NREQ, NEW, PAGE, TENANTS = 2, 16, 4, 4, 4
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case26")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    cfg = dataclasses.replace(
+        CONFIG_TINY, dtype=jnp.float32, decode_attention="blocked",
+    )
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(26)
+    bases = [
+        rng.integers(1, cfg.vocab_size, size=(3 * PAGE,)).astype(np.int32)
+        for _ in range(TENANTS)
+    ]
+    prompts = [
+        np.concatenate([
+            bases[i % TENANTS],
+            rng.integers(1, cfg.vocab_size, size=(3,)).astype(np.int32),
+        ])
+        for i in range(NREQ)
+    ]
+
+    reps = make_replicas(
+        cfg, RULES_DP_TP, params, count=K, mesh_shape=(1, 1),
+        batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+        paged_pages=16, page_size=PAGE, prefix_cache=True,
+    )
+    econ = KvEconomy(hbm_retained_target=0, burn_threshold=1e9)
+    router = FleetRouter(
+        reps, policy=FleetPolicy(prefix_weight=0.5), kv_economy=econ,
+    )
+
+    # Warm pass: compiles (engine programs + the spill/fill pair and
+    # their transfer plans) and one request per tenant, so every chain
+    # has a home for placement to predict against.
+    for i, b in enumerate(bases):
+        router.add_request(
+            np.concatenate([b, np.asarray([7 + i], np.int32)]),
+            rid=1000 + i,
+        )
+    while router.has_work():
+        router.step()
+    router.pop_finished()
+
+    print(f"case26: routing {NREQ} requests ({TENANTS} tenants, "
+          f"3-page shared prefixes) through K={K} paged replicas")
+    router.reset_stats()
+    for i, p in enumerate(prompts):
+        router.add_request(p, rid=i)
+    results, steps = {}, 0
+    while router.has_work():
+        router.step()
+        results.update(router.pop_finished())
+        steps += 1
+        if steps > 2000:
+            raise RuntimeError("fleet wedged")
+    results.update(router.pop_finished())
+    assert len(results) == NREQ, sorted(results)
+
+    stats = router.latency_stats()
+    report = econ.tier_report()
+    report["latency"] = {
+        k: stats[k]
+        for k in ("prefix_hit_rate", "tier_miss_rate", "requests",
+                  "generated")
+    }
+    assert stats["prefix_hit_rate"] > 0.5, stats
+    assert router.goodput_report()["reconcile_ok"]
+
+    print(f"  prefix hit rate  {stats['prefix_hit_rate']:.0%}   "
+          f"tier miss rate {stats['tier_miss_rate']:.0%}")
+    print(f"  demotions {report['demotions']}  promotions "
+          f"{report['promotions']} (peer {report['peer_promotions']})  "
+          f"spill {report['spill_bytes'] / 1e3:.0f} kB  "
+          f"fill {report['fill_bytes'] / 1e3:.0f} kB")
+    for name, r in sorted(report["replicas"].items()):
+        print(f"  {name}: hbm retained {r['hbm_retained_pages']} pages, "
+              f"host tier {r['host_pages']} pages "
+              f"({r['host_bytes'] / 1e3:.0f} kB)")
+
+    (out / "tier_report.json").write_text(json.dumps(report, indent=2))
+    (out / "metrics.prom").write_text(router.prometheus_text())
+    print(f"case26: artifacts in {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -385,6 +385,67 @@ def _kv_transfer_programs() -> list[EntryProgram]:
     ]
 
 
+def _kv_page_programs() -> list[EntryProgram]:
+    """The KV tier ladder's device programs (round 15 —
+    ``fleet/kv_economy.py`` rides between them): ``kv_page_spill``
+    gathers one physical page's K/V leaves for demotion to the host
+    tier, ``kv_page_fill`` writes a promoted page back into a freshly
+    allocated pool slot. Their goldens pin the tier ladder's claim that
+    demotion/promotion is pure LOCAL page movement — every cross-tier
+    byte travels in the counted ``HostBuffer`` transfer plans, and the
+    device side adds ZERO collectives. Built like the handoff programs
+    but on a PAGED prefix-cache engine (the only kind that tiers): one
+    short serve retains a prefix chain, spill + fill of its deepest
+    page populate the dispatch-arg caches, then each program relowers
+    AOT under its contract name."""
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.models.transformer import Transformer
+    from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
+
+    mesh = _mesh24()
+    built: dict = {}
+
+    def ensure():
+        if built:
+            return built["hlo"]
+        cfg = dataclasses.replace(_tiny_cfg(), decode_attention="blocked")
+        params = _sharded_serving_params(
+            Transformer(cfg), mesh, RULES_TP_SERVING
+        )
+        eng = ContinuousEngine(
+            cfg, mesh, RULES_TP_SERVING,
+            batch_size=2, max_new_tokens=4, refill_chunk=16,
+            paged_pages=10, page_size=4, prefix_cache=True,
+        )
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(
+            1, cfg.vocab_size, size=(9,)
+        ).astype(np.int32)
+        eng.serve(params, [prompt])
+        (key, *_) = eng.retained_prefixes()
+        rows, _ = eng.spill_page(key, drop=True)
+        eng.fill_page(key, rows)
+        built["eng"] = eng
+        built["hlo"] = {
+            eng.contract_name(k): v for k, v in eng.program_hlo().items()
+        }
+        return built["hlo"]
+
+    def explain():
+        if "sf" not in built:
+            ensure()
+            built["sf"] = built["eng"].explain_collectives()
+        return built["sf"]
+
+    return [
+        EntryProgram(
+            name, mesh, lambda name=name: ensure()[name],
+            shardflow=lambda name=name: explain()[name],
+        )
+        for name in ("kv_page_spill", "kv_page_fill")
+    ]
+
+
 def _swap_reshard_programs() -> list[EntryProgram]:
     """The weight-hot-swap staging programs (round 12). When
     ``ContinuousEngine.swap_weights`` stages a checkpoint that arrives in
@@ -659,6 +720,7 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         _zero1_q8(),
         *_serving_programs(),
         *_kv_transfer_programs(),
+        *_kv_page_programs(),
         *_swap_reshard_programs(),
         _moe_dispatch(),
         _seq_attention("ring_attention"),

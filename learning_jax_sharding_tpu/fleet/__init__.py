@@ -6,9 +6,14 @@ Layers: :mod:`.policies` (placement + fleet shedding policy),
 :mod:`.replica` (one engine on its sub-mesh; builders), :mod:`.router`
 (admission, handoff, failover, fleet telemetry), :mod:`.kv_transfer`
 (the arXiv-2112.01075-style resharding transfer plan the KV handoff
-rides).
+rides), :mod:`.kv_economy` (round 15: prefix-aware placement + the
+HBM → host → peer KV tier ladder).
 """
 
+from learning_jax_sharding_tpu.fleet.kv_economy import (  # noqa: F401
+    KvEconomy,
+    TierStore,
+)
 from learning_jax_sharding_tpu.fleet.kv_transfer import (  # noqa: F401
     DEFAULT_PAGE_TOKENS,
     Segment,

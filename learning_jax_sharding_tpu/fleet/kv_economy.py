@@ -1,0 +1,492 @@
+"""The KV economy (round 15): prefix-aware placement + the KV tier
+ladder — ROADMAP item 3, the difference between K independent engines
+and ONE coherent serving system.
+
+Millions of users means massive SHARED prefixes (system prompts,
+few-shot headers, per-tenant tool schemas) and far more warm KV than
+HBM. Two mechanisms, one module:
+
+* **Prefix-aware placement** — every paged+prefix replica exports a
+  queryable digest of its prefix registry
+  (``ContinuousEngine.prefix_digest``: one 8-byte hash per page-aligned
+  retained token prefix, epoch-invalidated on any registry change).
+  :meth:`KvEconomy.predicted_hits` hashes an arriving prompt's page
+  chain and walks it against each replica's digest AND its host tier,
+  predicting the longest LOCALLY-servable prefix per replica in tokens;
+  :class:`~.policies.FleetPolicy` subtracts ``prefix_weight ×
+  hit_tokens`` from the placement score, so the router lands a request
+  where its prefix already lives instead of re-prefilling it somewhere
+  idle. The prediction is recorded on the trace and compared against
+  the REALIZED hit at admission — a page evicted mid-route is a counted
+  graceful miss (the request just re-prefills), never a wrong token.
+
+* **The tier ladder, HBM → host RAM → peer replica** — each replica
+  gets a :class:`TierStore` (host-RAM LRU with a byte budget).
+  :meth:`KvEconomy.maintain` (called from every ``FleetRouter.step``)
+  DEMOTES: when a replica retains more reference-free prefix pages than
+  its HBM watermark — or is burning SLO budget, which demotes
+  aggressively to free pages for live work — the coldest pages spill to
+  its host tier (``engine.spill_page`` → the counted
+  ``parallel.resharding`` host plan; every byte priced, booked to the
+  ledger's ``kv_handoff`` bucket). :meth:`KvEconomy.promote` (called by
+  the router at placement) PROMOTES: the placed prompt's missing chain
+  pages fill back from the local host tier, a live peer's host tier, or
+  a NON-DESTRUCTIVE read of a peer's HBM (``spill_page(drop=False)``)
+  — stopping at the first page no tier holds, because a prefix chain is
+  only usable contiguously. Tier entries are stamped with the spilling
+  engine's ``weights_version``; a version mismatch is a MISS and drops
+  the entry (stale K/V is never served — the swap-commit registry flush
+  invalidates digests the same way).
+
+Host-side policy only: nothing here dispatches device code — the
+engine's golden-pinned ``kv_page_spill``/``kv_page_fill`` programs and
+the counted host plans do all the moving.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any
+
+
+class TierStore:
+    """One replica's HOST-RAM KV tier: page-key → spilled host rows,
+    LRU-ordered under a byte budget.
+
+    Entries carry the ``weights_version`` the K/V was computed under;
+    :meth:`get`/:meth:`peek` return rows only on a version match (a
+    mismatch can never become valid again — versions are monotone — so
+    :meth:`get` drops it). The store holds ``numpy`` buffers only: a
+    replica death takes its host tier with it
+    (:meth:`KvEconomy.on_replica_death`), exactly like a real process
+    exit would."""
+
+    def __init__(self, capacity_bytes: int = 64 << 20):
+        if capacity_bytes < 1:
+            raise ValueError("TierStore needs a positive byte budget")
+        self.capacity_bytes = int(capacity_bytes)
+        self._pages: OrderedDict[bytes, dict] = OrderedDict()
+        self.bytes_held = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._pages
+
+    def has(self, key: bytes, *, version: int) -> bool:
+        ent = self._pages.get(key)
+        return ent is not None and ent["version"] == version
+
+    def put(self, key: bytes, rows, *, version: int, nbytes: int) -> int:
+        """Insert (or refresh) an entry, then evict LRU-oldest entries
+        past the byte budget. Returns the bytes evicted making room."""
+        old = self._pages.pop(key, None)
+        if old is not None:
+            self.bytes_held -= old["bytes"]
+        self._pages[key] = {
+            "rows": rows, "version": int(version), "bytes": int(nbytes),
+        }
+        self.bytes_held += int(nbytes)
+        evicted = 0
+        while self.bytes_held > self.capacity_bytes and len(self._pages) > 1:
+            _, ent = self._pages.popitem(last=False)
+            self.bytes_held -= ent["bytes"]
+            self.evictions += 1
+            evicted += ent["bytes"]
+        return evicted
+
+    def get(self, key: bytes, *, version: int):
+        """Rows for ``key`` at ``version``, LRU-refreshed — or ``None``.
+        A version mismatch drops the entry (stale K/V is dead weight)."""
+        ent = self._pages.get(key)
+        if ent is None:
+            return None
+        if ent["version"] != version:
+            self._pages.pop(key)
+            self.bytes_held -= ent["bytes"]
+            return None
+        self._pages.move_to_end(key)
+        return ent["rows"]
+
+    def peek(self, key: bytes, *, version: int):
+        """Non-destructive :meth:`get` for PEER reads: no LRU refresh,
+        and a version mismatch leaves the entry alone — it may still be
+        valid for the owning replica (mid-rolling-swap fleets run mixed
+        versions)."""
+        ent = self._pages.get(key)
+        if ent is None or ent["version"] != version:
+            return None
+        return ent["rows"]
+
+    def drop_all(self) -> int:
+        dropped = self.bytes_held
+        self._pages.clear()
+        self.bytes_held = 0
+        return dropped
+
+
+class KvEconomy:
+    """Fleet-wide KV-economy coordinator: owns one :class:`TierStore`
+    per eligible replica and the demotion/promotion policy knobs.
+
+    Attach via ``FleetRouter(..., kv_economy=KvEconomy(...))`` — the
+    router calls :meth:`predicted_hits`/:meth:`promote` at placement,
+    :meth:`maintain` each step, :meth:`on_replica_death` at failover,
+    and :meth:`on_finish` at retirement (predicted-vs-realized books).
+
+    Knobs:
+
+    * ``host_bytes_per_replica`` — each host tier's byte budget.
+    * ``hbm_retained_target`` — retained reference-free pages a replica
+      may keep in HBM before :meth:`maintain` demotes the coldest
+      (default: half its page pool).
+    * ``burn_threshold`` — a replica whose worst SLO burn rate exceeds
+      this demotes EVERYTHING reference-free: error budget buys HBM
+      headroom for live work before the degradation ladder has to act.
+    * ``peer_fill`` — whether promotion may read a peer replica's host
+      tier or HBM (the third tier rung) when local tiers miss.
+
+    Eligibility: paged + prefix-cache, non-speculative replicas (the
+    engine enforces the same for spill/fill). A mixed fleet is fine —
+    ineligible replicas simply score no prefix bonus and hold no tier.
+    """
+
+    def __init__(
+        self,
+        *,
+        host_bytes_per_replica: int = 64 << 20,
+        hbm_retained_target: int | None = None,
+        burn_threshold: float = 2.0,
+        peer_fill: bool = True,
+        demote_min_reuse: int = 1,
+    ):
+        self.host_bytes_per_replica = int(host_bytes_per_replica)
+        self.hbm_retained_target = hbm_retained_target
+        self.burn_threshold = float(burn_threshold)
+        self.peer_fill = bool(peer_fill)
+        # Only pay the device→host copy for chain keys that arrivals
+        # have named at least this many times (demonstrated reuse): at
+        # the default 1 every cold chain is backed up; at 2+ one-shot
+        # prompts ride the free HBM LRU and never cost a transfer.
+        self.demote_min_reuse = int(demote_min_reuse)
+        self._router = None
+        self._tiers: dict[str, TierStore] = {}
+        self._page_size: int | None = None
+        self._chain_refs: dict[bytes, int] = {}   # key → arrival count
+
+    # --- wiring -----------------------------------------------------------
+
+    @staticmethod
+    def eligible(rep) -> bool:
+        eng = rep.engine
+        return bool(
+            getattr(eng, "_paged", False)
+            and getattr(eng, "_prefix", False)
+            and not getattr(eng, "_speculative", False)
+        )
+
+    def attach(self, router) -> None:
+        """Bind to a router: one host tier per eligible replica, and the
+        economy's counters/gauges on the ROUTER registry (fleet-scoped
+        metrics live with the fleet, per-engine spill/fill bytes with
+        each engine)."""
+        if self._router is not None and self._router is not router:
+            raise RuntimeError("KvEconomy is already attached to a router")
+        self._router = router
+        sizes = set()
+        for name, rep in router.replicas.items():
+            if self.eligible(rep):
+                self._tiers[name] = TierStore(self.host_bytes_per_replica)
+                sizes.add(rep.engine._page_size)
+        if len(sizes) > 1:
+            # One prompt → one page chain: mixed page sizes would make
+            # the same prefix hash to different keys per replica.
+            raise ValueError(
+                f"tiered replicas disagree on page_size: {sorted(sizes)}"
+            )
+        self._page_size = sizes.pop() if sizes else None
+        r = router.registry
+        self._c_demotions = r.counter(
+            "fleet_tier_demotions_total",
+            "prefix pages demoted HBM → host tier")
+        self._c_promotions = r.counter(
+            "fleet_tier_promotions_total",
+            "prefix pages promoted into HBM from any tier")
+        self._c_peer = r.counter(
+            "fleet_tier_peer_promotions_total",
+            "promoted pages sourced from a PEER replica (host or HBM)")
+        self._c_evictions = r.counter(
+            "fleet_tier_evictions_total",
+            "host-tier entries LRU-evicted past the byte budget")
+        self._c_spill_bytes = r.counter(
+            "fleet_tier_spill_bytes_total",
+            "bytes moved HBM → host by demotion sweeps")
+        self._c_fill_bytes = r.counter(
+            "fleet_tier_fill_bytes_total",
+            "bytes moved into HBM by promotions")
+        self._c_pred_tokens = r.counter(
+            "fleet_prefix_predicted_tokens_total",
+            "prefix-hit tokens the placement score predicted")
+        self._c_real_tokens = r.counter(
+            "fleet_prefix_realized_tokens_total",
+            "prefix-hit tokens admissions actually realized")
+        self._c_misroutes = r.counter(
+            "fleet_prefix_misroutes_total",
+            "finished requests whose realized hit fell short of the "
+            "routing prediction (tier race — graceful re-prefill)")
+        self._g_host_pages = r.gauge(
+            "fleet_tier_host_pages", "pages held across all host tiers")
+        self._g_host_bytes = r.gauge(
+            "fleet_tier_host_bytes", "bytes held across all host tiers")
+
+    def tier_of(self, name: str) -> TierStore | None:
+        return self._tiers.get(name)
+
+    # --- prefix-aware placement -------------------------------------------
+
+    def _chain(self, prompt) -> list[bytes]:
+        # Page-aligned prefix keys, shallowest first — the engine's own
+        # admission bound: the LAST prompt token always recomputes (its
+        # logits seed generation), so a full-length prompt of exactly k
+        # pages chains k-1 deep, not k.
+        ps = self._page_size
+        if ps is None or prompt.size <= ps:
+            return []
+        return [
+            prompt[: k * ps].tobytes()
+            for k in range(1, (int(prompt.size) - 1) // ps + 1)
+        ]
+
+    def predicted_hits(self, prompt) -> dict[str, int]:
+        """Replica name → predicted prefix-hit TOKENS for ``prompt``,
+        counting only what the replica can serve LOCALLY (HBM digest +
+        its own host tier). Peer pages are deliberately excluded: every
+        replica can reach them, so they carry no placement signal —
+        they are promotion's fallback, not routing's.
+
+        The router calls this exactly once per arrival, so it doubles
+        as the economy's demand census: each chain key's arrival count
+        feeds the ``demote_min_reuse`` admission filter (bounded by the
+        number of distinct chain keys the fleet has ever seen)."""
+        out: dict[str, int] = {}
+        chain = self._chain(prompt)
+        for key in chain:
+            self._chain_refs[key] = self._chain_refs.get(key, 0) + 1
+        for name, rep in self._router.replicas.items():
+            tier = self._tiers.get(name)
+            if tier is None or not rep.alive:
+                continue
+            eng = rep.engine
+            _, digest = eng.prefix_digest()
+            version = eng.weights_version
+            depth = 0
+            for k, key in enumerate(chain, start=1):
+                if (
+                    eng.prefix_hash(key) in digest
+                    or tier.has(key, version=version)
+                ):
+                    depth = k
+                else:
+                    break
+            out[name] = depth * self._page_size
+        return out
+
+    def promote(self, rep, prompt) -> int:
+        """ON-ADMISSION PROMOTION: fill ``prompt``'s missing chain pages
+        into ``rep``'s HBM — local host tier first, then (``peer_fill``)
+        a live peer's host tier or a non-destructive read of its HBM —
+        stopping at the first page no tier holds. Resident ancestors are
+        LRU-touched first so promoting a descendant cannot evict the
+        chain out from under itself. Returns pages promoted; a page-pool
+        exhaustion stops quietly (promotion yields to live work — the
+        admission simply realizes a shorter hit)."""
+        name = rep.name
+        tier = self._tiers.get(name)
+        if tier is None or not rep.alive:
+            return 0
+        eng = rep.engine
+        chain = self._chain(prompt)
+        if not chain:
+            return 0
+        version = eng.weights_version
+        _, digest = eng.prefix_digest()
+        resident = [k for k in chain if eng.prefix_hash(k) in digest]
+        missing = len(resident) < len(chain)
+        if missing and eng._cache is None:
+            eng.ensure_cache(rep.params)
+        for key in resident:
+            eng.touch_prefix(key)
+        promoted = 0
+        for key in chain:
+            if eng.prefix_hash(key) in digest:
+                continue
+            rows, src = tier.get(key, version=version), "host"
+            if rows is None and self.peer_fill:
+                rows, src = self._peer_read(name, key, version)
+            if rows is None:
+                break          # chain broken: deeper pages are unusable
+            try:
+                st = eng.fill_page(key, rows)
+            except RuntimeError:
+                break          # page pool exhausted: yield to live work
+            promoted += 1
+            self._c_promotions.inc()
+            self._c_fill_bytes.inc(st["bytes"])
+            if src == "peer":
+                self._c_peer.inc()
+            self._router.recorder.record(
+                "fleet.kv_promote", replica=name, src=src,
+                bytes=st["bytes"],
+            )
+        return promoted
+
+    def _peer_read(self, name: str, key: bytes, version: int):
+        """The third tier rung: a live peer's host tier, else a
+        non-destructive spill of the peer's OWN resident page — the
+        peer keeps serving its copy; we pay the (counted) wire bytes."""
+        for peer_name in sorted(self._tiers):
+            if peer_name == name:
+                continue
+            peer = self._router.replicas.get(peer_name)
+            if peer is None or not peer.alive:
+                continue
+            if peer.engine.weights_version != version:
+                continue       # mixed-version fleet: never cross-fill
+            rows = self._tiers[peer_name].peek(key, version=version)
+            if rows is not None:
+                return rows, "peer"
+            if peer.engine.prefix_hash(key) in peer.engine.prefix_digest()[1]:
+                try:
+                    rows, _ = peer.engine.spill_page(key, drop=False)
+                except (KeyError, RuntimeError):
+                    continue   # raced away / not readable — next peer
+                return rows, "peer"
+        return None, "none"
+
+    # --- demotion ---------------------------------------------------------
+
+    def _retained_target(self, eng) -> int:
+        if self.hbm_retained_target is not None:
+            return int(self.hbm_retained_target)
+        return max(1, (eng._paged_pages - 1) // 2)
+
+    def maintain(self) -> int:
+        """One DEMOTION sweep (the router calls this every step): each
+        replica spills its LRU-coldest reference-free pages to its host
+        tier while it retains more than its HBM watermark — or ALL of
+        them while its SLO burn exceeds ``burn_threshold`` (error
+        budget buys page-pool headroom before the ladder degrades).
+        Pages the tier ALREADY holds at the live weights version are
+        skipped, not re-spilled: their HBM copy is pure cache that the
+        engine's own LRU can evict for free, so repeating the
+        device→host transfer every sweep would be pure churn.
+        Returns pages demoted fleet-wide."""
+        demoted = 0
+        for name in sorted(self._tiers):
+            rep = self._router.replicas.get(name)
+            if rep is None or not rep.alive:
+                continue
+            eng = rep.engine
+            tier = self._tiers[name]
+            retained = eng.retained_prefixes()        # LRU-oldest first
+            target = self._retained_target(eng)
+            # Steady state demotes by WRITE-BACK (copy to host, leave
+            # the HBM page as evict-for-free cache — the engine's own
+            # LRU reclaims it under genuine pool pressure, and a page
+            # the tier backs is lossless to drop). Only a burning SLO
+            # budget force-drops, buying pool headroom immediately.
+            hot = self._router.policy.burn_rate(rep) > self.burn_threshold
+            if hot:
+                target = 0
+            for key in retained[: max(0, len(retained) - target)]:
+                if not hot and (
+                    tier.has(key, version=eng.weights_version)
+                    or self._chain_refs.get(key, 0) < self.demote_min_reuse
+                ):
+                    continue
+                try:
+                    rows, st = eng.spill_page(key, drop=hot)
+                except (KeyError, RuntimeError):
+                    continue   # became shared/unregistered since listing
+                evicted = tier.put(
+                    key, rows,
+                    version=eng.weights_version, nbytes=st["bytes"],
+                )
+                demoted += 1
+                self._c_demotions.inc()
+                self._c_spill_bytes.inc(st["bytes"])
+                if evicted:
+                    self._c_evictions.inc()
+                self._router.recorder.record(
+                    "fleet.kv_demote", replica=name, bytes=st["bytes"],
+                    host_evicted_bytes=evicted,
+                )
+        self._g_host_pages.set(sum(len(t) for t in self._tiers.values()))
+        self._g_host_bytes.set(
+            sum(t.bytes_held for t in self._tiers.values())
+        )
+        return demoted
+
+    # --- lifecycle hooks ---------------------------------------------------
+
+    def on_replica_death(self, name: str) -> None:
+        """A replica's host tier dies with its process: drop it whole —
+        peers must recompute from the prompt, NEVER serve KV whose owner
+        can no longer vouch for it (stale/partial pages are the one
+        thing the tier ladder must not produce)."""
+        tier = self._tiers.pop(name, None)
+        if tier is None:
+            return
+        dropped = tier.drop_all()
+        self._g_host_pages.set(sum(len(t) for t in self._tiers.values()))
+        self._g_host_bytes.set(
+            sum(t.bytes_held for t in self._tiers.values())
+        )
+        self._router.recorder.record(
+            "fleet.tier_dropped", replica=name, bytes=dropped,
+        )
+
+    def on_finish(self, predicted: int, realized: int | None) -> None:
+        """Predicted-vs-realized books, fed by ``FleetRouter._finish``."""
+        self._c_pred_tokens.inc(int(predicted))
+        if realized is not None:
+            self._c_real_tokens.inc(int(realized))
+            if realized < predicted:
+                self._c_misroutes.inc()
+
+    # --- reporting ---------------------------------------------------------
+
+    def tier_report(self) -> dict:
+        """JSON-able per-replica tier occupancy + fleet movement totals
+        — the ``case26`` artifact and the bench's bytes-moved-per-tier
+        breakdown."""
+        per: dict[str, dict] = {}
+        for name in sorted(self._tiers):
+            rep = self._router.replicas.get(name)
+            tier = self._tiers[name]
+            eng = rep.engine if rep is not None else None
+            per[name] = {
+                "alive": bool(rep is not None and rep.alive),
+                "hbm_retained_pages": (
+                    len(eng.retained_prefixes()) if eng is not None else 0
+                ),
+                "host_pages": len(tier),
+                "host_bytes": tier.bytes_held,
+                "host_evictions": tier.evictions,
+            }
+        return {
+            "replicas": per,
+            "page_size": self._page_size,
+            "host_bytes_per_replica": self.host_bytes_per_replica,
+            "demotions": int(self._c_demotions.value),
+            "promotions": int(self._c_promotions.value),
+            "peer_promotions": int(self._c_peer.value),
+            "host_evictions": int(self._c_evictions.value),
+            "spill_bytes": int(self._c_spill_bytes.value),
+            "fill_bytes": int(self._c_fill_bytes.value),
+            "predicted_tokens": int(self._c_pred_tokens.value),
+            "realized_tokens": int(self._c_real_tokens.value),
+            "misroutes": int(self._c_misroutes.value),
+        }

@@ -43,10 +43,21 @@ class FleetPolicy:
     ties decisively while healthy replicas are balanced purely by load.
     Ties break on replica name: routing is deterministic, so a fleet
     replay routes identically.
+
+    PREFIX-AWARE placement (round 15): when the router supplies
+    predicted prefix-hit tokens (from :class:`~.kv_economy.KvEconomy`
+    digest queries), the score SUBTRACTS ``prefix_weight × hit_tokens``
+    — a replica already holding a request's prefix skips that much
+    prefill, so cached tokens are negative load. The default makes a
+    50-token cached prefix worth one queued request: enough to steer
+    overlapping traffic onto warm replicas, not enough to pile every
+    request onto one replica past its queue. With no hints the policy
+    is exactly the prefix-blind round-11 behaviour.
     """
 
     depth_weight: float = 1.0
     burn_weight: float = 4.0
+    prefix_weight: float = 0.02
     max_inflight: int | None = None
 
     def __post_init__(self):
@@ -67,19 +78,27 @@ class FleetPolicy:
         """Can this replica take NEW work right now?"""
         return replica.alive and replica.engine.degradation_level < 3
 
-    def score(self, replica) -> float:
+    def score(self, replica, *, hit_tokens: float = 0.0) -> float:
         eng = replica.engine
         depth = eng.queue_depth() + eng.occupied_slots()
         return (
             self.depth_weight * depth
             + self.burn_weight * self.burn_rate(replica)
+            - self.prefix_weight * hit_tokens
         )
 
-    def rank(self, replicas) -> list:
-        """Eligible replicas, best placement first (deterministic)."""
+    def rank(self, replicas, hits: dict | None = None) -> list:
+        """Eligible replicas, best placement first (deterministic).
+        ``hits`` maps replica name → predicted prefix-hit tokens for the
+        request being placed; absent names score no bonus, and ``None``
+        (no KV economy attached) is exactly prefix-blind ranking."""
+        hits = hits or {}
         return sorted(
             (r for r in replicas if self.eligible(r)),
-            key=lambda r: (self.score(r), r.name),
+            key=lambda r: (
+                self.score(r, hit_tokens=hits.get(r.name, 0.0)),
+                r.name,
+            ),
         )
 
     def should_shed(self, inflight: int) -> bool:

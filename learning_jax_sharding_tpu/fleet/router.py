@@ -69,7 +69,7 @@ class _FleetRequest:
 
     __slots__ = (
         "rid", "prompt", "deadline_s", "arrival_t", "replica", "stage",
-        "reroutes",
+        "reroutes", "predicted_hit",
     )
 
     def __init__(self, rid, prompt, deadline_s, arrival_t):
@@ -80,6 +80,7 @@ class _FleetRequest:
         self.replica: str | None = None
         self.stage = "queued"        # prefill|handoff|decode|done
         self.reroutes = 0
+        self.predicted_hit = 0       # prefix tokens the placement predicted
 
 
 class FleetRouter:
@@ -108,6 +109,7 @@ class FleetRouter:
         registry: MetricsRegistry | None = None,
         kv_page_tokens: int = 64,
         max_pending_handoffs: int | None = None,
+        kv_economy: Any | None = None,
     ):
         reps = list(replicas)
         if not reps:
@@ -222,6 +224,12 @@ class FleetRouter:
         # engine's lifetime, so two cache-tree traversals per handoff
         # would be pure hot-path waste.
         self._row_layouts: dict[str, tuple] = {}
+        # The KV economy (round 15): prefix-aware placement hints +
+        # the HBM→host→peer tier ladder. Optional — without it the
+        # router is exactly the round-11 prefix-blind fleet.
+        self.kv_economy = kv_economy
+        if kv_economy is not None:
+            kv_economy.attach(self)
         self.reset_stats()
 
     # --- introspection -----------------------------------------------------
@@ -256,10 +264,12 @@ class FleetRouter:
         """Start a router-side latency window (``latency_stats``) and a
         fresh goodput-ledger window on every replica engine, so
         ``goodput_report`` covers the same interval the latency numbers
-        do."""
+        do. Engine stats windows reset too, so the fleet-level TTFT and
+        prefix/tier rates in ``latency_stats`` aggregate the same
+        interval as the router's own percentiles."""
         self._completed: list[dict] = []
         for rep in self.replicas.values():
-            rep.engine.ledger.begin_window()
+            rep.engine.reset_stats()
 
     # --- admission / routing ----------------------------------------------
 
@@ -303,7 +313,19 @@ class FleetRouter:
 
     def _route(self, freq: _FleetRequest, *, requeue: bool = False):
         last_err = "no eligible replica"
-        for rep in self.policy.rank(self._admission_pool()):
+        # Prefix-aware placement: predicted hit tokens per replica
+        # (digest + local host tier) become a score BONUS in rank().
+        hits = (
+            self.kv_economy.predicted_hits(freq.prompt)
+            if self.kv_economy is not None else {}
+        )
+        for rep in self.policy.rank(self._admission_pool(), hits=hits):
+            predicted = int(hits.get(rep.name, 0))
+            if self.kv_economy is not None and predicted:
+                # ON-ADMISSION PROMOTION, before the engine sees the
+                # request: host/peer-tier chain pages fill back into
+                # HBM so the admission's registry walk can hit them.
+                self.kv_economy.promote(rep, freq.prompt)
             try:
                 rep.engine.add_request(
                     freq.prompt, rid=freq.rid,
@@ -314,13 +336,21 @@ class FleetRouter:
                 continue
             freq.replica = rep.name
             freq.stage = "prefill" if self.disaggregated else "decode"
+            freq.predicted_hit = predicted
+            if self.kv_economy is not None:
+                # The engine compares this against the REALIZED hit at
+                # admission: a page evicted mid-route becomes a counted
+                # tier miss + graceful re-prefill, never a wrong token.
+                rep.engine.expected_prefix[freq.rid] = predicted
             self.traces.instant(
                 freq.rid, "route", replica=rep.name, requeue=requeue,
+                predicted_prefix_tokens=predicted,
             )
             self.recorder.record(
                 "fleet.route", rid=freq.rid, replica=rep.name,
                 requeue=requeue, queue_depth=rep.engine.queue_depth(),
                 burn_rate=self.policy.burn_rate(rep),
+                predicted_prefix_tokens=predicted,
             )
             return
         why = f"every replica refused (last: {last_err})"
@@ -379,6 +409,13 @@ class FleetRouter:
         for name in sorted(self.replicas):
             if self.replicas[name].alive:
                 self._collect(self.replicas[name])
+        if self.kv_economy is not None:
+            # One demotion sweep per fleet iteration, AFTER the engines
+            # stepped: admissions have pinned their chain pages (ref>0,
+            # not demotable), so the sweep only spills genuinely cold
+            # pages — demoting first would race promote() for the very
+            # pages this step's admissions were routed toward.
+            self.kv_economy.maintain()
         self._g_inflight.set(self.inflight())
         return [rid for rid in self._finished if rid not in before]
 
@@ -440,6 +477,21 @@ class FleetRouter:
         ok = not isinstance(result, RequestFailure)
         # Close the trace at the ROUTER — the one place that knows the
         # request's final verdict across every hop it took.
+        realized = None
+        if self.kv_economy is not None:
+            rep = self.replicas.get(freq.replica)
+            if rep is not None:
+                realized = rep.engine.prefix_realized.pop(freq.rid, None)
+                rep.engine.expected_prefix.pop(freq.rid, None)
+            self.kv_economy.on_finish(freq.predicted_hit, realized)
+            if freq.predicted_hit or realized:
+                # The trace records PREDICTED vs REALIZED hit — the
+                # router's placement bet and what admission delivered.
+                self.traces.instant(
+                    freq.rid, "prefix",
+                    predicted_tokens=freq.predicted_hit,
+                    realized_tokens=realized,
+                )
         self.traces.complete(
             freq.rid, status="ok" if ok else result.status, finish_t=now,
         )
@@ -451,6 +503,9 @@ class FleetRouter:
             ),
             "ok": ok,
             "reroutes": freq.reroutes,
+            "prompt_tokens": int(freq.prompt.size),
+            "prefix_predicted": freq.predicted_hit,
+            "prefix_realized": realized,
         })
         self.recorder.record(
             "fleet.finish", rid=freq.rid, replica=freq.replica, ok=ok,
@@ -694,6 +749,10 @@ class FleetRouter:
         self._g_alive.set(
             sum(1 for r in self.replicas.values() if r.alive)
         )
+        if self.kv_economy is not None:
+            # Its host tier dies with the process: peers recompute from
+            # the prompt rather than ever serving orphaned KV.
+            self.kv_economy.on_replica_death(rep.name)
         # 1. Drain the dead replica: every queued/in-flight request gets
         #    a visible "rerouted" terminal there and a requeueable record
         #    here. Results that finished BEFORE the death still surface.
@@ -770,7 +829,7 @@ class FleetRouter:
         if not comp:
             return None
         e2e = np.asarray([c["e2e"] for c in comp], np.float64)
-        return {
+        out = {
             "requests": len(comp),
             "ok": sum(1 for c in comp if c["ok"]),
             "generated": int(sum(c["generated"] for c in comp)),
@@ -778,6 +837,39 @@ class FleetRouter:
             "e2e_p50": float(np.percentile(e2e, 50)),
             "e2e_p99": float(np.percentile(e2e, 99)),
         }
+        # Fleet TTFT: every replica's engine stamps per-request ttft in
+        # ITS window (reset_stats aligns the windows), so the fleet
+        # percentile is over the union.
+        ttfts = [
+            c["ttft"]
+            for rep in self.replicas.values()
+            for c in rep.engine._completed
+            if c.get("ttft") is not None
+        ]
+        if ttfts:
+            t = np.asarray(ttfts, np.float64)
+            out["ttft_p50"] = float(np.percentile(t, 50))
+            out["ttft_p99"] = float(np.percentile(t, 99))
+        if self.kv_economy is not None:
+            # prefix_hit_rate: realized cache-hit tokens / prompt tokens
+            # over finished requests with a verdict (what fraction of
+            # prefill work the economy saved); tier_miss_rate: requests
+            # whose realization fell short of the routing prediction
+            # (graceful re-prefill, counted — never a wrong token).
+            scored = [
+                c for c in comp if c["prefix_realized"] is not None
+            ]
+            if scored:
+                realized = sum(c["prefix_realized"] for c in scored)
+                prompts = sum(c["prompt_tokens"] for c in scored)
+                out["prefix_hit_rate"] = (
+                    realized / prompts if prompts > 0 else 0.0
+                )
+                out["tier_miss_rate"] = sum(
+                    1 for c in scored
+                    if c["prefix_realized"] < c["prefix_predicted"]
+                ) / len(scored)
+        return out
 
     def fleet_snapshot(self) -> dict:
         """Per-replica registries merged into ONE fleet view: the

@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
 import time
 from collections import deque
 from typing import Any, Optional
@@ -123,6 +124,12 @@ from learning_jax_sharding_tpu.utils.profiling import annotate
 #: infrastructure errors (OOM, XLA internal) still propagate — recovery
 #: must never guess.
 _RECOVERABLE_DISPATCH = (InjectedFault, FloatingPointError)
+
+#: Cache leaves with a leading PHYSICAL-PAGE dim on paged engines — the
+#: leaves ``kv_page_spill``/``kv_page_fill`` move one page of. Per-slot
+#: counters (cache_index, position, block_table) stay: a retained prefix
+#: page carries K/V only; the mapping is host state.
+_PAGE_LEAF_KEYS = ("cached_key", "cached_value", "key_scale", "value_scale")
 
 
 class AdmissionError(RuntimeError):
@@ -1175,6 +1182,43 @@ class ContinuousEngine:
 
             return jax.tree_util.tree_map_with_path(leaf, cache, rows)
 
+        @jax.jit
+        def kv_page_spill(cache, pid):
+            """One physical PAGE's K/V — every page-pool leaf
+            (``_PAGE_LEAF_KEYS``) indexed at ``pid`` on its pool dim,
+            returned as a flatten-ordered LIST (the page has no per-slot
+            counters; a list avoids inventing a partial tree structure).
+            The demotion half of the KV tier ladder (round 15): a pure
+            per-device gather whose golden
+            (``analysis/golden/kv_page_spill.json``) pins that demoting
+            a page adds no collectives — the HBM→host bytes ride the
+            counted ``parallel.resharding`` host plan."""
+            return [
+                jax.lax.dynamic_index_in_dim(x, pid, 0, keepdims=False)
+                for path, x in jax.tree_util.tree_flatten_with_path(cache)[0]
+                if getattr(path[-1], "key", None) in _PAGE_LEAF_KEYS
+            ]
+
+        @jax.jit
+        def kv_page_fill(cache, page_rows, pid):
+            """Write a spilled page's K/V rows back into physical page
+            ``pid`` — the promotion half of the tier ladder, inverse of
+            ``kv_page_spill`` (same flatten-ordered leaf list). Its own
+            golden (``analysis/golden/kv_page_fill.json``) pins zero
+            collectives when the rows arrive in this cache's page-row
+            layout (pool dim dropped from each leaf's spec)."""
+            flat, treedef = jax.tree_util.tree_flatten_with_path(cache)
+            it = iter(page_rows)
+            out = []
+            for path, x in flat:
+                if getattr(path[-1], "key", None) in _PAGE_LEAF_KEYS:
+                    row = next(it)
+                    x = jax.lax.dynamic_update_index_in_dim(
+                        x, row.astype(x.dtype), pid, 0
+                    )
+                out.append(x)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
         # --- engine configuration and compiled programs -------------------
         self._mesh, self._rules = mesh, rules
         self._cfg, self._d_cfg = cfg, d_cfg
@@ -1224,6 +1268,8 @@ class ContinuousEngine:
         self._adapter_spec_mixed_step_fn = adapter_spec_mixed_step
         self._kv_export_fn = kv_export
         self._kv_ingest_fn = kv_ingest
+        self._kv_page_spill_fn = kv_page_spill
+        self._kv_page_fill_fn = kv_page_fill
 
         # --- persistent state ---------------------------------------------
         self.rng = jax.random.key(0)
@@ -1251,6 +1297,8 @@ class ContinuousEngine:
         self._last_mixed_args = None
         self._last_kv_export_args = None      # disaggregated handoff
         self._last_kv_ingest_args = None
+        self._last_kv_page_spill_args = None  # KV tier ladder (round 15)
+        self._last_kv_page_fill_args = None
         # Tenancy (round 12): zero-downtime weight hot-swap + multi-LoRA.
         # ``weights_version`` is pinned onto every request AT ADMISSION —
         # in-flight requests finish (or recompute bit-identically) on the
@@ -1263,6 +1311,16 @@ class ContinuousEngine:
         self._installed: tuple | None = None   # committed (params, draft)
         self._swap_jit_cache: dict = {}        # device_reshard programs
         self._swap_plan_cache: dict = {}       # host transfer plans
+        # KV economy (round 15): the prefix-registry DIGEST the fleet
+        # router queries for prefix-aware placement, plus the tier
+        # ladder's spill/fill bookkeeping. ``prefix_epoch`` bumps on any
+        # registry KEY change (register, evict, spill, fill, flush), so
+        # a digest is valid exactly while its epoch matches.
+        self.prefix_epoch = 0
+        self._digest_cache: tuple | None = None     # (epoch, hashes) memo
+        self.expected_prefix: dict[int, int] = {}   # rid → predicted hit toks
+        self.prefix_realized: dict[int, int] = {}   # rid → realized hit toks
+        self._page_plan_cache: dict = {}            # spill/fill host plans
         self._adapter_pool = adapter_pool
         self._init_telemetry(registry, tracer, slo, recorder)
         if adapter_pool is not None:
@@ -1378,6 +1436,27 @@ class ContinuousEngine:
             "engine_kv_ingests_total",
             "externally prefilled requests ingested (disaggregated "
             "handoff)")
+        self._c_pg_spills = r.counter(
+            "engine_kv_page_spills_total",
+            "retained prefix pages demoted (spilled) out of HBM to a "
+            "host tier")
+        self._c_pg_fills = r.counter(
+            "engine_kv_page_fills_total",
+            "prefix pages promoted (filled) back into HBM from a tier")
+        self._c_pg_bytes_out = r.counter(
+            "engine_kv_page_spill_bytes_total",
+            "bytes moved HBM → host demoting prefix pages")
+        self._c_pg_bytes_in = r.counter(
+            "engine_kv_page_fill_bytes_total",
+            "bytes moved host → HBM promoting prefix pages")
+        self._c_pfx_expected = r.counter(
+            "engine_prefix_expected_total",
+            "admissions the router placed expecting a prefix hit")
+        self._c_tier_miss = r.counter(
+            "engine_tier_misses_total",
+            "admissions whose realized prefix hit fell short of the "
+            "router's prediction (page evicted/raced away mid-route) — "
+            "the request gracefully re-prefilled the missing tokens")
         self._c_swap_staged = r.counter(
             "engine_swap_staged_total",
             "weight swaps staged (resharded into the serving layout off "
@@ -1508,6 +1587,7 @@ class ContinuousEngine:
         self._refcnt: dict[int, int] = {}
         self._cached_lru: dict[int, None] = {}
         self._shared_count = [0] * b   # leading registry pages per slot
+        self.prefix_epoch += 1         # any prior digest is now stale
         self._g_pages.set(0)
         self._g_retained.set(0)
 
@@ -1529,6 +1609,9 @@ class ContinuousEngine:
                 self._c_decode_s, self._c_mixed_s, self._c_stall_s,
                 self._c_requests, self._c_finished, self._c_shed,
                 self._c_deadline, self._c_req_failed, self._c_rerouted,
+                self._c_pg_spills, self._c_pg_fills,
+                self._c_pg_bytes_out, self._c_pg_bytes_in,
+                self._c_pfx_expected, self._c_tier_miss,
             )
         }
         # Window high-water for the page-pool gauge (live value rides on).
@@ -1637,6 +1720,10 @@ class ContinuousEngine:
             del self._prefix_registry[self._key_of_page.pop(pid)]
             del self._refcnt[pid]
             self._free_pages.append(pid)
+        # A dropped registry invalidates every exported digest — the
+        # router's prefix-aware placement must stop scoring stale hits
+        # (old-params K/V must never be routed TO, either).
+        self.prefix_epoch += 1
         # Refresh the export gauges: retained pages just went to zero and
         # a scraper must not keep seeing the flushed K/V.
         self._update_high_water()
@@ -1657,6 +1744,7 @@ class ContinuousEngine:
             del self._cached_lru[pid]
             del self._prefix_registry[self._key_of_page.pop(pid)]
             del self._refcnt[pid]
+            self.prefix_epoch += 1
             return pid
         raise RuntimeError(
             f"page pool exhausted ({self._paged_pages - 1} pages "
@@ -1732,6 +1820,7 @@ class ContinuousEngine:
                         self._key_of_page[pid] = key
                         self._refcnt[pid] = 0
                         self._cached_lru[pid] = None
+                        self.prefix_epoch += 1
                         continue
                 self._free_pages.append(pid)
             for pid in reversed(pages[:ns]):   # drop shared refs,
@@ -1836,6 +1925,8 @@ class ContinuousEngine:
         self._last_mixed_args = None
         self._last_kv_export_args = None
         self._last_kv_ingest_args = None
+        self._last_kv_page_spill_args = None
+        self._last_kv_page_fill_args = None
 
     # --- zero-downtime weight hot-swap (round 12) --------------------------
 
@@ -2387,6 +2478,235 @@ class ContinuousEngine:
                 self._g_active.set(int(self._active.sum()))
         return slot
 
+    # --- KV tier ladder (round 15): prefix digest + page spill/fill --------
+
+    @staticmethod
+    def prefix_hash(key: bytes) -> bytes:
+        """The 8-byte digest hash of one registry key (page-aligned
+        token-prefix bytes) — the unit :meth:`prefix_digest` exports and
+        the router matches prompt chains against."""
+        return hashlib.blake2b(key, digest_size=8).digest()
+
+    def prefix_digest(self) -> tuple[int, frozenset]:
+        """``(epoch, hashes)`` — a compact, queryable digest of the
+        prefix registry for PREFIX-AWARE FLEET PLACEMENT: one
+        :meth:`prefix_hash` per registered page-aligned token prefix.
+        The router hashes an arriving prompt's page chain and walks it
+        against each replica's digest to predict the longest cached
+        prefix BEFORE placing the request. ``epoch`` bumps on any
+        registry key change (register / evict / spill / fill /
+        swap-commit flush), so a cached digest is valid exactly while
+        its epoch matches; the memo makes steady-state queries O(1)."""
+        if not (self._paged and self._prefix):
+            return (self.prefix_epoch, frozenset())
+        if (
+            self._digest_cache is None
+            or self._digest_cache[0] != self.prefix_epoch
+        ):
+            self._digest_cache = (
+                self.prefix_epoch,
+                frozenset(
+                    self.prefix_hash(k) for k in self._prefix_registry
+                ),
+            )
+        return self._digest_cache
+
+    def retained_prefixes(self) -> list[bytes]:
+        """Registry keys of the REFERENCE-FREE retained pages, oldest
+        (LRU-eviction order) first — the tier ladder's demotion
+        candidates. Pages shared by live slots are excluded: they cannot
+        leave HBM mid-request."""
+        if not (self._paged and self._prefix):
+            return []
+        return [
+            self._key_of_page[pid]
+            for pid in self._cached_lru
+            if pid in self._key_of_page
+        ]
+
+    def touch_prefix(self, key: bytes) -> bool:
+        """LRU-refresh a resident reference-free prefix page. The tier
+        ladder touches a chain's RESIDENT ancestors before promoting its
+        missing descendants, so the promotion's own ``_take_page`` calls
+        cannot evict the chain out from under itself. No-op (``False``)
+        if the key is unregistered or the page is shared by a live
+        slot."""
+        if not (self._paged and self._prefix):
+            return False
+        pid = self._prefix_registry.get(key)
+        if pid is None or pid not in self._cached_lru:
+            return False
+        self._cached_lru.pop(pid)
+        self._cached_lru[pid] = None
+        return True
+
+    def _check_tier_supported(self, what: str):
+        if not (self._paged and self._prefix):
+            raise RuntimeError(
+                f"{what} requires a paged engine with prefix_cache=True"
+            )
+        if self._speculative:
+            # A spec engine's retained pages hold target AND draft K/V
+            # under one page id; spilling only the target leaves would
+            # hand a promoted page garbage draft state. Tier the plain
+            # engines; spec replicas serve prefix hits from HBM only.
+            raise RuntimeError(f"{what}: speculative engines are not tiered")
+
+    def _page_row_shardings(self) -> list:
+        """Per-leaf :class:`~jax.sharding.NamedSharding` of ONE page row
+        (the pool dim dropped), flatten-ordered like ``kv_page_spill``'s
+        output list — the destination layout host→HBM promotion reshards
+        into, making ``kv_page_fill`` a purely local update (what its
+        golden pins)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rows = []
+        for path, x in jax.tree_util.tree_flatten_with_path(self._cache)[0]:
+            if getattr(path[-1], "key", None) not in _PAGE_LEAF_KEYS:
+                continue
+            spec = getattr(x.sharding, "spec", None)
+            if spec is None or len(tuple(spec)) == 0:
+                rows.append(NamedSharding(self._mesh, PartitionSpec()))
+            else:
+                rows.append(
+                    NamedSharding(
+                        self._mesh, PartitionSpec(*tuple(spec)[1:])
+                    )
+                )
+        return rows
+
+    def spill_page(self, key: bytes, *, drop: bool = True):
+        """DEMOTE one retained prefix page out of HBM: gather its K/V
+        rows (``kv_page_spill``, one fixed-shape executable) and move
+        them to host numpy through the counted
+        ``parallel.resharding`` segment plan — every spilled byte is
+        priced and booked to the ledger's ``kv_handoff`` bucket. With
+        ``drop=True`` (demotion) the page leaves the registry and
+        returns to the free pool; ``drop=False`` is a NON-DESTRUCTIVE
+        read — the peer-tier path, where another replica copies this
+        replica's warm page without disturbing it. Returns
+        ``(rows, stats)``: flatten-ordered host page rows (the
+        ``fill_page`` input) and ``{"bytes", "segments"}``."""
+        self._check_tier_supported("spill_page")
+        pid = self._prefix_registry.get(key)
+        if pid is None:
+            raise KeyError("spill_page: key not in the prefix registry")
+        if drop and pid not in self._cached_lru:
+            raise RuntimeError(
+                "spill_page(drop=True): page is shared by live slots — "
+                "it cannot leave HBM mid-request"
+            )
+        if self._cache is None:
+            raise RuntimeError("spill_page: the engine holds no cache")
+        from learning_jax_sharding_tpu.parallel.resharding import (
+            HostBuffer,
+            execute_transfer,
+            plan_transfer,
+        )
+
+        with self.ledger.measure("kv_handoff"):
+            pid_j = jnp.int32(pid)
+            with activate(self._mesh, self._rules):
+                dev_rows = self._kv_page_spill_fn(self._cache, pid_j)
+            # Live-cache closure (see export_kv): relowering reads the
+            # engine's CURRENT cache, never a pinned stale copy.
+            self._last_kv_page_spill_args = lambda: (self._cache, pid_j)
+            host = HostBuffer()
+            rows, nbytes, nsegs = [], 0, 0
+            for x in dev_rows:
+                pkey = (tuple(x.shape), str(x.dtype), x.sharding, "spill")
+                plan = self._page_plan_cache.get(pkey)
+                if plan is None:
+                    plan = plan_transfer(
+                        x.shape, x.dtype.itemsize, x.sharding, host,
+                        seq_dim=None, page_tokens=None,
+                    )
+                    self._page_plan_cache[pkey] = plan
+                buf, stats = execute_transfer(plan, x)
+                rows.append(buf)
+                nbytes += stats["bytes"]
+                nsegs += stats["segments"]
+            if drop:
+                del self._cached_lru[pid]
+                del self._prefix_registry[self._key_of_page.pop(pid)]
+                del self._refcnt[pid]
+                self._free_pages.append(pid)
+                self.prefix_epoch += 1
+                self._update_high_water()
+            self._c_pg_spills.inc()
+            self._c_pg_bytes_out.inc(nbytes)
+            self.recorder.record(
+                "engine.kv_page_spill", pid=pid, bytes=nbytes,
+                segments=nsegs, dropped=drop,
+            )
+        return rows, {"bytes": nbytes, "segments": nsegs}
+
+    def fill_page(self, key: bytes, rows) -> dict:
+        """PROMOTE a spilled page back into HBM: take a physical page
+        (may LRU-evict a colder retained page), commit the host rows
+        under this cache's page-row layout through the counted host
+        plan, write them in with ``kv_page_fill``, and register ``key``
+        as a reference-free retained page (LRU-newest). The next
+        admission whose prompt chain reaches ``key`` maps it like any
+        HBM-resident prefix page. Returns ``{"bytes", "segments",
+        "pid"}``; raises if ``key`` is already resident (promotion is
+        not idempotent — check the digest first)."""
+        self._check_tier_supported("fill_page")
+        if key in self._prefix_registry:
+            raise ValueError("fill_page: key is already resident")
+        if self._cache is None:
+            raise RuntimeError(
+                "fill_page: the engine holds no cache — ensure_cache() "
+                "or serve a request first"
+            )
+        from learning_jax_sharding_tpu.parallel.resharding import (
+            HostBuffer,
+            execute_transfer,
+            plan_transfer,
+        )
+
+        with self.ledger.measure("kv_handoff"):
+            with self.ledger.measure("page_alloc"):
+                pid = self._take_page()
+            host = HostBuffer()
+            dev_rows, nbytes, nsegs = [], 0, 0
+            for x, dst in zip(rows, self._page_row_shardings()):
+                buf = np.asarray(x)
+                pkey = (tuple(buf.shape), str(buf.dtype), dst, "fill")
+                plan = self._page_plan_cache.get(pkey)
+                if plan is None:
+                    plan = plan_transfer(
+                        buf.shape, buf.dtype.itemsize, host, dst,
+                        seq_dim=None, page_tokens=None,
+                    )
+                    self._page_plan_cache[pkey] = plan
+                out, stats = execute_transfer(plan, buf)
+                dev_rows.append(out)
+                nbytes += stats["bytes"]
+                nsegs += stats["segments"]
+            pid_j = jnp.int32(pid)
+            with activate(self._mesh, self._rules):
+                self._cache = self._kv_page_fill_fn(
+                    self._cache, dev_rows, pid_j
+                )
+            # Only the one promoted row list stays retained for
+            # relowering, never a stale copy of the whole cache.
+            self._last_kv_page_fill_args = lambda: (
+                self._cache, dev_rows, pid_j,
+            )
+            self._prefix_registry[key] = pid
+            self._key_of_page[pid] = key
+            self._refcnt[pid] = 0
+            self._cached_lru[pid] = None
+            self.prefix_epoch += 1
+            self._update_high_water()
+            self._c_pg_fills.inc()
+            self._c_pg_bytes_in.inc(nbytes)
+            self.recorder.record(
+                "engine.kv_page_fill", pid=pid, bytes=nbytes, segments=nsegs,
+            )
+        return {"bytes": nbytes, "segments": nsegs, "pid": pid}
+
     def _retire(self, slot, now, retired):
         r = self._slot_req[slot]
         r.tokens = np.asarray(self._out[slot], np.int32)
@@ -2839,6 +3159,27 @@ class ContinuousEngine:
                                 self._c_pfx_hits.inc()
                                 self._c_pfx_pages.inc(len(shared))
                             self._update_high_water()
+                        if first_admission:
+                            # Predicted-vs-realized (round 15): the router
+                            # records its digest-based prediction under
+                            # the rid before placement; admission is the
+                            # moment of truth. A shortfall means the page
+                            # was evicted/spilled between scoring and
+                            # admission — the request just re-prefills
+                            # the missing tokens (graceful miss), and the
+                            # counter makes the race visible.
+                            realized = len(shared) * self._page_size
+                            self.prefix_realized[r.rid] = realized
+                            exp = self.expected_prefix.pop(r.rid, None)
+                            if exp is not None and exp > 0:
+                                self._c_pfx_expected.inc()
+                                if realized < exp:
+                                    self._c_tier_miss.inc()
+                                    self.recorder.record(
+                                        "engine.tier_miss", rid=r.rid,
+                                        expected=int(exp),
+                                        realized=realized,
+                                    )
             self._g_queue.set(len(self._queue))
 
     def _refill_dispatch(self, params, d_params, retired):
@@ -3842,6 +4183,19 @@ class ContinuousEngine:
             # kill shows up as rerouted work, not as fresh admissions.
             rerouted=int(self._win_delta(self._c_rerouted)),
         )
+        if self._paged and self._prefix:
+            # KV economy (round 15): the fraction of this window's
+            # admissions that reused retained prefix pages, and the
+            # fraction of router-predicted hits that admission could not
+            # realize (evicted/spilled mid-route — the tier race).
+            hits = self._win_delta(self._c_pfx_hits)
+            admitted = self._win_delta(self._c_requests)
+            exp = self._win_delta(self._c_pfx_expected)
+            miss = self._win_delta(self._c_tier_miss)
+            out.update(
+                prefix_hit_rate=(hits / admitted) if admitted else 0.0,
+                tier_miss_rate=(miss / exp) if exp else 0.0,
+            )
         return out
 
     def _snapshot_stats(self):
@@ -3907,6 +4261,10 @@ class ContinuousEngine:
             fns["kv_export"] = self._kv_export_fn
         if self._last_kv_ingest_args is not None:
             fns["kv_ingest"] = self._kv_ingest_fn
+        if self._last_kv_page_spill_args is not None:
+            fns["kv_page_spill"] = self._kv_page_spill_fn
+        if self._last_kv_page_fill_args is not None:
+            fns["kv_page_fill"] = self._kv_page_fill_fn
         return {k: cache_size(f) for k, f in fns.items()}
 
     def _dispatched_programs(self):
@@ -3965,6 +4323,16 @@ class ContinuousEngine:
                 "kv_ingest", self._kv_ingest_fn,
                 self._last_kv_ingest_args(),
             ))
+        if self._last_kv_page_spill_args is not None:
+            out.append((
+                "kv_page_spill", self._kv_page_spill_fn,
+                self._last_kv_page_spill_args(),
+            ))
+        if self._last_kv_page_fill_args is not None:
+            out.append((
+                "kv_page_fill", self._kv_page_fill_fn,
+                self._last_kv_page_fill_args(),
+            ))
         return out
 
     def _program_reports(self) -> dict[str, dict]:
@@ -4018,11 +4386,15 @@ class ContinuousEngine:
         "adapter_mixed_step": "adapter_mixed_step",
         "kv_export": "kv_export",
         "kv_ingest": "kv_ingest",
+        "kv_page_spill": "kv_page_spill",
+        "kv_page_fill": "kv_page_fill",
     }
 
     def contract_name(self, program: str) -> str:
         base = self.CONTRACT_NAMES.get(program, program)
-        if program in ("kv_export", "kv_ingest"):
+        if program in (
+            "kv_export", "kv_ingest", "kv_page_spill", "kv_page_fill"
+        ):
             # The handoff programs are only dispatchable on non-spec
             # engines (export/ingest raise otherwise) — one golden each.
             return base

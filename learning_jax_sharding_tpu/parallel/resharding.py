@@ -65,6 +65,29 @@ Box = tuple[tuple[int, int], ...]   # per-dim half-open (start, stop)
 
 
 @dataclasses.dataclass(frozen=True)
+class HostBuffer:
+    """Pseudo-sharding for a HOST-RAM endpoint of a transfer plan.
+
+    The tier ladder (``fleet/kv_economy.py``) moves KV pages between HBM
+    and host RAM. Rather than invent a second transfer path, host RAM
+    joins the segment algebra as one more "device": a ``HostBuffer``
+    implements the only protocol :func:`plan_transfer` needs —
+    ``devices_indices_map`` — and claims the WHOLE array as a single
+    shard box owned by itself. A device→host plan then prices the exact
+    spilled bytes through the same counted segments as a device→device
+    move, and :func:`execute_transfer` returns the assembled ``numpy``
+    buffer instead of committing a ``jax.Array``; host→device runs the
+    plan in reverse, reading segments straight out of the numpy buffer.
+    ``tag`` keys plan-cache identity (frozen dataclass ⇒ hashable/eq).
+    """
+
+    tag: str = "host"
+
+    def devices_indices_map(self, shape: Sequence[int]) -> dict:
+        return {self: tuple(slice(0, int(d)) for d in shape)}
+
+
+@dataclasses.dataclass(frozen=True)
 class Segment:
     """One block copy: the intersection ``box`` (GLOBAL coordinates) of a
     source shard and a destination shard, with the owning devices and
@@ -217,6 +240,10 @@ def execute_transfer(
     def src_block(dev) -> np.ndarray:
         buf = src_np.get(dev)
         if buf is None:
+            if isinstance(dev, HostBuffer):
+                # Host source: the whole array IS the shard.
+                buf = src_np[dev] = np.asarray(x)
+                return buf
             for s in x.addressable_shards:
                 if s.device == dev:
                     buf = src_np[dev] = np.asarray(s.data)
@@ -260,13 +287,19 @@ def execute_transfer(
         copied += 1
         nbytes += math.prod(hi - lo for lo, hi in box) * plan.itemsize
 
+    stats = {
+        "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
+    }
+    if isinstance(plan.dst_sharding, HostBuffer):
+        # Host destination: one full-array box; hand back the assembled
+        # numpy buffer — nothing to commit to a device.
+        (out,) = dst_bufs.values()
+        return out, stats
     out = jax.make_array_from_callback(
         shape, plan.dst_sharding,
         lambda idx: dst_bufs[_norm_box(idx, shape)],
     )
-    return out, {
-        "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
-    }
+    return out, stats
 
 
 def transfer_tree(

@@ -28,6 +28,7 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | overload_shed     | offered load > queue bound        | bounded queue + degradation ladder|
 | replica_kill      | engine replica dies mid-stream    | router failover + rerouted requeue|
 | swap_mid_stream   | weight-swap staging dies mid-serve| swap abort → stay on old version  |
+| tier_miss_under_kill | replica with promoted peer-tier KV dies mid-stream | tier drop + recompute from prompt |
 """
 
 from __future__ import annotations
@@ -359,6 +360,91 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             ),
         }
 
+    def tier_miss_kill():
+        # KV economy (round 15): a replica HOLDING PROMOTED PEER-TIER
+        # pages dies mid-stream. The dead replica's host tier must drop
+        # whole (a process death takes its RAM along), its in-flight
+        # request must requeue and RECOMPUTE FROM THE PROMPT on a
+        # survivor — the one thing the tier ladder must never do is
+        # serve stale/partial KV — and every stream must come out
+        # bit-identical to a fault-free solo paged engine.
+        from learning_jax_sharding_tpu.fleet import (
+            FleetRouter,
+            KvEconomy,
+            make_replicas,
+        )
+
+        bcfg = dataclasses.replace(cfg, decode_attention="blocked")
+        rng = np.random.default_rng(29)
+        base = rng.integers(1, cfg.vocab_size, size=(9,)).astype(np.int32)
+        o1, o2 = (
+            np.concatenate([
+                base[:8],
+                rng.integers(1, cfg.vocab_size, size=(3,)).astype(np.int32),
+            ])
+            for _ in range(2)
+        )
+        kw = dict(
+            batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+            paged_pages=12, page_size=4, prefix_cache=True,
+        )
+        treqs = {0: base, 1: o1, 2: o2}
+        solo = ContinuousEngine(bcfg, mesh, rules, **kw, recorder=rec)
+        ref, _ = _drive(solo, params, treqs)
+
+        reps = make_replicas(
+            bcfg, rules, params, count=2, mesh_shape=(1, 1),
+            recorder=rec, **kw,
+        )
+        econ = KvEconomy(hbm_retained_target=0, burn_threshold=1e9)
+        router = FleetRouter(reps, recorder=rec, kv_economy=econ)
+        fo_base = count("fleet.failover")
+        # Warm: base lands on unified0; the aggressive watermark demotes
+        # its retained chain to unified0's HOST tier during the drain.
+        router.add_request(base, rid=0)
+        out = router.drain(max_steps=400)
+        assert len(econ.tier_of("unified0")) == 2, "chain must demote"
+        # Stop demoting, then PEER-promote the chain onto unified1: it
+        # reads unified0's host tier across the fleet — unified1 now
+        # holds peer-sourced pages in its own HBM.
+        econ.hbm_retained_target = 8
+        peered = econ.promote(router.replicas["unified1"], base)
+        assert peered == 2, f"peer promotion filled {peered} pages"
+        assert econ.tier_report()["peer_promotions"] >= 2
+        # Both overlapping requests predict a full 8-token hit; load
+        # tie-breaking spreads them one per replica, so rid=2 streams on
+        # unified1 — and the rid-targeted fault kills THAT replica at
+        # the fleet.step seam while the request is mid-flight.
+        with ChaosInjector(
+            Fault("fleet.step", "raise", rid=2, count=1), recorder=rec,
+        ):
+            router.add_request(o1, rid=1)
+            router.add_request(o2, rid=2)
+            out.update(router.drain(max_steps=400))
+        dead = [r for r in reps if not r.alive]
+        assert len(dead) == 1 and dead[0].name == "unified1", dead
+        assert count("fleet.failover") == fo_base + 1
+        assert econ.tier_of("unified1") is None, (
+            "the dead replica's host tier must drop with it"
+        )
+        rerouted = int(
+            dead[0].engine.registry.counter("engine_rerouted_total").value
+        )
+        assert rerouted >= 1, "the victim must drain as rerouted"
+        for rid in treqs:
+            v = out[rid]
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, ref[rid])
+        stats = router.latency_stats()
+        rep = econ.tier_report()
+        return {
+            "dead": dead[0].name,
+            "peer_promotions": rep["peer_promotions"],
+            "demotions": rep["demotions"],
+            "rerouted": rerouted,
+            "prefix_hit_rate": round(stats.get("prefix_hit_rate", 0.0), 3),
+        }
+
     def swap_mid_stream():
         # Zero-downtime weight swap (round 12) interrupted at the
         # staging seam, mid-serve: the swap must ABORT — the engine
@@ -531,6 +617,9 @@ def run_matrix(verbose: bool = False) -> list[dict]:
          "router failover + rerouted requeue", replica_kill)
     cell("swap_mid_stream", "weight-swap staging dies mid-serve",
          "swap abort, stay on old version", swap_mid_stream)
+    cell("tier_miss_under_kill",
+         "replica holding promoted peer-tier KV dies mid-stream",
+         "tier drop + recompute from prompt", tier_miss_kill)
     cell("nan_grad_skip", "NaN grad/loss in-step",
          "guarded skip", lambda: nan_grad(tmp))
     cell("spike_rollback", "loss spike x1000",

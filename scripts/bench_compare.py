@@ -112,6 +112,20 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      "telemetry_overhead_pct", False),
     (re.compile(r"critical path p50 ([\d,.]+)\s*ms"), "ttft_cp_p50_ms",
      False),
+    # Round-15 KV-economy gates (bench.py's `[bench] kv economy ...`
+    # A/B lines): fleet TTFT p99 tracked explicitly (the generic `p99`
+    # pattern predates comma grouping); the realized prefix-hit rate is
+    # the placement-quality number (higher); the tier-miss rate counts
+    # routing predictions admission could not realize — graceful
+    # re-prefill, never a wrong token, but each one wasted a placement
+    # (lower); kv moved is what the tier ladder pays the host/peer
+    # buses per request — every byte is ledgered, fewer is cheaper
+    # (lower).
+    (re.compile(r"TTFT p99 ([\d,.]+)\s*ms"), "ttft_p99_ms", False),
+    (re.compile(r"prefix hit ([\d,.]+)%"), "prefix_hit_rate_pct", True),
+    (re.compile(r"tier miss ([\d,.]+)%"), "tier_miss_rate_pct", False),
+    (re.compile(r"kv moved ([\d,.]+)\s*kB/req"),
+     "kv_bytes_moved_per_req_kb", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

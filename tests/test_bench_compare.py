@@ -221,6 +221,47 @@ class TestExtraction:
         assert not by["goodput:ttft_cp_p50_ms"]["regressed"]
         assert not by["goodput:p99_ms"]["regressed"]
 
+    def test_kv_economy_gates_direction_aware(self):
+        """The round-15 KV-economy gates, per A/B line: aggregate tok/s
+        and the prefix-hit rate regress DOWN; fleet TTFT p99, the
+        tier-miss rate, and kv bytes moved per request regress UP —
+        the aware and blind lines gate independently, so the economy
+        regressing can't hide behind a healthy blind baseline."""
+        lines = [
+            "[bench] kv economy K=4 prefix-aware (80% overlap): "
+            "aggregate 1,115 tok/s, TTFT p99 315.6 ms, prefix hit 77%, "
+            "tier miss 4%, kv moved 7.7 kB/req (spill 369 kB, fill 0 "
+            "kB, peer 0 pages)",
+            "[bench] kv economy K=4 prefix-blind (80% overlap): "
+            "aggregate 883 tok/s, TTFT p99 381.7 ms",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        aware = "kv_economy_K=4_prefix-aware_(80%_overlap)"
+        blind = "kv_economy_K=4_prefix-blind_(80%_overlap)"
+        assert m[f"{aware}:aggregate_tok_s"] == (1115.0, True)
+        assert m[f"{aware}:ttft_p99_ms"] == (315.6, False)
+        assert m[f"{aware}:prefix_hit_rate_pct"] == (77.0, True)
+        assert m[f"{aware}:tier_miss_rate_pct"] == (4.0, False)
+        assert m[f"{aware}:kv_bytes_moved_per_req_kb"] == (7.7, False)
+        assert m[f"{blind}:aggregate_tok_s"] == (883.0, True)
+        assert m[f"{blind}:ttft_p99_ms"] == (381.7, False)
+        worse = _doc([
+            lines[0]
+            .replace("prefix hit 77%", "prefix hit 31%")
+            .replace("tier miss 4%", "tier miss 38%")
+            .replace("kv moved 7.7 kB/req", "kv moved 64.0 kB/req")
+            .replace("TTFT p99 315.6 ms", "TTFT p99 612.0 ms"),
+            lines[1],
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{aware}:prefix_hit_rate_pct"]["regressed"]
+        assert by[f"{aware}:tier_miss_rate_pct"]["regressed"]
+        assert by[f"{aware}:kv_bytes_moved_per_req_kb"]["regressed"]
+        assert by[f"{aware}:ttft_p99_ms"]["regressed"]
+        assert not by[f"{aware}:aggregate_tok_s"]["regressed"]
+        assert not by[f"{blind}:ttft_p99_ms"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

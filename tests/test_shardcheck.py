@@ -677,7 +677,7 @@ class TestCheckedInGoldens:
         "decode_step", "mixed_step",
         "spec_prefill", "spec_decode_step", "spec_mixed_step",
         "adapter_mixed_step", "spec_adapter_mixed_step",
-        "kv_export", "kv_ingest",
+        "kv_export", "kv_ingest", "kv_page_spill", "kv_page_fill",
         "swap_reshard", "swap_reshard_quant",
         "moe_dispatch", "ring_attention", "ulysses_attention",
     )
@@ -735,10 +735,15 @@ class TestCheckedInGoldens:
         contract: BOTH device-side programs of the KV handoff (the
         export gather, the ingest update) compile to ZERO collectives —
         every cross-replica byte rides the explicit, counted
-        fleet/kv_transfer plan, never a hidden XLA reshard."""
+        fleet/kv_transfer plan, never a hidden XLA reshard. The round-15
+        tier ladder's page programs (the spill gather, the fill update)
+        carry the same claim for the HBM↔host rungs: migration bytes
+        live in the counted ``HostBuffer`` plans only."""
         from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
 
-        for name in ("kv_export", "kv_ingest"):
+        for name in (
+            "kv_export", "kv_ingest", "kv_page_spill", "kv_page_fill",
+        ):
             c = Contract.load(GOLDEN_DIR / f"{name}.json")
             assert c.collectives == {}, (name, c.collectives)
             assert c.while_collectives == 0

@@ -519,7 +519,7 @@ class TestFaultMatrix:
         assert not bad, "unrecovered cells:\n" + "\n".join(
             f"  {r['cell']}: {r['error']}" for r in bad
         )
-        assert len(results) == 12
+        assert len(results) == 13
         # Every cell that injects through a chaos seam recorded it
         # (ckpt_corruption corrupts the filesystem directly; overload's
         # fault IS the offered load — neither crosses a seam).

@@ -1,0 +1,390 @@
+"""KV economy (round 15): prefix-aware placement + the HBM→host→peer
+tier ladder.
+
+Named to sort LAST alongside ``test_zfleet``/``test_ztenancy`` (same
+rationale: the end-to-end oracles build engines, and the tier-1 window
+should spend its budget on the faster oracles first).
+
+Four layers, cheapest first:
+
+* ``TierStore`` as a pure host-side structure — LRU byte budget,
+  weights-version fencing (``get`` drops a stale entry, ``peek``
+  leaves it for a mixed-version fleet mid-rolling-swap);
+* the ENGINE tier seam — spill/fill round-trips a retained page
+  bit-identically with every byte booked to the ledger's
+  ``kv_handoff`` bucket, the digest speaks page-aligned truth at
+  partial-page boundaries, a ``swap_weights`` commit invalidates it,
+  and a predicted-hit page evicted mid-route degrades to a counted
+  re-prefill, never a wrong token;
+* the ECONOMY over a 2-replica fleet — placement lands on the
+  longest-prefix replica, demotion feeds the host tier, promotion
+  (host AND peer) restores chains the admission then realizes, and
+  ``latency_stats`` books hit/miss rates while every replica's ledger
+  still reconciles.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.fleet import (
+    FleetPolicy,
+    FleetRouter,
+    KvEconomy,
+    TierStore,
+    make_replicas,
+    replicated_params,
+)
+from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+
+PAGE = 4
+ENGINE_KW = dict(
+    batch_size=2, max_new_tokens=4, refill_chunk=8,
+    paged_pages=12, page_size=PAGE, prefix_cache=True,
+)
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = dataclasses.replace(
+        CONFIG_TINY, dtype=jnp.float32, decode_attention="blocked",
+    )
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(3), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    rng = np.random.default_rng(23)
+    base = rng.integers(1, cfg.vocab_size, size=(9,)).astype(np.int32)
+    return cfg, params, base, rng
+
+
+def _engine(cfg, **over):
+    mesh = build_mesh((1, 1), ("data", "model"), devices=jax.devices()[:1])
+    return ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, **{**ENGINE_KW, **over}
+    ), mesh
+
+
+def _rows_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _edrain(eng, params, max_steps=200):
+    out = {}
+    steps = 0
+    while eng.has_work():
+        eng.step(params)
+        out.update(eng.pop_finished())
+        steps += 1
+        assert steps <= max_steps, "engine wedged"
+    out.update(eng.pop_finished())
+    return out
+
+
+class TestTierStore:
+    def test_lru_byte_budget_evicts_oldest(self):
+        t = TierStore(capacity_bytes=100)
+        t.put(b"a", ["ra"], version=0, nbytes=40)
+        t.put(b"b", ["rb"], version=0, nbytes=40)
+        assert t.get(b"a", version=0) == ["ra"]   # refresh: a is newest
+        t.put(b"c", ["rc"], version=0, nbytes=40)  # 120 > 100: evict b
+        assert b"b" not in t
+        assert b"a" in t and b"c" in t
+        assert t.evictions == 1
+        assert t.bytes_held == 80
+
+    def test_budget_always_keeps_latest_entry(self):
+        t = TierStore(capacity_bytes=10)
+        t.put(b"big", ["r"], version=0, nbytes=500)
+        assert b"big" in t and len(t) == 1
+
+    def test_get_drops_stale_version_peek_keeps_it(self):
+        t = TierStore(capacity_bytes=100)
+        t.put(b"k", ["r"], version=1, nbytes=10)
+        # peek at the wrong version: miss, but the entry survives — a
+        # peer mid-rolling-swap must not destroy another version's page.
+        assert t.peek(b"k", version=2) is None
+        assert b"k" in t
+        assert t.peek(b"k", version=1) == ["r"]
+        # get at the wrong version: the owner's versions only move
+        # forward, so the stale entry is garbage — dropped.
+        assert t.get(b"k", version=2) is None
+        assert b"k" not in t
+        assert t.bytes_held == 0
+
+    def test_put_refresh_replaces_bytes(self):
+        t = TierStore(capacity_bytes=100)
+        t.put(b"k", ["r1"], version=0, nbytes=30)
+        t.put(b"k", ["r2"], version=1, nbytes=50)
+        assert len(t) == 1
+        assert t.bytes_held == 50
+        assert t.get(b"k", version=1) == ["r2"]
+
+
+class TestEngineTier:
+    def test_spill_fill_round_trip_bit_identical_and_ledgered(
+        self, served
+    ):
+        cfg, params, base, _ = served
+        eng, mesh = _engine(cfg)
+        p = replicated_params(params, mesh)
+        ref = eng.serve(p, [base])[0]
+        (key, *_) = eng.retained_prefixes()
+        epoch0, digest0 = eng.prefix_digest()
+        rows, st = eng.spill_page(key, drop=True)
+        assert st["bytes"] > 0 and st["segments"] > 0
+        epoch1, digest1 = eng.prefix_digest()
+        assert epoch1 > epoch0
+        assert eng.prefix_hash(key) in digest0
+        assert eng.prefix_hash(key) not in digest1
+        st2 = eng.fill_page(key, rows)
+        assert st2["bytes"] == st["bytes"]
+        assert eng.prefix_hash(key) in eng.prefix_digest()[1]
+        # The promoted page serves the SAME tokens the recompute would.
+        again = eng.serve(p, [base])[0]
+        np.testing.assert_array_equal(ref, again)
+        # ... and a second spill returns bit-identical rows.
+        rows2, _ = eng.spill_page(key, drop=False)
+        _rows_equal(rows, rows2)
+        # Every byte booked: the ledger window closes with kv_handoff
+        # busy-time and still accounts for 100% of the wall.
+        assert eng.ledger.reconcile()["ok"]
+        assert eng.ledger.window_report()["buckets"]["kv_handoff"] > 0
+
+    def test_fill_rejects_resident_key_spill_rejects_unknown(
+        self, served
+    ):
+        cfg, params, base, _ = served
+        eng, mesh = _engine(cfg)
+        eng.serve(replicated_params(params, mesh), [base])
+        (key, *_) = eng.retained_prefixes()
+        rows, _ = eng.spill_page(key, drop=False)
+        with pytest.raises(ValueError):
+            eng.fill_page(key, rows)          # still resident
+        with pytest.raises(KeyError):
+            eng.spill_page(b"nope", drop=False)
+
+    def test_digest_is_page_aligned_at_partial_boundaries(self, served):
+        """A 9-token prompt on 4-token pages retains prefixes [:4] and
+        [:8] — never the ragged [:9] (the last prompt token always
+        recomputes, so no key can cover it)."""
+        cfg, params, base, _ = served
+        eng, mesh = _engine(cfg)
+        eng.serve(replicated_params(params, mesh), [base])
+        _, digest = eng.prefix_digest()
+        assert eng.prefix_hash(base[:4].tobytes()) in digest
+        assert eng.prefix_hash(base[:8].tobytes()) in digest
+        assert eng.prefix_hash(base[:9].tobytes()) not in digest
+        retained = set(eng.retained_prefixes())
+        assert base[:4].tobytes() in retained
+        assert base[:8].tobytes() in retained
+
+    def test_partial_page_overlap_realizes_whole_pages_only(
+        self, served
+    ):
+        """A second prompt sharing 6 of 8 cached tokens realizes ONE
+        page (4 tokens): hits never split a page."""
+        cfg, params, base, rng = served
+        eng, mesh = _engine(cfg)
+        p = replicated_params(params, mesh)
+        eng.serve(p, [base])
+        o = np.concatenate([
+            base[:6],
+            rng.integers(1, cfg.vocab_size, size=(3,)).astype(np.int32),
+        ])
+        solo, m2 = _engine(cfg)
+        ref = solo.serve(replicated_params(params, m2), [o])[0]
+        rid = eng.add_request(o)
+        eng.expected_prefix[rid] = 2 * PAGE    # router predicted [:8]
+        out = _edrain(eng, p)[rid]
+        np.testing.assert_array_equal(ref, np.asarray(out))
+        assert eng.prefix_realized.pop(rid) == PAGE
+
+    def test_evicted_mid_route_degrades_to_counted_re_prefill(
+        self, served
+    ):
+        """Score said hit, admission finds the page gone: the request
+        re-prefills from the prompt (bit-identical tokens) and the
+        tier-miss counter records the wasted placement."""
+        cfg, params, base, _ = served
+        eng, mesh = _engine(cfg)
+        p = replicated_params(params, mesh)
+        ref = eng.serve(p, [base])[0]
+        miss0 = eng._c_tier_miss.value
+        # Route-time view: both pages resident → predict 8 tokens ...
+        predicted = 2 * PAGE
+        rid = eng.add_request(base)
+        eng.expected_prefix[rid] = predicted
+        # ... then the deeper page vanishes before admission.
+        eng.spill_page(base[:8].tobytes(), drop=True)
+        out = _edrain(eng, p)[rid]
+        np.testing.assert_array_equal(ref, np.asarray(out))
+        assert eng.prefix_realized.pop(rid) == PAGE
+        assert eng._c_tier_miss.value == miss0 + 1
+
+    def test_swap_commit_drops_registry_and_digest(self, served):
+        cfg, params, base, _ = served
+        eng, mesh = _engine(cfg)
+        eng.serve(replicated_params(params, mesh), [base])
+        epoch0, digest0 = eng.prefix_digest()
+        assert digest0
+        new_params = jax.tree.map(
+            lambda x: x * (1.0 + 1e-3),
+            replicated_params(params, mesh),
+        )
+        assert eng.swap_weights(new_params, version=5)
+        epoch1, digest1 = eng.prefix_digest()
+        assert eng.weights_version == 5
+        assert not digest1          # old-params KV must not seed v5
+        assert epoch1 > epoch0
+        assert eng.retained_prefixes() == []
+
+
+class TestEconomyFleet:
+    @pytest.fixture(scope="class")
+    def fleet(self, served):
+        cfg, params, base, _ = served
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 1),
+            **ENGINE_KW,
+        )
+        from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+            FlightRecorder,
+        )
+
+        econ = KvEconomy(hbm_retained_target=0, burn_threshold=1e9)
+        router = FleetRouter(
+            reps, policy=FleetPolicy(prefix_weight=0.5), kv_economy=econ,
+            recorder=FlightRecorder(),
+        )
+        return router, econ
+
+    def test_attach_rejects_mixed_page_size(self, served):
+        cfg, params, _, _ = served
+        reps = make_replicas(
+            cfg, RULES_DP_TP, params, count=1, mesh_shape=(1, 1),
+            **ENGINE_KW,
+        ) + make_replicas(
+            cfg, RULES_DP_TP, params, count=1, mesh_shape=(1, 1),
+            offset=1, **{**ENGINE_KW, "page_size": 8},
+        )
+        with pytest.raises(ValueError):
+            FleetRouter(reps, kv_economy=KvEconomy())
+
+    def test_placement_lands_on_longest_prefix_replica(
+        self, served, fleet
+    ):
+        cfg, params, base, rng = served
+        router, econ = fleet
+        # Warm the base chain onto whichever replica placement picks.
+        router.add_request(base)
+        router.drain()
+        hits = econ.predicted_hits(base)
+        assert sorted(hits.values(), reverse=True)[0] == 2 * PAGE
+        home = max(hits, key=hits.get)
+        cold = next(n for n in router.replicas if n != home)
+        assert hits[cold] == 0
+        # An overlapping request must land ON the home replica even
+        # when its queue is deeper than the cold one's.
+        o = np.concatenate([
+            base[:8],
+            rng.integers(1, cfg.vocab_size, size=(3,)).astype(np.int32),
+        ])
+        rid = router.add_request(o)
+        router.drain()
+        fin = next(
+            e for e in router.recorder.events("fleet.finish")
+            if e["rid"] == rid
+        )
+        assert fin["replica"] == home
+        rec = next(c for c in router._completed if c["rid"] == rid)
+        assert rec["prefix_predicted"] == 2 * PAGE
+        assert rec["prefix_realized"] == 2 * PAGE
+        stats = router.latency_stats()
+        assert stats["prefix_hit_rate"] > 0
+        assert stats["tier_miss_rate"] == 0.0
+
+    def test_demotion_feeds_host_tier_and_promotion_realizes(
+        self, served, fleet
+    ):
+        cfg, params, base, rng = served
+        router, econ = fleet
+        router.add_request(base)
+        router.drain()
+        home = max(econ.predicted_hits(base), key=econ.predicted_hits(base).get)
+        # hbm_retained_target=0: the sweep demotes the chain to the
+        # host tier (write-back — the HBM copy stays evictable).
+        demoted = econ.maintain()
+        tier = econ.tier_of(home)
+        version = router.replicas[home].engine.weights_version
+        assert tier.has(base[:4].tobytes(), version=version)
+        assert tier.has(base[:8].tobytes(), version=version)
+        # Force the HBM copies out, then promotion restores the chain
+        # from the host tier and the NEXT admission realizes it.
+        eng = router.replicas[home].engine
+        for key in (base[:8].tobytes(), base[:4].tobytes()):
+            eng.spill_page(key, drop=True)
+        assert econ.predicted_hits(base)[home] == 2 * PAGE   # tier-held
+        filled = econ.promote(router.replicas[home], base)
+        assert filled == 2
+        rep = econ.tier_report()
+        assert rep["promotions"] >= 2
+        assert rep["fill_bytes"] > 0
+        assert rep["replicas"][home]["host_pages"] >= 2
+        # Every replica's ledger still accounts for 100% of its wall.
+        assert router.goodput_report()["reconcile_ok"]
+
+    def test_peer_promotion_copies_without_disturbing_owner(
+        self, served, fleet
+    ):
+        cfg, params, base, _ = served
+        router, econ = fleet
+        router.add_request(base)
+        router.drain()
+        hits = econ.predicted_hits(base)
+        home = max(hits, key=hits.get)
+        cold = next(n for n in router.replicas if n != home)
+        before = econ.tier_report()["peer_promotions"]
+        owner_digest = router.replicas[home].engine.prefix_digest()[1]
+        filled = econ.promote(router.replicas[cold], base)
+        assert filled == 2
+        assert econ.tier_report()["peer_promotions"] >= before + 2
+        # The owner's pages were read non-destructively.
+        assert router.replicas[home].engine.prefix_digest()[1] == (
+            owner_digest
+        )
+        # The copy is real: the cold replica now predicts the hit too.
+        assert econ.predicted_hits(base)[cold] == 2 * PAGE
+
+    def test_swap_commit_invalidates_router_prediction(
+        self, served, fleet
+    ):
+        """Runs LAST in the class: commits a swap on every replica, so
+        all cached KV (HBM and tier) is stale for the new version —
+        predicted hits must drop to zero fleet-wide."""
+        cfg, params, base, _ = served
+        router, econ = fleet
+        router.add_request(base)
+        router.drain()
+        assert max(econ.predicted_hits(base).values()) == 2 * PAGE
+        for rep in router.replicas.values():
+            new_params = jax.tree.map(
+                lambda x: x * (1.0 + 1e-3), rep.params,
+            )
+            assert rep.engine.swap_weights(new_params, version=7)
+        hits = econ.predicted_hits(base)
+        assert all(v == 0 for v in hits.values())
