@@ -870,6 +870,43 @@ def bench_fleet():
             _log(line)
 
 
+def bench_multistep():
+    """Multi-step scheduling horizon ladder (round 16): the fused
+    ``multi_step`` program (one dispatch per N engine iterations, host
+    demoted to an async next-horizon planner) vs today's
+    per-iteration loop, N ∈ {1, 2, 4, 8, 16}.
+
+    The ladder is host-loop physics over the emulated 8-device mesh —
+    nothing chip-specific — so it runs in a subprocess
+    (``scripts/perf_hostloop.py --bench-lines``) whose lines are
+    relayed, exactly like ``bench_fleet``. Two regimes per rung: "raw"
+    (emulated mesh as-is; owns the structural metrics — host_share,
+    steps/dispatch, boundary stall) and "multistep" (a modeled fixed
+    per-dispatch cost through the ``engine.dispatch`` seam, the
+    BENCH r05 tunneled-chip regime; owns the headline tok/s).
+    ``scripts/bench_compare.py`` gates host_share_pct (down) and
+    steps_per_dispatch (up) per rung, direction-aware."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent
+        / "scripts" / "perf_hostloop.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--bench-lines"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"perf_hostloop exited {proc.returncode}: {tail}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+
+
 def bench_kv_economy():
     """KV economy A/B (round 15): the SAME 80%-prefix-overlap traffic
     mix through K=4 paged replicas, prefix-aware (``KvEconomy`` wired:
@@ -1148,6 +1185,10 @@ def main():
         bench_fleet()
     except Exception as e:
         _log(f"[bench] fleet bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_multistep()
+    except Exception as e:
+        _log(f"[bench] multistep bench skipped: {type(e).__name__}: {e}")
     try:
         bench_kv_economy()
     except Exception as e:

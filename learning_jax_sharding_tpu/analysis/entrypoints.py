@@ -205,7 +205,8 @@ def _sharded_serving_params(model, mesh, rules):
 
 
 def _engine_programs(
-    *, speculative: bool, mixed: bool = False, adapters: bool = False
+    *, speculative: bool, mixed: bool = False, adapters: bool = False,
+    horizon: int = 1,
 ) -> list[EntryProgram]:
     """Prefill + decode via a real (tiny) ContinuousEngine: one short
     serve populates the dispatch-arg caches, then each program relowers
@@ -221,7 +222,14 @@ def _engine_programs(
     AdapterPool` and the contract is ``adapter_mixed_step`` /
     ``spec_adapter_mixed_step`` — the per-row LoRA gather + batch-1
     merged apply must add NO collectives beyond the base mixed step
-    (adapter slices are co-sharded with the kernels they adapt)."""
+    (adapter slices are co-sharded with the kernels they adapt). With
+    ``horizon > 1`` (round 16) the engine dispatches the SCANNED
+    multi-step family instead and contributes the ``multi_step`` /
+    ``spec_multi_step`` / ``adapter_multi_step`` /
+    ``spec_adapter_multi_step`` golden — the contract that fusing N
+    iterations into one ``lax.scan`` adds ZERO collectives over N× the
+    single-step multiset (shardflow prices the scanned body at the
+    horizon trip count)."""
     import dataclasses as dc
 
     from learning_jax_sharding_tpu.models.serving import ContinuousEngine
@@ -239,6 +247,8 @@ def _engine_programs(
             Transformer(cfg), mesh, RULES_TP_SERVING
         )
         kwargs: dict = dict(mixed=mixed) if mixed else {}
+        if horizon > 1:
+            kwargs["horizon"] = horizon
         d_params = None
         if speculative:
             d_cfg = dc.replace(cfg, num_layers=1)
@@ -293,7 +303,14 @@ def _engine_programs(
             built["sf"] = built["eng"].explain_collectives()
         return built["sf"]
 
-    if adapters:
+    if adapters and horizon > 1:
+        names = (
+            ("spec_adapter_multi_step",) if speculative
+            else ("adapter_multi_step",)
+        )
+    elif horizon > 1:
+        names = ("spec_multi_step",) if speculative else ("multi_step",)
+    elif adapters:
         names = (
             ("spec_adapter_mixed_step",) if speculative
             else ("adapter_mixed_step",)
@@ -322,6 +339,17 @@ def _serving_programs() -> list[EntryProgram]:
         *_engine_programs(speculative=True, mixed=True),
         *_engine_programs(speculative=False, mixed=True, adapters=True),
         *_engine_programs(speculative=True, mixed=True, adapters=True),
+        # The device-resident multi-step family (round 16): one scanned
+        # program per engaged family at horizon=4 — the golden pins that
+        # fusing the horizon adds no collectives over N single steps.
+        *_engine_programs(speculative=False, mixed=True, horizon=4),
+        *_engine_programs(speculative=True, mixed=True, horizon=4),
+        *_engine_programs(
+            speculative=False, mixed=True, adapters=True, horizon=4
+        ),
+        *_engine_programs(
+            speculative=True, mixed=True, adapters=True, horizon=4
+        ),
     ]
 
 

@@ -131,9 +131,15 @@ _HOT_CLASS_RE = re.compile(r"Engine")
 
 #: Engine methods whose ENTIRE wall-clock the goodput ledger must
 #: account for (telemetry/ledger.py's Σ buckets == wall invariant).
+#: Round 16 adds the multi-step planner family (``_plan_*``,
+#: ``_take_staged_plan``, ``_boundary_fingerprint``): the host's
+#: next-horizon planning runs CONCURRENT with an in-flight fused
+#: dispatch, so an untimed or device-syncing planner would both skew
+#: the sched bucket and serialize the overlap the design exists for.
 _LEDGER_PHASE_RE = re.compile(
     r"^(step|_admit|_sweep_deadlines|_try_commit_swap|export_kv|"
-    r"ingest_kv)$|dispatch"
+    r"ingest_kv|_take_staged_plan|_boundary_fingerprint)$"
+    r"|dispatch|^_plan_"
 )
 
 #: Compiled-executable dispatch: the engine's jitted callables are all

@@ -461,6 +461,7 @@ class ContinuousEngine:
         decode_chain: int = 1,
         mixed: bool = False,
         token_budget: int | None = None,
+        horizon: int = 1,
         temperature: float = 0.0,
         top_k: int | None = None,
         top_p: float | None = None,
@@ -504,6 +505,14 @@ class ContinuousEngine:
             )
         if decode_chain < 1:
             raise ValueError(f"decode_chain must be >= 1, got {decode_chain}")
+        if horizon < 1:
+            raise ValueError(f"horizon must be >= 1, got {horizon}")
+        if horizon > 1 and not mixed:
+            raise ValueError(
+                "horizon > 1 requires mixed=True: the multi-step scan "
+                "fuses the MIXED iteration body (the split engine's "
+                "decode_block already amortizes its loop on device)"
+            )
         if token_budget is not None and not mixed:
             raise ValueError("token_budget requires mixed=True")
         if token_budget is not None and token_budget < 1:
@@ -1140,6 +1149,194 @@ class ContinuousEngine:
                 remaining, rid, rng,
             )
 
+        def _multi_scan(apply_fn):
+            # THE device-resident multi-step loop (ROADMAP item 1): a
+            # ``lax.scan`` over the EXACT ``_mixed_core`` body, with the
+            # slot bookkeeping the host used to re-derive every iteration
+            # (tok/active/remaining) carried in the scan state instead.
+            # The host plans the whole horizon's refill schedule up front
+            # (stacked (N, B, ...) plan arrays ride as scan xs) and
+            # touches Python ONCE per horizon — one dispatch, one sync.
+            # Per-step ``lax.cond`` early-exit: a step the host did not
+            # plan (``live`` 0 — the fixed-shape horizon's trailing
+            # padding) or whose plan row has no refill while the carry
+            # holds no active row skips the model apply entirely, so
+            # padded steps cost control flow, not FLOPs. The ``live``
+            # gate is load-bearing, not an optimization: the host only
+            # consumes tokens from PLANNED links, so an unplanned step
+            # must not advance any row (a speculative row can still be
+            # active past the optimistic chain cap).
+            def run(params, cache, chunks, lengths, reset_mask, reset_to,
+                    live, tok, active, remaining, rid, rng):
+                def body(carry, x):
+                    tok, active, remaining, cache = carry
+                    chunk, lens, rmask, rto, lv = x
+
+                    def step(_):
+                        nxt, tok2, active2, remaining2, cache2 = (
+                            _mixed_core(
+                                apply_fn, params, cache, chunk, lens,
+                                rmask, rto, tok, active, remaining, rid,
+                                rng,
+                            )
+                        )
+                        return (tok2, active2, remaining2, cache2), nxt
+
+                    def frozen(_):
+                        return (tok, active, remaining, cache), tok
+
+                    has_work = jnp.logical_and(
+                        lv > 0,
+                        jnp.logical_or(
+                            jnp.any(lens > 0), jnp.any(active == 1)
+                        ),
+                    )
+                    return jax.lax.cond(has_work, step, frozen, None)
+
+                (tok, active, remaining, cache), toks = jax.lax.scan(
+                    body, (tok, active, remaining, cache),
+                    (chunks, lengths, reset_mask, reset_to, live),
+                )
+                return toks, tok, active, remaining, cache
+
+            return run
+
+        @jax.jit
+        def multi_step(
+            params, cache, chunks, lengths, reset_mask, reset_to, live,
+            tok, active, remaining, rid, rng,
+        ):
+            """``horizon`` fused engine iterations in ONE dispatch: a
+            ``lax.scan`` whose body is exactly ``mixed_step``'s
+            (``_mixed_core`` — shared, so the two program families cannot
+            drift), consuming one host-planned (chunk, lengths, resets)
+            plan row per step and carrying tok/active/remaining/cache
+            device-side. Per-row retirement happens IN-scan (remaining
+            hits 0 / EOS flips ``active``), and a ``cond`` skips steps
+            with no work, so the program is one executable per horizon
+            and the host syncs once per N tokens instead of once per
+            token. Token streams are bit-identical to N sequential
+            ``mixed_step`` iterations (test-pinned): the per-row
+            computation is the same, and sampling draws are keyed by
+            (request id, generated position), never by schedule."""
+            return _multi_scan(apply)(
+                params, cache, chunks, lengths, reset_mask, reset_to,
+                live, tok, active, remaining, rid, rng,
+            )
+
+        @jax.jit
+        def adapter_multi_step(
+            params, pool, aidx, cache, chunks, lengths, reset_mask,
+            reset_to, live, tok, active, remaining, rid, rng,
+        ):
+            """``multi_step`` with the per-row adapter gather: ``sel`` is
+            gathered ONCE outside the scan (``aidx`` is fixed for the
+            whole horizon — admission only lands at horizon boundaries),
+            then every scanned step applies each row's merged weights,
+            bit-identical to N ``adapter_mixed_step`` iterations."""
+            sel = jax.tree.map(lambda s: s[aidx], pool)
+            return _multi_scan(_adapter_apply(sel))(
+                params, cache, chunks, lengths, reset_mask, reset_to,
+                live, tok, active, remaining, rid, rng,
+            )
+
+        def _spec_multi_scan(apply_fn):
+            # The speculative multi-step loop: scans ``_spec_mixed_core``
+            # with the per-row rollback state (pos) and BOTH caches in
+            # the carry; each step's emission buffer/count/acceptance
+            # telemetry ride the scan ys (stacked (N, B, ...) — the host
+            # consumes them per planned link after the one sync).
+            def run(params, d_params, t_cache, d_cache, chunks, lengths,
+                    reset_mask, reset_to, live, tok, active, pos,
+                    remaining, rid, rng):
+                width = num_draft + 1
+
+                def body(carry, x):
+                    tok, active, pos, remaining, t_cache, d_cache = carry
+                    chunk, lens, rmask, rto, lv = x
+
+                    def step(_):
+                        (first_tok, buffer, count, acc, prop, tok2, pos2,
+                         active2, remaining2, t2, d2) = _spec_mixed_core(
+                            apply_fn, params, d_params, t_cache, d_cache,
+                            chunk, lens, rmask, rto, tok, active, pos,
+                            remaining, rid, rng,
+                        )
+                        return (
+                            (tok2, active2, pos2, remaining2, t2, d2),
+                            (first_tok, buffer, count, acc, prop),
+                        )
+
+                    def frozen(_):
+                        zi = jnp.zeros_like(tok)
+                        zb = jnp.zeros((tok.shape[0], width), jnp.int32)
+                        return (
+                            (tok, active, pos, remaining, t_cache,
+                             d_cache),
+                            (tok, zb, zi, zi, zi),
+                        )
+
+                    has_work = jnp.logical_and(
+                        lv > 0,
+                        jnp.logical_or(
+                            jnp.any(lens > 0), jnp.any(active == 1)
+                        ),
+                    )
+                    return jax.lax.cond(has_work, step, frozen, None)
+
+                carry0 = (tok, active, pos, remaining, t_cache, d_cache)
+                (tok, active, pos, remaining, t_cache, d_cache), ys = (
+                    jax.lax.scan(
+                        body, carry0,
+                        (chunks, lengths, reset_mask, reset_to, live),
+                    )
+                )
+                first_toks, buffers, counts, accs, props = ys
+                return (
+                    first_toks, buffers, counts, accs, props, tok, pos,
+                    active, remaining, t_cache, d_cache,
+                )
+
+            return run
+
+        @jax.jit
+        def spec_multi_step(
+            params, d_params, t_cache, d_cache, chunks, lengths,
+            reset_mask, reset_to, live, tok, active, pos, remaining, rid,
+            rng,
+        ):
+            """The speculative ``multi_step``: ``horizon`` scanned
+            ``spec_mixed_step`` bodies, each a budgeted refill sub-step
+            plus one draft-verify round, with the per-row rollback index
+            (``pos``) and both caches carried device-side. A step's
+            1..num_draft+1 accepted tokens land in its ys buffer row; the
+            host appends them per planned link after the single sync —
+            bit-identical to N sequential ``spec_mixed_step``
+            iterations."""
+            return _spec_multi_scan(apply)(
+                params, d_params, t_cache, d_cache, chunks, lengths,
+                reset_mask, reset_to, live, tok, active, pos, remaining,
+                rid, rng,
+            )
+
+        @jax.jit
+        def adapter_spec_multi_step(
+            params, pool, aidx, d_params, t_cache, d_cache, chunks,
+            lengths, reset_mask, reset_to, live, tok, active, pos,
+            remaining, rid, rng,
+        ):
+            """``spec_multi_step`` with the per-row adapter gather (once,
+            outside the scan — see ``adapter_multi_step``): verification
+            runs each row against its own merged weights, the shared
+            draft proposes with the base weights, exactly as in
+            ``adapter_spec_mixed_step``."""
+            sel = jax.tree.map(lambda s: s[aidx], pool)
+            return _spec_multi_scan(_adapter_apply(sel))(
+                params, d_params, t_cache, d_cache, chunks, lengths,
+                reset_mask, reset_to, live, tok, active, pos, remaining,
+                rid, rng,
+            )
+
         @jax.jit
         def kv_export(cache, slot):
             """One slot's cache ROW — every cache leaf indexed at ``slot``
@@ -1240,6 +1437,15 @@ class ContinuousEngine:
             token_budget if token_budget is not None
             else refill_chunk + batch_size
         )
+        # Public and runtime-tunable like decode_chain/token_budget: the
+        # number of fused engine iterations ONE dispatch advances
+        # (ROADMAP item 1). ``horizon=1`` IS today's loop — same
+        # programs, same goldens, same telemetry counters (test-pinned);
+        # ``horizon>1`` routes the steady-state mixed path through the
+        # scanned ``multi_step`` family (one executable per horizon) and
+        # demotes the host to the async boundary planner
+        # (``_plan_next_horizon``). Read at each dispatch.
+        self.horizon = horizon
         self._num_draft = num_draft
         self._speculative = speculative
         # Recovery policies (round 10): request TTLs, admission control,
@@ -1266,6 +1472,10 @@ class ContinuousEngine:
         self._spec_mixed_step_fn = spec_mixed_step
         self._adapter_mixed_step_fn = adapter_mixed_step
         self._adapter_spec_mixed_step_fn = adapter_spec_mixed_step
+        self._multi_step_fn = multi_step
+        self._spec_multi_step_fn = spec_multi_step
+        self._adapter_multi_step_fn = adapter_multi_step
+        self._adapter_spec_multi_step_fn = adapter_spec_multi_step
         self._kv_export_fn = kv_export
         self._kv_ingest_fn = kv_ingest
         self._kv_page_spill_fn = kv_page_spill
@@ -1295,6 +1505,12 @@ class ContinuousEngine:
         self._last_decode_args = None
         self._last_decode_plain_args = None   # degraded-spec decode_block
         self._last_mixed_args = None
+        self._last_multi_args = None          # multi-step scan (horizon>1)
+        # The async planner's staged next-horizon plan: (fingerprint,
+        # plan) — consumed by the next _multi_dispatch only when the
+        # boundary state still matches the prediction (see
+        # _plan_next_horizon), so staging can never change results.
+        self._staged_plan = None
         self._last_kv_export_args = None      # disaggregated handoff
         self._last_kv_ingest_args = None
         self._last_kv_page_spill_args = None  # KV tier ladder (round 15)
@@ -1407,6 +1623,22 @@ class ContinuousEngine:
             "engine_decode_stall_seconds_total",
             "dispatch seconds during which decoding rows sat idle "
             "behind another slot's refill")
+        self._c_multi_n = r.counter(
+            "engine_multi_dispatches_total",
+            "fused multi-step dispatches (horizon > 1 — one scanned "
+            "program advancing N engine iterations)")
+        self._c_multi_links = r.counter(
+            "engine_multi_links_total",
+            "engine iterations advanced inside multi-step dispatches "
+            "(steps_per_dispatch = links / dispatches)")
+        self._c_plan_staged = r.counter(
+            "engine_plan_staged_total",
+            "next-horizon refill plans staged by the async planner "
+            "while a multi-step program was in flight")
+        self._c_plan_reused = r.counter(
+            "engine_plan_reused_total",
+            "staged plans consumed at the next horizon boundary (the "
+            "boundary state matched the planner's prediction)")
         self._c_creations = r.counter(
             "engine_cache_creations_total", "cache-creating first refills")
         self._c_shed = r.counter(
@@ -1607,6 +1839,8 @@ class ContinuousEngine:
                 self._c_preempt, self._c_pfx_hits, self._c_pfx_pages,
                 self._c_spec_acc, self._c_spec_prop, self._c_refill_s,
                 self._c_decode_s, self._c_mixed_s, self._c_stall_s,
+                self._c_multi_n, self._c_multi_links,
+                self._c_plan_staged, self._c_plan_reused,
                 self._c_requests, self._c_finished, self._c_shed,
                 self._c_deadline, self._c_req_failed, self._c_rerouted,
                 self._c_pg_spills, self._c_pg_fills,
@@ -1923,6 +2157,8 @@ class ContinuousEngine:
         self._last_refill_args = self._last_decode_args = None
         self._last_decode_plain_args = None
         self._last_mixed_args = None
+        self._last_multi_args = None
+        self._staged_plan = None
         self._last_kv_export_args = None
         self._last_kv_ingest_args = None
         self._last_kv_page_spill_args = None
@@ -3671,6 +3907,11 @@ class ContinuousEngine:
                 else False
             )
         per_link = (self._num_draft + 1) if self._speculative else 1
+        # The fused-link count ONE host iteration covers: the multi-step
+        # horizon when engaged, else the decode chain (horizon=1 IS
+        # today's loop — same programs, byte-for-byte).
+        horizon = int(self.horizon)
+        n_links = horizon if horizon > 1 else max(1, self.decode_chain)
 
         def chain_cap(remaining, active):
             # Links the longest-running decoding row can still use
@@ -3687,7 +3928,7 @@ class ContinuousEngine:
         if self._paged and self._active.any():
             # Cover every decode position this chain can write, with the
             # decode path's recompute-preemption fallback.
-            links_hint = min(self.decode_chain, max(chain_dec, 1))
+            links_hint = min(n_links, max(chain_dec, 1))
             for slot in range(b):
                 if not self._active[slot]:
                     continue
@@ -3751,6 +3992,27 @@ class ContinuousEngine:
             # mid-chain.
             pool_t = self._adapter_pool.tree
             aidx_d = jnp.asarray(self._aidx)
+        if horizon > 1:
+            # Device-resident multi-step path: the horizon's plan is
+            # staged host-side and ONE scanned program advances all of
+            # it — same preamble (chaos seam, paged pre-ensure, chain
+            # caps) as the link loop below, so the two paths cannot
+            # drift on scheduling policy.
+            return self._multi_dispatch(
+                params, d_params, retired, n_links=n_links,
+                per_link=per_link, chain_dec=chain_dec,
+                was_active=was_active, n_active=n_active, tok_d=tok_d,
+                active_d=active_d, remaining_d=remaining_d, rid=rid,
+                pos_d=pos_d if self._speculative else None,
+                t_cache=t_cache if self._speculative else None,
+                d_cache=d_cache if self._speculative else None,
+                pool_t=(
+                    pool_t if self._adapter_pool is not None else None
+                ),
+                aidx_d=(
+                    aidx_d if self._adapter_pool is not None else None
+                ),
+            )
         segs = []
         starved_total = 0
         refill_scheduled = 0
@@ -3932,6 +4194,400 @@ class ContinuousEngine:
                         toks = [int(first_np[slot])]
                     self._consume(slot, toks, now, retired)
         return "mixed"
+
+    def _plan_horizon_links(
+        self, n_links, n_active, per_link, chain_dec, *, allow_preempt,
+    ):
+        """The HOST half of the multi-step scheduler: the per-link refill
+        plan for up to ``n_links`` fused links — ``_schedule_refill``'s
+        policy (FCFS by admission order, decode funded first out of
+        ``token_budget``) applied over a VIRTUAL pending advance: reads
+        ``self._pending`` through per-slot offsets and never consumes it;
+        the caller commits the advance when (and only when) the plan
+        dispatches. Returns ``(links, offs)`` where each link is
+        ``(chunk, lengths, starved, completes)``, or ``None`` when
+        ``allow_preempt=False`` (the in-flight planner) and the page pool
+        cannot cover the plan — preemption is a BOUNDARY decision, so
+        speculative staging aborts instead of un-admitting anyone."""
+        b = self._b
+        with self.ledger.measure("sched"):
+            offs = [0] * b
+            links = []
+            for link in range(n_links):
+                budget = (
+                    max(0, self.token_budget - n_active * per_link)
+                    if n_active else b * self._refill_chunk
+                )
+                lengths = np.zeros((b,), np.int32)
+                chunk = np.zeros((b, self._refill_chunk), np.int32)
+                starved = 0
+                completes = []
+                order = sorted(
+                    (
+                        s for s in range(b)
+                        if self._pending[s].size - offs[s] > 0
+                    ),
+                    key=lambda s: (
+                        self._slot_req[s].admit_t,
+                        self._slot_req[s].arrival_t,
+                    ),
+                )
+                for slot in order:
+                    if budget <= 0:
+                        starved += 1
+                        continue
+                    n = min(
+                        self._pending[slot].size - offs[slot],
+                        self._refill_chunk, budget,
+                    )
+                    if self._paged:
+                        consumed = (
+                            self._plen[slot] - self._pending[slot].size
+                            + offs[slot]
+                        )
+                        try:
+                            self._ensure(slot, consumed + n)
+                        except RuntimeError:
+                            if not allow_preempt:
+                                return None
+                            # Backpressure, exactly as _schedule_refill:
+                            # requeue unless this request is the only one
+                            # holding pages. Scrub the un-admitted slot
+                            # from the earlier planned links — nothing
+                            # dispatched yet, so the plan must not
+                            # stream a requeued request's chunks.
+                            if not any(
+                                self._req[s] >= 0
+                                for s in range(b) if s != slot
+                            ):
+                                raise
+                            self._unadmit(slot)
+                            self._c_preempt.inc()
+                            offs[slot] = 0
+                            for ch2, ln2, _s2, comp2 in links:
+                                ln2[slot] = 0
+                                ch2[slot, :] = 0
+                                if slot in comp2:
+                                    comp2.remove(slot)
+                            continue
+                    chunk[slot, :n] = (
+                        self._pending[slot][offs[slot]: offs[slot] + n]
+                    )
+                    lengths[slot] = n
+                    offs[slot] += n
+                    budget -= n
+                    if (
+                        offs[slot] == self._pending[slot].size
+                        and self._req[slot] >= 0
+                    ):
+                        completes.append(slot)
+                has_decode = n_active > 0 and link < chain_dec
+                if not lengths.any() and not has_decode:
+                    break
+                links.append((chunk, lengths, starved, completes))
+            return links, offs
+
+    def _boundary_fingerprint(self, n_links, n_active, per_link, chain_dec):
+        # Everything _plan_horizon_links reads: the slot occupancy, the
+        # pending sizes (contents are immutable between admissions, so
+        # sizes + request ids pin them), and the budget/cap inputs.
+        return (
+            tuple(self._req),
+            tuple(int(p.size) for p in self._pending),
+            int(n_active), int(chain_dec), int(n_links), int(per_link),
+            int(self.token_budget),
+        )
+
+    def _take_staged_plan(self, n_links, n_active, per_link, chain_dec):
+        """Consume the async planner's staged plan iff the boundary state
+        matches its prediction exactly — an EOS retirement, an admission,
+        a deadline eviction, a preemption, or a runtime knob change all
+        miss the fingerprint and fall back to live planning, so the
+        staged plan can only move host work off the boundary, never
+        change what dispatches."""
+        staged, self._staged_plan = self._staged_plan, None
+        if staged is None:
+            return None
+        fp, plan = staged
+        if fp != self._boundary_fingerprint(
+            n_links, n_active, per_link, chain_dec
+        ):
+            return None
+        self._c_plan_reused.inc()
+        return plan
+
+    def _multi_dispatch(
+        self, params, d_params, retired, *, n_links, per_link, chain_dec,
+        was_active, n_active, tok_d, active_d, remaining_d, rid,
+        pos_d=None, t_cache=None, d_cache=None, pool_t=None, aidx_d=None,
+    ):
+        # The DEVICE-RESIDENT steady-state loop (horizon > 1): plan the
+        # whole horizon's refill schedule host-side, dispatch ONE scanned
+        # ``multi_step`` program covering up to ``n_links`` fused
+        # iterations, overlap the NEXT horizon's planning with the
+        # in-flight device work (``_plan_next_horizon``), then sync ONCE
+        # and process every link's completions/consumption exactly as the
+        # per-link loop does. Reached from _mixed_dispatch AFTER its
+        # fallthroughs and preamble, so cache creation, degradation,
+        # pure-decode/pure-refill phases, the chaos seam, and the paged
+        # decode pre-ensure behave identically at every horizon.
+        b = self._b
+        plan = self._take_staged_plan(n_links, n_active, per_link, chain_dec)
+        reused = plan is not None
+        if plan is None:
+            plan = self._plan_horizon_links(
+                n_links, n_active, per_link, chain_dec, allow_preempt=True,
+            )
+        links, offs = plan
+        if not links:
+            return False
+        n_live = len(links)
+        # Commit the virtual pending advance NOW: the plan is final and
+        # the dispatch below is async — completions are processed after
+        # the one sync, from the per-link ``completes`` the plan carries.
+        for slot in range(b):
+            if offs[slot]:
+                self._pending[slot] = self._pending[slot][offs[slot]:]
+        starved_total = sum(link[2] for link in links)
+        refill_scheduled = sum(int(link[1].sum()) for link in links)
+        # Stack the plan into fixed-shape (N, B, ...) scan inputs — ONE
+        # executable per (horizon, program family); trailing padded
+        # steps ride the scan's cond skip. Link 0 carries every pending
+        # admission reset (idempotent on device, same as the link loop).
+        chunks = np.zeros((n_links, b, self._refill_chunk), np.int32)
+        lens = np.zeros((n_links, b), np.int32)
+        resets = np.zeros((n_links, b), bool)
+        reset_tos = np.zeros((n_links, b), np.int32)
+        for i, (chunk, lengths, _starved, _completes) in enumerate(links):
+            chunks[i] = chunk
+            lens[i] = lengths
+        resets[0] = self._needs_reset
+        reset_tos[0] = self._reset_to
+        if self._paged:
+            # All page allocation for the horizon happened in the plan
+            # (refill) and the preamble's pre-ensure (decode): push the
+            # final tables once for the whole horizon.
+            self._cache = (
+                (t_cache, d_cache) if self._speculative else self._cache
+            )
+            self._cache = self._set_tables(self._cache)
+            if self._speculative:
+                t_cache, d_cache = self._cache
+        live = np.zeros((n_links,), np.int32)
+        live[:n_live] = 1
+        chunks_d = jnp.asarray(chunks)
+        lens_d = jnp.asarray(lens)
+        resets_d = jnp.asarray(resets)
+        reset_tos_d = jnp.asarray(reset_tos)
+        live_d = jnp.asarray(live)
+        if self._speculative and self._adapter_pool is not None:
+            with self._led_device(
+                self._adapter_spec_multi_step_fn
+            ), annotate("engine.adapter_spec_multi_step"):
+                (first_toks, buffers, counts, accs, props, tok_d, pos_d,
+                 active_d, remaining_d, t_cache, d_cache) = (
+                    self._adapter_spec_multi_step_fn(
+                        params, pool_t, aidx_d, d_params, t_cache,
+                        d_cache, chunks_d, lens_d, resets_d, reset_tos_d,
+                        live_d, tok_d, active_d, pos_d, remaining_d, rid,
+                        self.rng,
+                    )
+                )
+            args = (
+                params, pool_t, aidx_d, d_params, t_cache, d_cache,
+                chunks_d, lens_d, resets_d, reset_tos_d, live_d, tok_d,
+                active_d, pos_d, remaining_d, rid, self.rng,
+            )
+        elif self._speculative:
+            with self._led_device(
+                self._spec_multi_step_fn
+            ), annotate("engine.spec_multi_step"):
+                (first_toks, buffers, counts, accs, props, tok_d, pos_d,
+                 active_d, remaining_d, t_cache, d_cache) = (
+                    self._spec_multi_step_fn(
+                        params, d_params, t_cache, d_cache, chunks_d,
+                        lens_d, resets_d, reset_tos_d, live_d, tok_d,
+                        active_d, pos_d, remaining_d, rid, self.rng,
+                    )
+                )
+            args = (
+                params, d_params, t_cache, d_cache, chunks_d, lens_d,
+                resets_d, reset_tos_d, live_d, tok_d, active_d, pos_d,
+                remaining_d, rid, self.rng,
+            )
+        elif self._adapter_pool is not None:
+            with self._led_device(
+                self._adapter_multi_step_fn
+            ), annotate("engine.adapter_multi_step"):
+                first_toks, tok_d, active_d, remaining_d, self._cache = (
+                    self._adapter_multi_step_fn(
+                        params, pool_t, aidx_d, self._cache, chunks_d,
+                        lens_d, resets_d, reset_tos_d, live_d, tok_d,
+                        active_d, remaining_d, rid, self.rng,
+                    )
+                )
+            buffers = counts = accs = props = None
+            args = (
+                params, pool_t, aidx_d, self._cache, chunks_d, lens_d,
+                resets_d, reset_tos_d, live_d, tok_d, active_d,
+                remaining_d, rid, self.rng,
+            )
+        else:
+            with self._led_device(
+                self._multi_step_fn
+            ), annotate("engine.multi_step"):
+                first_toks, tok_d, active_d, remaining_d, self._cache = (
+                    self._multi_step_fn(
+                        params, self._cache, chunks_d, lens_d, resets_d,
+                        reset_tos_d, live_d, tok_d, active_d,
+                        remaining_d, rid, self.rng,
+                    )
+                )
+            buffers = counts = accs = props = None
+            args = (
+                params, self._cache, chunks_d, lens_d, resets_d,
+                reset_tos_d, live_d, tok_d, active_d, remaining_d, rid,
+                self.rng,
+            )
+        self._last_multi_args = lambda a=args: a
+        if self._speculative:
+            self._cache = (t_cache, d_cache)
+        self._needs_reset[:] = False
+        self._reset_to[:] = 0
+        self.recorder.record(
+            "engine.mixed_schedule", links=n_live,
+            decode_rows=n_active, refill_tokens=refill_scheduled,
+            starved=starved_total, budget=self.token_budget,
+            queue_depth=len(self._queue), horizon=n_links,
+            plan_reused=reused,
+        )
+        self._c_multi_n.inc()
+        self._c_multi_links.inc(n_live)
+        if self._adapter_pool is not None:
+            self._c_adapter_n.inc(n_live)
+            self._c_adapter_rows.inc(
+                sum(
+                    1 for s in range(self._b)
+                    if self._req[s] >= 0 and self._aidx[s] > 0
+                ) * n_live
+            )
+        # THE async-planner window: the fused program is in flight and
+        # nothing below needs its results yet — stage the next horizon.
+        self._plan_next_horizon(n_links, per_link, chain_dec, links)
+        # ONE blocking readback for the whole horizon (the host's single
+        # touch per N iterations — books as in-flight device time).
+        with self._led_device():
+            toks_np = np.asarray(first_toks)
+            if self._speculative:
+                counts_np = np.asarray(counts)
+                buffers_np = np.asarray(buffers)
+                acc_np = np.asarray(accs)
+                props_np = np.asarray(props)
+        if self._speculative:
+            self._c_spec_acc.inc(int(acc_np[:n_live].sum()))
+            self._c_spec_prop.inc(int(props_np[:n_live].sum()))
+        now = time.perf_counter()
+        for i in range(n_live):
+            first_np = toks_np[i]
+            for slot in links[i][3]:
+                # Prompt complete at link i: its first token came from
+                # that link's refill pick (same rule as the link loop).
+                t = int(first_np[slot])
+                self._out[slot].append(t)
+                self._emitted[slot] = 1
+                self._tok[slot] = t
+                self._slot_req[slot].first_token_t = now
+                self._ttimes[slot].append(now)
+                self.tracer.instant(
+                    "request.first_token", rid=self._req[slot]
+                )
+                if (self._eos is not None and t == self._eos) or (
+                    self._max_new == 1
+                ):
+                    self._retire(slot, now, retired)
+                else:
+                    self._active[slot] = True
+            for slot in range(b):
+                # Decode consumption: rows decoding at HORIZON START
+                # that are still live (a row that retired at an earlier
+                # link froze on device — its later lanes carry no real
+                # tokens). Same rule as the link loop's per-seg pass.
+                if was_active[slot] and self._req[slot] >= 0:
+                    if self._speculative:
+                        toks = (
+                            buffers_np[i, slot, : counts_np[i, slot]]
+                            .tolist()
+                        )
+                    else:
+                        toks = [int(first_np[slot])]
+                    self._consume(slot, toks, now, retired)
+        return "mixed"
+
+    def _plan_next_horizon(self, n_links, per_link, chain_dec, links):
+        """The ASYNC PLANNER: runs while the fused multi-step program is
+        in flight (between its dispatch and the one blocking sync) and
+        stages the NEXT horizon's refill plan — including its page-run
+        reservations — against a PREDICTED boundary state. Reads only
+        host state the in-flight program never writes (pending prompt
+        views, the host page allocator) and performs NO device readback:
+        a planner sync would re-serialize the host onto the device clock
+        (lint-pinned, ``host-sync-in-hot-loop``). The staged plan
+        carries a fingerprint of the predicted state; the next dispatch
+        consumes it only on an exact match (``_take_staged_plan``), so a
+        wrong prediction costs a re-plan at the boundary, never a wrong
+        dispatch. Prediction is conservative: every active row advances
+        its MINIMUM (one token/round per decode link) and nobody emits
+        EOS — any faster drain or retirement misses the fingerprint."""
+        self._staged_plan = None
+        b = self._b
+        with self.ledger.measure("sched"):
+            n_dec = min(len(links), max(0, chain_dec))
+            rem = np.asarray(
+                [max(0, self._max_new - e) for e in self._emitted],
+                np.int32,
+            )
+            act = self._active.copy()      # horizon-start active rows
+            surv = act & (rem > n_dec)
+            rem_pred = rem.copy()
+            rem_pred[act] = np.maximum(rem_pred[act] - n_dec, 0)
+            req_pred = list(self._req)
+            for s in range(b):
+                if act[s] and not surv[s]:
+                    req_pred[s] = -1
+            for _c, _l, _st, comp in links:
+                for s in comp:
+                    # A prompt completing this horizon becomes an active
+                    # decode row at the boundary (unless it retires at
+                    # its first token — max_new == 1 here; EOS misses
+                    # the fingerprint).
+                    if self._max_new > 1:
+                        surv[s] = True
+                        rem_pred[s] = self._max_new - 1
+                    else:
+                        req_pred[s] = -1
+            n_active_pred = int(surv.sum())
+            chain_pred = (
+                -(-int(rem_pred[surv].max()) // per_link)
+                if surv.any() else 0
+            )
+            plan = self._plan_horizon_links(
+                n_links, n_active_pred, per_link, chain_pred,
+                allow_preempt=False,
+            )
+            if plan is None or not plan[0]:
+                return
+            fp = (
+                tuple(req_pred),
+                tuple(int(p.size) for p in self._pending),
+                n_active_pred, chain_pred, int(n_links), int(per_link),
+                int(self.token_budget),
+            )
+            self._staged_plan = (fp, plan)
+            self._c_plan_staged.inc()
+            self.recorder.record(
+                "engine.plan_staged", links=len(plan[0]),
+                predicted_active=n_active_pred,
+            )
 
     @property
     def degradation_level(self) -> int:
@@ -4161,6 +4817,22 @@ class ContinuousEngine:
             decode_stall_s=stall_s,
             decode_stall_share=(stall_s / busy) if busy else None,
         )
+        # Multi-step scheduler (round 16): engine iterations fused per
+        # host dispatch this window. 1.0 means the host round-tripped
+        # every token (horizon=1); the gate in scripts/bench_compare.py
+        # tracks it direction-aware (up = fewer host touches per token).
+        multi_n = self._win_delta(self._c_multi_n)
+        if multi_n:
+            out.update(
+                multi_dispatches=int(multi_n),
+                steps_per_dispatch=(
+                    self._win_delta(self._c_multi_links) / multi_n
+                ),
+                plan_reuse_rate=(
+                    self._win_delta(self._c_plan_reused)
+                    / max(1.0, self._win_delta(self._c_plan_staged))
+                ),
+            )
         # Recovery-policy telemetry (round 10), window-derived like the
         # rest: shed_rate is the fraction of ARRIVALS admission control
         # rejected; deadline_miss_rate the fraction of RETIREMENTS that
@@ -4257,6 +4929,21 @@ class ContinuousEngine:
                 self._spec_mixed_step_fn if self._speculative
                 else self._mixed_step_fn
             )
+        if self._mixed and self._last_multi_args is not None:
+            # The fused horizon program (horizon > 1): ONE additional
+            # steady-state executable per engaged program family — held
+            # at 1 per (horizon, family) by the same fixed-shape plan
+            # arrays that hold mixed_step at 1.
+            if self._adapter_pool is not None:
+                fns["adapter_multi_step"] = (
+                    self._adapter_spec_multi_step_fn if self._speculative
+                    else self._adapter_multi_step_fn
+                )
+            else:
+                fns["multi_step"] = (
+                    self._spec_multi_step_fn if self._speculative
+                    else self._multi_step_fn
+                )
         if self._last_kv_export_args is not None:
             fns["kv_export"] = self._kv_export_fn
         if self._last_kv_ingest_args is not None:
@@ -4313,6 +5000,20 @@ class ContinuousEngine:
                 )
                 name = "mixed_step"
             out.append((name, fn, self._last_mixed_args()))
+        if self._last_multi_args is not None:
+            if self._adapter_pool is not None:
+                fn = (
+                    self._adapter_spec_multi_step_fn if self._speculative
+                    else self._adapter_multi_step_fn
+                )
+                name = "adapter_multi_step"
+            else:
+                fn = (
+                    self._spec_multi_step_fn if self._speculative
+                    else self._multi_step_fn
+                )
+                name = "multi_step"
+            out.append((name, fn, self._last_multi_args()))
         if self._last_kv_export_args is not None:
             out.append((
                 "kv_export", self._kv_export_fn,
@@ -4384,6 +5085,8 @@ class ContinuousEngine:
         "decode_block_spec": "decode_step",
         "mixed_step": "mixed_step",
         "adapter_mixed_step": "adapter_mixed_step",
+        "multi_step": "multi_step",
+        "adapter_multi_step": "adapter_multi_step",
         "kv_export": "kv_export",
         "kv_ingest": "kv_ingest",
         "kv_page_spill": "kv_page_spill",
@@ -4454,9 +5157,18 @@ class ContinuousEngine:
         with activate(self._mesh, self._rules):
             for name, fn, args in self._dispatched_programs():
                 cname = self.contract_name(name)
+                # The fused horizon program scans its body ``horizon``
+                # times, not ``decode_block_steps``: price its in-loop
+                # collectives at the horizon trip count so the reconciled
+                # total caps at N× the single-step multiset.
+                hint = (
+                    int(self.horizon)
+                    if name in ("multi_step", "adapter_multi_step")
+                    else int(self._block_steps)
+                )
                 out[cname] = trace_shardflow(
                     cname, fn, *args, mesh=self._mesh,
-                    while_trip_hint=int(self._block_steps),
+                    while_trip_hint=hint,
                 )
         return out
 

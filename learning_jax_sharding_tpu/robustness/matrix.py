@@ -29,6 +29,19 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | replica_kill      | engine replica dies mid-stream    | router failover + rerouted requeue|
 | swap_mid_stream   | weight-swap staging dies mid-serve| swap abort → stay on old version  |
 | tier_miss_under_kill | replica with promoted peer-tier KV dies mid-stream | tier drop + recompute from prompt |
+| nan_logits_h4     | FloatingPointError at a FUSED (horizon=4) dispatch | quarantine within one horizon + ledger recovery |
+| hung_dispatch_h4  | hang-watchdog abort at a fused dispatch | quarantine within one horizon + ledger recovery |
+| overload_h4       | offered load > bound, horizon=4   | shed + ladder at horizon boundaries |
+| boundary_preempt  | SIGTERM while a horizon is in flight | boundary drain: commit the horizon, requeue, zero token loss |
+
+The ``*_h4`` rows are the round-16 multi-step variants: with ``horizon=4``
+the host dispatches ONE fused program per 4 engine iterations, so every
+recovery policy's detection granularity coarsens to the horizon
+boundary. The cells pin that this is the WHOLE price: faults are still
+detected at the dispatch that carries them (≤ one horizon late, never
+discovered later), survivors stay bit-identical, and the goodput ledger
+books the interrupted horizon's fault handling under ``recovery`` while
+still reconciling.
 """
 
 from __future__ import annotations
@@ -316,6 +329,148 @@ def run_matrix(verbose: bool = False) -> list[dict]:
                 np.testing.assert_array_equal(v, clean[rid])
         return {"shed": len(shed), "ladder_level": ladder.level,
                 "degrades": count("engine.degrade")}
+
+    # --- round-16 multi-step (horizon > 1) cells --------------------------
+    # One fused program now covers 4 engine iterations; the chaos seam
+    # fires once per FUSED dispatch, so these cells pin the coarsened
+    # detection granularity: a fault is caught at the dispatch that
+    # carries it (≤ one horizon late), never discovered afterwards.
+
+    meng = ContinuousEngine(
+        cfg, mesh, rules, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=8, mixed=True, horizon=4, recorder=rec,
+    )
+
+    def h4_fault(kind, rid, **fkw):
+        meng.reset_stats()          # fresh ledger window for the asserts
+        base_f = count("engine.dispatch_fault")
+        base_i = count("chaos.inject")
+        with ChaosInjector(
+            Fault("engine.dispatch", kind, rid=rid, count=-1, **fkw),
+            recorder=rec,
+        ):
+            out, _ = _drive(meng, params, reqs)
+        assert out[rid].status == "poisoned", out[rid]
+        # Greedy decoding keys every token by (request, position), so
+        # the multi-step engine's survivors must match the plain
+        # engine's fault-free reference bit for bit.
+        survivors_match(out, {rid})
+        faults = count("engine.dispatch_fault") - base_f
+        injected = count("chaos.inject") - base_i
+        # Detection within ONE horizon: every injection is caught at
+        # the fused dispatch it fired on — injections and detected
+        # faults pair 1:1; nothing surfaces a horizon late.
+        assert faults == injected > 0, (faults, injected)
+        rep = meng.ledger.window_report()
+        rec_s = rep["buckets"].get("recovery", 0.0)
+        assert rec_s > 0, "the interrupted horizon must book as recovery"
+        bal = meng.ledger.reconcile()
+        assert bal["ok"], bal
+        progs = [n for n, *_ in meng._dispatched_programs()]
+        assert "multi_step" in progs, progs
+        return {"quarantined": out[rid].status, "faults": faults,
+                "recovery_s": round(rec_s, 4)}
+
+    def nan_logits_h4():
+        return h4_fault("raise", 1, error=FloatingPointError)
+
+    def hung_h4():
+        return h4_fault("hang", 2)
+
+    def overload_h4():
+        # Shedding happens at admission and the ladder at the dispatch
+        # boundary — with horizon=4 that boundary arrives every 4
+        # iterations, and the policies must still bite.
+        slo = SLOMonitor([SLOTarget("ttft", 1e-9, objective=0.5)])
+        ladder = DegradationLadder(patience=1)
+        guarded = ContinuousEngine(
+            cfg, mesh, rules, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=8, mixed=True, horizon=4, recorder=rec,
+            slo=slo, degradation=ladder, max_queue=3,
+        )
+        out, shed = _drive(guarded, params, dict(enumerate(_prompts(cfg, 8))))
+        assert shed, "bounded queue must shed past max_queue"
+        assert ladder.level > 0, "impossible SLO must escalate the ladder"
+        for rid, v in out.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            if rid in clean:   # first four prompts match the reference set
+                np.testing.assert_array_equal(v, clean[rid])
+        # The fused path must have actually run under overload — the
+        # matrix's lock-stepped cohorts keep each planned horizon short,
+        # so the dispatch ratio is not the witness; the dispatched
+        # program is.
+        progs = [n for n, *_ in guarded._dispatched_programs()]
+        assert "multi_step" in progs, progs
+        bal = guarded.ledger.reconcile()
+        assert bal["ok"], bal
+        return {"shed": len(shed), "ladder_level": ladder.level,
+                "degrades": count("engine.degrade")}
+
+    def boundary_preempt():
+        # SIGTERM lands while a fused 4-iteration program is IN FLIGHT.
+        # Python delivers signals between host bytecodes, so a serving
+        # process's graceful-shutdown flag is only observable at the
+        # horizon boundary — and that is the contract this cell pins:
+        # the in-flight horizon COMMITS (its tokens surface in the
+        # drained partials — the device work is never thrown away), the
+        # drain produces requeueable records at the boundary, and the
+        # recompute is bit-identical. Zero token loss, end to end.
+        import signal
+
+        eng = ContinuousEngine(
+            cfg, mesh, rules, batch_size=2, max_new_tokens=8,
+            refill_chunk=8, mixed=True, horizon=4, recorder=rec,
+        )
+        ref, _ = _drive(eng, params, reqs)   # fault-free reference
+        term: list[int] = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: term.append(s))
+        try:
+            eng.reset()
+            eng.pop_finished()
+            for rid, p in reqs.items():
+                eng.add_request(p, rid=rid)
+            done: dict[int, Any] = {}
+            steps = 0
+            # rid-targeted: fires at the first fused dispatch that
+            # carries rid 2 — mid-stream by construction.
+            with ChaosInjector(
+                Fault("engine.dispatch", "sigterm", rid=2, count=1),
+                recorder=rec,
+            ):
+                while eng.has_work() and not term:
+                    eng.step(params)
+                    done.update(eng.pop_finished())
+                    steps += 1
+                    assert steps <= 400, "engine wedged under SIGTERM"
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+        assert term, "the injected SIGTERM must be delivered"
+        records = eng.drain_requests(status="rerouted", error="sigterm")
+        fails = eng.pop_finished()
+        committed = 0
+        for rid, f in fails.items():
+            assert isinstance(f, RequestFailure), (rid, f)
+            assert f.status == "rerouted", f
+            if f.tokens is not None:
+                # Partial output is a PREFIX of the fault-free stream —
+                # the committed horizon's tokens are intact, not junk.
+                np.testing.assert_array_equal(
+                    f.tokens, np.asarray(ref[rid])[: f.tokens.size]
+                )
+                committed += int(f.tokens.size) - len(reqs[rid])
+        assert committed > 0, (
+            "the in-flight horizon must commit at the boundary"
+        )
+        done2, _ = _drive(
+            eng, params, {r["rid"]: r["prompt"] for r in records}
+        )
+        done.update(done2)
+        assert sorted(done) == sorted(reqs), "zero drops across the drain"
+        for rid, v in done.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, ref[rid])
+        return {"delivered": len(term), "drained": len(records),
+                "committed_tokens": committed}
 
     def replica_kill():
         # Fleet failover (round 11): two unified replicas, one killed
@@ -620,6 +775,14 @@ def run_matrix(verbose: bool = False) -> list[dict]:
     cell("tier_miss_under_kill",
          "replica holding promoted peer-tier KV dies mid-stream",
          "tier drop + recompute from prompt", tier_miss_kill)
+    cell("nan_logits_h4", "NaN in logits at a fused horizon=4 dispatch",
+         "quarantine within one horizon", nan_logits_h4)
+    cell("hung_dispatch_h4", "hung fused dispatch (watchdog abort)",
+         "quarantine within one horizon", hung_h4)
+    cell("overload_h4", "offered load > bound at horizon=4",
+         "shed + ladder at horizon boundaries", overload_h4)
+    cell("boundary_preempt", "SIGTERM while a horizon is in flight",
+         "boundary drain + requeue, zero token loss", boundary_preempt)
     cell("nan_grad_skip", "NaN grad/loss in-step",
          "guarded skip", lambda: nan_grad(tmp))
     cell("spike_rollback", "loss spike x1000",

@@ -126,6 +126,17 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     (re.compile(r"tier miss ([\d,.]+)%"), "tier_miss_rate_pct", False),
     (re.compile(r"kv moved ([\d,.]+)\s*kB/req"),
      "kv_bytes_moved_per_req_kb", False),
+    # Round-16 multi-step gates (bench.py's `[bench] multistep ...`
+    # lines): steps/dispatch is engine iterations fused per host
+    # round-trip — THE number the device-resident scheduler exists to
+    # push up (1.0 means the host touched Python every token); it pairs
+    # with host_share_pct above, which the same refactor pushes down.
+    # Boundary-stall share is the fraction of engine busy time parked at
+    # horizon boundaries waiting on the single sync + re-plan — the
+    # async planner holds it down, so it regresses UPWARD.
+    (re.compile(r"steps/dispatch ([\d,.]+)"), "steps_per_dispatch", True),
+    (re.compile(r"boundary stall ([\d,.]+)%"), "boundary_stall_pct",
+     False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

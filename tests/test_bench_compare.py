@@ -262,6 +262,43 @@ class TestExtraction:
         assert not by[f"{aware}:aggregate_tok_s"]["regressed"]
         assert not by[f"{blind}:ttft_p99_ms"]["regressed"]
 
+    def test_multistep_gates_direction_aware(self):
+        """The round-16 multi-step gates, per horizon rung:
+        steps/dispatch (engine iterations fused per host round-trip —
+        the number the device-resident scheduler pushes up) regresses
+        DOWN; host_share and boundary stall regress UP; tok/s rides the
+        generic pattern; ITL p99 rides the generic `p99 X ms` latency
+        pattern. Each rung line gates independently, so a deep-horizon
+        rung silently falling back to one-step dispatches (steps/
+        dispatch -> 1.0) fails the gate even if tok/s holds."""
+        lines = [
+            "[bench] multistep h1: 568 tok/s, host_share 85.9%, "
+            "steps/dispatch 1.00, ITL p99 16.7 ms, boundary stall 10.4%",
+            "[bench] multistep h16: 1,213 tok/s, host_share 42.2%, "
+            "steps/dispatch 15.59, ITL p99 42.0 ms, boundary stall 6.9%",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        assert m["multistep_h1:steps_per_dispatch"] == (1.0, True)
+        assert m["multistep_h16:steps_per_dispatch"] == (15.59, True)
+        assert m["multistep_h16:host_share_pct"] == (42.2, False)
+        assert m["multistep_h16:boundary_stall_pct"] == (6.9, False)
+        assert m["multistep_h16:tok_s"] == (1213.0, True)
+        assert m["multistep_h16:p99_ms"] == (42.0, False)
+        worse = _doc([
+            lines[0],
+            lines[1]
+            .replace("steps/dispatch 15.59", "steps/dispatch 1.00")
+            .replace("host_share 42.2%", "host_share 83.0%")
+            .replace("boundary stall 6.9%", "boundary stall 39.0%"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["multistep_h16:steps_per_dispatch"]["regressed"]
+        assert by["multistep_h16:host_share_pct"]["regressed"]
+        assert by["multistep_h16:boundary_stall_pct"]["regressed"]
+        assert not by["multistep_h16:tok_s"]["regressed"]
+        assert not by["multistep_h1:steps_per_dispatch"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

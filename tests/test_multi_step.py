@@ -1,0 +1,455 @@
+"""Device-resident multi-step scheduling (models/serving.py, ``horizon>1``).
+
+THE oracle, inherited from test_mixed_step.py and applied to the fused
+HORIZON: scheduling must never change results. ``multi_step`` scans the
+exact ``mixed_step`` body N times per dispatch with the slot bookkeeping
+carried device-side, so every output — fresh prompts, boundary admits,
+prefix hits, budget starvation, speculative rounds, multi-tenant rows,
+retirement mid-horizon — must be BIT-IDENTICAL to the horizon=1 engine
+(itself pinned to the split engine), greedy and sampled alike. On top of
+the value oracle, this file pins the PROGRAM contract: ``horizon=1``
+dispatches exactly today's programs (no multi program compiled, multi
+counters silent), and ``horizon>1`` adds exactly ONE steady-state
+executable per engaged program family.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.models.serving import (
+    ContinuousEngine,
+    make_continuous_engine,
+)
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import (
+    RULES_DP_TP,
+    RULES_TP_SERVING,
+)
+
+NEW = 6
+
+DRAFT_CFG = dataclasses.replace(
+    CONFIG_TINY, num_layers=1, hidden=64, dtype=jnp.float32
+)
+
+
+@pytest.fixture(scope="module")
+def setup(mesh22):
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    model = Transformer(cfg)
+    probe = np.zeros((2, 8), np.int32)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(3), probe
+        )["params"]
+    )
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in (3, 9, 5, 1, 12, 7, 4)
+    ]
+    return cfg, params, prompts
+
+
+@pytest.fixture(scope="module")
+def mixed_ref(setup, mesh22):
+    """The horizon=1 fused engine the multi-step engine is held
+    bit-identical to (itself pinned to the split engine in
+    test_mixed_step.py)."""
+    cfg, params, prompts = setup
+    serve = make_continuous_engine(
+        cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+        refill_chunk=4, mixed=True,
+    )
+    return serve(params, prompts)
+
+
+def _draft_params():
+    model = Transformer(DRAFT_CFG)
+    toks = np.zeros((2, 8), np.int32)
+    return nn.meta.unbox(
+        model.init({"params": jax.random.key(7)}, toks)["params"]
+    )
+
+
+class TestMultiStep:
+    def test_matches_mixed_engine(self, setup, mesh22, mixed_ref):
+        """7 mixed-length requests through 2 slots at horizon=4: every
+        output equals the horizon=1 engine's bit for bit. With NEW=6 and
+        staggered completion, rows retire at links INSIDE the horizon
+        (the device active-mask freezes them; the host retires at the
+        boundary sync) — the retirement-mid-horizon case rides the base
+        oracle. Exactly ONE ``multi_step`` executable compiles and the
+        per-link ``mixed_step`` program never dispatches."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, horizon=4,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        eng = serve.engine
+        cc = eng.compile_counts()
+        assert cc["multi_step"] == 1
+        assert cc["mixed_step"] == 0
+        assert eng._c_multi_n.value > 0
+        # The whole point: > 1 engine iteration per host dispatch.
+        lat = serve.last_latency
+        assert lat["steps_per_dispatch"] > 1.0
+        assert eng.ledger.reconcile()["ok"]
+
+    def test_horizon_one_is_todays_loop(self, setup, mesh22, mixed_ref):
+        """``horizon=1`` must reduce EXACTLY to the current engine: same
+        outputs, same dispatched program set (no multi program compiled,
+        let alone dispatched), multi counters silent, and no staged
+        plans — byte-for-byte today's loop."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, horizon=1,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        eng = serve.engine
+        cc = eng.compile_counts()
+        assert "multi_step" not in cc
+        assert cc["mixed_step"] == 1
+        assert eng._c_multi_n.value == 0
+        assert eng._c_plan_staged.value == 0
+        assert eng._staged_plan is None
+        assert "steps_per_dispatch" not in serve.last_latency
+        names = [n for n, _f, _a in eng._dispatched_programs()]
+        assert "multi_step" not in names
+
+    @pytest.mark.slow
+    def test_horizon_sweep(self, setup, mesh22, mixed_ref):
+        """Horizons beyond the chain cap, below it, and absurdly past
+        the longest request: fixed-shape padding and the per-step live
+        gate keep every rung bit-identical."""
+        cfg, params, prompts = setup
+        for horizon in (2, 8, 16):
+            serve = make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, refill_chunk=4, mixed=True,
+                horizon=horizon,
+            )
+            outs = serve(params, prompts)
+            for r, g in zip(mixed_ref, outs):
+                np.testing.assert_array_equal(g, r)
+            assert serve.engine.compile_counts()["multi_step"] == 1
+
+    def test_refill_lands_at_boundary(self, setup, mesh22, mixed_ref):
+        """Requests admitted WHILE a horizon is in flight: the async
+        planner cannot see them (its staged plan's fingerprint misses),
+        so they refill at the NEXT boundary — outputs unchanged, and the
+        planner's stage/reuse accounting stays consistent."""
+        cfg, params, prompts = setup
+        eng = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, horizon=4,
+        ).engine
+        eng.add_request(prompts[0], rid=0)
+        eng.add_request(prompts[1], rid=1)
+        outs, steps, pending = {}, 0, list(range(2, 7))
+        while eng.has_work() or pending:
+            eng.step(params)
+            steps += 1
+            if pending:
+                i = pending.pop(0)
+                eng.add_request(prompts[i], rid=i)
+            outs.update(eng.pop_finished())
+        for i, r in enumerate(mixed_ref):
+            np.testing.assert_array_equal(outs[i], r)
+        assert eng._c_plan_reused.value <= eng._c_plan_staged.value
+
+    @pytest.mark.slow
+    def test_budget_starved(self, setup, mesh22, mixed_ref):
+        """A token budget smaller than one refill chunk: prompts trickle
+        across horizon links (and across horizons) while decode rows
+        keep advancing — results must not move."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, token_budget=3, horizon=4,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    @pytest.mark.slow
+    def test_eos_retires_mid_horizon(self, setup, mesh22):
+        """EOS emitted at a link INSIDE the horizon: the host retires
+        the row at the boundary sync exactly where the horizon=1 engine
+        stops it (consume truncates at EOS; the device active-mask only
+        ever freezes rows)."""
+        cfg, params, prompts = setup
+        base = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        ref = base(params, prompts)
+        eos = int(ref[0][len(prompts[0]) + 1])
+        ref_eng = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, eos_id=eos, mixed=True,
+        )
+        eos_ref = ref_eng(params, prompts)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, eos_id=eos, mixed=True, horizon=4,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(eos_ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    def test_paged_long_prompt(self, setup, mesh22):
+        """The paged engine at horizon=4 with a 44-token prompt through
+        8-token chunks: the planner's virtual page ensures cover refill
+        AND decode writes of the whole horizon."""
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        rng = np.random.default_rng(5)
+        long_prompts = [
+            rng.integers(1, cfg.vocab_size, size=(44,)).astype(np.int32),
+            prompts[0], prompts[2],
+        ]
+        ref_eng = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8,
+        )
+        ref = ref_eng(params, long_prompts)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8, horizon=4,
+        )
+        outs = serve(params, long_prompts)
+        for r, g in zip(ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+    def test_prefix_hits_across_calls(self, setup, mesh22):
+        """Prefix caching at horizon=4: the warm pass re-admits
+        shared-prefix prompts with pages already mapped (reset_to > 0
+        riding the scan's link-0 reset row) — outputs bit-identical,
+        hits counted."""
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        rng = np.random.default_rng(9)
+        system = rng.integers(
+            1, cfg.vocab_size, size=(16,)
+        ).astype(np.int32)
+        queue = [
+            np.concatenate([
+                system,
+                rng.integers(1, cfg.vocab_size, size=(4,)).astype(
+                    np.int32
+                ),
+            ])
+            for _ in range(4)
+        ]
+        ref_eng = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8, prefix_cache=True,
+        )
+        ref = ref_eng(params, queue)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            paged_pages=16, page_size=8, prefix_cache=True, horizon=4,
+        )
+        cold = serve(params, queue)
+        warm = serve(params, queue)
+        for r, g in zip(ref, cold):
+            np.testing.assert_array_equal(g, r)
+        for r, g in zip(ref, warm):
+            np.testing.assert_array_equal(g, r)
+        assert serve.last_stats["prefix_hits"] == len(queue)
+
+    @pytest.mark.slow
+    def test_sampled_schedule_independent(self, setup, mesh22):
+        """temperature > 0 at horizon=4 under a starving budget (a
+        maximally different schedule from the horizon=1 reference): the
+        IDENTICAL sampled stream per request — draws are keyed by
+        (request id, generated position), never by schedule."""
+        cfg, params, prompts = setup
+        ref_eng = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8, mixed=True,
+        )
+        multi = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+            refill_chunk=4, temperature=0.7, top_k=8, mixed=True,
+            token_budget=5, horizon=4,
+        )
+        a = ref_eng(params, prompts, rng=jax.random.key(42))
+        b = multi(params, prompts, rng=jax.random.key(42))
+        for r, g in zip(a, b):
+            np.testing.assert_array_equal(g, r)
+
+    def test_validation(self, setup, mesh22):
+        cfg, params, prompts = setup
+        with pytest.raises(ValueError, match="horizon must be >= 1"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, mixed=True, horizon=0,
+            )
+        with pytest.raises(ValueError, match="requires mixed=True"):
+            make_continuous_engine(
+                cfg, mesh22, RULES_DP_TP, batch_size=2,
+                max_new_tokens=NEW, horizon=4,
+            )
+
+    def test_runtime_tunable(self, setup, mesh22, mixed_ref):
+        """The horizon is a runtime knob read at each dispatch: the SAME
+        engine serves at horizon=1, is retuned to 4, and serves again —
+        both passes bit-identical, the multi program compiling only once
+        engaged."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True,
+        )
+        outs = serve(params, prompts)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert "multi_step" not in serve.engine.compile_counts()
+        serve.engine.horizon = 4
+        outs = serve(params, prompts)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.engine.compile_counts()["multi_step"] == 1
+
+
+class TestSpeculativeMulti:
+    """spec_multi_step: N scanned draft-verify rounds per dispatch, the
+    per-row rollback index and BOTH caches carried device-side, emission
+    buffers riding the scan ys."""
+
+    def test_weak_draft_matches(self, setup, mesh22, mixed_ref):
+        """Weak draft (near-zero acceptance) at horizon=4: per-row
+        rollback runs inside the scan — outputs bit-identical to the
+        plain horizon=1 engine, one spec multi executable."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, draft_config=DRAFT_CFG,
+            num_draft=3, horizon=4,
+        )
+        outs = serve(params, prompts, draft_params=_draft_params())
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.engine.compile_counts()["multi_step"] == 1
+
+    @pytest.mark.slow
+    def test_self_draft_fast_forward(self, setup, mesh22, mixed_ref):
+        """Self-draft (acceptance 1.0) at horizon=4: rows fast-forward
+        num_draft+1 tokens per scanned round — the live-mask gate must
+        freeze the padded steps past the planned links even though rows
+        drain FASTER than the optimistic chain cap assumed. Acceptance
+        stats survive the ys path."""
+        cfg, params, prompts = setup
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, draft_config=cfg, num_draft=2,
+            horizon=4,
+        )
+        outs = serve(params, prompts, draft_params=params)
+        for r, g in zip(mixed_ref, outs):
+            np.testing.assert_array_equal(g, r)
+        assert serve.last_stats["spec_accept_rate"] == 1.0
+
+    @pytest.mark.slow
+    def test_paged_speculative(self, setup, mesh22):
+        cfg, params, prompts = setup
+        cfg = dataclasses.replace(
+            cfg, max_seq_len=64, decode_attention="blocked"
+        )
+        dcfg = dataclasses.replace(
+            DRAFT_CFG, max_seq_len=64, decode_attention="blocked"
+        )
+        ref_eng = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            draft_config=dcfg, num_draft=2, paged_pages=20, page_size=8,
+        )
+        dp = _draft_params()
+        ref = ref_eng(params, prompts[:4], draft_params=dp)
+        serve = make_continuous_engine(
+            cfg, mesh22, RULES_TP_SERVING, batch_size=2,
+            max_new_tokens=NEW, refill_chunk=8, mixed=True,
+            draft_config=dcfg, num_draft=2, paged_pages=20, page_size=8,
+            horizon=4,
+        )
+        outs = serve(params, prompts[:4], draft_params=dp)
+        for r, g in zip(ref, outs):
+            np.testing.assert_array_equal(g, r)
+
+
+class TestAdapterMulti:
+    def test_multi_tenant_bit_identical(self, setup, mesh22):
+        """Base + tenant rows through one ``adapter_multi_step`` batch
+        at horizon=4: every stream equals the horizon=1 adapter engine's
+        (itself pinned to solo merged-weight engines in
+        test_ztenancy.py), with the per-row adapter gather hoisted once
+        outside the scan."""
+        from learning_jax_sharding_tpu.tenancy import AdapterPool
+        from learning_jax_sharding_tpu.training.lora import init_lora
+
+        cfg, params, prompts = setup
+        ad1 = jax.tree.map(
+            lambda x: x + 0.02, init_lora(jax.random.key(1), params, 4)
+        )
+        names = {0: None, 1: "t1", 2: "t1", 3: None, 4: "t1", 5: None}
+
+        def drive(eng):
+            for rid in range(6):
+                eng.add_request(
+                    prompts[rid], rid=rid, adapter=names[rid]
+                )
+            out, steps = {}, 0
+            while eng.has_work():
+                eng.step(params)
+                out.update(eng.pop_finished())
+                steps += 1
+                assert steps <= 400, "engine wedged"
+            out.update(eng.pop_finished())
+            cc = eng.compile_counts()
+            eng.close()
+            return out, cc
+
+        def pool():
+            p = AdapterPool(params, slots=4, rank=4)
+            p.add("t1", ad1, alpha=16.0)
+            return p
+
+        ref_eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, adapter_pool=pool(),
+        )
+        ref, _ = drive(ref_eng)
+        eng = ContinuousEngine(
+            cfg, mesh22, RULES_DP_TP, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=4, mixed=True, adapter_pool=pool(), horizon=4,
+        )
+        out, cc = drive(eng)
+        assert sorted(out) == sorted(ref)
+        for rid in out:
+            np.testing.assert_array_equal(out[rid], ref[rid])
+        assert cc["adapter_multi_step"] == 1
+        assert cc["adapter_mixed_step"] == 0
