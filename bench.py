@@ -502,6 +502,50 @@ def bench_shardflow():
     return block
 
 
+def bench_layout_search():
+    """Layout-search closed loop (round 17): run the abstract search
+    over the train step's param layout, then compile ONLY the hand
+    layout and the argmin layout and measure both — the predicted win
+    is confirmed against real execution, and the two tracked numbers
+    ride ``scripts/bench_compare.py`` direction-aware: ``layout gap``
+    (searched-vs-hand priced gap — growing means the committed layouts
+    drifted from the searchable optimum) and ``layout err`` (the
+    search's predicted-vs-measured error on the two layouts it
+    compiles, the analyzer-loop analogue of the shardflow model err).
+
+    Like ``bench_fleet``, the layout legs need device MULTIPLICITY the
+    one-chip bench host lacks, so the search + both measurements run on
+    the emulated 8-device mesh in a subprocess
+    (``scripts/layout_search.py --bench-lines``) whose ``[bench]``
+    lines are relayed verbatim; the subprocess prices the measured legs
+    with the live profile scaled to the emulated-device share of the
+    socket (its docstring records the convention)."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent / "scripts"
+        / "layout_search.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--entry", "train_step",
+         "--bench-lines", "--budget", "48"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"layout_search exited {proc.returncode}: {tail}")
+    block = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+        elif line.startswith("[bench-json] "):
+            block = json.loads(line[len("[bench-json] "):])
+    return block
+
+
 def bench_moe_125m():
     """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
     capacity routing, fp32 router — models/moe.py), same harness as the
@@ -1214,6 +1258,11 @@ def main():
     except Exception as e:
         _log(f"[bench] shardflow bench skipped: {type(e).__name__}: {e}")
         shardflow_block = None
+    try:
+        layout_search_block = bench_layout_search()
+    except Exception as e:
+        _log(f"[bench] layout_search bench skipped: {type(e).__name__}: {e}")
+        layout_search_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1257,6 +1306,12 @@ def main():
         # time vs the measured one for the tracked shapes
         # (analysis.shardflow + costmodel; gated by bench_compare).
         "shardflow": shardflow_block,
+        # Round-17 layout-search closed loop: the searched-vs-hand
+        # priced gap for the tracked train step and the measured
+        # confirmation on the two compiled layouts (analysis/
+        # layout_search.py; gated by bench_compare's `layout gap` /
+        # `layout err` patterns).
+        "layout_search": layout_search_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

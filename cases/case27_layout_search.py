@@ -1,0 +1,237 @@
+"""Case 27 — the layout-search closed loop recovers mis-shardings.
+
+Case 24 showed the analyzer NAMING a mis-sharded weight before any
+compile; this case closes the loop (round 17, ``analysis/
+layout_search.py``): hand the SAME seeded mistakes to the search and
+let it fix them — abstractly, by re-simulating the traced jaxpr per
+candidate and pricing each collective multiset, never compiling a
+candidate. The only compile in the whole story is the final argmin,
+compiled once at the end to hold the chosen layout's predicted
+contract against XLA's real partitioner.
+
+* **micro** — case 24's FF block with the transposed ``w2``
+  (``(None,'model')`` instead of ``('model',None)``): the search must
+  return a layout priced at or below the hand-tuned one, and running
+  it twice must produce byte-identical contracts (the determinism the
+  CI story depends on).
+* **macro** — case 24's tiny transformer with its largest
+  model-sharded kernel transposed (``mis_shard_one`` — the classic
+  checkpoint-resharding bug): same recovery requirement over the full
+  param tree, factorized per-layer with dominance pruning doing the
+  heavy cutting.
+
+Artifacts (``$LJST_ARTIFACT_DIR`` or a temp dir):
+``layout_search_micro.json`` / ``layout_search_macro.json`` (search
+results, pricing, the reconcile record of the one compiled argmin) and
+``argmin_micro.contract.json`` / ``argmin_macro.contract.json`` (the
+emitted golden-format contracts).
+
+Run: ``python cases/case27_layout_search.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from case24_shardflow import (  # noqa: E402
+    ff_block,
+    mis_shard_one,
+    sharded_params,
+)
+from learning_jax_sharding_tpu.analysis import costmodel  # noqa: E402
+from learning_jax_sharding_tpu.analysis.contracts import contract_of  # noqa: E402
+from learning_jax_sharding_tpu.analysis.layout_search import (  # noqa: E402
+    apply_assignment,
+    default_vary,
+    search_layout,
+)
+from learning_jax_sharding_tpu.analysis.shardflow import (  # noqa: E402
+    reconcile,
+    trace_shardflow,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import (  # noqa: E402
+    build_mesh,
+    mesh_sharding,
+    put,
+)
+from learning_jax_sharding_tpu.parallel.hlo import (  # noqa: E402
+    collective_counts,
+    compiled_hlo,
+)
+from learning_jax_sharding_tpu.parallel.logical import (  # noqa: E402
+    RULES_DP_TP,
+    activate,
+)
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    artifact_dir,
+)
+
+PROFILE = costmodel.table_profile("TPU v5 lite")
+B, S, D, H = 16, 128, 256, 2048
+
+
+def confirm_argmin(res, fn, *args):
+    """Compile the argmin layout — the ONE compile this case performs
+    per scenario — and require every actual collective to be claimed by
+    the search's predicted events."""
+    (fixed_args, _kw) = apply_assignment(res, args, _MESH)
+    text = compiled_hlo(fn, *fixed_args)
+    rec = reconcile(res.report, contract_of(res.name, text, mesh=_MESH))
+    assert not rec["unexplained"], (
+        f"{res.name}: compiled argmin has collectives the search did "
+        f"not predict: {rec['unexplained']}"
+    )
+    return rec, collective_counts(text)
+
+
+def micro(outdir):
+    x = put(np.ones((B, S, D), np.float32),
+            mesh_sharding(_MESH, "data", None, None))
+    w1 = put(np.ones((D, H), np.float32), mesh_sharding(_MESH, None, "model"))
+    w2_good = put(np.ones((H, D), np.float32),
+                  mesh_sharding(_MESH, "model", None))
+    w2_bad = put(np.ones((H, D), np.float32),
+                 mesh_sharding(_MESH, None, "model"))
+
+    # The hand-tuned yardstick the search must reach (or beat), priced
+    # the same abstract way.
+    hand = trace_shardflow("case27_ff_hand", ff_block, x, w1, w2_good,
+                           mesh=_MESH)
+    cost_hand = costmodel.price(hand, PROFILE)
+
+    vary_weights = (lambda p, leaf: default_vary(p, leaf) and leaf.ndim == 2)
+    res = search_layout(
+        "case27_ff", ff_block, x, w1, w2_bad, mesh=_MESH,
+        vary=vary_weights, budget=96, profile=PROFILE,
+    )
+    again = search_layout(
+        "case27_ff", ff_block, x, w1, w2_bad, mesh=_MESH,
+        vary=vary_weights, budget=96, profile=PROFILE,
+    )
+    assert res.contract.to_json() == again.contract.to_json(), (
+        "layout search is not deterministic"
+    )
+    assert res.assignment == again.assignment
+
+    # Recovery: the searched layout prices <= the hand-tuned one, and
+    # far below the seeded mistake.
+    assert res.best.predicted_s <= cost_hand.predicted_s * (1 + 1e-9), (
+        res.best.predicted_s, cost_hand.predicted_s,
+    )
+    assert res.gap_pct > 50.0, res.gap_pct  # the mistake was expensive
+
+    rec, counts = confirm_argmin(res, ff_block, x, w1, w2_bad)
+    print(f"[case27] micro: transposed w2 start priced "
+          f"{res.baseline.predicted_s * 1e6:.1f}us; search "
+          f"({res.evaluated} evals, {res.pruned} pruned) found "
+          f"{res.best.predicted_s * 1e6:.1f}us "
+          f"(hand-tuned: {cost_hand.predicted_s * 1e6:.1f}us)")
+    for line in res.changed_lines():
+        print(f"[case27] micro:   {line}")
+    print(f"[case27] micro: argmin compiled once — collectives {counts}, "
+          f"unexplained {rec['unexplained']}")
+    (outdir / "argmin_micro.contract.json").write_text(
+        res.contract.to_json()
+    )
+    return {
+        "hand_cost": cost_hand.to_dict(),
+        "search": res.to_dict(),
+        "reconcile": rec,
+        "compiled_counts": counts,
+    }
+
+
+def macro(outdir):
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = sharded_params(model, _MESH, RULES_DP_TP)
+    tokens = put(
+        np.random.default_rng(0).integers(1, cfg.vocab_size, size=(8, 32))
+        .astype(np.int32),
+        mesh_sharding(_MESH, "data", None),
+    )
+
+    def fwd(p, t):
+        return model.apply({"params": p}, t)
+
+    bad_params, swap = mis_shard_one(params, _MESH)
+    with activate(_MESH, RULES_DP_TP):
+        hand = trace_shardflow("case27_fwd_hand", fwd, params, tokens,
+                               mesh=_MESH)
+        cost_hand = costmodel.price(hand, PROFILE)
+        res = search_layout(
+            "case27_fwd", fwd, bad_params, tokens, mesh=_MESH,
+            budget=128, profile=PROFILE,
+        )
+
+    assert res.best.predicted_s <= cost_hand.predicted_s * (1 + 1e-9), (
+        res.best.predicted_s, cost_hand.predicted_s,
+    )
+    moved = {p for p in res.changed}
+    assert any(swap["param"] in p for p in moved), (
+        f"search did not move the seeded mis-sharded kernel "
+        f"{swap['param']}; moved {sorted(moved)}"
+    )
+
+    with activate(_MESH, RULES_DP_TP):
+        rec, counts = confirm_argmin(res, fwd, bad_params, tokens)
+    print(f"[case27] macro: {swap['param']} arrived as "
+          f"{swap['bad_spec']}; search ({res.evaluated} evals, "
+          f"{res.pruned} pruned, {res.sweeps} sweep(s)) priced "
+          f"{res.baseline.predicted_s * 1e6:.1f}us -> "
+          f"{res.best.predicted_s * 1e6:.1f}us "
+          f"(hand-tuned: {cost_hand.predicted_s * 1e6:.1f}us)")
+    for line in res.changed_lines():
+        print(f"[case27] macro:   {line}")
+    print(f"[case27] macro: argmin compiled once — collectives {counts}, "
+          f"unexplained {rec['unexplained']}")
+    (outdir / "argmin_macro.contract.json").write_text(
+        res.contract.to_json()
+    )
+    return {
+        "swap": swap,
+        "hand_cost": cost_hand.to_dict(),
+        "search": res.to_dict(),
+        "reconcile": rec,
+        "compiled_counts": counts,
+    }
+
+
+def main():
+    outdir = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case27")
+    )
+    outdir.mkdir(parents=True, exist_ok=True)
+
+    micro_rec = micro(outdir)
+    macro_rec = macro(outdir)
+
+    (outdir / "layout_search_micro.json").write_text(
+        json.dumps(micro_rec, indent=2, default=str)
+    )
+    (outdir / "layout_search_macro.json").write_text(
+        json.dumps(macro_rec, indent=2, default=str)
+    )
+    print(f"[case27] artifacts: {outdir}")
+    print("[case27] OK")
+
+
+_MESH = build_mesh((2, 4), ("data", "model"))
+
+if __name__ == "__main__":
+    main()

@@ -264,6 +264,56 @@ def price_event(
     return t * ((ev.trip or 1) if ev.in_loop else 1)
 
 
+#: Per-(op, axes, bytes, trip) wire-seconds memo for :func:`price_multiset`,
+#: additionally keyed by (profile name, link bandwidth, mesh sizes) so a
+#: calibrated profile or a different mesh can never serve stale prices.
+#: Bounded: distinct keys are few (one per distinct event shape), but a
+#: long-lived search session gets a hard cap instead of unbounded growth.
+_MULTISET_MEMO: dict[tuple, float] = {}
+_MULTISET_MEMO_MAX = 65536
+
+
+def price_multiset(
+    events: list,
+    profile: Profile,
+    mesh_sizes: dict[str, int],
+    *,
+    abort_above: float | None = None,
+) -> tuple[float, float, bool]:
+    """Batch-price a collective event multiset with memoized per-(op,
+    axes, bytes, trip) pricing — the layout search's inner loop
+    (``analysis.layout_search``) prices hundreds of candidate layouts
+    whose events repeat the same few shapes, and re-deriving ring
+    factors per candidate is pure waste. Term-exact: the total equals
+    ``sum(price_event(ev, ...))`` bit-for-bit (same per-event products,
+    same accumulation order; ``tests/test_shardflow.py`` pins this).
+
+    Returns ``(collective_seconds, wire_bytes, aborted)``. With
+    ``abort_above`` set, accumulation stops as soon as the partial sum
+    exceeds it and ``aborted`` is True — the search's dominance prune: a
+    candidate whose collective term alone already exceeds the incumbent's
+    total step time cannot win, so the rest of its events go unpriced.
+    """
+    key_base = (
+        profile.name, profile.link_bw, tuple(sorted(mesh_sizes.items())),
+    )
+    total = 0.0
+    for ev in events:
+        trip = (ev.trip or 1) if ev.in_loop else 1
+        key = key_base + (
+            ev.realizations[:1], ev.axes, int(ev.bytes), trip,
+        )
+        t = _MULTISET_MEMO.get(key)
+        if t is None:
+            if len(_MULTISET_MEMO) >= _MULTISET_MEMO_MAX:
+                _MULTISET_MEMO.clear()
+            t = _MULTISET_MEMO[key] = price_event(ev, profile, mesh_sizes)
+        total += t
+        if abort_above is not None and total > abort_above:
+            return total, total * profile.link_bw, True
+    return total, total * profile.link_bw, False
+
+
 @dataclasses.dataclass
 class PredictedCost:
     """A priced shardflow report: the three roofline terms and the
@@ -333,12 +383,7 @@ def price(
         profile = current_profile()
     mesh_sizes = dict(zip(report.mesh_axes, report.mesh_shape))
     n_dev = max(1, math.prod(report.mesh_shape))
-    coll = 0.0
-    wire = 0.0
-    for ev in report.events:
-        t = price_event(ev, profile, mesh_sizes)
-        coll += t
-        wire += t * profile.link_bw
+    coll, wire, _ = price_multiset(report.events, profile, mesh_sizes)
     # FLOPs are whole-program; per-device share under SPMD is /n_dev.
     # Thin (GEMV-regime) dots get their own achieved rate — the two
     # kernel populations run serially within a step, so the terms add.

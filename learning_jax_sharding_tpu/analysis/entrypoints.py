@@ -723,6 +723,84 @@ def _seq_attention(name: str) -> EntryProgram:
     return EntryProgram(name, mesh, hlo, shardflow=shardflow)
 
 
+#: Entry points the layout search (``analysis.layout_search``) knows how to
+#: re-search — a subset of :func:`build_entry_programs` names, audited as
+#: such by ``tests/test_shardcheck.py`` (a search-emitted contract must name
+#: a real entry point, and every searchable name must have a golden to be
+#: diffed against). train/ZeRO-1 search the param-tree (+ optimizer-state:
+#: the 2004.13336 weight-update space) axis choices; the engine families
+#: search the params + KV-cache layouts of the live dispatch args.
+SEARCHABLE_ENTRIES: tuple[str, ...] = (
+    "train_step", "zero1_update", "mixed_step", "multi_step",
+)
+
+
+def build_search_inputs(name: str, mesh: Any = None) -> dict:
+    """The layout search's view of one searchable entry point: the SAME
+    builders the contract pass compiles, returned pre-compile as
+    ``{name, fn, args, kwargs, mesh, rules, while_trip_hint,
+    vary_paths}`` — ``fn(*args)`` carries its hand-tuned shardings on
+    the committed argument leaves (the search's incumbent), and
+    ``vary_paths`` restricts the searched leaves by tree-path substring
+    (None = every float tensor of rank >= 2, the engine case: params +
+    KV cache)."""
+    if name not in SEARCHABLE_ENTRIES:
+        raise ValueError(
+            f"unknown searchable entry point {name!r}; "
+            f"known: {sorted(SEARCHABLE_ENTRIES)}"
+        )
+    mesh = mesh if mesh is not None else _mesh24()
+    if name in ("train_step", "zero1_update"):
+        zero1 = "data" if name == "zero1_update" else None
+        cfg, state, batch, step, rules = _train_state_and_step(
+            mesh, zero1_axis=zero1
+        )
+        return dict(
+            name=name, fn=step.jitted, args=(state, batch), kwargs={},
+            mesh=mesh, rules=rules, while_trip_hint=None,
+            # ZeRO-1 additionally searches the optimizer-state leaves —
+            # how the weight update shards over the data axis is the
+            # 2004.13336 search space; plain train_step fixes the
+            # moments to mirror the params and searches params only.
+            vary_paths=(
+                (".params", ".opt_state") if zero1 else (".params",)
+            ),
+        )
+    # mixed_step / multi_step: a live tiny engine, same construction as
+    # _engine_programs(mixed=True[, horizon=4]) — one short serve
+    # populates the dispatch-arg caches, then the search re-simulates
+    # that program's jaxpr per candidate layout (no candidate compiles).
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.models.transformer import Transformer
+    from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
+
+    cfg = _tiny_cfg()
+    params = _sharded_serving_params(Transformer(cfg), mesh, RULES_TP_SERVING)
+    kwargs: dict = dict(mixed=True)
+    if name == "multi_step":
+        kwargs["horizon"] = 4
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_TP_SERVING,
+        batch_size=2, max_new_tokens=8, refill_chunk=16,
+        decode_block_steps=4, **kwargs,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in (20, 5)
+    ]
+    eng.serve(params, prompts)
+    progs = {n: (f, a) for n, f, a in eng._dispatched_programs()}
+    fn, args = progs[name]
+    hint = (
+        int(eng.horizon) if name == "multi_step" else int(eng._block_steps)
+    )
+    return dict(
+        name=name, fn=fn, args=tuple(args), kwargs={}, mesh=mesh,
+        rules=RULES_TP_SERVING, while_trip_hint=hint, vary_paths=None,
+    )
+
+
 def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
     """All contract-checkable programs (or the named subset), lazily
     compiled. Must run under the 8-device emulated mesh (the CLI forces

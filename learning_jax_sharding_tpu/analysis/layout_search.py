@@ -1,0 +1,511 @@
+"""Closed-loop layout search: find faster shardings BEFORE XLA compiles.
+
+Rounds 8/13 built the instruments — ``analysis.shardflow`` predicts the
+per-line collective multiset of a program from its arguments' shardings
+(abstract eval only, no compile), ``analysis.costmodel`` prices that
+multiset with a bench-calibrated roofline. This module closes the loop
+(ROADMAP item 2, grounded in arXiv 2211.05322 / 2004.13336): enumerate
+candidate ``PartitionSpec`` assignments over a program's argument
+leaves, re-simulate the SAME traced jaxpr per candidate
+(:func:`~.shardflow.simulate_jaxpr` — the jaxpr is traced exactly once),
+price each event multiset (:func:`~.costmodel.price_multiset`, memoized),
+and return the argmin layout plus a machine-checkable expected-collective
+contract in the existing ``analysis/golden/*.json`` format. Nothing is
+compiled: the only compile a caller ever pays is for the final argmin,
+if it chooses to run it.
+
+Tractability, per the round-17 design:
+
+* **factorized enumeration** — each searched leaf (a param kernel, an
+  optimizer moment, a KV-cache tensor) is its own decision; leaves are
+  visited grouped per layer, largest-bytes groups first, and the search
+  is greedy coordinate descent over those decisions (re-swept until a
+  full sweep finds no improvement). The cross-product over layers is
+  never enumerated.
+* **dominance pruning** — every candidate evaluation prices its events
+  with ``abort_above=<incumbent's total step time>``: a candidate whose
+  partial collective sum alone already exceeds the best total cannot
+  win and is cut mid-pricing (counted in ``SearchResult.pruned``).
+* **explicit budget** — ``budget`` caps total candidate evaluations
+  (jaxpr simulations), incumbent included; exhaustion is reported, not
+  an error.
+* **deterministic tie-break** — candidates enumerate in a fixed order
+  (sorted mesh axes x dim positions, groups by descending bytes then
+  name) and only a STRICTLY cheaper candidate replaces the incumbent,
+  so equal-cost layouts resolve to the earliest enumerated (the hand
+  layout itself on a full tie). Same entry + mesh + budget =>
+  byte-identical chosen layout and emitted contract.
+
+Entry-point integration rides ``analysis.entrypoints.
+build_search_inputs`` (the same builders the contract pass compiles);
+``scripts/layout_search.py`` is the CLI, ``scripts/shardcheck.py
+--optimize`` the advisory CI mode, ``bench.py bench_layout_search`` the
+measured confirmation, and ``cases/case27_layout_search.py`` the demo
+recovering the case24 mis-shardings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import re
+from typing import Any, Callable
+
+import numpy as np
+
+from learning_jax_sharding_tpu.analysis import costmodel
+from learning_jax_sharding_tpu.analysis.contracts import Contract
+from learning_jax_sharding_tpu.analysis.shardflow import (
+    ShardflowReport,
+    Spec,
+    simulate_jaxpr,
+    spec_of_sharding,
+)
+
+__all__ = [
+    "Decision",
+    "SearchResult",
+    "apply_assignment",
+    "candidate_dims",
+    "contract_from_report",
+    "dims_str",
+    "partition_spec",
+    "search_entry",
+    "search_layout",
+]
+
+
+# ---------------------------------------------------------------------------
+# Candidate enumeration
+# ---------------------------------------------------------------------------
+
+
+def candidate_dims(
+    shape: tuple, mesh_sizes: dict[str, int]
+) -> tuple[tuple, ...]:
+    """Every way to place each non-degenerate mesh axis on at most one
+    dim of ``shape`` (or leave it unused), restricted to placements
+    whose per-dim shard factor divides the dim — the per-leaf search
+    space, as dims tuples in :class:`~.shardflow.Spec` form (one
+    ``tuple[str, ...]`` per dim). Deterministic order: axes sorted by
+    name, placements in ``itertools.product`` order over
+    ``(unused, dim 0, dim 1, ...)`` per axis; the first entry is always
+    fully replicated."""
+    axes = sorted(a for a, n in mesh_sizes.items() if n > 1)
+    ndim = len(shape)
+    out: list[tuple] = []
+    seen: set[tuple] = set()
+    for combo in itertools.product([None, *range(ndim)], repeat=len(axes)):
+        dims: list[list[str]] = [[] for _ in range(ndim)]
+        for ax, d in zip(axes, combo):
+            if d is not None:
+                dims[d].append(ax)
+        ok = True
+        for d in range(ndim):
+            f = 1
+            for ax in dims[d]:
+                f *= mesh_sizes[ax]
+            if f > 1 and shape[d] % f:
+                ok = False
+                break
+        if not ok:
+            continue
+        cand = tuple(tuple(d) for d in dims)
+        if cand not in seen:
+            seen.add(cand)
+            out.append(cand)
+    return tuple(out)
+
+
+def dims_str(dims: tuple) -> str:
+    """Render a Spec dims tuple PartitionSpec-style:
+    ``(('data',), (), ('model',)) -> "('data', None, 'model')"``."""
+    parts = [
+        "None" if not d else (
+            repr(d[0]) if len(d) == 1 else "+".join(d)
+        )
+        for d in dims
+    ]
+    return "(" + ", ".join(parts) + ")"
+
+
+_LAYER_RE = re.compile(r"layers?_\d+")
+
+
+def _group_of(path: str) -> str:
+    """Factorization group for one leaf path: its layer token when the
+    path carries one (``layers_3``), else the path itself — embed /
+    lm_head / final-norm leaves each form their own group."""
+    m = _LAYER_RE.search(path)
+    return m.group(0) if m else path
+
+
+def _nbytes(leaf: Any) -> int:
+    nb = getattr(leaf, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    shape = tuple(getattr(leaf, "shape", ()) or ())
+    itemsize = np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+    n = 1
+    for s in shape:
+        n *= int(s)
+    return n * itemsize
+
+
+def default_vary(path: str, leaf: Any) -> bool:
+    """Default searched-leaf predicate: floating tensors of rank >= 2
+    (param kernels, optimizer moments, KV cache pages); token buffers,
+    scalars, biases and norm scales stay put."""
+    del path
+    dt = getattr(leaf, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        if not np.issubdtype(np.dtype(dt), np.floating):
+            return False
+    except TypeError:
+        return False
+    return int(getattr(leaf, "ndim", 0)) >= 2
+
+
+# ---------------------------------------------------------------------------
+# Search result
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """One searched leaf: its flattened-arg index, tree path, layer
+    group, and the deterministic candidate dims enumeration."""
+
+    index: int
+    path: str
+    group: str
+    shape: tuple
+    nbytes: int
+    candidates: tuple[tuple, ...]
+
+
+@dataclasses.dataclass
+class SearchResult:
+    """The argmin layout and everything needed to audit how the search
+    got there."""
+
+    name: str
+    mesh_axes: list[str]
+    mesh_shape: list[int]
+    baseline: costmodel.PredictedCost
+    best: costmodel.PredictedCost
+    assignment: dict[str, tuple]           # path -> chosen dims
+    baseline_assignment: dict[str, tuple]  # path -> incumbent dims
+    evaluated: int
+    pruned: int
+    budget: int
+    sweeps: int
+    exhausted: bool
+    report: ShardflowReport
+    baseline_report: ShardflowReport
+    contract: Contract
+
+    @property
+    def gap_pct(self) -> float:
+        """How much cheaper the searched layout prices than the
+        hand-tuned incumbent, in % of the incumbent's step time —
+        0 when the hand layout is already the argmin (down is better:
+        a growing gap means the hand layouts drifted from optimal)."""
+        base = self.baseline.predicted_s
+        if base <= 0:
+            return 0.0
+        return max(0.0, 100.0 * (base - self.best.predicted_s) / base)
+
+    @property
+    def changed(self) -> dict[str, tuple]:
+        """``path -> (incumbent dims, chosen dims)`` for every leaf the
+        search actually moved."""
+        return {
+            p: (self.baseline_assignment[p], d)
+            for p, d in self.assignment.items()
+            if d != self.baseline_assignment[p]
+        }
+
+    def changed_lines(self) -> list[str]:
+        return [
+            f"{p}: {dims_str(old)} -> {dims_str(new)}"
+            for p, (old, new) in sorted(self.changed.items())
+        ]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "mesh_axes": self.mesh_axes,
+            "mesh_shape": self.mesh_shape,
+            "baseline_cost": self.baseline.to_dict(),
+            "best_cost": self.best.to_dict(),
+            "gap_pct": self.gap_pct,
+            "changed": {
+                p: {"from": dims_str(old), "to": dims_str(new)}
+                for p, (old, new) in sorted(self.changed.items())
+            },
+            "assignment": {
+                p: dims_str(d) for p, d in sorted(self.assignment.items())
+            },
+            "evaluated": self.evaluated,
+            "pruned": self.pruned,
+            "budget": self.budget,
+            "sweeps": self.sweeps,
+            "exhausted": self.exhausted,
+            "contract": self.contract.to_json(),
+        }
+
+
+def contract_from_report(report: ShardflowReport) -> Contract:
+    """The search's ready-to-commit output: the argmin layout's
+    PREDICTED collective multiset in the exact golden-contract shape
+    (``analysis/golden/*.json``; byte-identical formatting via
+    :meth:`~.contracts.Contract.to_json`). Counts/bytes come from each
+    event's first realization like
+    :meth:`~.shardflow.ShardflowReport.predicted_counts`;
+    ``while_collectives`` counts the in-loop events;
+    ``max_constant_bytes`` is 0 — the trace sees no HLO constants."""
+    collectives: dict[str, dict] = {}
+    n_while = 0
+    for ev in report.events:
+        if ev.kind == "slice" or not ev.realizations:
+            continue
+        op, ax = ev.realizations[0]
+        grp = collectives.setdefault(
+            f"{op}@{ax}", {"count": 0, "max_bytes": 0}
+        )
+        grp["count"] += 1
+        grp["max_bytes"] = max(grp["max_bytes"], int(ev.bytes))
+        if ev.in_loop:
+            n_while += 1
+    return Contract(
+        name=report.name,
+        mesh_shape=[int(x) for x in report.mesh_shape],
+        mesh_axes=[str(a) for a in report.mesh_axes],
+        collectives=dict(sorted(collectives.items())),
+        while_collectives=n_while,
+        max_constant_bytes=0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# The search
+# ---------------------------------------------------------------------------
+
+
+def search_layout(
+    name: str,
+    fn: Callable,
+    *args,
+    mesh: Any,
+    vary: Callable[[str, Any], bool] | None = None,
+    budget: int = 96,
+    profile: costmodel.Profile | None = None,
+    while_trip_hint: int | None = None,
+    max_sweeps: int = 3,
+    **kwargs,
+) -> SearchResult:
+    """Search the sharding layout of ``fn(*args)``'s argument leaves.
+
+    ``args`` carry the INCUMBENT layout on their committed shardings
+    (same convention as :func:`~.shardflow.trace_shardflow`); ``vary``
+    selects which leaves are searched (default :func:`default_vary`).
+    The function is traced to a jaxpr exactly once; every candidate is
+    an abstract re-simulation — NO candidate is ever compiled. Returns
+    the argmin :class:`SearchResult` (the incumbent itself when nothing
+    cheaper is found within ``budget`` evaluations)."""
+    import jax
+
+    if profile is None:
+        profile = costmodel.current_profile()
+    if budget < 1:
+        raise ValueError(f"budget must be >= 1, got {budget}")
+    inner = getattr(fn, "__wrapped__", fn)
+    closed = jax.make_jaxpr(inner)(*args, **kwargs)
+    flat, _ = jax.tree_util.tree_flatten_with_path((args, kwargs))
+    paths = [jax.tree_util.keystr(p) for p, _ in flat]
+    leaves = [leaf for _, leaf in flat]
+    mesh_sizes = {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+    base_specs: list[Spec] = []
+    for leaf in leaves:
+        ndim = int(getattr(leaf, "ndim", np.ndim(leaf)))
+        sh = getattr(leaf, "sharding", None)
+        base_specs.append(
+            spec_of_sharding(sh, ndim) if sh is not None
+            else Spec.replicated(ndim)
+        )
+
+    vary = vary if vary is not None else default_vary
+    decisions: list[Decision] = []
+    for i, (path, leaf) in enumerate(zip(paths, leaves)):
+        if not vary(path, leaf):
+            continue
+        shape = tuple(int(s) for s in (getattr(leaf, "shape", ()) or ()))
+        cands = candidate_dims(shape, mesh_sizes)
+        if len(cands) < 2:
+            continue
+        decisions.append(Decision(
+            index=i, path=path, group=_group_of(path), shape=shape,
+            nbytes=_nbytes(leaf), candidates=cands,
+        ))
+    # Factorized order: heaviest groups first (the big offenders — embed,
+    # lm_head — get fixed before a tight budget runs out), then group
+    # name; within a group, heaviest leaf first, path as tie-break.
+    group_bytes: dict[str, int] = {}
+    for d in decisions:
+        group_bytes[d.group] = group_bytes.get(d.group, 0) + d.nbytes
+    decisions.sort(
+        key=lambda d: (-group_bytes[d.group], d.group, -d.nbytes, d.path)
+    )
+
+    def evaluate(specs, abort_above=None):
+        rep = simulate_jaxpr(
+            name, closed, specs, mesh,
+            while_trip_hint=while_trip_hint, arg_avals=leaves,
+        )
+        coll, _wire, aborted = costmodel.price_multiset(
+            rep.events, profile, mesh_sizes, abort_above=abort_above,
+        )
+        if aborted:
+            return rep, None
+        return rep, costmodel.price(rep, profile)
+
+    current = list(base_specs)
+    base_report, base_cost = evaluate(current)
+    evaluated, pruned = 1, 0
+    best_report, best_cost = base_report, base_cost
+    exhausted = evaluated >= budget
+    sweeps = 0
+    improved = True
+    while improved and sweeps < max_sweeps and not exhausted:
+        improved = False
+        sweeps += 1
+        for d in decisions:
+            cur_dims = current[d.index].dims
+            for cand in d.candidates:
+                if cand == cur_dims:
+                    continue
+                if evaluated >= budget:
+                    exhausted = True
+                    break
+                trial = list(current)
+                trial[d.index] = Spec(cand)
+                rep, cost = evaluate(
+                    trial, abort_above=best_cost.predicted_s
+                )
+                evaluated += 1
+                if cost is None:   # dominance prune cut it mid-pricing
+                    pruned += 1
+                    continue
+                # Strict < : equal-cost candidates lose to the earlier
+                # enumerated layout (the incumbent on a full tie) — the
+                # deterministic tie-break.
+                if cost.predicted_s < best_cost.predicted_s:
+                    current = trial
+                    best_report, best_cost = rep, cost
+                    cur_dims = cand
+                    improved = True
+            if exhausted:
+                break
+
+    assignment = {
+        d.path: current[d.index].dims
+        for d in sorted(decisions, key=lambda d: d.path)
+    }
+    baseline_assignment = {
+        d.path: base_specs[d.index].dims
+        for d in sorted(decisions, key=lambda d: d.path)
+    }
+    return SearchResult(
+        name=name,
+        mesh_axes=[str(a) for a in mesh.axis_names],
+        mesh_shape=[int(mesh.shape[a]) for a in mesh.axis_names],
+        baseline=base_cost,
+        best=best_cost,
+        assignment=assignment,
+        baseline_assignment=baseline_assignment,
+        evaluated=evaluated,
+        pruned=pruned,
+        budget=budget,
+        sweeps=sweeps,
+        exhausted=exhausted,
+        report=best_report,
+        baseline_report=base_report,
+        contract=contract_from_report(best_report),
+    )
+
+
+def partition_spec(dims: tuple):
+    """A Spec dims tuple as the ``PartitionSpec`` it denotes."""
+    from jax.sharding import PartitionSpec as P
+
+    return P(*(
+        None if not d else (d[0] if len(d) == 1 else tuple(d))
+        for d in dims
+    ))
+
+
+def apply_assignment(result: SearchResult, args: tuple, mesh: Any,
+                     kwargs: dict | None = None) -> tuple[tuple, dict]:
+    """Re-commit ``args`` to the searched layout: every leaf the search
+    moved is ``device_put`` onto its chosen ``PartitionSpec`` (untouched
+    leaves keep their committed sharding). This — plus one compile of
+    the returned args — is the ONLY device work in the whole loop; use
+    it to realize the argmin for measurement (``bench.py
+    bench_layout_search``) or adoption."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    kwargs = kwargs or {}
+    flat, treedef = jax.tree_util.tree_flatten_with_path((args, kwargs))
+    changed = result.changed
+    out = []
+    for p, leaf in flat:
+        path = jax.tree_util.keystr(p)
+        if path in changed:
+            leaf = jax.device_put(
+                leaf, NamedSharding(mesh, partition_spec(changed[path][1]))
+            )
+        out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# Entry-point integration
+# ---------------------------------------------------------------------------
+
+
+def search_entry(
+    entry: str,
+    mesh: Any = None,
+    *,
+    budget: int = 96,
+    profile: costmodel.Profile | None = None,
+) -> SearchResult:
+    """Run the layout search for one searchable entry point
+    (``entrypoints.SEARCHABLE_ENTRIES``), built by the SAME builders the
+    contract pass compiles — the committed argument shardings are the
+    hand-tuned incumbent the search must beat or match."""
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_search_inputs,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import activate
+
+    t = build_search_inputs(entry, mesh)
+    vary_paths = t["vary_paths"]
+    if vary_paths is None:
+        vary = default_vary
+    else:
+        def vary(path, leaf, _paths=tuple(vary_paths)):
+            return default_vary(path, leaf) and any(
+                s in path for s in _paths
+            )
+    with activate(t["mesh"], t["rules"]):
+        return search_layout(
+            t["name"], t["fn"], *t["args"], mesh=t["mesh"], vary=vary,
+            budget=budget, profile=profile,
+            while_trip_hint=t["while_trip_hint"], **t["kwargs"],
+        )

@@ -618,6 +618,38 @@ class _Interp:
             + [tuple(ls_d[i]) for i in m_dims]
             + [tuple(rs_d[i]) for i in n_dims]
         )
+        # One mesh axis can shard at most ONE dim of the product: when
+        # both operands bring free dims sharded on the same axis (e.g.
+        # batch-sharded lhs against an output-sharded rhs on one axis),
+        # GSPMD keeps the first and gathers the other operand off the
+        # axis before the dot.
+        kept: set[str] = set()
+        fixed: list[Dim] = []
+        for pos, d in enumerate(out_dims):
+            dup = tuple(a for a in d if a in kept and self.sizes.get(a, 1) > 1)
+            if dup:
+                if pos < len(lb):
+                    side_v, side_dims, idx = lhs, ls_d, lb[pos]
+                elif pos < len(lb) + len(m_dims):
+                    side_v, side_dims, idx = lhs, ls_d, m_dims[pos - len(lb)]
+                else:
+                    side_v, side_dims, idx = (
+                        rhs, rs_d, n_dims[pos - len(lb) - len(m_dims)]
+                    )
+                dst = [tuple(x) for x in side_dims]
+                dst[idx] = tuple(a for a in dst[idx] if a not in dup)
+                self._reshard(
+                    Spec(tuple(tuple(x) for x in side_dims)), tuple(dst),
+                    side_v, eqn,
+                    "free dims of both dot operands sharded on the same "
+                    "axis — the product can use it once; GSPMD gathers "
+                    "the other side",
+                )
+                side_dims[idx] = dst[idx]
+                d = tuple(a for a in d if a not in dup)
+            kept.update(d)
+            fixed.append(tuple(d))
+        out_dims = fixed
         # A free dim sharded on the same axis as a pending partial can't
         # coexist (an axis shards OR reduces, not both): drop the dim
         # sharding — GSPMD replicates that operand dim into the product.
@@ -1161,6 +1193,58 @@ def _map_reshape(dims, in_shape, out_shape, sizes):
 # ---------------------------------------------------------------------------
 
 
+def simulate_jaxpr(
+    name: str,
+    closed: Any,
+    in_specs: list[Spec],
+    mesh: Any,
+    *,
+    while_trip_hint: int | None = None,
+    out_hint: list[Spec] | None = None,
+    arg_avals: list[Any] | None = None,
+) -> ShardflowReport:
+    """Run the propagation interpreter over an ALREADY-TRACED closed
+    jaxpr with explicit per-invar input :class:`Spec`\\ s — the layout
+    search's inner loop (``analysis.layout_search``): the jaxpr is
+    traced once per entry point, then re-simulated per candidate
+    sharding assignment with no re-trace and no compile. ``arg_avals``
+    (default: the jaxpr invars' avals) sizes the input HBM streaming
+    charge; :func:`trace_shardflow` passes the concrete argument leaves
+    so its accounting is unchanged."""
+    in_specs = list(in_specs)
+    # make_jaxpr flattens args in tree order == invars order.
+    if len(in_specs) < len(closed.jaxpr.invars):
+        in_specs += [Spec.replicated(0)] * (
+            len(closed.jaxpr.invars) - len(in_specs)
+        )
+    if arg_avals is None:
+        arg_avals = [v.aval for v in closed.jaxpr.invars]
+    interp = _Interp(mesh, while_trip_hint=while_trip_hint)
+    # Program inputs are streamed from HBM once (loop bodies re-charge
+    # their own operands through the trip multiplier).
+    sizes = interp.sizes
+    for leaf, spec in zip(arg_avals, in_specs):
+        interp.hbm_bytes += _aval_bytes(leaf) / max(
+            1, spec.shard_factor(sizes)
+        )
+    out_specs = interp.run(closed.jaxpr, in_specs[:len(closed.jaxpr.invars)],
+                           out_hint)
+    for v, spec in zip(closed.jaxpr.outvars, out_specs):
+        interp.hbm_bytes += _aval_bytes(v) / max(
+            1, spec.shard_factor(sizes)
+        )
+    return ShardflowReport(
+        name=name,
+        mesh_axes=[str(a) for a in mesh.axis_names],
+        mesh_shape=[int(mesh.shape[a]) for a in mesh.axis_names],
+        events=interp.events,
+        flops=interp.flops,
+        hbm_bytes=interp.hbm_bytes,
+        out_specs=out_specs,
+        flops_thin=interp.flops_thin,
+    )
+
+
 def trace_shardflow(
     name: str,
     fn: Callable,
@@ -1189,11 +1273,6 @@ def trace_shardflow(
             spec_of_sharding(sh, ndim) if sh is not None
             else Spec.replicated(ndim)
         )
-    # make_jaxpr flattens args in tree order == invars order.
-    if len(in_specs) < len(closed.jaxpr.invars):
-        in_specs += [Spec.replicated(0)] * (
-            len(closed.jaxpr.invars) - len(in_specs)
-        )
     out_hint = None
     if out_shardings is not None:
         import jax as _jax
@@ -1203,29 +1282,9 @@ def trace_shardflow(
         for v, sh in zip(closed.jaxpr.outvars, hint_flat):
             ndim = len(getattr(getattr(v, "aval", None), "shape", ()) or ())
             out_hint.append(spec_of_sharding(sh, ndim))
-    interp = _Interp(mesh, while_trip_hint=while_trip_hint)
-    # Program inputs are streamed from HBM once (loop bodies re-charge
-    # their own operands through the trip multiplier).
-    sizes = interp.sizes
-    for leaf, spec in zip(flat, in_specs):
-        interp.hbm_bytes += _aval_bytes(leaf) / max(
-            1, spec.shard_factor(sizes)
-        )
-    out_specs = interp.run(closed.jaxpr, in_specs[:len(closed.jaxpr.invars)],
-                           out_hint)
-    for v, spec in zip(closed.jaxpr.outvars, out_specs):
-        interp.hbm_bytes += _aval_bytes(v) / max(
-            1, spec.shard_factor(sizes)
-        )
-    return ShardflowReport(
-        name=name,
-        mesh_axes=[str(a) for a in mesh.axis_names],
-        mesh_shape=[int(mesh.shape[a]) for a in mesh.axis_names],
-        events=interp.events,
-        flops=interp.flops,
-        hbm_bytes=interp.hbm_bytes,
-        out_specs=out_specs,
-        flops_thin=interp.flops_thin,
+    return simulate_jaxpr(
+        name, closed, in_specs, mesh,
+        while_trip_hint=while_trip_hint, out_hint=out_hint, arg_avals=flat,
     )
 
 

@@ -137,6 +137,18 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     (re.compile(r"steps/dispatch ([\d,.]+)"), "steps_per_dispatch", True),
     (re.compile(r"boundary stall ([\d,.]+)%"), "boundary_stall_pct",
      False),
+    # Round-17 layout-search gates (bench.py's `[bench] layout_search
+    # ...` lines): `layout gap` is the priced searched-vs-hand gap — a
+    # growing gap means the committed hand layouts drifted away from the
+    # searchable optimum (down is better; 0 = hand layout already
+    # argmin); `layout err` is the search-specific predicted-vs-measured
+    # error on the two layouts it actually compiles (phrased distinctly
+    # from the shardflow pass's `model err` so the two gates never
+    # double-match one line).
+    (re.compile(r"layout gap ([\d,.]+)%"), "layout_search_gap_pct",
+     False),
+    (re.compile(r"layout err ([\d,.]+)%"),
+     "layout_predicted_vs_measured_pct", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""layout_search: find a faster sharding for an entry point BEFORE compiling.
+
+The closed loop over the round-8/13 instruments
+(``analysis/layout_search.py``): enumerate candidate ``PartitionSpec``
+assignments for one entry point's searched leaves (param tree for
+``train_step``, param + optimizer state for ``zero1_update`` — the
+2004.13336 weight-update space — param/KV layouts for ``mixed_step`` /
+``multi_step``), re-simulate the entry's jaxpr per candidate (traced
+once, abstract eval only — NOTHING is compiled), price each collective
+multiset with the bench-calibrated roofline, and print the argmin
+layout, its priced cost against the hand-tuned incumbent, and a
+ready-to-commit expected-collective contract in the
+``analysis/golden/*.json`` format.
+
+Usage::
+
+    python scripts/layout_search.py --entry train_step --mesh 2x4 --budget 96
+    python scripts/layout_search.py --entry zero1_update --json
+    python scripts/layout_search.py --entry mixed_step \
+        --emit-contract /tmp/mixed_step.search.json
+
+Determinism: same entry + mesh + budget => byte-identical chosen layout
+and contract (pricing uses the seeded "TPU v5 lite" table profile by
+default; ``--profile live`` prices for the attached backend instead).
+
+Exit codes: 0 ran (whether or not a cheaper layout was found), 2 bad
+arguments / infrastructure error. The search result is ADVISORY — the
+gate for committed layouts stays ``scripts/shardcheck.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+
+def _parse_mesh(text: str):
+    try:
+        shape = tuple(int(p) for p in text.lower().split("x"))
+    except ValueError:
+        shape = ()
+    if len(shape) != 2 or any(s < 1 for s in shape):
+        raise SystemExit(
+            f"layout_search: --mesh must look like 2x4 (data x model), "
+            f"got {text!r}"
+        )
+    return shape
+
+
+def _bench_lines(args) -> int:
+    """The bench.py leg: search with the seeded table profile (the
+    deterministic argmin TPUs would adopt), then compile + measure ONLY
+    the hand layout and that argmin, pricing both with the LIVE profile
+    so predicted-vs-measured is apples-to-apples on this host. On a
+    non-TPU host the mesh is emulated and the live profile is scaled by
+    1/n_devices — the emulated devices timeshare one socket, so each
+    sustains that fraction of the calibrated rates (emulated 'links'
+    are memcpy, calibrate()'s convention). The workload is the
+    bench_shardflow shape family (125M on TPU, the scaled-down
+    same-architecture config on CPU) — the tiny entry-point shapes are
+    emulation-overhead-dominated and would measure the harness, not the
+    layout. Two compiles total; no other candidate ever touches XLA."""
+    import dataclasses
+
+    shape = _parse_mesh(args.mesh)
+    n_dev = shape[0] * shape[1]
+    try:
+        force_emulated_devices(n_dev)
+    except RuntimeError as e:
+        print(f"layout_search: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+    import numpy as np
+    import optax
+
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.layout_search import (
+        apply_assignment,
+        default_vary,
+        search_layout,
+    )
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_125M,
+        Transformer,
+        next_token_loss,
+    )
+    from learning_jax_sharding_tpu.parallel import (
+        build_mesh,
+        mesh_sharding,
+        put,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import (
+        RULES_DP_TP,
+        activate,
+    )
+    from learning_jax_sharding_tpu.training.pipeline import (
+        make_train_step,
+        sharded_train_state,
+    )
+    from learning_jax_sharding_tpu.utils.bench import time_fn
+
+    if args.entry != "train_step":
+        print(f"layout_search: --bench-lines measures train_step only, "
+              f"got {args.entry}", file=sys.stderr)
+        return 2
+
+    mesh = build_mesh(shape, ("data", "model"))
+    table = costmodel.table_profile(args.profile) if args.profile != "live" \
+        else costmodel.current_profile()
+    live = costmodel.current_profile()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu and n_dev > 1:
+        live = dataclasses.replace(
+            live, name=f"{live.name}/{n_dev}dev",
+            peak_flops=live.peak_flops / n_dev,
+            hbm_bw=live.hbm_bw / n_dev, link_bw=live.link_bw / n_dev,
+        )
+
+    if on_tpu:
+        cfg, b, s = CONFIG_125M, 8, 1024
+    else:
+        cfg = dataclasses.replace(
+            CONFIG_125M, vocab_size=8192, num_layers=2, features=256,
+            num_heads=4, head_dim=64, hidden=1024, max_seq_len=512,
+        )
+        b, s = 8, 384
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(b, s + 1)).astype(np.int32)
+    sh = mesh_sharding(mesh, "data", None)
+    batch = {"inputs": put(tokens[:, :-1], sh),
+             "targets": put(tokens[:, 1:], sh)}
+    state, state_sh = sharded_train_state(
+        Transformer(cfg), optax.adamw(3e-4), batch["inputs"],
+        {"params": jax.random.key(0)}, mesh, RULES_DP_TP,
+    )
+    step = make_train_step(
+        state_sh, {k: v.sharding for k, v in batch.items()}, mesh,
+        RULES_DP_TP, loss_fn=next_token_loss, donate_state=False,
+    )
+
+    def vary(path, leaf):
+        return default_vary(path, leaf) and ".params" in path
+
+    t0 = time.perf_counter()
+    with activate(mesh, RULES_DP_TP):
+        res = search_layout(
+            "train_step", step.jitted, state, batch, mesh=mesh,
+            vary=vary, budget=args.budget, profile=table,
+        )
+    search_wall = time.perf_counter() - t0
+
+    # Compile #1: the hand layout — the step as built.
+    measured_hand = time_fn(step, state, batch, min_time=1.0, repeats=2)
+    # Compile #2: the argmin — re-commit the moved leaves, rebuild the
+    # step around the new sharding tree (jit in_shardings would silently
+    # reshard inputs back to the hand layout otherwise).
+    (state2, batch2), _ = apply_assignment(res, (state, batch), mesh)
+    step2 = make_train_step(
+        jax.tree.map(lambda x: x.sharding, state2),
+        {k: v.sharding for k, v in batch2.items()}, mesh, RULES_DP_TP,
+        loss_fn=next_token_loss, donate_state=False,
+    )
+    measured_best = time_fn(step2, state2, batch2, min_time=1.0, repeats=2)
+
+    pred_hand = costmodel.price(res.baseline_report, live)
+    pred_best = costmodel.price(res.report, live)
+    cmp_hand = costmodel.compare(pred_hand.predicted_s, measured_hand)
+    cmp_best = costmodel.compare(pred_best.predicted_s, measured_best)
+    err = max(cmp_hand["err_pct"], cmp_best["err_pct"])
+    meas_delta = 100.0 * (measured_hand - measured_best) / measured_hand
+
+    print(f"[bench] layout_search {args.entry} ({args.mesh} emulated, "
+          f"budget {res.budget}): searched {res.evaluated} candidates "
+          f"({res.pruned} pruned) in {search_wall:.1f}s, "
+          f"{len(res.changed)} leaves moved, layout gap "
+          f"{res.gap_pct:.1f}% ({table.name})")
+    print(f"[bench] layout_search {args.entry} measured: hand "
+          f"{measured_hand * 1e3:.2f} vs argmin "
+          f"{measured_best * 1e3:.2f} ms measured "
+          f"(delta {meas_delta:+.1f}%), layout err {err:.1f}% "
+          f"(hand {cmp_hand['err_pct']:.1f}%, argmin "
+          f"{cmp_best['err_pct']:.1f}%, {live.name})")
+    print("[bench-json] " + json.dumps({
+        "entry": args.entry,
+        "mesh": args.mesh,
+        "budget": res.budget,
+        "evaluated": res.evaluated,
+        "pruned": res.pruned,
+        "search_wall_seconds": round(search_wall, 2),
+        "gap_pct": round(res.gap_pct, 2),
+        "changed": res.changed_lines(),
+        "measured_hand_ms": round(measured_hand * 1e3, 4),
+        "measured_argmin_ms": round(measured_best * 1e3, 4),
+        "measured_delta_pct": round(meas_delta, 2),
+        "err_pct": round(err, 2),
+        "hand": cmp_hand,
+        "argmin": cmp_best,
+        "search_profile": table.name,
+        "measure_profile": live.name,
+    }))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        SEARCHABLE_ENTRIES,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entry", required=True, choices=SEARCHABLE_ENTRIES,
+                    help="entry point whose layout to search")
+    ap.add_argument("--mesh", default="2x4", metavar="RxC",
+                    help="mesh shape as data x model (default 2x4)")
+    ap.add_argument("--budget", type=int, default=96,
+                    help="max candidate evaluations, incumbent included "
+                    "(default 96)")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="emulated device count (default: mesh size)")
+    ap.add_argument("--profile", default="TPU v5 lite",
+                    help='pricing profile: a table kind (default '
+                    '"TPU v5 lite") or "live" for the attached backend')
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument("--emit-contract", default=None, metavar="PATH",
+                    help="also write the argmin layout's contract JSON "
+                    "here (golden format, ready to review/commit)")
+    ap.add_argument(
+        "--bench-lines", action="store_true",
+        help="bench mode for bench.py: search (table profile), then "
+        "compile + measure ONLY the hand layout and the argmin layout "
+        "and print `[bench] layout_search ...` lines (gap + "
+        "predicted-vs-measured err) plus one `[bench-json] {...}` line",
+    )
+    args = ap.parse_args(argv)
+    if args.bench_lines:
+        return _bench_lines(args)
+
+    shape = _parse_mesh(args.mesh)
+    n_dev = args.devices if args.devices is not None else shape[0] * shape[1]
+    try:
+        force_emulated_devices(n_dev)
+    except RuntimeError as e:  # backend already initialized differently
+        print(f"layout_search: {e}", file=sys.stderr)
+        return 2
+
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.layout_search import (
+        dims_str,
+        search_entry,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+
+    mesh = build_mesh(shape, ("data", "model"))
+    profile = (
+        costmodel.current_profile() if args.profile == "live"
+        else costmodel.table_profile(args.profile)
+    )
+
+    # Host-side search wall time for PERF.md — the search dispatches no
+    # device work (abstract simulation only), so there is nothing to
+    # synchronize before reading the clock.
+    t0 = time.perf_counter()
+    res = search_entry(args.entry, mesh, budget=args.budget, profile=profile)
+    wall = time.perf_counter() - t0
+
+    if args.emit_contract:
+        pathlib.Path(args.emit_contract).write_text(res.contract.to_json())
+
+    if args.json:
+        doc = res.to_dict()
+        doc["wall_seconds"] = round(wall, 2)
+        doc["profile"] = profile.name
+        print(json.dumps(doc, indent=2))
+        return 0
+
+    print(f"== layout_search {res.name} on {args.mesh} "
+          f"({profile.name}, budget {res.budget})")
+    print(f"   evaluated {res.evaluated} candidates "
+          f"({res.pruned} dominance-pruned, {res.sweeps} sweep(s)"
+          f"{', budget exhausted' if res.exhausted else ''}) "
+          f"in {wall:.1f}s")
+    print(f"   hand-tuned incumbent: {res.baseline.predicted_s * 1e3:.3f} ms "
+          f"({res.baseline.bound}-bound)")
+    print(f"   searched argmin:      {res.best.predicted_s * 1e3:.3f} ms "
+          f"({res.best.bound}-bound)  gap {res.gap_pct:.1f}%")
+    if res.changed:
+        print("   changed leaves:")
+        for line in res.changed_lines():
+            print(f"     {line}")
+    else:
+        print("   hand layout is already the argmin — nothing to change")
+    kept = sum(1 for p in res.assignment if p not in res.changed)
+    print(f"   ({kept}/{len(res.assignment)} searched leaves keep the "
+          "hand layout)")
+    print("   expected-collective contract for the argmin layout:")
+    for ln in res.contract.to_json().rstrip("\n").splitlines():
+        print(f"     {ln}")
+    if args.emit_contract:
+        print(f"   contract written to {args.emit_contract}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -299,6 +299,44 @@ class TestExtraction:
         assert not by["multistep_h16:tok_s"]["regressed"]
         assert not by["multistep_h1:steps_per_dispatch"]["regressed"]
 
+    def test_layout_search_gates_direction_aware(self):
+        """The round-17 layout-search gates: the gap between the
+        hand-tuned layout and the searched argmin regresses UP (a
+        growing gap means the hand layouts drifted from optimal), and
+        so does the predicted-vs-measured error of the cost model on
+        the two compiled layouts. `layout err` must NOT ride the
+        round-8 `model err` pattern — they gate different things."""
+        lines = [
+            "[bench] layout_search train_step (2x4 emulated, budget 48): "
+            "searched 48 candidates (31 pruned) in 1.7s, 2 leaves moved, "
+            "layout gap 32.5% (TPU v5 lite)",
+            "[bench] layout_search train_step measured: hand 15.10 vs "
+            "argmin 13.88 ms measured (delta +8.1%), layout err 19.6% "
+            "(hand 19.6%, argmin 12.4%, cpu-x8)",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        assert m[
+            "layout_search_train_step_(2x4_emulated,_budget_48)"
+            ":layout_search_gap_pct"
+        ] == (32.5, False)
+        assert m["layout_search_train_step_measured"
+                 ":layout_predicted_vs_measured_pct"] == (19.6, False)
+        assert not any(
+            k.endswith(":predicted_vs_measured_pct") for k in m
+        )
+        worse = _doc([
+            lines[0].replace("layout gap 32.5%", "layout gap 55.0%"),
+            lines[1].replace("layout err 19.6% ", "layout err 41.0% "),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[
+            "layout_search_train_step_(2x4_emulated,_budget_48)"
+            ":layout_search_gap_pct"
+        ]["regressed"]
+        assert by["layout_search_train_step_measured"
+                  ":layout_predicted_vs_measured_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

@@ -717,6 +717,36 @@ class TestCheckedInGoldens:
             f"re-wire): {sorted(orphaned)}"
         )
 
+    def test_searchable_entries_are_live_entry_points(self):
+        """Round-17 audit extension: every entry the layout search can
+        target (``SEARCHABLE_ENTRIES``) must name a live entry-point
+        program AND a checked-in golden — a search advisory against a
+        renamed entry would otherwise point at nothing, and its emitted
+        contract could never be diffed against the golden it claims to
+        improve on."""
+        from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
+        from learning_jax_sharding_tpu.analysis.entrypoints import (
+            SEARCHABLE_ENTRIES,
+            build_entry_programs,
+        )
+
+        entry_names = {p.name for p in build_entry_programs()}
+        golden_names = {f.stem for f in GOLDEN_DIR.glob("*.json")}
+        searchable = set(SEARCHABLE_ENTRIES)
+        assert searchable <= entry_names, (
+            f"searchable entries naming no live entry point: "
+            f"{sorted(searchable - entry_names)}"
+        )
+        assert searchable <= golden_names, (
+            f"searchable entries without a golden to diff against: "
+            f"{sorted(searchable - golden_names)}"
+        )
+        # The search's contract emitter must preserve the entry name so
+        # the emitted file is comparable against the golden of the same
+        # entry (byte-format parity is pinned in test_layout_search).
+        golden = Contract.load(GOLDEN_DIR / "train_step.json")
+        assert golden.name == "train_step"
+
     def test_goldens_record_real_communication(self):
         from learning_jax_sharding_tpu.analysis import GOLDEN_DIR
 

@@ -314,3 +314,85 @@ class TestCostModel:
         # the efficiency factor, regardless of device count (n_dev=8).
         assert cost.n_dev == 8
         assert cost.predicted_mfu == pytest.approx(0.5)
+
+
+class TestPriceMultiset:
+    """The round-17 batch pricing API the layout search's inner loop
+    rides: term-exact against per-event ``price_event``, memoized per
+    (profile, mesh, realization, axes, bytes, trip), and abortable
+    mid-sum for dominance pruning."""
+
+    PROFILE = TestCostModel.PROFILE
+    SIZES = {"x": 2, "y": 4}
+
+    def test_term_exact_vs_price_event(self):
+        events = [
+            _ar_event(),
+            _ar_event(bytes=3_000_000, realizations=(("all-gather", "y"),)),
+            _ar_event(axes=("x",), realizations=(("all-reduce", "x"),),
+                      in_loop=True, trip=7),
+        ]
+        total, wire, aborted = costmodel.price_multiset(
+            events, self.PROFILE, self.SIZES
+        )
+        exact = sum(
+            costmodel.price_event(e, self.PROFILE, self.SIZES)
+            for e in events
+        )
+        assert not aborted
+        assert total == pytest.approx(exact, rel=0, abs=0)  # term-exact
+        assert wire == pytest.approx(total * self.PROFILE.link_bw)
+
+    def test_price_goes_through_multiset(self):
+        events = [_ar_event(), _ar_event(in_loop=True, trip=3)]
+        cost = costmodel.price(_report(events), self.PROFILE)
+        total, _, _ = costmodel.price_multiset(
+            events, self.PROFILE, self.SIZES
+        )
+        assert cost.collective_s == pytest.approx(total, rel=0, abs=0)
+
+    def test_memoizes_repeated_terms(self, monkeypatch):
+        calls = {"n": 0}
+        real = costmodel._ring_factor
+
+        def counting(op, n):
+            calls["n"] += 1
+            return real(op, n)
+
+        monkeypatch.setattr(costmodel, "_ring_factor", counting)
+        costmodel._MULTISET_MEMO.clear()
+        events = [_ar_event() for _ in range(50)]
+        costmodel.price_multiset(events, self.PROFILE, self.SIZES)
+        first = calls["n"]
+        assert first <= len(_ar_event().realizations) * 2  # priced once
+        costmodel.price_multiset(events, self.PROFILE, self.SIZES)
+        assert calls["n"] == first  # second batch fully memoized
+
+    def test_abort_above_cuts_mid_sum(self):
+        one = costmodel.price_event(_ar_event(), self.PROFILE, self.SIZES)
+        events = [_ar_event() for _ in range(10)]
+        total, _, aborted = costmodel.price_multiset(
+            events, self.PROFILE, self.SIZES, abort_above=2.5 * one
+        )
+        assert aborted
+        # Cut as soon as the partial sum crossed the incumbent: three
+        # terms in, not ten.
+        assert total == pytest.approx(3 * one)
+
+    def test_abort_above_not_triggered_at_exact_total(self):
+        one = costmodel.price_event(_ar_event(), self.PROFILE, self.SIZES)
+        total, _, aborted = costmodel.price_multiset(
+            [_ar_event()] * 4, self.PROFILE, self.SIZES,
+            abort_above=4 * one + 1e-18
+        )
+        assert not aborted
+        assert total == pytest.approx(4 * one)
+
+    def test_loop_trip_keys_separately(self):
+        once, _, _ = costmodel.price_multiset(
+            [_ar_event()], self.PROFILE, self.SIZES
+        )
+        looped, _, _ = costmodel.price_multiset(
+            [_ar_event(in_loop=True, trip=5)], self.PROFILE, self.SIZES
+        )
+        assert looped == pytest.approx(once * 5)
