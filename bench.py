@@ -546,6 +546,72 @@ def bench_layout_search():
     return block
 
 
+def bench_memflow():
+    """Memflow reconciliation (round 18): the static per-device
+    peak-HBM analyzer (``analysis/memflow.py``) against what XLA's
+    ``compiled.memory_analysis()`` reports for the searchable entry
+    points — the accuracy number behind the layout search's HBM budget
+    gate and ``shardcheck --memory``'s OOM findings.
+
+    Like ``bench_fleet``, the entry points need the emulated 8-device
+    mesh, so the pass runs in a subprocess (``scripts/shardcheck.py
+    --pass memory --json``); this relay prints one ``[bench] memflow
+    <entry>`` line per searchable entry plus a summary line, and
+    ``scripts/bench_compare.py`` gates ``memflow err`` per line
+    direction-aware (phrased distinctly from shardflow's ``model err``
+    and the search's ``layout err``). The per-entry peak table lands in
+    the JSON line's ``memflow`` block. The signed error is structurally
+    POSITIVE (memflow over-predicts: it cannot see XLA's rematerialized
+    fusions freeing buffers early), which is what makes the budget gate
+    safe — drift toward 0 is fine, drift NEGATIVE would mean the gate
+    can pass layouts that OOM."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent / "scripts"
+        / "shardcheck.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--pass", "memory", "--json"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-5:])
+        raise RuntimeError(
+            f"shardcheck --pass memory exited {proc.returncode}: {tail}"
+        )
+    doc = json.loads(proc.stdout)
+    entries: dict = {}
+    worst = 0.0
+    for rec in doc.get("memory", []):
+        rep, rc = rec["report"], rec["reconciled"]
+        err = abs(float(rc["err_pct"]))
+        worst = max(worst, err)
+        _log(
+            f"[bench] memflow {rec['name']}: predicted peak "
+            f"{rep['peak_mib']:.1f} MiB/device at {rep['peak_where']}, "
+            f"XLA measures {rc['measured_bytes'] / 2**20:.1f} MiB, "
+            f"memflow err {err:.1f}%"
+        )
+        entries[rec["name"]] = {
+            "peak_bytes": rep["peak_bytes"],
+            "peak_where": rep["peak_where"],
+            "measured_bytes": rc["measured_bytes"],
+            "signed_err_pct": rc["signed_err_pct"],
+            "unexplained": rc["unexplained"],
+            "donated": rec["donated"],
+        }
+    if entries:
+        _log(
+            f"[bench] memflow summary: worst of {len(entries)} entries, "
+            f"memflow err {worst:.1f}%"
+        )
+    return {"entries": entries, "worst_err_pct": worst} if entries else None
+
+
 def bench_moe_125m():
     """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
     capacity routing, fp32 router — models/moe.py), same harness as the
@@ -1263,6 +1329,11 @@ def main():
     except Exception as e:
         _log(f"[bench] layout_search bench skipped: {type(e).__name__}: {e}")
         layout_search_block = None
+    try:
+        memflow_block = bench_memflow()
+    except Exception as e:
+        _log(f"[bench] memflow bench skipped: {type(e).__name__}: {e}")
+        memflow_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1312,6 +1383,12 @@ def main():
         # layout_search.py; gated by bench_compare's `layout gap` /
         # `layout err` patterns).
         "layout_search": layout_search_block,
+        # Round-18 memflow reconciliation: the static liveness
+        # analyzer's per-entry predicted peak vs XLA's memory_analysis
+        # on the searchable entries (analysis/memflow.py; gated by
+        # bench_compare's `memflow err` pattern) — the accuracy bound
+        # on the layout search's HBM budget gate.
+        "memflow": memflow_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

@@ -20,6 +20,11 @@ Three levels, one finding type, one CLI (``scripts/shardcheck.py``):
    and a roofline-priced step time, reconciled against the SAME golden
    contracts level 1 checks (an actual collective no predicted event
    explains is a gated ``unexplained-collective`` finding).
+5. **memflow** (:mod:`.memflow`) — the memory face of level 4: a
+   jaxpr-level liveness walk predicts per-device peak HBM (sharding-,
+   donation- and scan/remat-aware), reconciled against
+   ``compiled.memory_analysis()`` under baseline-pinned tolerances and
+   gated against the device HBM budget (``shardcheck --memory``).
 
 Static verdicts land in the PR-2 flight recorder / registry
 (:func:`~.findings.report_findings`), so a post-mortem bundle shows what
@@ -28,7 +33,9 @@ the static layer already knew.
 
 from __future__ import annotations
 
+import contextlib
 import pathlib
+import time
 
 from learning_jax_sharding_tpu.analysis.contracts import (
     Contract,
@@ -60,12 +67,31 @@ GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
 BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "baseline.json"
 
 
+@contextlib.contextmanager
+def _program_timer(program_seconds: dict | None, name: str):
+    """Accumulate one program's wall-clock into ``program_seconds`` (the
+    ``shardcheck --timings`` attribution surface). Host-side only: the
+    passes compile and walk jaxprs, they dispatch no device work, so
+    there is nothing to sync before reading the clock."""
+    if program_seconds is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        program_seconds[name] = (
+            program_seconds.get(name, 0.0) + time.perf_counter() - t0
+        )
+
+
 def run_contract_pass(
     golden_dir: str | pathlib.Path = GOLDEN_DIR,
     *,
     names: list[str] | None = None,
     update: bool = False,
     programs: list | None = None,
+    program_seconds: dict | None = None,
 ) -> list[Finding]:
     """Compile every registered entry point (``analysis.entrypoints``)
     and diff its collective contract against the goldens. With
@@ -73,7 +99,8 @@ def run_contract_pass(
     ``programs`` shares one ``build_entry_programs`` result across
     passes (their per-program caches hold the built state/step, so the
     jaxpr pass then reuses this pass's compiles instead of re-paying
-    them)."""
+    them). ``program_seconds`` accumulates per-program wall-clock for
+    ``shardcheck --timings``."""
     from learning_jax_sharding_tpu.analysis.entrypoints import (
         build_entry_programs,
     )
@@ -82,12 +109,14 @@ def run_contract_pass(
     findings: list[Finding] = []
     for prog in (programs if programs is not None
                  else build_entry_programs(names)):
-        observed = contract_of(prog.name, prog.hlo(), mesh=prog.mesh)
-        if update:
-            golden_dir.mkdir(parents=True, exist_ok=True)
-            (golden_dir / f"{prog.name}.json").write_text(observed.to_json())
-        else:
-            findings.extend(check_against_golden(golden_dir, observed))
+        with _program_timer(program_seconds, prog.name):
+            observed = contract_of(prog.name, prog.hlo(), mesh=prog.mesh)
+            if update:
+                golden_dir.mkdir(parents=True, exist_ok=True)
+                (golden_dir / f"{prog.name}.json").write_text(
+                    observed.to_json())
+            else:
+                findings.extend(check_against_golden(golden_dir, observed))
     return findings
 
 
@@ -96,6 +125,7 @@ def run_jaxpr_pass(
     names: list[str] | None = None,
     baseline: str | pathlib.Path | None = BASELINE_PATH,
     programs: list | None = None,
+    program_seconds: dict | None = None,
 ) -> list[Finding]:
     """Jaxpr + donation lint over the train-shaped entry points (serving
     programs manage buffers through the engine's slot pool, not
@@ -119,15 +149,16 @@ def run_jaxpr_pass(
     findings: list[Finding] = []
     for prog in (programs if programs is not None
                  else build_entry_programs(names)):
-        if prog.donation is not None:
-            findings.extend(prog.donation()["findings"])
-        if prog.jaxpr is not None:
-            used: dict[str, int] = {}
-            allowed = budgets.get(prog.name, {})
-            for f in prog.jaxpr():
-                used[f.rule] = used.get(f.rule, 0) + 1
-                if used[f.rule] > int(allowed.get(f.rule, 0)):
-                    findings.append(f)
+        with _program_timer(program_seconds, prog.name):
+            if prog.donation is not None:
+                findings.extend(prog.donation()["findings"])
+            if prog.jaxpr is not None:
+                used: dict[str, int] = {}
+                allowed = budgets.get(prog.name, {})
+                for f in prog.jaxpr():
+                    used[f.rule] = used.get(f.rule, 0) + 1
+                    if used[f.rule] > int(allowed.get(f.rule, 0)):
+                        findings.append(f)
     return findings
 
 
@@ -138,6 +169,7 @@ def run_shardflow_pass(
     programs: list | None = None,
     explain: bool = False,
     profile=None,
+    program_seconds: dict | None = None,
 ) -> tuple[list[Finding], list[dict]]:
     """The pre-compile pass: simulate GSPMD propagation over every entry
     point's jaxpr (:mod:`.shardflow`), reconcile the predicted collective
@@ -173,19 +205,77 @@ def run_shardflow_pass(
         path = golden_dir / f"{prog.name}.json"
         if not path.exists():
             continue
-        rep = prog.shardflow()
-        result = reconcile(rep, Contract.load(path))
-        findings.extend(reconcile_findings(result))
-        cost = costmodel.price(rep, profile)
-        entry = {
-            "name": prog.name,
-            "reconcile": result,
-            "cost": cost.to_dict(),
-            "top_events": costmodel.rank_events(rep, profile),
-        }
-        if explain:
-            entry["explanation"] = render_explanation(rep)
+        with _program_timer(program_seconds, prog.name):
+            rep = prog.shardflow()
+            result = reconcile(rep, Contract.load(path))
+            findings.extend(reconcile_findings(result))
+            cost = costmodel.price(rep, profile)
+            entry = {
+                "name": prog.name,
+                "reconcile": result,
+                "cost": cost.to_dict(),
+                "top_events": costmodel.rank_events(rep, profile),
+            }
+            if explain:
+                entry["explanation"] = render_explanation(rep)
         reports.append(entry)
+    return findings, reports
+
+
+def run_memflow_pass(
+    *,
+    names: list[str] | None = None,
+    baseline: str | pathlib.Path | None = BASELINE_PATH,
+    budget_bytes: float | None = None,
+    headroom: float = 0.8,
+    mesh=None,
+    program_seconds: dict | None = None,
+) -> tuple[list[Finding], list[dict]]:
+    """The memory face of the shardflow pass (``shardcheck --memory``):
+    for every searchable entry point, run :mod:`.memflow`'s jaxpr-level
+    liveness analysis (sharding- and donation-aware), reconcile the
+    predicted per-device peak against ``compiled.memory_analysis()``
+    under the per-entry tolerance pinned in the baseline file's
+    ``memflow_tolerance_pct`` section, and gate peaks that exceed
+    ``budget_bytes x headroom``. With ``budget_bytes=None`` the budget
+    defaults to :func:`utils.memory.device_hbm_bytes` — ``None`` on
+    emulated-CPU hosts, where only the reconciliation gates."""
+    import json
+
+    from learning_jax_sharding_tpu.analysis import memflow
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        SEARCHABLE_ENTRIES,
+    )
+    from learning_jax_sharding_tpu.utils.memory import device_hbm_bytes
+
+    tolerances: dict = {}
+    if baseline is not None:
+        p = pathlib.Path(baseline)
+        if p.exists() and p.read_text().strip():
+            tolerances = json.loads(p.read_text()).get(
+                "memflow_tolerance_pct", {})
+    if budget_bytes is None:
+        budget_bytes = device_hbm_bytes()
+    findings: list[Finding] = []
+    reports: list[dict] = []
+    for name in SEARCHABLE_ENTRIES:
+        if names is not None and name not in names:
+            continue
+        with _program_timer(program_seconds, name):
+            analysis = memflow.analyze_entry(name, mesh)
+            tol = tolerances.get(name)
+            findings.extend(memflow.memory_findings(
+                analysis,
+                budget_bytes=budget_bytes,
+                headroom=headroom,
+                tolerance_pct=float(tol) if tol is not None else None,
+            ))
+        reports.append({
+            "name": name,
+            "report": analysis["report"].to_dict(),
+            "reconciled": analysis["reconciled"],
+            "donated": analysis["donated"],
+        })
     return findings, reports
 
 
@@ -223,5 +313,6 @@ __all__ = [
     "run_ast_pass",
     "run_contract_pass",
     "run_jaxpr_pass",
+    "run_memflow_pass",
     "run_shardflow_pass",
 ]

@@ -12,9 +12,12 @@ step. This pass reads the ground truth off the executable:
 * **applied**   — the compiled module's ``input_output_alias`` header
   (what XLA actually aliased; a request with no matching output buffer,
   or on a backend without donation support, silently drops here);
-* **eligible**  — non-donated inputs whose (shape, dtype) matches an
-  output buffer not already claimed by an alias: a donation the caller
-  COULD have requested and didn't.
+* **eligible**  — non-donated inputs whose (shape, dtype, per-device
+  bytes) matches an output buffer not already claimed by an alias: a
+  donation the caller COULD have requested and didn't. Sizes are the
+  SHARDED per-device buffers when the compiled executable is at hand,
+  so a replicated input never claims a model-sharded output of the same
+  logical shape and the bytes-at-stake agree with memflow's accounting.
 
 Verdict rules: ``donation-not-applied`` (requested, dropped) and
 ``donation-missed`` (eligible, never requested). The train-step shaped
@@ -49,8 +52,33 @@ def aliased_params(compiled_text: str) -> set[int]:
     return set()
 
 
-def _leaf_key(info: Any) -> tuple:
-    return (tuple(info.shape), str(info.dtype))
+def _device_bytes(info: Any, sharding: Any = None) -> int:
+    """Per-device bytes of one buffer: the shard's shape when the
+    compiled sharding is known, the logical shape otherwise (identical on
+    an unsharded program, which is why the two keying modes agree there)."""
+    import numpy as np
+
+    shape = tuple(info.shape)
+    if sharding is not None:
+        try:
+            shape = tuple(sharding.shard_shape(tuple(info.shape)))
+        except (TypeError, ValueError, AttributeError):
+            pass  # keep the logical shape: a sharding we cannot query
+    try:
+        itemsize = np.dtype(info.dtype).itemsize
+    except TypeError:
+        itemsize = int(getattr(info.dtype, "itemsize", 4) or 4)
+    import math
+
+    return int(math.prod(shape) or 1) * itemsize
+
+
+def _leaf_key(info: Any, sharding: Any = None) -> tuple:
+    # Keyed on the PER-DEVICE buffer, not just (shape, dtype): a donation
+    # is only real if the shard XLA would reuse is the same size, and the
+    # bytes-at-stake a finding reports must agree with memflow's sharded
+    # accounting.
+    return (tuple(info.shape), str(info.dtype), _device_bytes(info, sharding))
 
 
 def donation_report(jitted: Any, *args, **kwargs) -> dict:
@@ -72,36 +100,66 @@ def donation_report(jitted: Any, *args, **kwargs) -> dict:
     if not isinstance(jitted, jax.stages.Wrapped):
         jitted = jax.jit(jitted)
     lowered = jitted.lower(*args, **kwargs)
-    return report_from_lowered(lowered, lowered.compile().as_text())
+    compiled = lowered.compile()
+    return report_from_lowered(lowered, compiled.as_text(),
+                               compiled=compiled)
 
 
-def report_from_lowered(lowered: Any, compiled_text: str) -> dict:
+def _flat_shardings(compiled: Any, n_in: int, n_out: int) -> tuple:
+    """Per-leaf input/output shardings off the compiled executable, or
+    ``(None, None)`` sides when the flat counts do not line up (then the
+    keying falls back to logical sizes for that side)."""
+    in_sh = out_sh = None
+    if compiled is not None:
+        try:
+            args_sh, kwargs_sh = compiled.input_shardings
+            flat = list(args_sh) + list(jax.tree.leaves(kwargs_sh))
+            if len(flat) == n_in:
+                in_sh = flat
+        except (AttributeError, TypeError, ValueError):
+            pass  # backend without sharding introspection
+        try:
+            flat = list(jax.tree.leaves(compiled.output_shardings))
+            if len(flat) == n_out:
+                out_sh = flat
+        except (AttributeError, TypeError, ValueError):
+            pass  # backend without sharding introspection
+    return in_sh, out_sh
+
+
+def report_from_lowered(lowered: Any, compiled_text: str, *,
+                        compiled: Any = None) -> dict:
     """:func:`donation_report` from an existing ``Lowered`` + compiled
-    HLO text (no extra compile)."""
+    HLO text (no extra compile). Pass ``compiled`` when available so
+    eligibility is matched on sharded per-device buffer sizes — a
+    replicated input does NOT claim a model-sharded output of the same
+    logical shape."""
     in_leaves = jax.tree.leaves(lowered.args_info)
     out_leaves = jax.tree.leaves(
         lowered.out_info,
         is_leaf=lambda x: hasattr(x, "shape") and hasattr(x, "dtype"),
     )
     aliases = aliased_params(compiled_text)
+    in_sh, out_sh = _flat_shardings(compiled, len(in_leaves),
+                                    len(out_leaves))
 
-    # Free output buffers by (shape, dtype): each applied alias consumes
-    # one matching output; what remains is what an un-donated input could
-    # still have claimed.
+    # Free output buffers by (shape, dtype, per-device bytes): each
+    # applied alias consumes one matching output; what remains is what an
+    # un-donated input could still have claimed.
     free_outputs: dict[tuple, int] = {}
-    for o in out_leaves:
-        k = _leaf_key(o)
+    for j, o in enumerate(out_leaves):
+        k = _leaf_key(o, out_sh[j] if out_sh else None)
         free_outputs[k] = free_outputs.get(k, 0) + 1
     for i, info in enumerate(in_leaves):
         if i in aliases:
-            k = _leaf_key(info)
+            k = _leaf_key(info, in_sh[i] if in_sh else None)
             if free_outputs.get(k, 0) > 0:
                 free_outputs[k] -= 1
 
     inputs: list[dict] = []
     findings: list[Finding] = []
     for i, info in enumerate(in_leaves):
-        k = _leaf_key(info)
+        k = _leaf_key(info, in_sh[i] if in_sh else None)
         donated = bool(getattr(info, "donated", False))
         if donated and i in aliases:
             verdict = "donated"
@@ -114,23 +172,27 @@ def report_from_lowered(lowered: Any, compiled_text: str) -> dict:
                 "matching output buffer (shape/dtype/sharding changed?) "
                 "or the backend dropped it; the input stays alive next "
                 "to the output",
-                data={"param": i, "shape": list(k[0]), "dtype": k[1]},
+                data={"param": i, "shape": list(k[0]), "dtype": k[1],
+                      "device_bytes": k[2]},
             ))
         elif free_outputs.get(k, 0) > 0:
             free_outputs[k] -= 1
             verdict = "eligible"
             findings.append(Finding(
                 "donation", "donation-missed", f"param{i}",
-                f"param {i} {k[1]}{list(k[0])} matches an un-aliased "
-                "output buffer but was never donated — donate it (e.g. "
-                "donate_argnums) to update in place instead of holding "
-                "both generations",
-                data={"param": i, "shape": list(k[0]), "dtype": k[1]},
+                f"param {i} {k[1]}{list(k[0])} "
+                f"({k[2] / 2**20:.2f} MiB/device) matches an un-aliased "
+                "output buffer of the same per-device size but was never "
+                "donated — donate it (e.g. donate_argnums) to update in "
+                "place instead of holding both generations",
+                data={"param": i, "shape": list(k[0]), "dtype": k[1],
+                      "device_bytes": k[2]},
             ))
         else:
             verdict = "ok"
         inputs.append({
             "param": i, "shape": list(k[0]), "dtype": k[1],
+            "device_bytes": k[2],
             "donated": donated, "aliased": i in aliases,
             "verdict": verdict,
         })

@@ -29,6 +29,14 @@ Tractability, per the round-17 design:
 * **explicit budget** — ``budget`` caps total candidate evaluations
   (jaxpr simulations), incumbent included; exhaustion is reported, not
   an error.
+* **HBM feasibility** — with ``hbm_budget_bytes`` set, every candidate's
+  per-device peak HBM is predicted first (:mod:`.memflow`'s liveness
+  walk over the same traced jaxpr) and candidates over
+  ``budget x headroom`` are REJECTED before pricing (counted in
+  ``SearchResult.oom_rejected``): the search returns the cheapest
+  layout that FITS, not the cheapest layout. When the incumbent itself
+  does not fit, the first fitting candidate seeds the best — a pricier
+  layout that runs beats a cheaper one that OOMs.
 * **deterministic tie-break** — candidates enumerate in a fixed order
   (sorted mesh axes x dim positions, groups by descending bytes then
   name) and only a STRICTLY cheaper candidate replaces the incumbent,
@@ -206,6 +214,14 @@ class SearchResult:
     report: ShardflowReport
     baseline_report: ShardflowReport
     contract: Contract
+    # HBM feasibility (populated only when search_layout ran with
+    # hbm_budget_bytes set; fits is None on an unconstrained search).
+    hbm_budget_bytes: float | None = None
+    hbm_headroom: float = 0.8
+    oom_rejected: int = 0
+    peak_bytes: int | None = None
+    baseline_peak_bytes: int | None = None
+    fits: bool | None = None
 
     @property
     def gap_pct(self) -> float:
@@ -235,8 +251,21 @@ class SearchResult:
         ]
 
     def to_dict(self) -> dict:
+        hbm = None
+        if self.hbm_budget_bytes:
+            hbm = {
+                "budget_bytes": float(self.hbm_budget_bytes),
+                "headroom": float(self.hbm_headroom),
+                "cap_bytes": float(self.hbm_budget_bytes)
+                * float(self.hbm_headroom),
+                "peak_bytes": self.peak_bytes,
+                "baseline_peak_bytes": self.baseline_peak_bytes,
+                "fits": self.fits,
+                "oom_rejected": self.oom_rejected,
+            }
         return {
             "name": self.name,
+            **({"hbm": hbm} if hbm else {}),
             "mesh_axes": self.mesh_axes,
             "mesh_shape": self.mesh_shape,
             "baseline_cost": self.baseline.to_dict(),
@@ -305,6 +334,9 @@ def search_layout(
     profile: costmodel.Profile | None = None,
     while_trip_hint: int | None = None,
     max_sweeps: int = 3,
+    hbm_budget_bytes: float | None = None,
+    hbm_headroom: float = 0.8,
+    donated: tuple = (),
     **kwargs,
 ) -> SearchResult:
     """Search the sharding layout of ``fn(*args)``'s argument leaves.
@@ -315,8 +347,17 @@ def search_layout(
     The function is traced to a jaxpr exactly once; every candidate is
     an abstract re-simulation — NO candidate is ever compiled. Returns
     the argmin :class:`SearchResult` (the incumbent itself when nothing
-    cheaper is found within ``budget`` evaluations)."""
+    cheaper is found within ``budget`` evaluations).
+
+    With ``hbm_budget_bytes`` (+ ``hbm_headroom``, ``donated`` flat-arg
+    indices), every candidate's per-device peak HBM is predicted via
+    :func:`~.memflow.simulate_memflow` BEFORE pricing and layouts over
+    the cap are rejected — the result is the cheapest layout that fits,
+    with ``SearchResult.fits=False`` only when no enumerated candidate
+    fits within the budget (then the incumbent is reported as-is)."""
     import jax
+
+    from learning_jax_sharding_tpu.analysis import memflow
 
     if profile is None:
         profile = costmodel.current_profile()
@@ -373,10 +414,28 @@ def search_layout(
             return rep, None
         return rep, costmodel.price(rep, profile)
 
+    cap = None
+    if hbm_budget_bytes:
+        cap = float(hbm_budget_bytes) * float(hbm_headroom)
+
+    def peak_of(specs):
+        return memflow.simulate_memflow(
+            name, closed, specs, mesh, donated=donated,
+            while_trip_hint=while_trip_hint,
+        ).peak_bytes
+
     current = list(base_specs)
     base_report, base_cost = evaluate(current)
-    evaluated, pruned = 1, 0
-    best_report, best_cost = base_report, base_cost
+    base_peak = peak_of(current) if cap is not None else None
+    base_fits = cap is None or base_peak <= cap
+    evaluated, pruned, oom_rejected = 1, 0, 0
+    current_peak = base_peak
+    if base_fits:
+        best_report, best_cost, best_peak = base_report, base_cost, base_peak
+    else:
+        # The incumbent OOMs: any fitting candidate beats it, whatever
+        # the price. best stays empty until one is found.
+        best_report, best_cost, best_peak = None, None, None
     exhausted = evaluated >= budget
     sweeps = 0
     improved = True
@@ -393,8 +452,27 @@ def search_layout(
                     break
                 trial = list(current)
                 trial[d.index] = Spec(cand)
+                peak = None
+                if cap is not None:
+                    peak = peak_of(trial)
+                    if peak > cap:
+                        evaluated += 1
+                        oom_rejected += 1
+                        if best_cost is None and peak < current_peak:
+                            # Nothing fits yet: descend on predicted
+                            # peak, so a feasible region two sharding
+                            # moves away (e.g. BOTH optimizer moments
+                            # replicated) stays reachable by
+                            # single-coordinate steps.
+                            current = trial
+                            current_peak = peak
+                            cur_dims = cand
+                            improved = True
+                        continue
                 rep, cost = evaluate(
-                    trial, abort_above=best_cost.predicted_s
+                    trial,
+                    abort_above=(best_cost.predicted_s
+                                 if best_cost is not None else None),
                 )
                 evaluated += 1
                 if cost is None:   # dominance prune cut it mid-pricing
@@ -403,13 +481,25 @@ def search_layout(
                 # Strict < : equal-cost candidates lose to the earlier
                 # enumerated layout (the incumbent on a full tie) — the
                 # deterministic tie-break.
-                if cost.predicted_s < best_cost.predicted_s:
+                if (best_cost is None
+                        or cost.predicted_s < best_cost.predicted_s):
                     current = trial
-                    best_report, best_cost = rep, cost
+                    current_peak = peak
+                    best_report, best_cost, best_peak = rep, cost, peak
                     cur_dims = cand
                     improved = True
             if exhausted:
                 break
+
+    fits = None
+    if cap is not None:
+        fits = best_cost is not None
+        if best_cost is None:
+            # Nothing enumerable fits within the eval budget — report
+            # the incumbent, flagged, rather than inventing a layout.
+            best_report, best_cost, best_peak = (
+                base_report, base_cost, base_peak
+            )
 
     assignment = {
         d.path: current[d.index].dims
@@ -435,6 +525,12 @@ def search_layout(
         report=best_report,
         baseline_report=base_report,
         contract=contract_from_report(best_report),
+        hbm_budget_bytes=hbm_budget_bytes,
+        hbm_headroom=hbm_headroom,
+        oom_rejected=oom_rejected,
+        peak_bytes=None if best_peak is None else int(best_peak),
+        baseline_peak_bytes=None if base_peak is None else int(base_peak),
+        fits=fits,
     )
 
 
@@ -484,6 +580,9 @@ def search_entry(
     *,
     budget: int = 96,
     profile: costmodel.Profile | None = None,
+    hbm_budget_bytes: float | None = None,
+    hbm_headroom: float = 0.8,
+    donated: tuple = (),
 ) -> SearchResult:
     """Run the layout search for one searchable entry point
     (``entrypoints.SEARCHABLE_ENTRIES``), built by the SAME builders the
@@ -507,5 +606,7 @@ def search_entry(
         return search_layout(
             t["name"], t["fn"], *t["args"], mesh=t["mesh"], vary=vary,
             budget=budget, profile=profile,
-            while_trip_hint=t["while_trip_hint"], **t["kwargs"],
+            while_trip_hint=t["while_trip_hint"],
+            hbm_budget_bytes=hbm_budget_bytes, hbm_headroom=hbm_headroom,
+            donated=donated, **t["kwargs"],
         )

@@ -43,6 +43,16 @@ structure matters:
   (``telemetry/ledger.py``) — the static face of the accounting
   identity tier-1 gates at runtime. New engine code paths must open (or
   sit inside) a bucket frame.
+* ``unbounded-host-buffer`` — a ``.append(...)`` of a device-array
+  value (a ``jnp.``/``jax.device_put``/``jax.random.`` result, direct
+  or via a local name) onto a container inside a loop body of an
+  ``*Engine`` class, where the container is never evicted in the same
+  function (no ``pop``/``popleft``/``popitem``/``clear``, no ``del
+  c[...]``, never rebound): the host-side analogue of a KV leak — each
+  retained element pins its device buffer, so the engine's resident
+  set grows with requests served until the allocator fails far from
+  the append that caused it. Cap the container (deque/maxlen), evict
+  on a schedule, or read the value back to host before retaining it.
 * ``swallowed-exception`` — a bare ``except:`` that does not re-raise,
   or an ``except Exception/BaseException:`` whose body is only
   ``pass``/``...``: the failure vanishes without a record — in a
@@ -339,6 +349,15 @@ class _Visitor(ast.NodeVisitor):
         if jit_decos:
             self._check_static_defaults(node, jit_decos)
             self._check_captures(node)
+        # unbounded-host-buffer runs per DIRECT Engine method (one walk
+        # covers its nested closures; the func_depth guard stops nested
+        # defs from re-reporting).
+        if (
+            self.func_depth == 0
+            and self.class_stack
+            and _HOT_CLASS_RE.search(self.class_stack[-1])
+        ):
+            self._check_unbounded_buffers(node)
         # A DIRECT method of an *Engine class whose name marks it a
         # ledger-covered phase; nested closures inherit the flag (their
         # bodies run inside the phase), unrelated nested defs don't
@@ -383,6 +402,67 @@ class _Visitor(ast.NodeVisitor):
                     "list/dict default raises `unhashable type` on "
                     "first use (use a tuple/frozen value)",
                 ))
+
+    # --- unbounded host buffers: the host-side KV leak ------------------
+    _EVICTORS = ("pop", "popleft", "popitem", "clear")
+
+    def _check_unbounded_buffers(self, fn):
+        """unbounded-host-buffer over one Engine method: device-valued
+        ``.append`` in a loop onto a container with no eviction (and no
+        rebinding — ``self._log = self._log[-n:]`` is a trim) anywhere
+        in the function."""
+        dev_local: set[str] = set()
+        evicted: set[str] = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                if isinstance(n.value, ast.Call) and _DEVICE_MAKERS.search(
+                    _dotted(n.value.func)
+                ):
+                    for t in n.targets:
+                        dev_local.update(_flat_targets(t))
+                for t in n.targets:
+                    if isinstance(t, (ast.Name, ast.Attribute)):
+                        evicted.add(_dotted(t))
+            elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ) and n.func.attr in self._EVICTORS:
+                evicted.add(_dotted(n.func.value))
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        evicted.add(_dotted(t.value))
+        self._walk_appends(fn, 0, dev_local, evicted)
+
+    def _walk_appends(self, node, depth, dev_local, evicted):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            depth += 1
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "append"
+            and depth > 0
+            and node.args
+        ):
+            arg = node.args[0]
+            is_dev = (
+                isinstance(arg, ast.Call)
+                and bool(_DEVICE_MAKERS.search(_dotted(arg.func)))
+            ) or (isinstance(arg, ast.Name) and arg.id in dev_local)
+            container = _dotted(node.func.value)
+            if is_dev and container and container not in evicted:
+                self.findings.append(Finding(
+                    "ast", "unbounded-host-buffer",
+                    f"{self.path}:{node.lineno}",
+                    f"`{container}.append(...)` retains a device array "
+                    "per loop iteration in an engine with no eviction "
+                    "of the container in scope — the host-side KV leak: "
+                    "each element pins its device buffer and the "
+                    "resident set grows with requests served; cap the "
+                    "container, evict on a schedule, or move the value "
+                    "to host first",
+                ))
+        for child in ast.iter_child_nodes(node):
+            self._walk_appends(child, depth, dev_local, evicted)
 
     # --- swallowed exceptions: failures that leave no trace -------------
     def visit_ExceptHandler(self, node: ast.ExceptHandler):

@@ -149,6 +149,17 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      False),
     (re.compile(r"layout err ([\d,.]+)%"),
      "layout_predicted_vs_measured_pct", False),
+    # Round-18 memflow gates (bench.py's `[bench] memflow ...` lines):
+    # `memflow err` is the static liveness analyzer's per-entry
+    # predicted-vs-measured peak-HBM error against XLA's
+    # ``compiled.memory_analysis()`` — phrased distinctly from `model
+    # err` (shardflow time) and `layout err` (layout search) so the
+    # three analyzer gates never double-match one line. Lower is
+    # better: the error growing means the liveness model (donation
+    # credits, scan high-water, sharded buffer sizing) drifted from
+    # what XLA actually allocates, which is the OOM-gate's accuracy.
+    (re.compile(r"memflow err ([\d,.]+)%"),
+     "memflow_predicted_vs_measured_pct", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

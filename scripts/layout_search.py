@@ -19,6 +19,14 @@ Usage::
     python scripts/layout_search.py --entry zero1_update --json
     python scripts/layout_search.py --entry mixed_step \
         --emit-contract /tmp/mixed_step.search.json
+    python scripts/layout_search.py --entry train_step \
+        --hbm-budget-bytes 16e9 --headroom 0.8   # cheapest layout that FITS
+
+With ``--hbm-budget-bytes`` the search prices only candidates whose
+memflow peak (``analysis/memflow.py``, per-device, donation-aware) fits
+under ``budget x headroom`` — "cheapest comms that fits" instead of
+"cheapest comms, hope it fits"; over-cap candidates are rejected before
+pricing and counted as ``oom_rejected``.
 
 Determinism: same entry + mesh + budget => byte-identical chosen layout
 and contract (pricing uses the seeded "TPU v5 lite" table profile by
@@ -230,6 +238,14 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--profile", default="TPU v5 lite",
                     help='pricing profile: a table kind (default '
                     '"TPU v5 lite") or "live" for the attached backend')
+    ap.add_argument("--hbm-budget-bytes", type=float, default=None,
+                    metavar="BYTES",
+                    help="per-device HBM budget; candidates whose memflow "
+                    "peak exceeds BYTES x headroom are rejected before "
+                    "pricing (default: no memory gate)")
+    ap.add_argument("--headroom", type=float, default=0.8,
+                    help="usable fraction of --hbm-budget-bytes "
+                    "(default 0.8 — fragmentation + runtime reserve)")
     ap.add_argument("--json", action="store_true", help="machine output")
     ap.add_argument("--emit-contract", default=None, metavar="PATH",
                     help="also write the argmin layout's contract JSON "
@@ -270,7 +286,10 @@ def main(argv: list[str] | None = None) -> int:
     # device work (abstract simulation only), so there is nothing to
     # synchronize before reading the clock.
     t0 = time.perf_counter()
-    res = search_entry(args.entry, mesh, budget=args.budget, profile=profile)
+    res = search_entry(
+        args.entry, mesh, budget=args.budget, profile=profile,
+        hbm_budget_bytes=args.hbm_budget_bytes, hbm_headroom=args.headroom,
+    )
     wall = time.perf_counter() - t0
 
     if args.emit_contract:
@@ -293,6 +312,17 @@ def main(argv: list[str] | None = None) -> int:
           f"({res.baseline.bound}-bound)")
     print(f"   searched argmin:      {res.best.predicted_s * 1e3:.3f} ms "
           f"({res.best.bound}-bound)  gap {res.gap_pct:.1f}%")
+    if res.hbm_budget_bytes:
+        cap = res.hbm_budget_bytes * res.hbm_headroom
+        peaks = " -> ".join(
+            f"{p / 2**20:.2f} MiB"
+            for p in (res.baseline_peak_bytes, res.peak_bytes)
+            if p is not None
+        )
+        print(f"   hbm gate: cap {cap / 2**30:.2f} GiB/device "
+              f"(budget x {res.hbm_headroom:g} headroom), peak {peaks} — "
+              f"{'fits' if res.fits else 'NO FITTING LAYOUT in budget'} "
+              f"({res.oom_rejected} candidates rejected over cap)")
     if res.changed:
         print("   changed leaves:")
         for line in res.changed_lines():

@@ -28,6 +28,18 @@ emulated mesh, the AST pass only reads source):
   ``unexplained-collective`` finding; ``--explain`` renders the
   per-source-line "why this collective exists" report.
 
+``--memory`` adds the memflow pass (``analysis/memflow.py``): a
+jaxpr-level liveness walk predicts per-device peak HBM per searchable
+entry point (sharding-, donation- and scan-aware), reconciles it
+against ``compiled.memory_analysis()`` under the tolerances pinned in
+``analysis/baseline.json`` (``memflow_tolerance_pct``), and GATES
+peaks over ``--memory-budget-bytes x --headroom`` — OOM as a
+pre-compile review finding at the peak-owning buffer's source line.
+
+``--timings`` prints the per-program-family wall-clock breakdown
+(train / zero1 / serving / engine / kv / reshard / ops), so the next
+budget creep is attributable to a family instead of re-justified blind.
+
 ``--optimize`` adds the ADVISORY layout-search pass
 (``analysis/layout_search.py``): for each train-shaped entry point it
 searches the sharding space abstractly (no compiles) and reports when a
@@ -75,12 +87,45 @@ from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E
 
 PASSES = ("contracts", "jaxpr", "ast", "shardflow")
 
+#: Opt-in passes selectable with --pass but not part of the default
+#: (budgeted) full run.
+EXTRA_PASSES = ("memory",)
+
+
+def _family(name: str) -> str:
+    """Program family for the --timings breakdown. spec_/adapter_
+    variants time with their base program — they are the same family's
+    compile cost, scaled."""
+    base = name
+    while True:
+        for pre in ("spec_", "adapter_"):
+            if base.startswith(pre):
+                base = base[len(pre):]
+                break
+        else:
+            break
+    if base.startswith("train_step"):
+        return "train"
+    if base.startswith("zero1"):
+        return "zero1"
+    if base in ("first_prefill", "prefill", "decode_step"):
+        return "serving"
+    if base.endswith(("mixed_step", "multi_step")):
+        return "engine"
+    if base.startswith("kv_"):
+        return "kv"
+    if base.startswith("swap_"):
+        return "reshard"
+    return "ops"
+
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
-        "--pass", dest="passes", action="append", choices=PASSES,
-        help="run only this pass (repeatable; default: all three)",
+        "--pass", dest="passes", action="append",
+        choices=PASSES + EXTRA_PASSES,
+        help="run only this pass (repeatable; default: all four — "
+        "'memory' only via --memory or an explicit --pass memory)",
     )
     ap.add_argument(
         "--update-golden", action="store_true",
@@ -110,6 +155,28 @@ def main(argv: list[str] | None = None) -> int:
         "it is itself a gated finding (0 disables)",
     )
     ap.add_argument(
+        "--memory", action="store_true",
+        help="also run the memflow pass: per-entry-point predicted "
+        "per-device peak HBM, reconciled against "
+        "compiled.memory_analysis() and gated against the HBM budget",
+    )
+    ap.add_argument(
+        "--memory-budget-bytes", type=float, default=None,
+        help="per-device HBM budget for the memflow pass (default: "
+        "utils.memory.device_hbm_bytes(), which is None on emulated-CPU "
+        "hosts — then only the reconciliation gates)",
+    )
+    ap.add_argument(
+        "--headroom", type=float, default=0.8,
+        help="fraction of the HBM budget a predicted peak may use "
+        "before the memflow pass fails it (default 0.8)",
+    )
+    ap.add_argument(
+        "--timings", action="store_true",
+        help="print the per-program-family wall-clock breakdown and "
+        "include program/family seconds in the JSON doc",
+    )
+    ap.add_argument(
         "--optimize", action="store_true",
         help="also run the layout search (analysis/layout_search.py) "
         "over the train-shaped entry points and REPORT when it finds a "
@@ -132,8 +199,10 @@ def main(argv: list[str] | None = None) -> int:
     passes = tuple(dict.fromkeys(args.passes)) if args.passes else PASSES
     if args.explain and "shardflow" not in passes:
         passes = passes + ("shardflow",)
+    if args.memory and "memory" not in passes:
+        passes = passes + ("memory",)
     needs_mesh = args.update_golden or args.optimize or (
-        {"contracts", "jaxpr", "shardflow"} & set(passes)
+        {"contracts", "jaxpr", "shardflow", "memory"} & set(passes)
     )
     if needs_mesh:
         try:
@@ -149,6 +218,7 @@ def main(argv: list[str] | None = None) -> int:
         run_ast_pass,
         run_contract_pass,
         run_jaxpr_pass,
+        run_memflow_pass,
         run_shardflow_pass,
     )
     from learning_jax_sharding_tpu.analysis.findings import Finding
@@ -193,23 +263,38 @@ def main(argv: list[str] | None = None) -> int:
     t0 = time.perf_counter()
     findings = []
     timings: dict[str, float] = {}
+    # Per-program wall-clock across all passes, for the --timings
+    # family breakdown (always collected — two clock reads per program).
+    program_seconds: dict[str, float] = {}
     shardflow_reports: list[dict] = []
+    memory_reports: list[dict] = []
     for name in passes:
         tp = time.perf_counter()
         if name == "contracts":
             findings += run_contract_pass(
-                golden_dir, names=args.only, programs=programs
+                golden_dir, names=args.only, programs=programs,
+                program_seconds=program_seconds,
             )
         elif name == "jaxpr":
             findings += run_jaxpr_pass(
-                names=args.only, baseline=baseline, programs=programs
+                names=args.only, baseline=baseline, programs=programs,
+                program_seconds=program_seconds,
             )
         elif name == "shardflow":
             sf_findings, shardflow_reports = run_shardflow_pass(
                 golden_dir, names=args.only, programs=programs,
                 explain=args.explain,
+                program_seconds=program_seconds,
             )
             findings += sf_findings
+        elif name == "memory":
+            mf_findings, memory_reports = run_memflow_pass(
+                names=args.only, baseline=baseline,
+                budget_bytes=args.memory_budget_bytes,
+                headroom=args.headroom,
+                program_seconds=program_seconds,
+            )
+            findings += mf_findings
         else:
             findings += run_ast_pass(_REPO, baseline=baseline)
         timings[name] = time.perf_counter() - tp
@@ -280,8 +365,21 @@ def main(argv: list[str] | None = None) -> int:
     }
     if shardflow_reports:
         doc["shardflow"] = shardflow_reports
+    if memory_reports:
+        doc["memory"] = memory_reports
     if args.optimize:
         doc["optimize"] = advisories
+    family_seconds: dict[str, float] = {}
+    for pname, secs in program_seconds.items():
+        fam = _family(pname)
+        family_seconds[fam] = family_seconds.get(fam, 0.0) + secs
+    if args.timings:
+        doc["program_seconds"] = {
+            k: round(v, 2) for k, v in program_seconds.items()
+        }
+        doc["family_seconds"] = {
+            k: round(v, 2) for k, v in family_seconds.items()
+        }
     import os
 
     if os.environ.get("LJST_ARTIFACT_DIR"):
@@ -307,6 +405,25 @@ def main(argv: list[str] | None = None) -> int:
                 text = rep.get("explanation")
                 if text:
                     print(text)
+        for rep in memory_reports:
+            r = rep["report"]
+            rc = rep["reconciled"]
+            line = (f"[memory] {rep['name']}: predicted peak "
+                    f"{r['peak_mib']:.2f} MiB/device at {r['peak_where']}")
+            if rc.get("measured_bytes") is not None:
+                line += (f" — XLA measures "
+                         f"{rc['measured_bytes'] / 2**20:.2f} MiB "
+                         f"({rc['signed_err_pct']:+.1f}%)")
+            print(line)
+        if args.timings:
+            attributed = sum(family_seconds.values())
+            print(f"[timings] {attributed:.1f}s of {wall:.1f}s wall "
+                  "attributed to entry programs; per family:")
+            for fam, secs in sorted(family_seconds.items(),
+                                    key=lambda kv: -kv[1]):
+                n = sum(1 for p in program_seconds if _family(p) == fam)
+                print(f"[timings]   {fam:<8} {secs:6.1f}s "
+                      f"across {n} program(s)")
         for adv in advisories:
             print(f"[advisory] layout-search: {adv['entry']} has a "
                   f"layout priced {adv['gap_pct']:.1f}% cheaper "

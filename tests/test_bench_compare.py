@@ -337,6 +337,42 @@ class TestExtraction:
         assert by["layout_search_train_step_measured"
                   ":layout_predicted_vs_measured_pct"]["regressed"]
 
+    def test_memflow_gates_direction_aware(self):
+        """The round-18 memflow gates: the static liveness analyzer's
+        predicted-vs-measured peak-HBM error per searchable entry (and
+        the summary's worst-of line) regresses UP — the error growing
+        means the donation/scan/sharding model drifted from what XLA
+        allocates, which bounds the OOM gate's accuracy. `memflow err`
+        must not ride shardflow's `model err` or the search's `layout
+        err` patterns."""
+        lines = [
+            "[bench] memflow train_step: predicted peak 101.6 "
+            "MiB/device at train_step:dot_general pipeline.py:88, "
+            "XLA measures 54.9 MiB, memflow err 85.2%",
+            "[bench] memflow summary: worst of 4 entries, "
+            "memflow err 85.2%",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        assert m["memflow_train_step"
+                 ":memflow_predicted_vs_measured_pct"] == (85.2, False)
+        assert m["memflow_summary"
+                 ":memflow_predicted_vs_measured_pct"] == (85.2, False)
+        assert not any(
+            k.endswith(":predicted_vs_measured_pct")
+            or k.endswith(":layout_predicted_vs_measured_pct")
+            for k in m
+        )
+        worse = _doc([
+            lines[0].replace("memflow err 85.2%", "memflow err 120.0%"),
+            lines[1],
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["memflow_train_step"
+                  ":memflow_predicted_vs_measured_pct"]["regressed"]
+        assert not by["memflow_summary"
+                      ":memflow_predicted_vs_measured_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

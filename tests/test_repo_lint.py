@@ -61,6 +61,31 @@ def test_baseline_has_no_dead_budget():
     )
 
 
+def test_unbounded_host_buffer_rule_is_live():
+    """The round-18 rule fires on its target pattern. The repo-wide
+    clean gate above passes VACUOUSLY if a rule is dropped from the
+    visitor — this pins that ``unbounded-host-buffer`` is actually
+    wired in (it has zero live repo hits, so no baseline entry keeps
+    it honest the way the suppressions audit does for the others)."""
+    import textwrap
+
+    from learning_jax_sharding_tpu.analysis.source_lint import lint_source
+
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+
+        class ContinuousEngine:
+            def _admit(self, req):
+                for tok in req.tokens:
+                    self._trace.append(jnp.asarray(tok))
+        """
+    )
+    assert [f.rule for f in lint_source("demo.py", src)] == [
+        "unbounded-host-buffer"
+    ]
+
+
 def test_jaxpr_budgets_reference_live_entry_points_and_rules():
     """The symmetric audit for the OTHER budget section (round 13):
     ``jaxpr_budgets`` keys on (entry-point name → rule → count), and a

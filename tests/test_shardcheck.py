@@ -651,6 +651,73 @@ class TestSourceLint:
         """
         assert self._rules(src) == []
 
+    def test_unbounded_host_buffer_direct_device_append_flags(self):
+        # The host-side KV leak: one device array retained per loop
+        # iteration, container never evicted anywhere in the method.
+        src = """
+        import jax.numpy as jnp
+
+        class ContinuousEngine:
+            def _admit(self, req):
+                for tok in req.tokens:
+                    self._trace.append(jnp.asarray(tok))
+        """
+        assert self._rules(src) == ["unbounded-host-buffer"]
+
+    def test_unbounded_host_buffer_via_local_name_flags(self):
+        # The device value travels through a local binding — the rule
+        # tracks names assigned from jnp./jax.random. makers.
+        src = """
+        import jax.numpy as jnp
+
+        class SpecEngine:
+            def step(self):
+                while self.has_work():
+                    logits = jnp.zeros((8, 1024))
+                    self._history.append(logits)
+        """
+        assert self._rules(src) == ["unbounded-host-buffer"]
+
+    def test_unbounded_host_buffer_evicted_container_clean(self):
+        # Any eviction of the SAME container in scope bounds it: a
+        # pop on a schedule, a del, or a rebinding trim.
+        src = """
+        import jax.numpy as jnp
+
+        class ContinuousEngine:
+            def step(self):
+                for tok in self.stream:
+                    self._trace.append(jnp.asarray(tok))
+                    if len(self._trace) > 64:
+                        self._trace.pop(0)
+
+            def _admit(self, req):
+                for tok in req.tokens:
+                    self._window.append(jnp.asarray(tok))
+                self._window = self._window[-64:]
+        """
+        assert self._rules(src) == []
+
+    def test_unbounded_host_buffer_host_value_or_cold_path_clean(self):
+        # Appending a host value retains no device buffer; appends
+        # outside a loop or outside an *Engine class are one-shot /
+        # not the serving hot path.
+        src = """
+        import jax.numpy as jnp
+
+        class ContinuousEngine:
+            def step(self):
+                for tok in self.stream:
+                    self._ids.append(tok)
+                self._snapshot.append(jnp.zeros((4,)))
+
+        class TraceRecorder:
+            def record(self):
+                for tok in self.stream:
+                    self._trace.append(jnp.asarray(tok))
+        """
+        assert self._rules(src) == []
+
     def test_baseline_budget(self):
         fs = [
             Finding("ast", "raw-clock", "a.py:10", "m"),
