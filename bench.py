@@ -612,6 +612,63 @@ def bench_memflow():
     return {"entries": entries, "worst_err_pct": worst} if entries else None
 
 
+def bench_commscope():
+    """Comm observatory (round 19): the measured per-axis α–β link
+    profiles from the commscope calibration ladder plus the realized
+    comm/compute overlap attribution of one saturated serving window
+    (``telemetry/commscope.py`` + the goodput ledger's per-family
+    device split).
+
+    Like ``bench_fleet``, the ladder needs device multiplicity, so it
+    runs on the emulated 8-device mesh in a subprocess
+    (``scripts/perf_commscope.py --bench-lines``) whose ``[bench]``
+    lines are relayed verbatim. ``scripts/bench_compare.py`` gates
+    them direction-aware: ``axis bandwidth`` (higher), ``comm fit
+    err`` / ``exposed comm`` / ``comm prediction err`` (lower). The
+    ``overlap ratio`` is printed but NOT gated — overlapping more or
+    less comm is a scheduling outcome, not monotonic goodness."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent / "scripts"
+        / "perf_commscope.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--json"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-5:])
+        raise RuntimeError(
+            f"perf_commscope exited {proc.returncode}: {tail}"
+        )
+    res = json.loads(proc.stdout)
+    for axis, ap in sorted(res["profile"].items()):
+        _log(
+            f"[bench] commscope axis {axis} (8-dev emulated): "
+            f"axis bandwidth {ap['beta_gb_s']:.3f} GB/s, "
+            f"alpha {ap['alpha_us']:.1f} us, "
+            f"comm fit err {ap['fit_err_pct']:.1f}%"
+        )
+    ratio = res.get("overlap_ratio")
+    _log(
+        f"[bench] commscope overlap (8-dev emulated): "
+        f"exposed comm {res['exposed_share_pct']:.2f}% of device, "
+        f"overlap ratio "
+        f"{(ratio or 0.0) * 100.0:.1f}%, "
+        f"comm prediction err {res['model_err_pct']:.1f}%"
+    )
+    return {
+        "profile": res["profile"],
+        "exposed_share_pct": res["exposed_share_pct"],
+        "overlap_ratio": ratio,
+        "model_err_pct": res["model_err_pct"],
+    }
+
+
 def bench_moe_125m():
     """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
     capacity routing, fp32 router — models/moe.py), same harness as the
@@ -1334,6 +1391,11 @@ def main():
     except Exception as e:
         _log(f"[bench] memflow bench skipped: {type(e).__name__}: {e}")
         memflow_block = None
+    try:
+        commscope_block = bench_commscope()
+    except Exception as e:
+        _log(f"[bench] commscope bench skipped: {type(e).__name__}: {e}")
+        commscope_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1389,6 +1451,13 @@ def main():
         # bench_compare's `memflow err` pattern) — the accuracy bound
         # on the layout search's HBM budget gate.
         "memflow": memflow_block,
+        # Round-19 comm observatory: measured per-axis α–β link
+        # profiles (commscope calibration ladder) and the serving
+        # window's realized comm/compute overlap decomposition
+        # (telemetry/commscope.py; gated by bench_compare's
+        # `axis bandwidth` / `comm fit err` / `exposed comm` /
+        # `comm prediction err` patterns).
+        "commscope": commscope_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

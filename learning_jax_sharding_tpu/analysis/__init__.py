@@ -25,6 +25,12 @@ Three levels, one finding type, one CLI (``scripts/shardcheck.py``):
    donation- and scan/remat-aware), reconciled against
    ``compiled.memory_analysis()`` under baseline-pinned tolerances and
    gated against the device HBM budget (``shardcheck --memory``).
+6. **comm** (:mod:`..telemetry.commscope`) — the measured face of
+   level 4: run the commscope calibration ladder on the live mesh, fit
+   per-axis α–β link profiles, gate the fit's reconciliation error
+   against the baseline's ``commscope_tolerance_pct``, and re-price
+   every entry point's predicted collectives with the MEASURED profile
+   next to the pinned-table prediction (``shardcheck --comm``).
 
 Static verdicts land in the PR-2 flight recorder / registry
 (:func:`~.findings.report_findings`), so a post-mortem bundle shows what
@@ -279,6 +285,108 @@ def run_memflow_pass(
     return findings, reports
 
 
+def run_comm_pass(
+    *,
+    names: list[str] | None = None,
+    baseline: str | pathlib.Path | None = BASELINE_PATH,
+    mesh=None,
+    programs: list | None = None,
+    profile=None,
+    ops: tuple[str, ...] = ("psum", "all_gather", "ppermute"),
+    sizes_bytes: tuple[int, ...] = (1 << 16, 1 << 19, 1 << 22),
+    program_seconds: dict | None = None,
+) -> tuple[list[Finding], dict]:
+    """The measured face of the shardflow pass (``shardcheck --comm``):
+    run the commscope calibration ladder (a REDUCED sweep — three ops,
+    three sizes — sized for CI) on the entry points' mesh, fit per-axis
+    α–β link profiles, gate the fit's worst per-axis reconciliation
+    error against the ceilings pinned in the baseline file's
+    ``commscope_tolerance_pct`` section, and re-price every entry
+    point's predicted collective multiset with the measured profile —
+    the per-line pinned-prediction vs measured-profile table.
+
+    Returns ``(findings, report)`` where ``report`` is JSON-plain:
+    ``{"profile": <CommProfile dict>, "fit_errors_pct": {axis: pct},
+    "programs": [{"name", "pinned_s", "measured_s", "lines": [...]}]}``.
+    Opt-in only (not part of the budgeted full run): the ladder times
+    real dispatches, so it costs wall-clock the static passes don't.
+    """
+    import json
+
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.entrypoints import (
+        build_entry_programs,
+    )
+    from learning_jax_sharding_tpu.telemetry import commscope
+
+    tolerances: dict = {}
+    if baseline is not None:
+        p = pathlib.Path(baseline)
+        if p.exists() and p.read_text().strip():
+            tolerances = json.loads(p.read_text()).get(
+                "commscope_tolerance_pct", {})
+    progs = (programs if programs is not None
+             else build_entry_programs(names))
+    if mesh is None:
+        if not progs:
+            raise ValueError("run_comm_pass needs a mesh or ≥1 program")
+        mesh = progs[0].mesh
+
+    findings: list[Finding] = []
+    with _program_timer(program_seconds, "commscope_ladder"):
+        comm_profile = commscope.calibrate_mesh(
+            mesh, ops=ops, sizes_bytes=sizes_bytes,
+        )
+    errs = commscope.fit_errors(comm_profile.axes,
+                                comm_profile.measurements)
+    default_tol = tolerances.get("_default")
+    for axis, err in sorted(errs.items()):
+        tol = tolerances.get(axis, default_tol)
+        if tol is not None and err > float(tol):
+            findings.append(Finding(
+                "comm", "commscope-fit-tolerance", f"mesh axis {axis!r}",
+                f"α–β fit misses its own ladder measurements by "
+                f"{err:.1f}% (worst cell), over the {float(tol):.1f}% "
+                "ceiling pinned in baseline.json — the link is not "
+                "α–β-linear here (noisy host, cache cliff, or the sweep "
+                "sizes need rebalancing); re-run scripts/commscope.py "
+                "and re-justify the tolerance",
+                data={"axis": axis, "err_pct": round(err, 2),
+                      "tolerance_pct": float(tol)},
+            ))
+
+    base = profile if profile is not None else costmodel.current_profile()
+    calibrated = costmodel.calibrate_axis_profiles(comm_profile, base=base)
+    prog_rows: list[dict] = []
+    for prog in progs:
+        if prog.shardflow is None:
+            continue
+        with _program_timer(program_seconds, prog.name):
+            rep = prog.shardflow()
+            pinned = commscope.line_comm_predictions(rep, base)
+            measured = commscope.line_comm_predictions(rep, calibrated)
+        lines = [
+            {
+                "where": w,
+                "pinned_s": pinned[w],
+                "measured_s": measured.get(w, 0.0),
+            }
+            for w in sorted(pinned, key=lambda w: -pinned[w])
+        ]
+        prog_rows.append({
+            "name": prog.name,
+            "pinned_s": sum(pinned.values()),
+            "measured_s": sum(measured.values()),
+            "lines": lines,
+        })
+    report = {
+        "profile": comm_profile.to_dict(),
+        "fit_errors_pct": {a: round(e, 2) for a, e in sorted(errs.items())},
+        "programs": prog_rows,
+    }
+    return findings, report
+
+
 def run_ast_pass(
     root: str | pathlib.Path,
     *,
@@ -311,6 +419,7 @@ __all__ = [
     "missed_donation_bytes",
     "report_findings",
     "run_ast_pass",
+    "run_comm_pass",
     "run_contract_pass",
     "run_jaxpr_pass",
     "run_memflow_pass",

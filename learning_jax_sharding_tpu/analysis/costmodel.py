@@ -65,6 +65,11 @@ class Profile:
     #: the memory term anyway, but CPU thin matmuls run ~7× below the
     #: square-matmul rate and need their own bucket.
     thin_flops: float | None = None
+    #: Measured per-axis α–β link models from the commscope calibration
+    #: ladder: ``((axis, alpha_s, beta_bytes_per_s), ...)``. None → every
+    #: collective prices on the flat ``link_bw`` (the pinned-table
+    #: fallback). Attach via :func:`calibrate_axis_profiles`.
+    axis_profiles: tuple[tuple[str, float, float], ...] | None = None
     source: str = "table"
 
     def to_dict(self) -> dict:
@@ -251,17 +256,82 @@ def _ring_factor(op: str, n: int) -> float:
     return 1.0
 
 
+def _axis_alpha_beta(
+    profile: Profile, axes: tuple[str, ...]
+) -> tuple[float, float] | None:
+    """Combined (α, β) when EVERY event axis has a measured profile:
+    latencies add across axes (sequential phases), bandwidth is the
+    slowest link. None when any axis is uncalibrated — the event then
+    falls back to the flat ``link_bw`` table path."""
+    if not profile.axis_profiles or not axes:
+        return None
+    table = {a: (al, be) for a, al, be in profile.axis_profiles}
+    alpha = 0.0
+    beta = math.inf
+    for a in axes:
+        ab = table.get(a)
+        if ab is None:
+            return None
+        alpha += ab[0]
+        beta = min(beta, ab[1])
+    return alpha, beta
+
+
 def price_event(
     ev: CommEvent, profile: Profile, mesh_sizes: dict[str, int]
 ) -> float:
-    """Seconds of wire time for one predicted event (× trip in loops)."""
+    """Seconds of wire time for one predicted event (× trip in loops).
+
+    With measured ``axis_profiles`` attached (commscope calibration) the
+    event's axes price as ``α + wire_bytes / β``; otherwise the flat
+    pinned ``link_bw`` divides the wire bytes as before. Zero-wire
+    events (axis size 1, reshard slices) stay free either way — no
+    collective runs, so no α is paid."""
     t = 0.0
     for (op, _ax) in ev.realizations[:1]:
         n = 1
         for a in ev.axes:
             n *= mesh_sizes.get(a, 1)
-        t = ev.bytes * _ring_factor(op, n) / max(profile.link_bw, 1.0)
+        wire = ev.bytes * _ring_factor(op, n)
+        if wire <= 0:
+            t = 0.0
+            continue
+        ab = _axis_alpha_beta(profile, ev.axes)
+        if ab is not None:
+            t = ab[0] + wire / max(ab[1], 1.0)
+        else:
+            t = wire / max(profile.link_bw, 1.0)
     return t * ((ev.trip or 1) if ev.in_loop else 1)
+
+
+def calibrate_axis_profiles(
+    measurements: Iterable[dict] | Any,
+    base: Profile | None = None,
+) -> Profile:
+    """Fold measured commscope data into a pricing profile.
+
+    ``measurements`` is either the raw ladder record list
+    (``telemetry.commscope.run_ladder`` output — the α–β fit runs here)
+    or an already-fitted ``telemetry.commscope.CommProfile``. Returns a
+    copy of ``base`` (default: the live backend's profile) with
+    ``axis_profiles`` attached; everything else — including the pinned
+    ``link_bw`` fallback for uncalibrated axes — is preserved.
+    """
+    from learning_jax_sharding_tpu.telemetry import commscope
+
+    if base is None:
+        base = current_profile()
+    if isinstance(measurements, commscope.CommProfile):
+        axis_ab = measurements.axis_alpha_beta()
+    else:
+        fitted = commscope.fit_axis_profiles(measurements)
+        axis_ab = tuple(
+            (a, p.alpha_s, p.beta_bytes_per_s)
+            for a, p in sorted(fitted.items())
+        )
+    return dataclasses.replace(
+        base, axis_profiles=axis_ab, source=base.source + "+commscope",
+    )
 
 
 #: Per-(op, axes, bytes, trip) wire-seconds memo for :func:`price_multiset`,
@@ -295,7 +365,8 @@ def price_multiset(
     total step time cannot win, so the rest of its events go unpriced.
     """
     key_base = (
-        profile.name, profile.link_bw, tuple(sorted(mesh_sizes.items())),
+        profile.name, profile.link_bw, profile.axis_profiles,
+        tuple(sorted(mesh_sizes.items())),
     )
     total = 0.0
     for ev in events:

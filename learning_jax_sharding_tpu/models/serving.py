@@ -1751,16 +1751,69 @@ class ContinuousEngine:
         # carries, so the sink costs nothing when absent.
         self.trace_sink = None
         self.trace_replica = "engine"
+        # fn-identity → program-family memo for _program_family (device
+        # frames tag their ledger seconds with the dispatching program).
+        self._fam_cache: dict[int, str] = {}
+
+    #: jitted-fn attribute → program-family name, mirroring the names
+    #: :meth:`_dispatched_programs` publishes — the ledger's per-family
+    #: device attribution must key identically or overlap_report rows
+    #: would never match a costmodel prediction.
+    _FN_FAMILY_ATTRS = (
+        ("_first_refill_fn", "first_refill"),
+        ("_refill_step_fn", "refill_step"),
+        ("_decode_block_spec_fn", "decode_block_spec"),
+        ("_decode_block_fn", "decode_block"),
+        ("_adapter_spec_mixed_step_fn", "adapter_mixed_step"),
+        ("_adapter_mixed_step_fn", "adapter_mixed_step"),
+        ("_spec_mixed_step_fn", "mixed_step"),
+        ("_mixed_step_fn", "mixed_step"),
+        ("_adapter_spec_multi_step_fn", "adapter_multi_step"),
+        ("_adapter_multi_step_fn", "adapter_multi_step"),
+        ("_spec_multi_step_fn", "multi_step"),
+        ("_multi_step_fn", "multi_step"),
+        ("_kv_export_fn", "kv_export"),
+        ("_kv_ingest_fn", "kv_ingest"),
+        ("_kv_page_spill_fn", "kv_page_spill"),
+        ("_kv_page_fill_fn", "kv_page_fill"),
+    )
+
+    def _program_family(self, fn):
+        """Program-family name for a jitted engine fn (None for frames
+        with no fn — blocking readbacks book as "unattributed")."""
+        if fn is None:
+            return None
+        fam = self._fam_cache.get(id(fn))
+        if fam is None:
+            fam = "unattributed"
+            for attr, name in self._FN_FAMILY_ATTRS:
+                if getattr(self, attr, None) is fn:
+                    fam = name
+                    break
+            self._fam_cache[id(fn)] = fam
+        return fam
 
     @contextlib.contextmanager
-    def _led_device(self, fn=None):
+    def _led_device(self, fn=None, family=None):
         """Ledger frame for a dispatch or blocking readback: books to
-        the ``device`` bucket, unless ``fn``'s executable cache GREW
+        the ``device`` bucket (tagged with ``fn``'s program family for
+        :meth:`overlap_report`), unless ``fn``'s executable cache GREW
         inside the region — then the call paid a trace+compile, not a
         device step, and the whole frame re-buckets to ``compile`` (the
-        compile-steal idiom; ``cache_size`` probes the jit cache)."""
+        compile-steal idiom; ``cache_size`` probes the jit cache).
+
+        ``family`` tags a frame WITHOUT a cache probe — the sync-frame
+        form: under async dispatch the dispatch frame books only enqueue
+        microseconds, so the blocking readback that drains a program's
+        in-flight seconds must carry the SAME family tag or the
+        overlap_report attribution would book the device time as
+        unattributed."""
         before = cache_size(fn) if fn is not None else None
-        with self.ledger.measure("device") as f:
+        with self.ledger.measure(
+            "device",
+            family=family if family is not None
+            else self._program_family(fn),
+        ) as f:
             yield f
             if before is not None:
                 after = cache_size(fn)
@@ -3501,6 +3554,7 @@ class ContinuousEngine:
                     "engine.first_refill"
                 ):
                     tok_new, self._cache = self._first_refill_fn(*first_args)
+                seg_fam = "first_refill"
                 self.cache_creations += 1
                 self._c_creations.inc()
                 self.recorder.record(
@@ -3527,6 +3581,7 @@ class ContinuousEngine:
                         params, d_params, self._cache, chunk_d, lengths_d,
                         reset_d, reset_to_d, rid_d, self.rng,
                     )
+                seg_fam = "refill_step"
                 self._last_refill_args = lambda: (
                     params, d_params, self._cache, chunk_d, lengths_d,
                     reset_d, reset_to_d, rid_d, self.rng,
@@ -3550,11 +3605,11 @@ class ContinuousEngine:
                         and self._req[slot] >= 0
                     ):
                         seg_completes.append(slot)
-            segs.append((tok_new, seg_completes))
+            segs.append((tok_new, seg_completes, seg_fam))
         if not segs:
             return False
-        for tok_new, seg_completes in segs:
-            with self._led_device():
+        for tok_new, seg_completes, seg_fam in segs:
+            with self._led_device(family=seg_fam):
                 tok_new = np.asarray(tok_new)   # each segment's own sync
             now = time.perf_counter()       # its host-visibility time
             for slot in seg_completes:
@@ -3701,7 +3756,7 @@ class ContinuousEngine:
                 active_d, pos_d, remaining_d, rid, self.rng,
             )
             # ONE sync for the whole chain.
-            with self._led_device():
+            with self._led_device(family="decode_block_spec"):
                 segs = [
                     tuple(np.asarray(x) for x in seg) for seg in segs
                 ]
@@ -3753,7 +3808,7 @@ class ContinuousEngine:
                     params, self._cache, tok_d, active_d, remaining_d,
                     rid, self.rng,
                 )
-            with self._led_device():
+            with self._led_device(family="decode_block"):
                 segs = [np.asarray(t) for t in segs]   # ONE sync
             now = time.perf_counter()
             was_active = self._active.copy()
@@ -4066,6 +4121,7 @@ class ContinuousEngine:
                     chunk_d, lengths_d, reset_d, reset_to_d, tok_d,
                     active_d, pos_d, remaining_d, rid, self.rng,
                 )
+                link_fam = "adapter_mixed_step"
             elif self._speculative:
                 with self._led_device(
                     self._spec_mixed_step_fn
@@ -4083,6 +4139,7 @@ class ContinuousEngine:
                     lengths_d, reset_d, reset_to_d, tok_d, active_d,
                     pos_d, remaining_d, rid, self.rng,
                 )
+                link_fam = "mixed_step"
             elif self._adapter_pool is not None:
                 with self._led_device(
                     self._adapter_mixed_step_fn
@@ -4100,6 +4157,7 @@ class ContinuousEngine:
                     lengths_d, reset_d, reset_to_d, tok_d, active_d,
                     remaining_d, rid, self.rng,
                 )
+                link_fam = "adapter_mixed_step"
             else:
                 with self._led_device(
                     self._mixed_step_fn
@@ -4117,6 +4175,7 @@ class ContinuousEngine:
                     reset_to_d, tok_d, active_d, remaining_d, rid,
                     self.rng,
                 )
+                link_fam = "mixed_step"
             self._last_mixed_args = lambda a=args: a
             self._needs_reset[:] = False
             self._reset_to[:] = 0
@@ -4153,7 +4212,7 @@ class ContinuousEngine:
                 int(((self._aidx > 0) & occ).sum()) * len(segs)
             )
         for first_tok, buffer, counts, acc, prop, seg_completes in segs:
-            with self._led_device():
+            with self._led_device(family=link_fam):
                 first_np = np.asarray(first_tok)   # each link's own sync
             now = time.perf_counter()
             for slot in seg_completes:
@@ -4175,7 +4234,7 @@ class ContinuousEngine:
                 else:
                     self._active[slot] = True
             if self._speculative:
-                with self._led_device():
+                with self._led_device(family=link_fam):
                     counts_np = np.asarray(counts)
                     buffer_np = np.asarray(buffer)
                     acc_np = np.asarray(acc)
@@ -4398,6 +4457,7 @@ class ContinuousEngine:
                 chunks_d, lens_d, resets_d, reset_tos_d, live_d, tok_d,
                 active_d, pos_d, remaining_d, rid, self.rng,
             )
+            fused_fam = "adapter_multi_step"
         elif self._speculative:
             with self._led_device(
                 self._spec_multi_step_fn
@@ -4415,6 +4475,7 @@ class ContinuousEngine:
                 resets_d, reset_tos_d, live_d, tok_d, active_d, pos_d,
                 remaining_d, rid, self.rng,
             )
+            fused_fam = "multi_step"
         elif self._adapter_pool is not None:
             with self._led_device(
                 self._adapter_multi_step_fn
@@ -4432,6 +4493,7 @@ class ContinuousEngine:
                 resets_d, reset_tos_d, live_d, tok_d, active_d,
                 remaining_d, rid, self.rng,
             )
+            fused_fam = "adapter_multi_step"
         else:
             with self._led_device(
                 self._multi_step_fn
@@ -4449,6 +4511,7 @@ class ContinuousEngine:
                 reset_tos_d, live_d, tok_d, active_d, remaining_d, rid,
                 self.rng,
             )
+            fused_fam = "multi_step"
         self._last_multi_args = lambda a=args: a
         if self._speculative:
             self._cache = (t_cache, d_cache)
@@ -4476,7 +4539,7 @@ class ContinuousEngine:
         self._plan_next_horizon(n_links, per_link, chain_dec, links)
         # ONE blocking readback for the whole horizon (the host's single
         # touch per N iterations — books as in-flight device time).
-        with self._led_device():
+        with self._led_device(family=fused_fam):
             toks_np = np.asarray(first_toks)
             if self._speculative:
                 counts_np = np.asarray(counts)
@@ -5137,7 +5200,9 @@ class ContinuousEngine:
         )
         return findings
 
-    def explain_collectives(self) -> dict[str, "object"]:
+    def explain_collectives(
+        self, *, measured: bool = False, profile=None
+    ) -> dict[str, "object"]:
         """Pre-compile collective attribution for every dispatched engine
         program: run the GSPMD propagation simulator
         (``analysis.shardflow``) over each program's jaxpr and return a
@@ -5148,7 +5213,15 @@ class ContinuousEngine:
         Trace-only (``jax.make_jaxpr``): no compiles, so this is cheap
         enough to run on a live engine. Decode-family programs advance
         ``decode_block_steps`` tokens per dispatch inside their device
-        loop; that trip count prices the in-loop collectives."""
+        loop; that trip count prices the in-loop collectives.
+
+        With ``measured=True`` each contract name instead maps to
+        ``{"report", "measured_comm_s", "lines"}``: the same report plus
+        the ledger window's measured collective seconds for that program
+        family (exposed + overlapped from :meth:`overlap_report`),
+        attributed per SOURCE LINE proportionally to the costmodel's
+        per-line prediction (``telemetry.commscope``) — the
+        predicted-vs-measured table ``shardcheck --explain`` prints."""
         from learning_jax_sharding_tpu.analysis.shardflow import (
             trace_shardflow,
         )
@@ -5170,7 +5243,125 @@ class ContinuousEngine:
                     cname, fn, *args, mesh=self._mesh,
                     while_trip_hint=hint,
                 )
-        return out
+        if not measured:
+            return out
+
+        from learning_jax_sharding_tpu.analysis import costmodel
+        from learning_jax_sharding_tpu.telemetry import commscope
+
+        if profile is None:
+            profile = costmodel.current_profile()
+        overlap = self.ledger.overlap_report(
+            predicted=self._comm_predictions(profile, out)
+        )
+        res = {}
+        for name, _fn, _args in self._dispatched_programs():
+            cname = self.contract_name(name)
+            rep = out.get(cname)
+            if rep is None:
+                continue
+            fam = overlap["families"].get(name)
+            meas = (
+                fam["exposed_comm_s"] + fam["overlapped_comm_s"]
+                if fam else 0.0
+            )
+            res[cname] = {
+                "report": rep,
+                "measured_comm_s": meas,
+                "lines": commscope.line_report(rep, profile, meas),
+            }
+        return res
+
+    def _comm_predictions(self, profile, reports) -> dict[str, dict]:
+        """Per-dispatch ``{"compute_s", "comm_s"}`` costmodel prediction
+        per program family (keys = :meth:`_dispatched_programs` names,
+        matching the ledger's device-family tags). ``compute_s`` is the
+        non-collective roofline (max of compute/memory terms) — the
+        serial lens :func:`~.commscope.decompose_overlap` needs."""
+        from learning_jax_sharding_tpu.analysis import costmodel
+
+        preds = {}
+        for name, _fn, _args in self._dispatched_programs():
+            rep = reports.get(self.contract_name(name))
+            if rep is None:
+                continue
+            cost = costmodel.price(rep, profile)
+            preds[name] = {
+                "compute_s": max(cost.compute_s, cost.memory_s),
+                "comm_s": cost.collective_s,
+            }
+        return preds
+
+    def overlap_report(self, profile=None) -> dict:
+        """Decompose the ledger window's device seconds into compute /
+        exposed-comm / overlapped-comm per program family
+        (``GoodputLedger.overlap_report``), with per-dispatch costmodel
+        predictions derived from this engine's own shardflow reports.
+        The decomposition sums back to the device bucket exactly, so
+        ``reconcile()`` is untouched."""
+        from learning_jax_sharding_tpu.analysis import costmodel
+
+        if profile is None:
+            profile = costmodel.current_profile()
+        reports = self.explain_collectives()
+        return self.ledger.overlap_report(
+            predicted=self._comm_predictions(profile, reports)
+        )
+
+    def comm_report(
+        self, profile=None, comm_profile=None, *, export_gauges=True,
+    ) -> dict:
+        """The comm-observatory verdict for the current ledger window.
+
+        Combines the overlap decomposition with per-source-line
+        predicted-vs-measured attribution for every program family, and
+        (by default) publishes the ``comm_axis_bandwidth_bytes_per_s``
+        and ``comm_exposed_seconds_total{family,axis}`` gauges into this
+        engine's registry — the Prometheus/fleet-merge path.
+
+        ``comm_profile`` is a measured ``telemetry.commscope.CommProfile``
+        (calibration ladder output); when given, pricing uses its
+        per-axis α–β models via ``costmodel.calibrate_axis_profiles``
+        with the pinned table as fallback."""
+        from learning_jax_sharding_tpu.analysis import costmodel
+        from learning_jax_sharding_tpu.telemetry import commscope
+
+        if profile is None:
+            profile = costmodel.current_profile()
+        if comm_profile is not None:
+            profile = costmodel.calibrate_axis_profiles(
+                comm_profile, base=profile)
+            if export_gauges:
+                commscope.export_profile_gauges(self.registry, comm_profile)
+        reports = self.explain_collectives()
+        overlap = self.ledger.overlap_report(
+            predicted=self._comm_predictions(profile, reports)
+        )
+        families = {}
+        for name, fam in overlap["families"].items():
+            rep = reports.get(self.contract_name(name))
+            meas = fam["exposed_comm_s"] + fam["overlapped_comm_s"]
+            shares = (
+                commscope.axis_comm_shares(rep, profile)
+                if rep is not None else {}
+            )
+            if export_gauges:
+                commscope.export_exposed_gauges(
+                    self.registry, name, fam["exposed_comm_s"], shares)
+            families[name] = {
+                **fam,
+                "measured_comm_s": meas,
+                "axis_shares": shares,
+                "lines": (
+                    commscope.line_report(rep, profile, meas)
+                    if rep is not None else []
+                ),
+            }
+        return {
+            "profile": profile.to_dict(),
+            "overlap": overlap,
+            "families": families,
+        }
 
     def collective_axis_volume(self) -> dict[str, dict]:
         """Per-MESH-AXIS collective byte volume for each engine program:

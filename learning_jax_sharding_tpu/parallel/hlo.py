@@ -29,11 +29,13 @@ COLLECTIVE_OPS = (
 # contains spaces. Matching on `= <type> <op>(` avoids counting occurrences
 # inside fusion/computation names; `-done` ops are deliberately excluded so an
 # async pair counts once. Group 1 is the result type (byte volumes for
-# telemetry.devview's per-axis attribution), group 2 the op — ONE regex
-# serves both collective_counts and collective_instructions, so the anchor
-# cannot drift between them.
+# telemetry.devview's per-axis attribution), group 2 the op, group 3 the
+# `-start` suffix when present (async pairs need different byte accounting:
+# their tuple interleaves operands with results) — ONE regex serves both
+# collective_counts and collective_instructions, so the anchor cannot drift
+# between them.
 _INSTR_RE = re.compile(
-    r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) + r")(?:-start)?\("
+    r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(COLLECTIVE_OPS) + r")(-start)?\("
 )
 
 # One typed-shape token inside a result type: `bf16[8,128]` / `f32[]` /
@@ -188,10 +190,15 @@ def collective_instructions(hlo_text: str) -> list[dict]:
 
     Each record is ``{"op", "bytes", "replica_groups", "computation",
     "in_while", "source_target_pairs", "channel_id"}``: ``bytes`` is the
-    LARGEST typed operand/result buffer in the instruction's result type
-    (for async ``-start`` pairs the tuple holds operand AND result, so
-    the max is the post-collective buffer — the honest wire-volume proxy
-    for a grown all-gather); ``replica_groups`` is a list of
+    TOTAL result-buffer volume of the instruction — for a sync
+    collective the sum over its (possibly variadic tuple) result
+    elements, since a multi-operand all-gather / reduce-scatter moves
+    every operand, not just the largest; for an async ``-start`` pair,
+    whose 2k-tuple interleaves k operands with k results, the sum of the
+    per-pair maxima (the post-collective buffer of each operand — the
+    honest wire-volume proxy for a grown all-gather). Commscope's
+    per-line attribution keys on this total; ``replica_groups`` is a
+    list of
     partition-id lists (ids are positions in the mesh's flattened device
     order under SPMD partitioning), or None when XLA printed none —
     including the channel-lowered empty ``replica_groups={}`` form,
@@ -213,11 +220,26 @@ def collective_instructions(hlo_text: str) -> list[dict]:
         m = _INSTR_RE.search(line)
         if m is None:
             continue
-        type_str, op = m.group(1), m.group(2)
-        nbytes = 0
-        for dt, dims in _SHAPE_RE.findall(type_str):
-            numel = math.prod(int(d) for d in dims.split(",") if d)
-            nbytes = max(nbytes, (numel * _dtype_bits(dt) + 7) // 8)
+        type_str, op, started = m.group(1), m.group(2), bool(m.group(3))
+        elems = [
+            (math.prod(int(d) for d in dims.split(",") if d)
+             * _dtype_bits(dt) + 7) // 8
+            for dt, dims in _SHAPE_RE.findall(type_str)
+        ]
+        if started and len(elems) >= 2 and len(elems) % 2 == 0:
+            # Async tuple: (op₀..opₖ₋₁, res₀..resₖ₋₁) — count each
+            # operand/result pair once at its larger (post-collective)
+            # side, summed across the variadic operands.
+            k = len(elems) // 2
+            nbytes = sum(max(elems[i], elems[i + k]) for i in range(k))
+        elif started and elems:
+            # Unexpected async tuple arity: fall back to the largest
+            # buffer rather than double-counting operands as results.
+            nbytes = max(elems)
+        else:
+            # Sync result (scalar type or variadic tuple): every element
+            # IS a moved buffer, so the volume is the sum.
+            nbytes = sum(elems)
         gm = _GROUPS_RE.search(line)
         groups = _parse_replica_groups(gm.group(1)) if gm else None
         pm = _PAIRS_RE.search(line)

@@ -24,7 +24,13 @@ Stage 2 (PR 2) — diagnosis, four more:
   byte attribution;
 * :mod:`~.telemetry.slo` — streaming TTFT/TPOT/ITL/queue-wait percentile
   estimators and SLO targets with burn-rate counters, exported through
-  the registry/Prometheus path.
+  the registry/Prometheus path;
+* :mod:`~.telemetry.commscope` — the comm observatory: a calibration
+  ladder of timed micro-collectives fitting per-axis α–β link profiles
+  (persisted under ``analysis/profiles/``), per-source-line
+  predicted-vs-measured collective attribution, and the compute /
+  exposed-comm / overlapped-comm decomposition behind
+  ``GoodputLedger.overlap_report``.
 
 Consumers: ``models.serving.ContinuousEngine`` (per-request span
 timeline, queue/page-pool gauges, SLO feed, flight-recorder lifecycle
@@ -34,6 +40,16 @@ breakdown + the diagnosis block), and ``cases/case18_observability.py``
 / ``cases/case19_diagnosis.py`` (the end-to-end drivers).
 """
 
+from learning_jax_sharding_tpu.telemetry.commscope import (  # noqa: F401
+    AxisProfile,
+    CommProfile,
+    attribute_measured_seconds,
+    calibrate_mesh,
+    decompose_overlap,
+    fit_alpha_beta,
+    fit_axis_profiles,
+    run_ladder,
+)
 from learning_jax_sharding_tpu.telemetry.compile_watch import (  # noqa: F401
     CompileWatch,
     WatchedFunction,

@@ -1,0 +1,568 @@
+"""Comm observatory: measured per-axis collective profiles + overlap
+attribution.
+
+The analysis substrate (shardflow → costmodel → layout_search → memflow)
+*plans* against the collectives GSPMD inserts, but until this module it
+priced them with a flat, pinned per-axis table and assumed serial
+(zero-overlap) execution. Commscope is the instrument that measures what
+the model asserts:
+
+* **Calibration ladder** — :func:`run_ladder` times micro-collectives
+  (psum / all-gather / reduce-scatter / ppermute) per mesh axis across a
+  byte-size sweep with the latency-cancelled ``utils.bench.time_fn``
+  harness, and :func:`fit_axis_profiles` fits a per-axis α–β model
+  ``t = α + wire_bytes / β`` by least squares. Profiles persist as
+  versioned JSON under ``analysis/profiles/`` (:class:`CommProfile`);
+  ``costmodel.calibrate_axis_profiles`` folds them into
+  ``price_event`` with the pinned table as fallback.
+
+* **Attribution** — :func:`attribute_measured_seconds` distributes a
+  measured comm-seconds total across source lines proportionally to each
+  line's *predicted* collective seconds (from
+  ``parallel/hlo.collective_instructions`` bytes through shardflow
+  events), producing the per-line predicted-vs-measured report
+  ``engine.explain_collectives(measured=True)`` and ``shardcheck
+  --comm`` render.
+
+* **Overlap decomposition** — :func:`decompose_overlap` splits measured
+  device seconds into compute / exposed-comm / overlapped-comm such that
+  the three ALWAYS sum back exactly; ``GoodputLedger.overlap_report``
+  applies it per program family, preserving the ledger's reconciliation
+  invariant (comm seconds book under ``device``, never ``telemetry``).
+
+Emulated-CPU caveat: on a host-emulated mesh every "link" is a memcpy
+through one shared memory system, so ladder bandwidths are memcpy
+bandwidths and axes look near-identical. The instrument is still honest —
+it measures what dispatches actually cost *here* — but chip numbers land
+via ``bench.py`` on real hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any, Iterable, Mapping, Sequence
+
+#: Bump when the persisted JSON schema changes; :meth:`CommProfile.load`
+#: refuses mismatched files rather than silently misreading them.
+PROFILE_VERSION = 1
+
+#: Default home for persisted profiles (checked-in reference profiles
+#: live here; runtime dumps go under ``$LJST_ARTIFACT_DIR``).
+PROFILE_DIR = (
+    pathlib.Path(__file__).resolve().parents[1] / "analysis" / "profiles"
+)
+
+#: Ladder micro-collectives, matching ``parallel/collectives.py`` idioms.
+LADDER_OPS = ("psum", "all_gather", "reduce_scatter", "ppermute")
+
+#: Per-device buffer bytes swept by default: small enough to finish in
+#: seconds on the emulated mesh, wide enough (256×) to separate α from β.
+DEFAULT_SIZES = (1 << 15, 1 << 17, 1 << 19, 1 << 21, 1 << 23)
+
+
+def wire_bytes(op: str, n: int, local_bytes: float) -> float:
+    """Bytes crossing links per device for one ladder collective over an
+    ``n``-device axis with a ``local_bytes`` per-device input buffer.
+
+    Ring algorithm volumes, the same convention as
+    ``costmodel._ring_factor`` (all-reduce moves the buffer twice minus
+    the resident shard; gather/scatter once; permute one full hop).
+    """
+    if n <= 1:
+        return 0.0
+    if op == "psum":
+        return 2.0 * local_bytes * (n - 1) / n
+    if op == "all_gather":
+        return float(local_bytes * (n - 1))     # receives n-1 peer shards
+    if op == "reduce_scatter":
+        return local_bytes * (n - 1) / n
+    if op == "ppermute":
+        return float(local_bytes)
+    raise ValueError(f"unknown ladder op {op!r}")
+
+
+# --- calibration ladder ----------------------------------------------------
+
+
+def _ladder_step(mesh, op: str, axis: str, local_elems: int):
+    """Build ``(jitted_fn, input)`` for one timed micro-collective: a
+    ``shard_map`` whose body is exactly one collective over ``axis``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = int(mesh.shape[axis])
+    m = int(local_elems)
+    if op == "psum":
+        body = lambda x: lax.psum(x, axis)                      # noqa: E731
+        out_spec = P()
+    elif op == "all_gather":
+        body = lambda x: lax.all_gather(                        # noqa: E731
+            x, axis, axis=0, tiled=True)
+        out_spec = P()
+    elif op == "reduce_scatter":
+        body = lambda x: lax.psum_scatter(                      # noqa: E731
+            x, axis, scatter_dimension=0, tiled=True)
+        out_spec = P(axis)
+    elif op == "ppermute":
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        body = lambda x: lax.ppermute(x, axis, perm)            # noqa: E731
+        out_spec = P(axis)
+    else:
+        raise ValueError(f"unknown ladder op {op!r}")
+
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis),), out_specs=out_spec,
+        check_vma=False,
+    ))
+    x = jax.device_put(
+        np.ones((n * m,), np.float32),
+        NamedSharding(mesh, P(axis)),
+    )
+    del jnp
+    return fn, x
+
+
+def run_ladder(
+    mesh,
+    *,
+    ops: Sequence[str] = LADDER_OPS,
+    sizes_bytes: Sequence[int] = DEFAULT_SIZES,
+    axes: Sequence[str] | None = None,
+    min_time: float = 0.05,
+    repeats: int = 2,
+    warmup: int = 1,
+) -> list[dict[str, Any]]:
+    """Time the calibration ladder on ``mesh``; returns one record per
+    (axis, op, size) cell::
+
+        {"op", "axis", "n", "bytes", "wire_bytes", "seconds"}
+
+    ``bytes`` is the per-device input buffer; ``seconds`` comes from the
+    ``time_fn`` harness (compiles excluded), so the records feed
+    :func:`fit_axis_profiles` directly. Axes of size 1 are skipped — no
+    collective runs there.
+
+    Every call is synced before the next dispatch: XLA CPU's collective
+    rendezvous DEADLOCKS when participants from multiple in-flight runs
+    of the same program interleave (observed on the emulated mesh —
+    "waiting for all participants to arrive" across distinct run_ids),
+    so the async k-calls-one-readback chain ``time_fn`` normally builds
+    is not available here. The per-call sync overhead is constant per
+    collective, which is exactly the α term the fit estimates.
+    """
+    from ..utils.bench import time_fn
+    from .spans import device_sync
+
+    out: list[dict[str, Any]] = []
+    for axis in tuple(axes if axes is not None else mesh.axis_names):
+        n = int(mesh.shape[axis])
+        if n <= 1:
+            continue
+        for op in ops:
+            for b in sizes_bytes:
+                # float32 elems, rounded up so reduce-scatter can tile.
+                m = max(n, -(-int(b) // 4 // n) * n)
+                fn, x = _ladder_step(mesh, op, axis, m)
+
+                def call(fn=fn, x=x):
+                    y = fn(x)
+                    device_sync(y)
+                    return y
+
+                s = time_fn(
+                    call, min_time=min_time, repeats=repeats,
+                    warmup=warmup,
+                )
+                local = 4.0 * m
+                out.append({
+                    "op": op, "axis": axis, "n": n, "bytes": local,
+                    "wire_bytes": wire_bytes(op, n, local),
+                    "seconds": float(s),
+                })
+    return out
+
+
+# --- α–β fit ---------------------------------------------------------------
+
+
+def fit_alpha_beta(
+    points: Iterable[tuple[float, float]],
+) -> tuple[float, float, float]:
+    """Least-squares fit of ``t = α + wire_bytes / β`` over ``(wire,
+    seconds)`` points; returns ``(alpha_s, beta_bytes_per_s, r2)``.
+
+    Exact on noiseless synthetic timings (pinned in
+    ``tests/test_commscope.py``): the slope is ``1/β`` and the intercept
+    is ``α``, clamped to physical ranges (α ≥ 0, β > 0) only afterwards
+    so clean data round-trips unperturbed.
+    """
+    pts = [(float(w), float(t)) for w, t in points if w > 0]
+    if len(pts) < 2:
+        raise ValueError("need ≥ 2 points with wire_bytes > 0 to fit α–β")
+    n = float(len(pts))
+    sx = sum(w for w, _ in pts)
+    sy = sum(t for _, t in pts)
+    sxx = sum(w * w for w, _ in pts)
+    sxy = sum(w * t for w, t in pts)
+    denom = n * sxx - sx * sx
+    slope = (n * sxy - sx * sy) / denom if denom else 0.0
+    intercept = (sy - slope * sx) / n
+    alpha = max(0.0, intercept)
+    beta = 1.0 / slope if slope > 1e-18 else 1e18
+    mean = sy / n
+    ss_tot = sum((t - mean) ** 2 for _, t in pts)
+    ss_res = sum((t - (intercept + slope * w)) ** 2 for w, t in pts)
+    r2 = 1.0 - ss_res / ss_tot if ss_tot > 0 else 1.0
+    return alpha, beta, r2
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisProfile:
+    """Fitted α–β model for one mesh axis."""
+
+    axis: str
+    alpha_s: float              # fixed per-collective latency, seconds
+    beta_bytes_per_s: float     # asymptotic link bandwidth
+    n_devices: int
+    points: int                 # ladder cells behind the fit
+    r2: float
+
+    def predict_s(self, wire: float) -> float:
+        """Model seconds for ``wire`` bytes on this axis."""
+        if wire <= 0:
+            return 0.0
+        return self.alpha_s + wire / max(self.beta_bytes_per_s, 1.0)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "AxisProfile":
+        return cls(**{k: d[k] for k in (
+            "axis", "alpha_s", "beta_bytes_per_s", "n_devices", "points",
+            "r2",
+        )})
+
+
+def fit_axis_profiles(
+    measurements: Iterable[Mapping[str, Any]],
+) -> dict[str, AxisProfile]:
+    """Group ladder records by axis and fit one :class:`AxisProfile`
+    each. All ops pool into one fit per axis — ring wire volumes already
+    normalize op shape into ``wire_bytes``, so a shared α–β line is the
+    per-axis link model the cost model consumes."""
+    by_axis: dict[str, list[Mapping[str, Any]]] = {}
+    for m in measurements:
+        if m.get("wire_bytes", 0) > 0:
+            by_axis.setdefault(str(m["axis"]), []).append(m)
+    out: dict[str, AxisProfile] = {}
+    for axis, ms in sorted(by_axis.items()):
+        alpha, beta, r2 = fit_alpha_beta(
+            (m["wire_bytes"], m["seconds"]) for m in ms
+        )
+        out[axis] = AxisProfile(
+            axis=axis, alpha_s=alpha, beta_bytes_per_s=beta,
+            n_devices=max(int(m["n"]) for m in ms), points=len(ms), r2=r2,
+        )
+    return out
+
+
+def fit_errors(
+    profiles: Mapping[str, AxisProfile],
+    measurements: Iterable[Mapping[str, Any]],
+) -> dict[str, float]:
+    """Worst per-axis |predicted − measured| / measured, in percent —
+    the reconciliation number gated against ``baseline.json``'s
+    ``commscope_tolerance_pct``."""
+    worst: dict[str, float] = {}
+    for m in measurements:
+        ap = profiles.get(str(m.get("axis")))
+        if ap is None or m.get("wire_bytes", 0) <= 0:
+            continue
+        meas = float(m["seconds"])
+        err = abs(ap.predict_s(m["wire_bytes"]) - meas) / max(meas, 1e-12)
+        worst[ap.axis] = max(worst.get(ap.axis, 0.0), err * 100.0)
+    return worst
+
+
+# --- persisted profile -----------------------------------------------------
+
+
+@dataclasses.dataclass
+class CommProfile:
+    """A fitted, persistable set of per-axis profiles for one mesh."""
+
+    platform: str
+    mesh_axes: tuple[str, ...]
+    mesh_shape: tuple[int, ...]
+    axes: dict[str, AxisProfile]
+    measurements: list[dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    created_unix: float = 0.0
+    version: int = PROFILE_VERSION
+
+    def axis_alpha_beta(self) -> tuple[tuple[str, float, float], ...]:
+        """The ``(axis, α, β)`` tuple ``costmodel.Profile.axis_profiles``
+        carries (hashable, ordered by axis name)."""
+        return tuple(
+            (a, p.alpha_s, p.beta_bytes_per_s)
+            for a, p in sorted(self.axes.items())
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "version": self.version,
+            "platform": self.platform,
+            "mesh_axes": list(self.mesh_axes),
+            "mesh_shape": list(self.mesh_shape),
+            "axes": {a: p.to_dict() for a, p in sorted(self.axes.items())},
+            "measurements": list(self.measurements),
+            "created_unix": self.created_unix,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "CommProfile":
+        v = int(d.get("version", -1))
+        if v != PROFILE_VERSION:
+            raise ValueError(
+                f"comm profile version {v} != supported {PROFILE_VERSION}; "
+                f"re-run the calibration ladder (scripts/commscope.py)"
+            )
+        return cls(
+            platform=str(d["platform"]),
+            mesh_axes=tuple(d["mesh_axes"]),
+            mesh_shape=tuple(int(s) for s in d["mesh_shape"]),
+            axes={
+                a: AxisProfile.from_dict(p) for a, p in d["axes"].items()
+            },
+            measurements=list(d.get("measurements", [])),
+            created_unix=float(d.get("created_unix", 0.0)),
+            version=v,
+        )
+
+    def default_path(self) -> pathlib.Path:
+        shape = "x".join(str(s) for s in self.mesh_shape)
+        return PROFILE_DIR / f"comm_profile_{self.platform}_{shape}.json"
+
+    def save(self, path: pathlib.Path | str | None = None) -> pathlib.Path:
+        path = pathlib.Path(path) if path is not None else self.default_path()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.to_dict(), indent=2, sort_keys=True)
+                        + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: pathlib.Path | str) -> "CommProfile":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def fit_profile(
+    mesh,
+    measurements: Sequence[Mapping[str, Any]],
+    *,
+    platform: str | None = None,
+    keep_measurements: bool = True,
+    created_unix: float = 0.0,
+) -> CommProfile:
+    """Fit a :class:`CommProfile` from ladder records on ``mesh``."""
+    if platform is None:
+        import jax
+
+        platform = jax.default_backend()
+    return CommProfile(
+        platform=str(platform),
+        mesh_axes=tuple(mesh.axis_names),
+        mesh_shape=tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        axes=fit_axis_profiles(measurements),
+        measurements=[dict(m) for m in measurements]
+        if keep_measurements else [],
+        created_unix=created_unix,
+    )
+
+
+def calibrate_mesh(mesh, **ladder_kwargs) -> CommProfile:
+    """Run the ladder and fit in one call — the whole instrument."""
+    created = ladder_kwargs.pop("created_unix", 0.0)
+    ms = run_ladder(mesh, **ladder_kwargs)
+    return fit_profile(mesh, ms, created_unix=created)
+
+
+# --- attribution -----------------------------------------------------------
+
+
+def attribute_measured_seconds(
+    line_predictions: Mapping[str, float],
+    measured_s: float,
+) -> dict[str, dict[str, float]]:
+    """Distribute measured comm wall-clock across source lines
+    proportionally to each line's predicted collective seconds.
+
+    Pure algebra, pinned in tests: two collectives sharing one line pool
+    into one key (callers sum their predictions before calling); if every
+    prediction is zero the measured total splits evenly so no second is
+    dropped; Σ measured_s over lines == ``measured_s`` exactly.
+    """
+    preds = {k: max(0.0, float(v)) for k, v in line_predictions.items()}
+    total = sum(preds.values())
+    out: dict[str, dict[str, float]] = {}
+    n = len(preds)
+    for line, p in preds.items():
+        share = p / total if total > 0 else (1.0 / n if n else 0.0)
+        out[line] = {
+            "predicted_s": p,
+            "measured_s": measured_s * share,
+        }
+    return out
+
+
+def line_comm_predictions(
+    report,
+    profile,
+    mesh_sizes: Mapping[str, int] | None = None,
+) -> dict[str, float]:
+    """Predicted collective seconds per source line for one shardflow
+    report, priced with ``profile`` (α–β aware once calibrated)."""
+    from ..analysis import costmodel
+
+    if mesh_sizes is None:
+        mesh_sizes = dict(zip(report.mesh_axes, report.mesh_shape))
+    out: dict[str, float] = {}
+    for ev in report.events:
+        out[ev.where] = out.get(ev.where, 0.0) + costmodel.price_event(
+            ev, profile, mesh_sizes)
+    return out
+
+
+def line_report(
+    report,
+    profile,
+    measured_comm_s: float,
+    *,
+    mesh_sizes: Mapping[str, int] | None = None,
+) -> list[dict[str, Any]]:
+    """Per-source-line predicted-vs-measured rows for one program,
+    sorted by predicted seconds descending — the table
+    ``explain_collectives(measured=True)`` and ``shardcheck --comm``
+    print."""
+    preds = line_comm_predictions(report, profile, mesh_sizes)
+    attr = attribute_measured_seconds(preds, measured_comm_s)
+    ops: dict[str, list[str]] = {}
+    for ev in report.events:
+        for op, ax in ev.realizations[:1]:
+            ops.setdefault(ev.where, []).append(
+                f"{op}@{'+'.join(ev.axes) or '-'}")
+    rows = [
+        {
+            "where": line,
+            "ops": sorted(set(ops.get(line, []))),
+            "predicted_s": a["predicted_s"],
+            "measured_s": a["measured_s"],
+        }
+        for line, a in attr.items()
+    ]
+    rows.sort(key=lambda r: (-r["predicted_s"], r["where"]))
+    return rows
+
+
+def axis_comm_shares(
+    report,
+    profile,
+    mesh_sizes: Mapping[str, int] | None = None,
+) -> dict[str, float]:
+    """Fraction of a program's predicted comm seconds per axis label
+    (multi-axis collectives label as ``a+b``) — the split used to book
+    ``comm_exposed_seconds_total{family,axis}``. Sums to 1 when any comm
+    is predicted."""
+    from ..analysis import costmodel
+
+    if mesh_sizes is None:
+        mesh_sizes = dict(zip(report.mesh_axes, report.mesh_shape))
+    per_axis: dict[str, float] = {}
+    for ev in report.events:
+        label = "+".join(ev.axes) or "-"
+        per_axis[label] = per_axis.get(label, 0.0) + costmodel.price_event(
+            ev, profile, mesh_sizes)
+    total = sum(per_axis.values())
+    if total <= 0:
+        return {}
+    return {a: s / total for a, s in per_axis.items()}
+
+
+# --- overlap decomposition -------------------------------------------------
+
+
+def decompose_overlap(
+    device_s: float,
+    predicted_compute_s: float,
+    predicted_comm_s: float,
+) -> dict[str, Any]:
+    """Split measured device seconds into compute / exposed-comm /
+    overlapped-comm, using predicted serial compute ``C`` and predicted
+    comm ``K`` as the lens on measured ``D``.
+
+    By construction the three parts sum back to ``D`` exactly in every
+    branch (model error is absorbed into the compute part, never
+    invented as comm):
+
+    * ``exposed``    = clamp(D − C, 0, K) — comm visible past compute;
+    * ``overlapped`` = min(K − exposed, D − exposed) — comm hidden under
+      compute, bounded by remaining device time;
+    * ``compute``    = D − exposed − overlapped (≥ 0).
+
+    ``realized_overlap_ratio`` = overlapped / K, or None when no comm
+    was predicted — the signal ROADMAP item 4's hierarchy-aware pricing
+    calibrates against.
+    """
+    d = max(0.0, float(device_s))
+    c = max(0.0, float(predicted_compute_s))
+    k = max(0.0, float(predicted_comm_s))
+    exposed = min(max(0.0, d - c), k)
+    overlapped = max(0.0, min(k - exposed, d - exposed))
+    compute = d - exposed - overlapped
+    return {
+        "compute_s": compute,
+        "exposed_comm_s": exposed,
+        "overlapped_comm_s": overlapped,
+        "realized_overlap_ratio": (overlapped / k) if k > 0 else None,
+    }
+
+
+# --- registry export -------------------------------------------------------
+
+
+def export_profile_gauges(registry, profile: CommProfile) -> None:
+    """Publish fitted per-axis bandwidth into the Prometheus/fleet-merge
+    path as ``comm_axis_bandwidth_bytes_per_s{axis="..."}`` gauges."""
+    for axis, ap in sorted(profile.axes.items()):
+        registry.gauge(
+            f'comm_axis_bandwidth_bytes_per_s{{axis="{axis}"}}',
+            "measured ring bandwidth from the commscope α–β fit",
+        ).set(ap.beta_bytes_per_s)
+        registry.gauge(
+            f'comm_axis_alpha_seconds{{axis="{axis}"}}',
+            "measured per-collective latency from the commscope α–β fit",
+        ).set(ap.alpha_s)
+
+
+def export_exposed_gauges(
+    registry,
+    family: str,
+    exposed_s: float,
+    axis_shares: Mapping[str, float],
+    *,
+    metric: str = "comm_exposed_seconds_total",
+) -> None:
+    """Publish a family's window exposed-comm seconds, split across axes
+    by predicted comm share, as ``{family,axis}``-labeled gauges."""
+    shares = dict(axis_shares) or {"-": 1.0}
+    for axis, share in sorted(shares.items()):
+        registry.gauge(
+            f'{metric}{{family="{family}",axis="{axis}"}}',
+            "window exposed (non-overlapped) collective seconds",
+        ).set(exposed_s * share)

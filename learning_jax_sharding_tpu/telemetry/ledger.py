@@ -82,12 +82,13 @@ class Frame:
     the frame to ``compile`` if the cache grew (the dispatch paid a
     trace+compile, not a device step)."""
 
-    __slots__ = ("bucket", "t0", "child_s")
+    __slots__ = ("bucket", "t0", "child_s", "family")
 
-    def __init__(self, bucket: str, t0: float):
+    def __init__(self, bucket: str, t0: float, family: Optional[str] = None):
         self.bucket = bucket
         self.t0 = t0
         self.child_s = 0.0
+        self.family = family
 
     def rebucket(self, bucket: str) -> None:
         self.bucket = bucket
@@ -114,16 +115,29 @@ class GoodputLedger:
         self._covered = 0.0          # cumulative top-level frame seconds
         self._windows = 0            # top-level frames opened (≈ steps)
         self._stack: list[Frame] = []
+        # Per-family DEVICE attribution: every device-bucket second also
+        # lands under exactly one program-family key ("unattributed" when
+        # the caller didn't tag), so Σ families == device bucket by
+        # construction — the base overlap_report() decomposes on.
+        self._dev_family: dict[str, float] = {}
+        self._dev_calls: dict[str, int] = {}
         t = clock()
         self._t_created = t
         self._win_t = t
         self._win_totals: dict[str, float] = {}
         self._win_covered = 0.0
+        self._win_dev_family: dict[str, float] = {}
+        self._win_dev_calls: dict[str, int] = {}
 
     # --- recording ---------------------------------------------------------
 
-    def _add(self, bucket: str, seconds: float) -> None:
+    def _add(
+        self, bucket: str, seconds: float, family: Optional[str] = None
+    ) -> None:
         self._totals[bucket] = self._totals.get(bucket, 0.0) + seconds
+        if bucket == "device":
+            fam = family or "unattributed"
+            self._dev_family[fam] = self._dev_family.get(fam, 0.0) + seconds
         if self._registry is not None:
             c = self._counters.get(bucket)
             if c is None:
@@ -136,26 +150,36 @@ class GoodputLedger:
                 c.inc(seconds)
 
     @contextlib.contextmanager
-    def measure(self, bucket: str) -> Iterator[Frame]:
+    def measure(
+        self, bucket: str, family: Optional[str] = None
+    ) -> Iterator[Frame]:
         """Attribute the enclosed wall-clock to ``bucket``, exclusively:
         time claimed by nested ``measure`` frames is subtracted here and
         booked there. A top-level frame also accrues covered wall (the
-        idle-derivation base)."""
-        f = Frame(bucket, self._clock())
+        idle-derivation base). ``family`` tags device frames with the
+        program family for :meth:`overlap_report` — frames that rebucket
+        away from ``device`` (compile-steal) drop out of the family
+        totals together with their device seconds."""
+        f = Frame(bucket, self._clock(), family)
         self._stack.append(f)
         try:
             yield f
         finally:
             total = self._clock() - f.t0
             self._stack.pop()
-            self._add(f.bucket, max(0.0, total - f.child_s))
+            self._add(f.bucket, max(0.0, total - f.child_s), f.family)
+            if f.bucket == "device":
+                fam = f.family or "unattributed"
+                self._dev_calls[fam] = self._dev_calls.get(fam, 0) + 1
             if self._stack:
                 self._stack[-1].child_s += total
             else:
                 self._covered += total
                 self._windows += 1
 
-    def account(self, bucket: str, seconds: float) -> None:
+    def account(
+        self, bucket: str, seconds: float, family: Optional[str] = None
+    ) -> None:
         """Retrospective booking: ``seconds`` of wall that already passed
         land in ``bucket``. Inside an open frame this STEALS from the
         enclosing frame (its exclusive time shrinks by the same amount,
@@ -164,7 +188,7 @@ class GoodputLedger:
         this loop's clock."""
         if seconds < 0:
             raise ValueError(f"cannot account {seconds} s")
-        self._add(bucket, seconds)
+        self._add(bucket, seconds, family)
         if self._stack:
             self._stack[-1].child_s += seconds
         else:
@@ -183,6 +207,8 @@ class GoodputLedger:
         self._win_t = self._clock()
         self._win_totals = dict(self._totals)
         self._win_covered = self._covered
+        self._win_dev_family = dict(self._dev_family)
+        self._win_dev_calls = dict(self._dev_calls)
 
     def window_buckets(self) -> dict[str, float]:
         """Per-bucket seconds since :meth:`begin_window`, with derived
@@ -265,6 +291,83 @@ class GoodputLedger:
             "eps": eps,
             "open_frames": len(self._stack),
             "buckets": buckets,
+        }
+
+    def device_families(self) -> dict[str, dict[str, float]]:
+        """Window device seconds and dispatch counts per program family.
+
+        Σ over families of ``seconds`` equals the window's ``device``
+        bucket by construction — every device booking (measure-close,
+        :meth:`account`, rebucket-into-device) passes through
+        :meth:`_add`, which accrues the family total with the SAME
+        number."""
+        out: dict[str, dict[str, float]] = {}
+        for fam, s in self._dev_family.items():
+            d = s - self._win_dev_family.get(fam, 0.0)
+            n = self._dev_calls.get(fam, 0) - self._win_dev_calls.get(fam, 0)
+            if d != 0.0 or n != 0:
+                out[fam] = {"seconds": d, "calls": float(n)}
+        return out
+
+    def overlap_report(
+        self,
+        predicted: Optional[dict[str, dict[str, float]]] = None,
+    ) -> dict:
+        """Decompose the window's ``device`` bucket into compute /
+        exposed-comm / overlapped-comm per program family (ROADMAP item
+        4's *realized overlap* signal).
+
+        ``predicted`` maps family → ``{"compute_s", "comm_s"}``
+        PER-DISPATCH costmodel predictions; each is scaled by the
+        family's window dispatch count before
+        :func:`~.commscope.decompose_overlap` splits that family's
+        measured device seconds. Families without a prediction count as
+        pure compute — comm seconds are never invented. The parts sum
+        back to the device bucket exactly (exposed comm books under
+        ``device``, never ``telemetry``), so :meth:`reconcile` is
+        untouched by construction.
+        """
+        from .commscope import decompose_overlap
+
+        fams = self.device_families()
+        device = self.window_buckets().get("device", 0.0)
+        predicted = predicted or {}
+        families: dict[str, dict] = {}
+        tot = {"compute_s": 0.0, "exposed_comm_s": 0.0,
+               "overlapped_comm_s": 0.0}
+        attributed = 0.0
+        pred_comm = 0.0
+        for fam, rec in sorted(fams.items()):
+            d_s, calls = rec["seconds"], int(rec["calls"])
+            p = predicted.get(fam)
+            scale = calls if calls > 0 else 1
+            c_s = (p.get("compute_s", 0.0) * scale) if p else d_s
+            k_s = (p.get("comm_s", 0.0) * scale) if p else 0.0
+            dec = decompose_overlap(d_s, c_s, k_s)
+            families[fam] = {
+                "device_s": d_s,
+                "calls": calls,
+                "predicted_compute_s": c_s if p else None,
+                "predicted_comm_s": k_s if p else None,
+                **dec,
+            }
+            attributed += d_s
+            pred_comm += k_s
+            for k in tot:
+                tot[k] += dec[k]
+        overlapped = tot["overlapped_comm_s"]
+        return {
+            "families": families,
+            "device_s": device,
+            "attributed_s": attributed,
+            "residual_s": device - attributed,
+            **tot,
+            "exposed_comm_share": (
+                tot["exposed_comm_s"] / device if device > 0 else 0.0
+            ),
+            "realized_overlap_ratio": (
+                overlapped / pred_comm if pred_comm > 0 else None
+            ),
         }
 
     def totals(self) -> dict[str, float]:

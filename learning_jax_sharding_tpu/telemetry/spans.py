@@ -57,10 +57,17 @@ class Tracer:
     the most recent window, not the run's first minutes.
     """
 
-    def __init__(self, *, enabled: bool = True, max_events: int = 200_000):
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        max_events: int = 200_000,
+        name: str | None = None,
+    ):
         import collections
 
         self.enabled = enabled
+        self.name = name or "tracer"
         self.dropped = 0
         self.sink_errors = 0   # on_event sink raises (counted, not fatal)
         self._events: "collections.deque[dict]" = collections.deque(
@@ -69,7 +76,15 @@ class Tracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._t0 = time.perf_counter()
-        self._pid = os.getpid()
+        # Deterministic ids: the OS pid and raw thread idents change per
+        # run, which made merged fleet timelines interleave replicas
+        # nondeterministically in Perfetto. Events carry pid 1 and small
+        # first-seen thread indexes; the real OS pid survives in the
+        # process-name metadata (`chrome_trace`), and `merge_tracers`
+        # re-pids per replica.
+        self._pid = 1
+        self._os_pid = os.getpid()
+        self._tid_of: dict[int, int] = {}
         self._max_events = max_events
         # Optional event sink (``FlightRecorder.attach_tracer`` sets it):
         # called with each emitted event dict, outside the ring lock. A
@@ -97,13 +112,29 @@ class Tracer:
                 # recorder attachment is visible in the tracer's state.
                 self.sink_errors += 1
 
+    def _tid(self) -> int:
+        """Stable small tid for the calling thread: 1, 2, ... in
+        first-seen order — deterministic for single-threaded loops
+        (always 1), and never a raw ident that reshuffles every run."""
+        ident = threading.get_ident()
+        t = self._tid_of.get(ident)
+        if t is None:
+            with self._lock:
+                t = self._tid_of.setdefault(ident, len(self._tid_of) + 1)
+        return t
+
+    def thread_ids(self) -> list[int]:
+        """Assigned tids, sorted — for thread-name metadata emission."""
+        with self._lock:
+            return sorted(self._tid_of.values())
+
     def _base(self, name: str, ph: str, **extra) -> dict:
         ev = {
             "name": name,
             "ph": ph,
             "ts": self._now_us(),
             "pid": self._pid,
-            "tid": threading.get_ident(),
+            "tid": self._tid(),
         }
         ev.update(extra)
         return ev
@@ -204,10 +235,31 @@ class Tracer:
             self._events.clear()
             self.dropped = 0
 
+    def metadata_events(self, *, pid: int | None = None) -> list[dict]:
+        """Chrome ``M``-phase name rows for this tracer's process and
+        threads — deterministic content, so exported traces diff cleanly
+        run-to-run (the real OS pid rides in args, not in the ids)."""
+        pid = self._pid if pid is None else pid
+        rows = [{
+            "name": "process_name", "ph": "M", "ts": 0.0, "pid": pid,
+            "tid": 0,
+            "args": {"name": self.name, "os_pid": self._os_pid},
+        }]
+        rows.extend(
+            {
+                "name": "thread_name", "ph": "M", "ts": 0.0, "pid": pid,
+                "tid": t,
+                "args": {"name": f"thread {t}"},
+            }
+            for t in self.thread_ids()
+        )
+        return rows
+
     def chrome_trace(self) -> dict:
-        """Perfetto/chrome://tracing-loadable trace object."""
+        """Perfetto/chrome://tracing-loadable trace object, metadata
+        (process/thread names) first."""
         return {
-            "traceEvents": self.events,
+            "traceEvents": self.metadata_events() + self.events,
             "displayTimeUnit": "ms",
             "otherData": {"dropped_events": self.dropped},
         }

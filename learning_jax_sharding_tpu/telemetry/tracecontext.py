@@ -350,9 +350,27 @@ def merge_tracers(
     events: list[dict] = []
     for i, (name, tr) in enumerate(sorted(tracers.items())):
         pid = i + 1
+        # Deterministic track identity: pid from the sorted replica-name
+        # order, process_sort_index matching it, and the tracer's own
+        # metadata rows (process/thread names; tids are the tracer's
+        # small first-seen indexes, not raw thread idents) — so the
+        # merged fleet timeline sorts identically across runs in
+        # Perfetto instead of interleaving by OS-assigned ids.
+        meta = getattr(tr, "metadata_events", None)
+        if meta is not None:
+            rows = meta(pid=pid)
+            for row in rows:
+                if row["name"] == "process_name":
+                    row["args"] = dict(row["args"], name=f"replica {name}")
+            events.extend(rows)
+        else:
+            events.append({
+                "name": "process_name", "ph": "M", "ts": 0.0, "pid": pid,
+                "tid": 0, "args": {"name": f"replica {name}"},
+            })
         events.append({
-            "name": "process_name", "ph": "M", "pid": pid,
-            "args": {"name": f"replica {name}"},
+            "name": "process_sort_index", "ph": "M", "ts": 0.0, "pid": pid,
+            "tid": 0, "args": {"sort_index": i},
         })
         off_us = (t0s[name] - base) * 1e6
         for ev in tr.events:

@@ -505,7 +505,7 @@ def fit(
                 # the first iteration (and any mid-run recompile) paid a
                 # trace+compile, not a device step.
                 cache_before = cache_size(step_fn.jitted)
-                with led.measure("device") as frame, \
+                with led.measure("device", family="train_step") as frame, \
                         tr.span("train_step", step=i + 1), hb:
                     state, loss = step_fn(state, batch)
                     loss, gnorm = (

@@ -160,6 +160,27 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
     # what XLA actually allocates, which is the OOM-gate's accuracy.
     (re.compile(r"memflow err ([\d,.]+)%"),
      "memflow_predicted_vs_measured_pct", False),
+    # Round-19 commscope gates (bench.py's `[bench] commscope ...`
+    # lines): per-axis measured link bandwidth from the calibration
+    # ladder (higher — the fitted β dropping means dispatch overheads
+    # crept into the collectives themselves); `comm fit err` is the
+    # α–β model's worst-cell error against its own ladder (lower);
+    # `exposed comm` is the share of the serving window's device
+    # seconds NOT hidden behind compute (lower — the overlap goal);
+    # `comm prediction err` is the calibrated costmodel's serial
+    # prediction vs the measured device bucket (lower; phrased
+    # distinctly from `model err` / `layout err` / `memflow err` so
+    # the four analyzer gates never double-match one line). The
+    # `overlap ratio` on the same line is deliberately NOT gated:
+    # overlapping more or less comm is a scheduling outcome, not
+    # monotonic goodness.
+    (re.compile(r"axis bandwidth ([\d,.]+)\s*GB/s"),
+     "comm_axis_bandwidth_gb_s", True),
+    (re.compile(r"comm fit err ([\d,.]+)%"), "comm_fit_err_pct", False),
+    (re.compile(r"exposed comm ([\d,.]+)% of device"),
+     "exposed_comm_share_pct", False),
+    (re.compile(r"comm prediction err ([\d,.]+)%"),
+     "comm_model_err_pct", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""commscope: calibrate per-axis collective link profiles by measuring.
+
+Runs the telemetry/commscope.py calibration ladder — timed
+micro-collectives (psum / all-gather / reduce-scatter / ppermute) per
+mesh axis across a byte-size sweep, latency-cancelled via
+``utils.bench.time_fn`` — fits a per-axis α–β model
+``t = α + wire_bytes / β``, and persists the result as versioned JSON
+(``CommProfile``). The saved profile feeds
+``costmodel.calibrate_axis_profiles`` (measured pricing with the pinned
+table as fallback), ``engine.comm_report()``, and the checked-in
+reference under ``analysis/profiles/``.
+
+Usage::
+
+    python scripts/commscope.py                      # 2x4 emulated mesh
+    python scripts/commscope.py --mesh 4x2 --json
+    python scripts/commscope.py --out analysis/profiles/my_profile.json
+    python scripts/commscope.py --sizes 131072,1048576 --ops psum,ppermute
+
+Emulated-CPU caveat (printed with the profile): on a host-emulated mesh
+every "link" is a memcpy through one shared memory system, so the
+fitted β is host memory bandwidth and axes look near-identical. The
+instrument is still honest — it measures what dispatches cost HERE —
+but chip-class numbers require real hardware.
+
+Exit codes: 0 profile fitted and saved, 2 bad arguments /
+infrastructure error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+
+def _parse_mesh(text: str):
+    try:
+        shape = tuple(int(p) for p in text.lower().split("x"))
+    except ValueError:
+        shape = ()
+    if not shape or any(s < 1 for s in shape):
+        raise SystemExit(
+            f"commscope: --mesh must look like 2x4 (data x model), "
+            f"got {text!r}"
+        )
+    return shape
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mesh", default="2x4",
+                    help="mesh shape, data x model (default 2x4)")
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated ladder ops (default: "
+                    "psum,all_gather,reduce_scatter,ppermute)")
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated per-device buffer bytes for the "
+                    "sweep (default: 32KiB..8MiB, 5 points)")
+    ap.add_argument("--min-time", type=float, default=0.05,
+                    help="per-cell minimum timed window, seconds")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="time_fn repeats per cell (median taken)")
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default: "
+                    "analysis/profiles/comm_profile_<platform>_<shape>"
+                    ".json)")
+    ap.add_argument("--no-measurements", action="store_true",
+                    help="drop raw ladder records from the saved JSON "
+                    "(keeps only the fitted per-axis profiles)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    shape = _parse_mesh(args.mesh)
+    ndev = 1
+    for s in shape:
+        ndev *= s
+    try:
+        force_emulated_devices(ndev)
+    except RuntimeError as e:  # backend already initialized differently
+        print(f"commscope: {e}", file=sys.stderr)
+        return 2
+
+    from learning_jax_sharding_tpu.parallel import build_mesh
+    from learning_jax_sharding_tpu.telemetry import commscope
+
+    axis_names = ("data", "model")[: len(shape)] if len(shape) <= 2 else \
+        tuple(f"ax{i}" for i in range(len(shape)))
+    mesh = build_mesh(shape, axis_names)
+
+    kwargs: dict = {
+        "min_time": args.min_time, "repeats": args.repeats,
+    }
+    if args.ops:
+        kwargs["ops"] = tuple(args.ops.split(","))
+    if args.sizes:
+        kwargs["sizes_bytes"] = tuple(
+            int(float(s)) for s in args.sizes.split(",")
+        )
+
+    t0 = time.perf_counter()
+    measurements = commscope.run_ladder(mesh, **kwargs)
+    profile = commscope.fit_profile(
+        mesh, measurements,
+        keep_measurements=not args.no_measurements,
+        created_unix=time.time(),
+    )
+    wall = time.perf_counter() - t0
+    errs = commscope.fit_errors(profile.axes, measurements)
+    path = profile.save(args.out)
+
+    if args.json:
+        print(json.dumps({
+            "path": str(path),
+            "wall_seconds": round(wall, 2),
+            "fit_errors_pct": {a: round(e, 2) for a, e in errs.items()},
+            "profile": profile.to_dict(),
+        }, indent=2))
+        return 0
+    print(f"commscope: {len(measurements)} ladder cells on "
+          f"{'x'.join(str(s) for s in shape)} {profile.platform} mesh "
+          f"in {wall:.1f}s -> {path}")
+    for axis, ap_ in sorted(profile.axes.items()):
+        print(f"[comm] axis {axis} (n={ap_.n_devices}): "
+              f"alpha {ap_.alpha_s * 1e6:.1f} us, "
+              f"beta {ap_.beta_bytes_per_s / 1e9:.2f} GB/s "
+              f"(r2 {ap_.r2:.3f}, {ap_.points} cells, "
+              f"worst fit err {errs.get(axis, 0.0):.1f}%)")
+    if profile.platform == "cpu":
+        print("[comm] note: emulated-CPU mesh — β is host memcpy "
+              "bandwidth, not an interconnect; axes will look alike")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

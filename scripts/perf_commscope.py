@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Commscope bench leg: measured link profiles + realized overlap (round 19).
+
+Two instruments in one ladder, both feeding ``bench_compare.py`` gates:
+
+1. **Calibration** — run a reduced commscope ladder on the 8-device
+   emulated mesh, fit per-axis α–β link profiles, and print one
+   ``[bench] commscope axis ...`` line per axis (bandwidth, α, worst
+   fit error). The fit error is asserted under the per-axis ceilings
+   pinned in ``analysis/baseline.json`` (``commscope_tolerance_pct``).
+
+2. **Attribution** — drive one saturated serving window with per-family
+   device accounting armed, then read
+   ``engine.comm_report(comm_profile=...)``: the goodput ledger's
+   device bucket decomposed into compute / exposed-comm /
+   overlapped-comm per program family under the MEASURED profile's
+   predictions. Prints the ``[bench] commscope overlap ...`` line
+   (exposed-comm share, realized overlap ratio, comm model error) and
+   asserts the decomposition sums back to the device bucket exactly
+   (the ledger's reconciliation invariant, extended).
+
+Emulated-CPU caveat (PERF.md round 19): the "links" are memcpys through
+one shared host memory system, so β is memcpy bandwidth and the fit
+errors run far above what a real interconnect shows — the ceilings in
+baseline.json are sized for that, and the chip-class numbers land when
+this ladder runs on real hardware.
+
+Usage:
+    python scripts/perf_commscope.py [--bench-lines] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+NREQ, NEW = 32, 24
+
+#: Reduced ladder (3 ops x 3 sizes per axis) — enough spread to fit α–β
+#: while the whole leg stays sub-minute on the emulated mesh.
+LADDER_OPS = ("psum", "all_gather", "ppermute")
+LADDER_SIZES = (1 << 16, 1 << 19, 1 << 22)
+
+
+def _build():
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+    from learning_jax_sharding_tpu.parallel import build_mesh
+    from learning_jax_sharding_tpu.parallel.logical import (
+        RULES_DP_TP,
+        activate,
+        tree_shardings,
+    )
+
+    cfg = dataclasses.replace(
+        CONFIG_TINY, dtype=jnp.float32, features=256, hidden=1024,
+        num_layers=4, head_dim=64,
+    )
+    mesh = build_mesh((2, 4), ("data", "model"))
+    model = Transformer(cfg)
+    # Params BORN SHARDED under the serving rules: the shardflow
+    # predictions read shardings off the committed argument leaves, so
+    # replicated host params would price every program at zero comm.
+    probe = np.zeros((2, 8), np.int32)
+
+    def init(r, t):
+        return model.init({"params": r}, t)
+
+    with activate(mesh, RULES_DP_TP):
+        abstract = jax.eval_shape(init, jax.random.key(0), probe)
+        shardings = tree_shardings(abstract, mesh, RULES_DP_TP)
+        params = jax.jit(
+            lambda r, t: nn.meta.unbox(init(r, t)),
+            out_shardings=shardings,
+        )(jax.random.key(0), probe)["params"]
+    rng = np.random.default_rng(19)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=NREQ)
+    ]
+    return cfg, mesh, params, prompts
+
+
+def _drive(eng, params, prompts):
+    for p in prompts:
+        eng.add_request(p)
+    while eng.has_work():
+        eng.step(params)
+    eng.pop_finished()
+
+
+def _tolerances() -> dict:
+    p = _REPO / "learning_jax_sharding_tpu" / "analysis" / "baseline.json"
+    if p.exists():
+        return json.loads(p.read_text()).get("commscope_tolerance_pct", {})
+    return {}
+
+
+def run() -> dict:
+    from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.telemetry import commscope
+
+    cfg, mesh, params, prompts = _build()
+
+    comm_profile = commscope.calibrate_mesh(
+        mesh, ops=LADDER_OPS, sizes_bytes=LADDER_SIZES,
+    )
+    fit_errs = commscope.fit_errors(
+        comm_profile.axes, comm_profile.measurements,
+    )
+
+    eng = ContinuousEngine(
+        cfg, mesh, RULES_DP_TP, batch_size=4, max_new_tokens=NEW,
+        refill_chunk=16, decode_block_steps=16, mixed=True,
+    )
+    _drive(eng, params, prompts[:4])            # warm: compiles excluded
+    eng.ledger.begin_window()
+    _drive(eng, params, prompts)
+    rec = eng.ledger.reconcile()
+    assert rec["ok"], f"ledger failed to reconcile: {rec}"
+    report = eng.comm_report(comm_profile=comm_profile)
+    overlap = report["overlap"]
+
+    # The extended invariant: per family AND in total, the decomposition
+    # must sum back to the measured device bucket exactly.
+    for fam, row in overlap["families"].items():
+        total = (row["compute_s"] + row["exposed_comm_s"]
+                 + row["overlapped_comm_s"])
+        assert abs(total - row["device_s"]) < 1e-9, (
+            f"overlap decomposition leaks for {fam!r}: "
+            f"{total} != {row['device_s']}"
+        )
+    assert abs(overlap["attributed_s"] + overlap["residual_s"]
+               - overlap["device_s"]) < 1e-9, "family attribution leaks"
+
+    # Comm model error: calibrated serial prediction (compute + comm)
+    # vs the measured device bucket, over families with predictions.
+    priced = [r for r in overlap["families"].values()
+              if r["predicted_comm_s"] is not None]
+    pred = sum(r["predicted_compute_s"] + r["predicted_comm_s"]
+               for r in priced)
+    dev = sum(r["device_s"] for r in priced)
+    model_err = abs(pred - dev) / dev * 100.0 if dev > 0 else 0.0
+    return {
+        "profile": {
+            a: {"alpha_us": ap.alpha_s * 1e6,
+                "beta_gb_s": ap.beta_bytes_per_s / 1e9,
+                "r2": ap.r2,
+                "fit_err_pct": fit_errs.get(a, 0.0)}
+            for a, ap in sorted(comm_profile.axes.items())
+        },
+        "overlap": overlap,
+        "model_err_pct": model_err,
+        "exposed_share_pct": overlap["exposed_comm_share"] * 100.0,
+        "overlap_ratio": overlap["realized_overlap_ratio"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--bench-lines", action="store_true",
+                    help="print only the [bench] lines (for bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    res = run()
+    lines = []
+    for axis, ap_ in res["profile"].items():
+        lines.append(
+            f"[bench] commscope axis {axis} (8-dev emulated): "
+            f"axis bandwidth {ap_['beta_gb_s']:.3f} GB/s, "
+            f"alpha {ap_['alpha_us']:.1f} us, "
+            f"comm fit err {ap_['fit_err_pct']:.1f}%"
+        )
+    ratio = res["overlap_ratio"]
+    lines.append(
+        f"[bench] commscope overlap (8-dev emulated): "
+        f"exposed comm {res['exposed_share_pct']:.2f}% of device, "
+        f"overlap ratio "
+        f"{ratio * 100.0 if ratio is not None else 0.0:.1f}%, "
+        f"comm prediction err {res['model_err_pct']:.1f}%"
+    )
+    if args.json:
+        print(json.dumps(res, indent=2, default=float))
+    else:
+        for ln in lines:
+            print(ln)
+
+    # The gate: the α–β fit must hold its own ladder within the per-axis
+    # ceilings baseline.json pins for this (emulated) platform.
+    tol = _tolerances()
+    default_tol = tol.get("_default")
+    for axis, ap_ in res["profile"].items():
+        ceiling = tol.get(axis, default_tol)
+        if ceiling is not None:
+            assert ap_["fit_err_pct"] <= float(ceiling), (
+                f"commscope fit err {ap_['fit_err_pct']:.1f}% on axis "
+                f"{axis!r} breaches the {float(ceiling):.0f}% baseline "
+                "ceiling"
+            )
+    if not args.bench_lines and not args.json:
+        print("perf_commscope: fit within baseline ceilings, "
+              "decomposition reconciles with the device bucket")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,15 @@ against ``compiled.memory_analysis()`` under the tolerances pinned in
 peaks over ``--memory-budget-bytes x --headroom`` — OOM as a
 pre-compile review finding at the peak-owning buffer's source line.
 
+``--comm`` adds the commscope pass (``telemetry/commscope.py``): time a
+reduced calibration ladder of micro-collectives on the emulated mesh,
+fit per-axis α–β link profiles (gated against ``baseline.json``'s
+``commscope_tolerance_pct``), and print every entry point's per-line
+predicted collective seconds under the pinned table NEXT TO the
+measured profile — the static/measured reconciliation for comm cost.
+On emulated-CPU hosts the "links" are memcpys, so the fit measures
+host memory bandwidth; the reconciliation still gates.
+
 ``--timings`` prints the per-program-family wall-clock breakdown
 (train / zero1 / serving / engine / kv / reshard / ops), so the next
 budget creep is attributable to a family instead of re-justified blind.
@@ -89,7 +98,7 @@ PASSES = ("contracts", "jaxpr", "ast", "shardflow")
 
 #: Opt-in passes selectable with --pass but not part of the default
 #: (budgeted) full run.
-EXTRA_PASSES = ("memory",)
+EXTRA_PASSES = ("memory", "comm")
 
 
 def _family(name: str) -> str:
@@ -161,6 +170,15 @@ def main(argv: list[str] | None = None) -> int:
         "compiled.memory_analysis() and gated against the HBM budget",
     )
     ap.add_argument(
+        "--comm", action="store_true",
+        help="also run the commscope pass: time a reduced calibration "
+        "ladder on the emulated mesh, fit per-axis α–β link profiles "
+        "gated against baseline.json's commscope_tolerance_pct, and "
+        "print each entry point's per-line pinned-prediction vs "
+        "measured-profile collective seconds (opt-in — the ladder "
+        "times real dispatches, so it stays out of the budgeted run)",
+    )
+    ap.add_argument(
         "--memory-budget-bytes", type=float, default=None,
         help="per-device HBM budget for the memflow pass (default: "
         "utils.memory.device_hbm_bytes(), which is None on emulated-CPU "
@@ -201,8 +219,10 @@ def main(argv: list[str] | None = None) -> int:
         passes = passes + ("shardflow",)
     if args.memory and "memory" not in passes:
         passes = passes + ("memory",)
+    if args.comm and "comm" not in passes:
+        passes = passes + ("comm",)
     needs_mesh = args.update_golden or args.optimize or (
-        {"contracts", "jaxpr", "shardflow", "memory"} & set(passes)
+        {"contracts", "jaxpr", "shardflow", "memory", "comm"} & set(passes)
     )
     if needs_mesh:
         try:
@@ -216,6 +236,7 @@ def main(argv: list[str] | None = None) -> int:
         GOLDEN_DIR,
         report_findings,
         run_ast_pass,
+        run_comm_pass,
         run_contract_pass,
         run_jaxpr_pass,
         run_memflow_pass,
@@ -253,7 +274,7 @@ def main(argv: list[str] | None = None) -> int:
     # per-program caches hold each built state/step and its single AOT
     # compile, so contracts + jaxpr don't pay the compiles twice.
     programs = None
-    if {"contracts", "jaxpr", "shardflow"} & set(passes):
+    if {"contracts", "jaxpr", "shardflow", "comm"} & set(passes):
         from learning_jax_sharding_tpu.analysis.entrypoints import (
             build_entry_programs,
         )
@@ -268,6 +289,7 @@ def main(argv: list[str] | None = None) -> int:
     program_seconds: dict[str, float] = {}
     shardflow_reports: list[dict] = []
     memory_reports: list[dict] = []
+    comm_report: dict = {}
     for name in passes:
         tp = time.perf_counter()
         if name == "contracts":
@@ -295,6 +317,12 @@ def main(argv: list[str] | None = None) -> int:
                 program_seconds=program_seconds,
             )
             findings += mf_findings
+        elif name == "comm":
+            cm_findings, comm_report = run_comm_pass(
+                names=args.only, baseline=baseline, programs=programs,
+                program_seconds=program_seconds,
+            )
+            findings += cm_findings
         else:
             findings += run_ast_pass(_REPO, baseline=baseline)
         timings[name] = time.perf_counter() - tp
@@ -367,6 +395,8 @@ def main(argv: list[str] | None = None) -> int:
         doc["shardflow"] = shardflow_reports
     if memory_reports:
         doc["memory"] = memory_reports
+    if comm_report:
+        doc["comm"] = comm_report
     if args.optimize:
         doc["optimize"] = advisories
     family_seconds: dict[str, float] = {}
@@ -383,8 +413,14 @@ def main(argv: list[str] | None = None) -> int:
     import os
 
     if os.environ.get("LJST_ARTIFACT_DIR"):
-        out = artifact_dir("shardcheck") / "shardcheck.json"
-        out.write_text(json.dumps(doc, indent=2))
+        adir = artifact_dir("shardcheck")
+        (adir / "shardcheck.json").write_text(json.dumps(doc, indent=2))
+        if comm_report:
+            # The fitted profile stands alone too, loadable back through
+            # CommProfile.load for reuse outside this run.
+            (adir / "comm_profile.json").write_text(
+                json.dumps(comm_report["profile"], indent=2,
+                           sort_keys=True) + "\n")
     if args.json:
         print(json.dumps(doc, indent=2))
     else:
@@ -415,6 +451,21 @@ def main(argv: list[str] | None = None) -> int:
                          f"{rc['measured_bytes'] / 2**20:.2f} MiB "
                          f"({rc['signed_err_pct']:+.1f}%)")
             print(line)
+        if comm_report:
+            for axis, ap in sorted(comm_report["profile"]["axes"].items()):
+                err = comm_report["fit_errors_pct"].get(axis, 0.0)
+                print(f"[comm] axis {axis} (n={ap['n_devices']}): "
+                      f"alpha {ap['alpha_s'] * 1e6:.1f} us, "
+                      f"beta {ap['beta_bytes_per_s'] / 1e9:.2f} GB/s "
+                      f"(r2 {ap['r2']:.3f}, worst fit err {err:.1f}%)")
+            for pr in comm_report["programs"]:
+                print(f"[comm] {pr['name']}: predicted comm "
+                      f"{pr['pinned_s'] * 1e3:.3f} ms pinned-table vs "
+                      f"{pr['measured_s'] * 1e3:.3f} ms measured-profile")
+                for ln in pr["lines"][:5]:
+                    print(f"[comm]   {ln['where']}: "
+                          f"{ln['pinned_s'] * 1e3:.3f} -> "
+                          f"{ln['measured_s'] * 1e3:.3f} ms")
         if args.timings:
             attributed = sum(family_seconds.values())
             print(f"[timings] {attributed:.1f}s of {wall:.1f}s wall "

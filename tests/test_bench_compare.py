@@ -373,6 +373,64 @@ class TestExtraction:
         assert not by["memflow_summary"
                       ":memflow_predicted_vs_measured_pct"]["regressed"]
 
+    def test_commscope_gates_direction_aware(self):
+        """The round-19 commscope gates: per-axis measured bandwidth
+        regresses DOWN (higher is better), while the fit error, the
+        exposed-comm share, and the calibrated prediction error all
+        regress UP. `comm prediction err` must not ride shardflow's
+        `model err` pattern, and the overlap ratio on the same line is
+        deliberately ungated (a scheduling outcome, not monotonic)."""
+        lines = [
+            "[bench] commscope axis data (8-dev emulated): "
+            "axis bandwidth 0.290 GB/s, alpha 1440.5 us, "
+            "comm fit err 128.4%",
+            "[bench] commscope overlap (8-dev emulated): "
+            "exposed comm 58.65% of device, overlap ratio 11.6%, "
+            "comm prediction err 423.1%",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        assert m["commscope_axis_data_(8-dev_emulated)"
+                 ":comm_axis_bandwidth_gb_s"] == (0.290, True)
+        assert m["commscope_axis_data_(8-dev_emulated)"
+                 ":comm_fit_err_pct"] == (128.4, False)
+        assert m["commscope_overlap_(8-dev_emulated)"
+                 ":exposed_comm_share_pct"] == (58.65, False)
+        assert m["commscope_overlap_(8-dev_emulated)"
+                 ":comm_model_err_pct"] == (423.1, False)
+        # the overlap ratio stays ungated, and the prediction error
+        # never double-matches the shardflow `model err` gate
+        assert not any("overlap_ratio" in k for k in m)
+        assert not any(
+            k.endswith(":predicted_vs_measured_pct") for k in m
+        )
+        worse = _doc([
+            lines[0].replace("axis bandwidth 0.290", "axis bandwidth 0.100")
+                    .replace("comm fit err 128.4%", "comm fit err 200.0%"),
+            lines[1].replace("exposed comm 58.65%", "exposed comm 80.00%")
+                    .replace("comm prediction err 423.1%",
+                             "comm prediction err 900.0%"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["commscope_axis_data_(8-dev_emulated)"
+                  ":comm_axis_bandwidth_gb_s"]["regressed"]
+        assert by["commscope_axis_data_(8-dev_emulated)"
+                  ":comm_fit_err_pct"]["regressed"]
+        assert by["commscope_overlap_(8-dev_emulated)"
+                  ":exposed_comm_share_pct"]["regressed"]
+        assert by["commscope_overlap_(8-dev_emulated)"
+                  ":comm_model_err_pct"]["regressed"]
+        better = _doc([
+            lines[0].replace("axis bandwidth 0.290", "axis bandwidth 0.500"),
+            lines[1].replace("exposed comm 58.65%", "exposed comm 20.00%"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), better, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert not by["commscope_axis_data_(8-dev_emulated)"
+                      ":comm_axis_bandwidth_gb_s"]["regressed"]
+        assert not by["commscope_overlap_(8-dev_emulated)"
+                      ":exposed_comm_share_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

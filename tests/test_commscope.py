@@ -1,0 +1,413 @@
+"""Comm observatory units (round 19, ``telemetry/commscope.py``).
+
+Pure algebra pinned exactly — the α–β fit on noiseless synthetic
+timings, profile JSON round-trip + version gating, the proportional
+measured-seconds attribution, and the overlap decomposition's
+sums-back-to-device invariant (both standalone and through the goodput
+ledger) — plus the costmodel's calibrated-axis pricing path with its
+pinned-table fallback, and one small REAL ladder integration on the
+emulated mesh (feasible since the ladder syncs every call)."""
+
+import json
+
+import pytest
+
+from learning_jax_sharding_tpu.analysis import costmodel
+from learning_jax_sharding_tpu.analysis.shardflow import (
+    CommEvent,
+    ShardflowReport,
+)
+from learning_jax_sharding_tpu.telemetry import commscope
+from learning_jax_sharding_tpu.telemetry.commscope import (
+    AxisProfile,
+    CommProfile,
+    attribute_measured_seconds,
+    decompose_overlap,
+    fit_alpha_beta,
+    fit_axis_profiles,
+    fit_errors,
+    wire_bytes,
+)
+from learning_jax_sharding_tpu.telemetry.ledger import GoodputLedger
+from learning_jax_sharding_tpu.telemetry.registry import MetricsRegistry
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, s):
+        self.t += s
+
+
+# --- wire volumes and the α–β fit -----------------------------------------
+
+
+class TestFit:
+    def test_wire_bytes_ring_volumes(self):
+        b = 1024.0
+        assert wire_bytes("psum", 4, b) == pytest.approx(2 * b * 3 / 4)
+        assert wire_bytes("all_gather", 4, b) == pytest.approx(3 * b)
+        assert wire_bytes("reduce_scatter", 4, b) == pytest.approx(b * 3 / 4)
+        assert wire_bytes("ppermute", 4, b) == pytest.approx(b)
+        # a 1-device axis runs no collective at all
+        for op in commscope.LADDER_OPS:
+            assert wire_bytes(op, 1, b) == 0.0
+        with pytest.raises(ValueError):
+            wire_bytes("all_to_nowhere", 4, b)
+
+    def test_fit_recovers_exact_alpha_beta(self):
+        """Noiseless t = α + w/β must round-trip through the fit."""
+        alpha, beta = 5e-6, 2.5e9
+        pts = [(w, alpha + w / beta)
+               for w in (1e4, 1e5, 1e6, 1e7)]
+        a, b, r2 = fit_alpha_beta(pts)
+        assert a == pytest.approx(alpha, rel=1e-9)
+        assert b == pytest.approx(beta, rel=1e-9)
+        assert r2 == pytest.approx(1.0)
+
+    def test_fit_clamps_negative_intercept_to_zero(self):
+        # bandwidth-only data with a negative LSQ intercept: α clamps,
+        # β stays positive
+        pts = [(1e6, 1e-4), (2e6, 3e-4)]
+        a, b, _ = fit_alpha_beta(pts)
+        assert a == 0.0
+        assert b > 0
+        with pytest.raises(ValueError):
+            fit_alpha_beta([(1e6, 1e-4)])       # one point can't fit
+
+    def test_fit_axis_profiles_pools_ops_per_axis(self):
+        alpha, beta = 2e-6, 1e9
+        ms = []
+        for op in ("psum", "all_gather"):
+            for b in (1 << 16, 1 << 20):
+                w = wire_bytes(op, 4, float(b))
+                ms.append({"op": op, "axis": "model", "n": 4,
+                           "bytes": float(b), "wire_bytes": w,
+                           "seconds": alpha + w / beta})
+        profs = fit_axis_profiles(ms)
+        assert set(profs) == {"model"}
+        ap = profs["model"]
+        assert ap.points == 4 and ap.n_devices == 4
+        assert ap.alpha_s == pytest.approx(alpha, rel=1e-9)
+        assert ap.beta_bytes_per_s == pytest.approx(beta, rel=1e-9)
+        # a perfect fit reconciles at 0% everywhere
+        errs = fit_errors(profs, ms)
+        assert errs["model"] == pytest.approx(0.0, abs=1e-6)
+
+    def test_fit_errors_reports_worst_cell(self):
+        ap = AxisProfile(axis="data", alpha_s=0.0,
+                         beta_bytes_per_s=1e9, n_devices=2, points=2,
+                         r2=1.0)
+        ms = [
+            {"axis": "data", "wire_bytes": 1e6, "seconds": 1e-3},  # 0%
+            {"axis": "data", "wire_bytes": 1e6, "seconds": 2e-3},  # 50%
+        ]
+        errs = fit_errors({"data": ap}, ms)
+        assert errs["data"] == pytest.approx(50.0)
+
+
+# --- persisted profile -----------------------------------------------------
+
+
+class TestProfilePersistence:
+    def _profile(self):
+        return CommProfile(
+            platform="cpu", mesh_axes=("data", "model"),
+            mesh_shape=(2, 4),
+            axes={"data": AxisProfile(
+                axis="data", alpha_s=1e-6, beta_bytes_per_s=5e9,
+                n_devices=2, points=8, r2=0.99)},
+            measurements=[{"op": "psum", "axis": "data", "n": 2,
+                           "bytes": 4096.0, "wire_bytes": 4096.0,
+                           "seconds": 2e-6}],
+            created_unix=1e9,
+        )
+
+    def test_round_trip_preserves_everything(self, tmp_path):
+        p = self._profile()
+        path = p.save(tmp_path / "prof.json")
+        back = CommProfile.load(path)
+        assert back == p
+        assert back.version == commscope.PROFILE_VERSION
+        assert back.axis_alpha_beta() == (("data", 1e-6, 5e9),)
+
+    def test_version_mismatch_is_rejected(self, tmp_path):
+        d = self._profile().to_dict()
+        d["version"] = commscope.PROFILE_VERSION + 1
+        path = tmp_path / "future.json"
+        path.write_text(json.dumps(d))
+        with pytest.raises(ValueError, match="version"):
+            CommProfile.load(path)
+
+    def test_default_path_names_platform_and_shape(self):
+        p = self._profile()
+        assert p.default_path().name == "comm_profile_cpu_2x4.json"
+        assert p.default_path().parent == commscope.PROFILE_DIR
+
+    def test_checked_in_reference_profile_loads(self):
+        ref = commscope.PROFILE_DIR / "comm_profile_cpu_2x4.json"
+        prof = CommProfile.load(ref)
+        assert prof.platform == "cpu"
+        assert set(prof.axes) == {"data", "model"}
+        for ap in prof.axes.values():
+            assert ap.beta_bytes_per_s > 0
+
+
+# --- attribution algebra ---------------------------------------------------
+
+
+class TestAttribution:
+    def test_measured_seconds_split_proportionally(self):
+        attr = attribute_measured_seconds(
+            {"a.py:1": 3e-3, "b.py:2": 1e-3}, 8.0)
+        assert attr["a.py:1"]["measured_s"] == pytest.approx(6.0)
+        assert attr["b.py:2"]["measured_s"] == pytest.approx(2.0)
+        total = sum(a["measured_s"] for a in attr.values())
+        assert total == pytest.approx(8.0)   # nothing dropped
+
+    def test_zero_predictions_split_evenly(self):
+        attr = attribute_measured_seconds(
+            {"a.py:1": 0.0, "b.py:2": 0.0}, 4.0)
+        assert attr["a.py:1"]["measured_s"] == pytest.approx(2.0)
+        assert attr["b.py:2"]["measured_s"] == pytest.approx(2.0)
+        assert attribute_measured_seconds({}, 4.0) == {}
+
+    def test_line_report_pools_shared_lines(self):
+        ev = lambda where, nbytes: CommEvent(          # noqa: E731
+            kind="reduce", axes=("model",), bytes=nbytes, where=where,
+            primitive="dot_general", reason="t",
+            realizations=(("all-reduce", "model"),))
+        rep = ShardflowReport(
+            name="t", mesh_axes=["data", "model"], mesh_shape=[2, 4],
+            events=[ev("a.py:1", 1 << 20), ev("a.py:1", 1 << 20),
+                    ev("b.py:2", 1 << 20)],
+            flops=0, hbm_bytes=0, out_specs=[],
+        )
+        prof = costmodel.table_profile("TPU v5 lite")
+        rows = commscope.line_report(rep, prof, 3.0)
+        assert [r["where"] for r in rows] == ["a.py:1", "b.py:2"]
+        assert rows[0]["measured_s"] == pytest.approx(2.0)
+        assert rows[1]["measured_s"] == pytest.approx(1.0)
+        assert rows[0]["ops"] == ["all-reduce@model"]
+
+
+# --- overlap decomposition -------------------------------------------------
+
+
+class TestDecomposeOverlap:
+    @pytest.mark.parametrize("d,c,k", [
+        (10.0, 6.0, 2.0),    # comm fully exposed past compute
+        (10.0, 9.5, 2.0),    # partially exposed, partially hidden
+        (10.0, 12.0, 2.0),   # compute over-predicts: comm fully hidden
+        (10.0, 0.0, 15.0),   # comm over-predicts: capped at device
+        (10.0, 4.0, 0.0),    # no predicted comm: pure compute
+        (0.0, 1.0, 1.0),     # empty window
+    ])
+    def test_parts_always_sum_to_device(self, d, c, k):
+        dec = decompose_overlap(d, c, k)
+        total = (dec["compute_s"] + dec["exposed_comm_s"]
+                 + dec["overlapped_comm_s"])
+        assert total == pytest.approx(d)
+        assert all(dec[p] >= 0.0 for p in
+                   ("compute_s", "exposed_comm_s", "overlapped_comm_s"))
+
+    def test_exposed_is_device_minus_compute_capped_at_comm(self):
+        dec = decompose_overlap(10.0, 6.0, 2.0)
+        assert dec["exposed_comm_s"] == pytest.approx(2.0)
+        assert dec["overlapped_comm_s"] == pytest.approx(0.0)
+        assert dec["realized_overlap_ratio"] == pytest.approx(0.0)
+        dec = decompose_overlap(10.0, 9.5, 2.0)
+        assert dec["exposed_comm_s"] == pytest.approx(0.5)
+        assert dec["overlapped_comm_s"] == pytest.approx(1.5)
+        assert dec["realized_overlap_ratio"] == pytest.approx(0.75)
+
+    def test_no_predicted_comm_has_no_ratio(self):
+        assert decompose_overlap(5.0, 5.0, 0.0)[
+            "realized_overlap_ratio"] is None
+
+
+# --- the goodput ledger's per-family split ---------------------------------
+
+
+class TestLedgerOverlapReport:
+    def test_family_decomposition_sums_to_device_bucket(self):
+        clk = _Clock()
+        led = GoodputLedger(clock=clk)
+        with led.measure("device", family="decode_block"):
+            clk.tick(4.0)
+        with led.measure("device", family="decode_block"):
+            clk.tick(4.0)
+        with led.measure("device", family="first_refill"):
+            clk.tick(2.0)
+        with led.measure("device"):          # sync with no family tag
+            clk.tick(1.0)
+        rep = led.overlap_report(predicted={
+            # per-dispatch prediction: x2 dispatches = 6 compute + 1 comm
+            "decode_block": {"compute_s": 3.0, "comm_s": 0.5},
+        })
+        fams = rep["families"]
+        db = fams["decode_block"]
+        assert db["calls"] == 2
+        assert db["predicted_compute_s"] == pytest.approx(6.0)  # scaled
+        assert db["predicted_comm_s"] == pytest.approx(1.0)
+        assert db["exposed_comm_s"] == pytest.approx(1.0)
+        # no prediction → pure compute, predicted fields None (not 0)
+        fr = fams["first_refill"]
+        assert fr["predicted_comm_s"] is None
+        assert fr["compute_s"] == pytest.approx(2.0)
+        assert fr["exposed_comm_s"] == 0.0
+        # untagged frames stay visible under the "unattributed" family
+        assert rep["device_s"] == pytest.approx(11.0)
+        assert rep["attributed_s"] + rep["residual_s"] == pytest.approx(
+            rep["device_s"])
+        assert fams["unattributed"]["device_s"] == pytest.approx(1.0)
+        assert fams["unattributed"]["predicted_comm_s"] is None
+        for row in fams.values():
+            total = (row["compute_s"] + row["exposed_comm_s"]
+                     + row["overlapped_comm_s"])
+            assert total == pytest.approx(row["device_s"])
+        # and the ledger still reconciles — the split is a VIEW over the
+        # device bucket, not a new booking
+        assert led.reconcile()["ok"]
+
+    def test_exposed_comm_books_under_device_never_telemetry(self):
+        """The ledger invariant the goodput gate leans on: arming the
+        overlap view must not move a single second out of ``device`` —
+        exposed comm is a decomposition of device time, so the
+        ``telemetry`` bucket stays empty and the window's device total
+        is byte-identical before and after the report."""
+        clk = _Clock()
+        led = GoodputLedger(clock=clk)
+        with led.measure("device", family="mixed_step"):
+            clk.tick(3.0)
+        before = led.window_buckets()
+        rep = led.overlap_report(predicted={
+            "mixed_step": {"compute_s": 1.0, "comm_s": 5.0},
+        })
+        after = led.window_buckets()
+        assert rep["families"]["mixed_step"]["exposed_comm_s"] > 0
+        assert after["device"] == before["device"] == pytest.approx(3.0)
+        assert after.get("telemetry", 0.0) == 0.0
+        assert led.reconcile()["ok"]
+
+
+# --- calibrated pricing ----------------------------------------------------
+
+
+class TestCalibratedPricing:
+    def _event(self, axes=("model",), nbytes=1 << 20, op="all-reduce"):
+        return CommEvent(
+            kind="reduce", axes=axes, bytes=nbytes, where="x.py:1",
+            primitive="dot_general", reason="t",
+            realizations=((op, axes[0] if axes else "-"),))
+
+    def _comm_profile(self):
+        return CommProfile(
+            platform="cpu", mesh_axes=("data", "model"),
+            mesh_shape=(2, 4),
+            axes={"model": AxisProfile(
+                axis="model", alpha_s=1e-5, beta_bytes_per_s=1e9,
+                n_devices=4, points=4, r2=1.0)},
+        )
+
+    def test_calibrated_axis_prices_alpha_beta(self):
+        base = costmodel.table_profile("TPU v5 lite")
+        prof = costmodel.calibrate_axis_profiles(
+            self._comm_profile(), base=base)
+        ev = self._event()
+        wire = ev.bytes * 2 * 3 / 4          # all-reduce ring on n=4
+        got = costmodel.price_event(ev, prof, {"data": 2, "model": 4})
+        assert got == pytest.approx(1e-5 + wire / 1e9)
+        # the pinned table fallback prices the same event flat
+        flat = costmodel.price_event(ev, base, {"data": 2, "model": 4})
+        assert flat == pytest.approx(wire / base.link_bw)
+
+    def test_uncalibrated_axis_falls_back_to_table(self):
+        base = costmodel.table_profile("TPU v5 lite")
+        prof = costmodel.calibrate_axis_profiles(
+            self._comm_profile(), base=base)     # only "model" measured
+        ev = self._event(axes=("data",))
+        wire = ev.bytes * 2 * 1 / 2              # ring on n=2
+        got = costmodel.price_event(ev, prof, {"data": 2, "model": 4})
+        assert got == pytest.approx(wire / base.link_bw)
+
+    def test_calibration_preserves_base_profile_fields(self):
+        base = costmodel.table_profile("TPU v5 lite")
+        prof = costmodel.calibrate_axis_profiles(
+            self._comm_profile(), base=base)
+        assert prof.link_bw == base.link_bw
+        assert prof.peak_flops == base.peak_flops
+        assert prof.axis_profiles == (("model", 1e-5, 1e9),)
+
+    def test_calibrate_from_raw_ladder_records(self):
+        alpha, beta = 2e-6, 1e9
+        ms = []
+        for b in (1 << 16, 1 << 20):
+            w = wire_bytes("psum", 4, float(b))
+            ms.append({"op": "psum", "axis": "model", "n": 4,
+                       "bytes": float(b), "wire_bytes": w,
+                       "seconds": alpha + w / beta})
+        prof = costmodel.calibrate_axis_profiles(
+            ms, base=costmodel.table_profile("TPU v5 lite"))
+        (axis, a, b) = prof.axis_profiles[0]
+        assert axis == "model"
+        assert a == pytest.approx(alpha, rel=1e-6)
+        assert b == pytest.approx(beta, rel=1e-6)
+
+
+# --- registry export -------------------------------------------------------
+
+
+class TestGaugeExport:
+    def test_profile_and_exposed_gauges(self):
+        reg = MetricsRegistry()
+        prof = CommProfile(
+            platform="cpu", mesh_axes=("data",), mesh_shape=(2,),
+            axes={"data": AxisProfile(
+                axis="data", alpha_s=2e-6, beta_bytes_per_s=3e9,
+                n_devices=2, points=4, r2=1.0)},
+        )
+        commscope.export_profile_gauges(reg, prof)
+        commscope.export_exposed_gauges(
+            reg, "decode_block", 0.5, {"data": 0.8, "model": 0.2})
+        text = reg.prometheus_text()
+        assert 'comm_axis_bandwidth_bytes_per_s{axis="data"} 3' in text
+        assert 'comm_axis_alpha_seconds{axis="data"}' in text
+        assert ('comm_exposed_seconds_total{family="decode_block",'
+                'axis="data"} 0.4') in text
+        assert ('comm_exposed_seconds_total{family="decode_block",'
+                'axis="model"} 0.1') in text
+
+    def test_exposed_gauges_without_shares_use_placeholder_axis(self):
+        reg = MetricsRegistry()
+        commscope.export_exposed_gauges(reg, "first_refill", 0.25, {})
+        text = reg.prometheus_text()
+        assert ('comm_exposed_seconds_total{family="first_refill",'
+                'axis="-"} 0.25') in text
+
+
+# --- one real (tiny) ladder ------------------------------------------------
+
+
+class TestLadderIntegration:
+    def test_tiny_ladder_fits_a_profile(self, mesh22):
+        """One real timed cellset on the emulated mesh: 2 ops x 1 size
+        on one 2-device axis. Feasible at test budget because the
+        ladder syncs every call (the CPU rendezvous constraint) and
+        min_time is tiny; asserts structure, not speed."""
+        ms = commscope.run_ladder(
+            mesh22, ops=("psum", "ppermute"), sizes_bytes=(1 << 12, 1 << 14),
+            axes=("data",), min_time=0.0, repeats=1, warmup=1,
+        )
+        assert len(ms) == 4
+        assert all(m["seconds"] > 0 for m in ms)
+        assert all(m["wire_bytes"] > 0 for m in ms)
+        prof = commscope.fit_profile(mesh22, ms)
+        assert "data" in prof.axes
+        assert prof.axes["data"].n_devices == 2
+        back = CommProfile.from_dict(prof.to_dict())
+        assert back == prof

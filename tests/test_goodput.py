@@ -282,8 +282,20 @@ class TestTraceStore:
             extra_events=[{"name": "marker", "ph": "i", "ts": 0.0}],
         )
         ev = merged["traceEvents"]
-        names = {e["args"]["name"]: e["pid"] for e in ev if e["ph"] == "M"}
+        names = {
+            e["args"]["name"]: e["pid"]
+            for e in ev
+            if e["ph"] == "M" and e["name"] == "process_name"
+        }
         assert names == {"replica a": 1, "replica b": 2}
+        # Deterministic Perfetto ordering: each replica pid also carries
+        # a process_sort_index row matching its sorted-name rank.
+        sorts = {
+            e["pid"]: e["args"]["sort_index"]
+            for e in ev
+            if e["ph"] == "M" and e["name"] == "process_sort_index"
+        }
+        assert sorts == {1: 0, 2: 1}
         by_name = {e["name"]: e for e in ev if e["ph"] == "X"}
         # a's epoch is 2 s after b's: same local ts lands 2e6 µs later.
         assert by_name["y"]["ts"] == pytest.approx(
@@ -363,6 +375,30 @@ class TestEngineLedger:
         assert rep["host_share"] is not None and 0.0 < rep["host_share"] < 1.0
         assert rep["top_contributor"] in set(BUCKETS) - {"device"}
         assert rep["telemetry_share"] < 0.05
+
+    def test_exposed_comm_is_a_view_over_device_never_telemetry(
+        self, served
+    ):
+        """The round-19 overlap decomposition must be a pure VIEW: per
+        family it sums back to that family's measured device seconds,
+        the family totals cover the device bucket, and arming the view
+        moves nothing into ``telemetry`` (so ``reconcile()`` is
+        untouched by construction)."""
+        eng, _ = served
+        before = eng.ledger.window_buckets()
+        rep = eng.overlap_report()
+        assert rep["families"], "device seconds lost their family tags"
+        for fam, row in rep["families"].items():
+            total = (row["compute_s"] + row["exposed_comm_s"]
+                     + row["overlapped_comm_s"])
+            assert total == pytest.approx(row["device_s"]), (fam, row)
+        assert rep["attributed_s"] + rep["residual_s"] == pytest.approx(
+            rep["device_s"])
+        after = eng.ledger.window_buckets()
+        assert after["device"] == pytest.approx(before["device"])
+        assert after.get("telemetry", 0.0) == pytest.approx(
+            before.get("telemetry", 0.0))
+        assert eng.ledger.reconcile()["ok"]
 
 
 class TestChaosAttribution:

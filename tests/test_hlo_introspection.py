@@ -285,16 +285,61 @@ class TestRoutingAttributes:
     def test_variadic_all_gather_tuple_result(self):
         # Variadic all-gather: several operands gathered in one
         # instruction, tuple-typed result holding EVERY output buffer.
-        # bytes is the largest (bf16[8,16] = 256 > f32[8,4] = 128).
+        # The instruction moves ALL of them, so bytes is the SUM
+        # (f32[8,4] = 128 plus bf16[8,16] = 256) — taking only the
+        # largest undercounted multi-operand gathers, and commscope's
+        # per-line attribution keys on this volume.
         hlo = """
   %ag = (f32[8,4]{1,0}, bf16[8,16]{1,0}) all-gather(f32[4,4]{1,0} %a, bf16[4,16]{1,0} %b), channel_id=2, replica_groups={{0,1}}, dimensions={0}
 """
         [ins] = collective_instructions(hlo)
         assert ins["op"] == "all-gather"
-        assert ins["bytes"] == 8 * 16 * 2
+        assert ins["bytes"] == 8 * 4 * 4 + 8 * 16 * 2
         assert ins["replica_groups"] == [[0, 1]]
         assert ins["channel_id"] == 2
         assert ins["source_target_pairs"] is None
+
+    def test_variadic_reduce_scatter_bytes_sum(self):
+        # Variadic reduce-scatter: both tuple elements are scattered
+        # outputs; the volume is their sum, not the max.
+        hlo = """
+  %rs = (bf16[8,4]{1,0}, f32[8,4]{1,0}) reduce-scatter(bf16[16,4]{1,0} %a, f32[16,4]{1,0} %b), replica_groups={{0,1}}, dimensions={0}, to_apply=%add
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["op"] == "reduce-scatter"
+        assert ins["bytes"] == 8 * 4 * 2 + 8 * 4 * 4
+
+    def test_async_start_bytes_are_post_collective_side(self):
+        # Async single-operand pair: the 2-tuple is (operand, result) of
+        # ONE transfer — bytes is the larger (post-gather) side, not the
+        # sum of both halves.
+        hlo = """
+  %ag-start = (f32[4,8]{1,0}, f32[4,16]{1,0}) all-gather-start(f32[4,8]{1,0} %p0), replica_groups={{0,1}}, dimensions={1}
+  %ag-done = f32[4,16]{1,0} all-gather-done((f32[4,8]{1,0}, f32[4,16]{1,0}) %ag-start)
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["bytes"] == 4 * 16 * 4
+
+    def test_variadic_async_start_sums_pair_maxima(self):
+        # Variadic async all-gather: 2k-tuple interleaves k operands
+        # with k results (operands first). Each operand/result pair
+        # counts once at its larger side, summed across operands:
+        # max(f32[4,8], f32[4,16]) + max(bf16[4,4], bf16[4,8]).
+        hlo = """
+  %ag-start = (f32[4,8]{1,0}, bf16[4,4]{1,0}, f32[4,16]{1,0}, bf16[4,8]{1,0}) all-gather-start(f32[4,8]{1,0} %a, bf16[4,4]{1,0} %b), replica_groups={{0,1}}, dimensions={1}
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["bytes"] == 4 * 16 * 4 + 4 * 8 * 2
+
+    def test_odd_async_tuple_falls_back_to_max(self):
+        # An async tuple whose arity is not 2k (context/extra scratch
+        # element) cannot be paired up — the largest buffer is the
+        # conservative fallback rather than double-counting.
+        hlo = """
+  %ar-start = (f32[64]{0}, f32[64]{0}, u32[]) all-reduce-start(f32[64]{0} %x), replica_groups={{0,1}}, to_apply=%add
+"""
+        [ins] = collective_instructions(hlo)
+        assert ins["bytes"] == 64 * 4
 
     def test_fields_default_none_for_plain_collectives(self):
         hlo = """
