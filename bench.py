@@ -1037,6 +1037,50 @@ def bench_fleet():
             _log(line)
 
 
+def bench_economics():
+    """Workload observatory (round 20): the canonical 24h-compressed
+    day replayed through a K=4 unified fleet
+    (``fleet/loadgen.py``), JOINed into the per-tenant bill
+    (``telemetry/economics.py``) — fleet goodput ratio under the paced
+    trace, fleet-wide cost per generated token, and the worst tenant's
+    SLO burn rate.
+
+    Like ``bench_fleet``, the replay needs device multiplicity, so it
+    runs on the emulated 8-device mesh in a subprocess
+    (``scripts/replay.py --json``) and its ``[bench]`` line is relayed.
+    ``scripts/bench_compare.py`` gates ``goodput_ratio`` (higher),
+    ``cost/token`` (lower), and ``worst tenant burn`` (lower — the
+    zero-old floor means a clean 0.00 baseline still fails a round
+    that starts burning). The returned block also carries the
+    conservation verdict: Σ per-tenant device-seconds must equal the
+    fleet ledger's device bucket — attribution that invents or drops
+    seconds is a bug, not a pricing choice."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent / "scripts" / "replay.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--json"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-5:])
+        raise RuntimeError(f"replay exited {proc.returncode}: {tail}")
+    res = json.loads(proc.stdout)
+    _log(res["bench_line"])
+    return {
+        k: res[k] for k in (
+            "k", "speed", "offered", "admitted", "shed",
+            "generated_tokens", "goodput_ratio", "cost_per_token_usd",
+            "worst_tenant", "worst_tenant_burn_rate", "conservation_ok",
+        )
+    }
+
+
 def bench_multistep():
     """Multi-step scheduling horizon ladder (round 16): the fused
     ``multi_step`` program (one dispatch per N engine iterations, host
@@ -1396,6 +1440,11 @@ def main():
     except Exception as e:
         _log(f"[bench] commscope bench skipped: {type(e).__name__}: {e}")
         commscope_block = None
+    try:
+        economics_block = bench_economics()
+    except Exception as e:
+        _log(f"[bench] economics bench skipped: {type(e).__name__}: {e}")
+        economics_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1458,6 +1507,12 @@ def main():
         # `axis bandwidth` / `comm fit err` / `exposed comm` /
         # `comm prediction err` patterns).
         "commscope": commscope_block,
+        # Round-20 workload observatory: the canonical day replayed
+        # through a K=4 fleet, priced per tenant (fleet/loadgen.py +
+        # telemetry/economics.py; gated by bench_compare's
+        # `goodput_ratio` / `cost/token` / `worst tenant burn`
+        # patterns), with the tier-1 conservation verdict.
+        "economics": economics_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

@@ -1,0 +1,233 @@
+"""Case 29 — the workload observatory, end to end.
+
+The round-20 economics layer on one trace-driven fleet replay, on the
+emulated 8-device mesh:
+
+* **deterministic load generation** — a :class:`TraceSpec` (diurnal
+  interactive traffic with an evening flash crowd, bursty batch, a calm
+  free tier) compressed to a few replay-seconds, written as versioned
+  JSONL whose bytes regenerate identically from the spec;
+* **paced replay** — arrivals admit at their trace instants through
+  ``FleetRouter.add_request(arrival_t=...)``, so queue-wait and SLO
+  burn measure offered-load truth while a ~2 Hz sampler captures the
+  per-tenant burn TIMELINE;
+* **the economics JOIN** — per-request trace legs × per-replica goodput
+  ledger windows × byte counters, apportioned into per-tenant
+  device-seconds / tokens / bytes-moved and priced via the costmodel
+  device table — with the conservation verdict (Σ per-tenant attributed
+  device-seconds == the fleet ledger's device bucket) printed and
+  asserted;
+* **the exports** — ``economics_*{tenant=...}`` Prometheus gauges
+  (hostile label values escaped) and tenant lanes in the merged
+  Perfetto timeline.
+
+Artifacts (``sys.argv[1]``, else ``$LJST_ARTIFACT_DIR/case29``, else a
+temp dir): ``trace.jsonl`` (the generated day), ``economics.json`` (the
+priced bill), ``burn_timeline.json`` (per-tenant burn samples),
+``replay_trace.json`` (Perfetto, tenant lanes), ``metrics.prom``.
+
+Emulated-CPU caveat: device-seconds here are host-emulated seconds, so
+the absolute $ figures exercise the plumbing, not a price list — the
+INVARIANTS (conservation, one-roll-up-per-request, replay determinism)
+are what carry to hardware.
+
+Run: ``python cases/case29_workload_observatory.py [outdir]``
+"""
+
+import _bootstrap  # noqa: F401  (repo-root import path)
+from learning_jax_sharding_tpu.parallel import force_emulated_devices
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+import json  # noqa: E402
+import pathlib  # noqa: E402
+import sys  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from learning_jax_sharding_tpu.fleet import (  # noqa: E402
+    FlashCrowd,
+    FleetRouter,
+    TenantSpec,
+    TraceSpec,
+    make_replicas,
+    read_trace,
+    replay_trace,
+    write_trace,
+)
+from learning_jax_sharding_tpu.models.transformer import (  # noqa: E402
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP  # noqa: E402
+from learning_jax_sharding_tpu.telemetry import (  # noqa: E402
+    SLOMonitor,
+    SLOTarget,
+    fleet_economics,
+    write_economics,
+)
+from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: E402
+    artifact_dir,
+)
+
+K, NEW, SPEED = 2, 8, 4.0
+
+
+def _spec() -> TraceSpec:
+    """A small observatory day: 6 virtual seconds, three tenants, one
+    flash crowd — enough traffic to exercise every attribution path
+    without canonical-day runtime."""
+    return TraceSpec(
+        duration_s=6.0,
+        seed=29,
+        tenants=(
+            TenantSpec(
+                "interactive", rate_rps=2.0, burstiness=2.0,
+                diurnal_amplitude=0.6, diurnal_phase=0.25,
+                prompt_len_min=4, prompt_len_tail=4.0, prompt_len_max=20,
+            ),
+            TenantSpec(
+                "batch", rate_rps=1.0, burstiness=3.0,
+                prompt_len_min=8, prompt_len_tail=8.0, prompt_len_max=32,
+            ),
+            TenantSpec(
+                "free-tier", rate_rps=0.7, prompt_len_min=3,
+                prompt_len_tail=2.0, prompt_len_max=10,
+            ),
+        ),
+        flash_crowds=(
+            FlashCrowd(
+                tenant="interactive", t_s=4.0, duration_s=1.0,
+                multiplier=6.0,
+            ),
+        ),
+    )
+
+
+def main() -> int:
+    out = (
+        pathlib.Path(sys.argv[1]) if len(sys.argv) > 1
+        else artifact_dir("case29")
+    )
+    out.mkdir(parents=True, exist_ok=True)
+
+    # --- 1. the trace: generated, persisted, byte-stable ------------------
+    spec = _spec()
+    write_trace(out / "trace.jsonl", spec)
+    header, events = read_trace(out / "trace.jsonl")
+    by_tenant: dict = {}
+    for ev in events:
+        by_tenant[ev["tenant"]] = by_tenant.get(ev["tenant"], 0) + 1
+    print(
+        f"case29: trace v{header['trace_version']}: {len(events)} "
+        f"arrivals over {spec.duration_s:g}s virtual — " + ", ".join(
+            f"{t}={n}" for t, n in sorted(by_tenant.items())
+        )
+    )
+
+    # --- 2. the fleet, warmed past its compiles ---------------------------
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    slo = SLOMonitor([
+        SLOTarget("queue_wait", 0.25, objective=0.9),
+        SLOTarget("ttft", 0.5, objective=0.9),
+        SLOTarget("e2e", 2.0, objective=0.9),
+    ])
+    reps = make_replicas(
+        cfg, RULES_DP_TP, params, count=K, mesh_shape=(1, 2),
+        batch_size=4, max_new_tokens=NEW, refill_chunk=16,
+        decode_block_steps=4, slo=slo,
+    )
+    router = FleetRouter(reps)
+    rng = np.random.default_rng(7)
+    warm = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=6)
+    ]
+    for rep in reps:
+        rep.engine.serve(rep.params, warm[: rep.engine._b + 1])
+    for p in warm:
+        router.add_request(p)
+    router.drain(max_steps=2000)
+    router.pop_finished()
+    router.reset_stats()
+
+    # --- 3. paced replay with the burn-timeline sampler -------------------
+    timeline, last = [], [-1.0]
+
+    def _tick(elapsed: float) -> None:
+        if elapsed - last[0] < 0.5:
+            return
+        last[0] = elapsed
+        timeline.append(
+            {"t_s": round(elapsed, 3), "burn": slo.tenant_burn_rates()}
+        )
+
+    rep = replay_trace(
+        router, events, seed=spec.seed, vocab_size=cfg.vocab_size,
+        speed=SPEED, pace=True, on_tick=_tick,
+    )
+    print(
+        f"case29: replayed {rep['offered']} arrivals at {SPEED:g}x in "
+        f"{rep['wall_s']:.1f}s wall ({len(rep['admission_order'])} "
+        f"admitted, {len(rep['shed'])} shed)"
+    )
+
+    # --- 4. the economics JOIN + the conservation verdict -----------------
+    econ = fleet_economics(router, replay=rep, slo=slo)
+    cons = econ["measured"]["conservation"]
+    assert cons["ok"], cons
+    rolls = econ["deterministic"]["tenants"]
+    assert sum(r["requests"] for r in rolls.values()) == len(
+        rep["admission_order"]
+    ), "every admitted request lands in exactly one tenant roll-up"
+
+    write_economics(out / "economics.json", econ)
+    (out / "burn_timeline.json").write_text(
+        json.dumps({"speed": SPEED, "samples": timeline}, indent=2)
+    )
+    (out / "replay_trace.json").write_text(
+        json.dumps(router.merged_chrome_trace())
+    )
+    prom = router.registry.prometheus_text()
+    assert "economics_cost_usd" in prom
+    (out / "metrics.prom").write_text(prom)
+
+    print(f"{'tenant':<16}{'req':>5}{'ok':>4}{'shed':>5}{'tok':>6}"
+          f"{'device s':>10}{'cost u$':>9}{'u$/tok':>8}{'burn':>6}")
+    m = econ["measured"]["tenants"]
+    for ten in sorted(set(rolls) | set(m)):
+        r = rolls.get(ten, {})
+        mt = m.get(ten, {})
+        cpt = mt.get("cost_per_token_usd")
+        print(
+            f"{ten:<16}{r.get('requests', 0):>5}{r.get('ok', 0):>4}"
+            f"{r.get('shed', 0):>5}{r.get('generated_tokens', 0):>6}"
+            f"{mt.get('device_seconds', 0.0):>10.3f}"
+            f"{mt.get('cost_usd', 0.0) * 1e6:>9.2f}"
+            + (f"{cpt * 1e6:>8.3f}" if cpt else f"{'—':>8}")
+            + f"{mt.get('worst_burn_rate', 0.0):>6.2f}"
+        )
+    fleet = econ["measured"]["fleet"]
+    print(
+        f"case29: conservation residual "
+        f"{cons['residual_s']:.2e}s <= eps {cons['eps']:.2e}s; "
+        f"goodput_ratio {fleet['goodput_ratio'] * 100:.1f}%, "
+        f"worst tenant {econ['measured']['worst_tenant']} "
+        f"(burn {econ['measured']['worst_tenant_burn_rate']:.2f}); "
+        f"{len(timeline)} burn samples; artifacts in {out}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

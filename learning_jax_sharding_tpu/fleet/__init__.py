@@ -7,7 +7,9 @@ Layers: :mod:`.policies` (placement + fleet shedding policy),
 (admission, handoff, failover, fleet telemetry), :mod:`.kv_transfer`
 (the arXiv-2112.01075-style resharding transfer plan the KV handoff
 rides), :mod:`.kv_economy` (round 15: prefix-aware placement + the
-HBM → host → peer KV tier ladder).
+HBM → host → peer KV tier ladder), :mod:`.loadgen` (round 20: the
+deterministic trace-driven load generator + replay harness behind the
+workload observatory).
 """
 
 from learning_jax_sharding_tpu.fleet.kv_economy import (  # noqa: F401
@@ -21,6 +23,19 @@ from learning_jax_sharding_tpu.fleet.kv_transfer import (  # noqa: F401
     execute_transfer,
     plan_transfer,
     transfer_tree,
+)
+from learning_jax_sharding_tpu.fleet.loadgen import (  # noqa: F401
+    TRACE_VERSION,
+    FlashCrowd,
+    TenantSpec,
+    TraceSpec,
+    canonical_day_spec,
+    canonical_trace_path,
+    generate_trace,
+    read_trace,
+    replay_trace,
+    synth_prompt,
+    write_trace,
 )
 from learning_jax_sharding_tpu.fleet.policies import (  # noqa: F401
     FleetPolicy,

@@ -1,0 +1,449 @@
+"""Deterministic trace-driven load generation (round 20).
+
+The chaos matrix proves the fleet SURVIVES; nothing before this module
+proved its ECONOMICS — because nothing could drive the fleet with a
+realistic, repeatable traffic shape. This is the missing arrival
+process, in three pieces:
+
+* **generation** — :func:`generate_trace` turns a :class:`TraceSpec`
+  (per-tenant diurnal rate curves, bursty cluster arrivals, heavy-tail
+  Pareto prompt lengths, flash-crowd spikes) into a sorted list of
+  arrival events. Everything derives from ``numpy`` Generators seeded
+  by ``(spec.seed, tenant index)``, so the same spec always produces
+  the same trace, byte for byte.
+* **the versioned JSONL trace format** — :func:`write_trace` /
+  :func:`read_trace`. Line 1 is a header record (``trace_version``,
+  seed, event count, the full spec); every following line is one
+  arrival ``{"rid", "t", "tenant", "prompt_len"}`` with sorted keys and
+  compact separators, so regeneration is BYTE-identical and a trace
+  diff is a line diff. Prompt token CONTENT is never stored — it is
+  resynthesized from ``(seed, rid, prompt_len)`` by
+  :func:`synth_prompt`, which keeps the canonical trace small and the
+  replay exact.
+* **replay** — :func:`replay_trace` feeds the events to a
+  :class:`~.router.FleetRouter` through ``add_request(arrival_t=...)``,
+  so queue-wait accounting measures the request's TRUE age under the
+  trace's clock, not its age at the Python line that admitted it.
+  Paced mode sleeps the offered-load gaps (wall-clock realistic,
+  measured seconds); unpaced mode admits everything up front
+  (deterministic admission/shed order — the determinism tests' mode).
+  Every arrival passes the ``"loadgen.arrival"`` chaos seam, where a
+  ``mutate`` fault may amplify one event into ``copies`` simultaneous
+  clones — the flash-crowd injection the ``flash_crowd`` matrix cell
+  drives.
+
+The checked-in canonical trace (:func:`canonical_trace_path`) is one
+virtual DAY compressed to 24 replay-seconds (1 s ≙ 1 h): an
+``interactive`` tenant peaking midday with an evening flash crowd, a
+night-heavy ``batch`` tenant with long heavy-tail prompts, and a calm
+``free-tier`` — the fixed workload ``bench.py bench_economics`` and
+``scripts/replay.py`` price.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import pathlib
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+#: Version stamp of the JSONL trace format; bumped on any change to the
+#: header/event schema so a replayer can refuse traces it cannot honor.
+TRACE_VERSION = 1
+
+#: rid base for chaos-cloned arrivals (``copies`` > 1): far above any
+#: plausible trace rid, so clones never collide with not-yet-admitted
+#: trace events (rid = 1_000_000 + source_rid * 1000 + copy_index).
+_CLONE_RID_BASE = 1_000_000
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's arrival process + prompt-length distribution.
+
+    Arrivals are a bursty (clustered) Poisson process thinned by a
+    diurnal sine: clusters arrive at rate ``rate_rps × m(t) /
+    burstiness`` with ``m(t) = 1 + diurnal_amplitude · sin(2π(t/T −
+    diurnal_phase))`` (T = the trace duration, one virtual day), each
+    cluster holds a geometric number of arrivals (mean ``burstiness``)
+    jittered by Exponential(``burst_jitter_s``) gaps — so ``rate_rps``
+    stays the mean offered rate while ``burstiness`` controls how
+    clumped it is. Prompt lengths are ``prompt_len_min`` plus a Pareto
+    tail with index ``prompt_len_alpha`` scaled so the mean excess is
+    ``prompt_len_tail`` tokens, clipped at ``prompt_len_max``.
+    """
+
+    name: str
+    rate_rps: float
+    burstiness: float = 1.0
+    burst_jitter_s: float = 0.02
+    diurnal_amplitude: float = 0.0
+    diurnal_phase: float = 0.0
+    prompt_len_min: int = 4
+    prompt_len_tail: float = 6.0
+    prompt_len_alpha: float = 2.5
+    prompt_len_max: int = 64
+
+    def __post_init__(self):
+        if self.rate_rps <= 0:
+            raise ValueError(f"rate_rps must be > 0, got {self.rate_rps}")
+        if self.burstiness < 1.0:
+            raise ValueError(
+                f"burstiness must be >= 1, got {self.burstiness}"
+            )
+        if not 0.0 <= self.diurnal_amplitude <= 1.0:
+            raise ValueError(
+                "diurnal_amplitude must be in [0, 1], got "
+                f"{self.diurnal_amplitude}"
+            )
+        if self.prompt_len_alpha <= 1.0:
+            raise ValueError(    # mean of a Pareto tail diverges at <= 1
+                f"prompt_len_alpha must be > 1, got {self.prompt_len_alpha}"
+            )
+        if not 1 <= self.prompt_len_min <= self.prompt_len_max:
+            raise ValueError(
+                f"need 1 <= prompt_len_min <= prompt_len_max, got "
+                f"[{self.prompt_len_min}, {self.prompt_len_max}]"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashCrowd:
+    """A spike window: extra Poisson arrivals for one tenant at
+    ``multiplier ×`` its base rate over ``[t_s, t_s + duration_s)`` —
+    ON TOP of the base process (a flash crowd adds traffic, it does not
+    reshape the day)."""
+
+    tenant: str
+    t_s: float
+    duration_s: float
+    multiplier: float = 10.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """The full generation recipe — everything :func:`generate_trace`
+    needs, and exactly what the trace header records."""
+
+    duration_s: float
+    seed: int = 0
+    tenants: tuple[TenantSpec, ...] = ()
+    flash_crowds: tuple[FlashCrowd, ...] = ()
+
+    def __post_init__(self):
+        if self.duration_s <= 0:
+            raise ValueError(
+                f"duration_s must be > 0, got {self.duration_s}"
+            )
+        if not self.tenants:
+            raise ValueError("a trace needs at least one tenant")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"tenant names must be unique: {names}")
+        known = set(names)
+        for fc in self.flash_crowds:
+            if fc.tenant not in known:
+                raise ValueError(
+                    f"flash crowd names unknown tenant {fc.tenant!r}"
+                )
+
+
+def _diurnal(t: float, spec: TraceSpec, ten: TenantSpec) -> float:
+    return max(0.0, 1.0 + ten.diurnal_amplitude * math.sin(
+        2.0 * math.pi * (t / spec.duration_s - ten.diurnal_phase)
+    ))
+
+
+def _lengths(rng, ten: TenantSpec, n: int) -> np.ndarray:
+    # Pareto(α) has mean 1/(α−1); scaling by tail·(α−1) makes the mean
+    # excess over prompt_len_min exactly prompt_len_tail.
+    excess = rng.pareto(ten.prompt_len_alpha, size=n) * (
+        ten.prompt_len_tail * (ten.prompt_len_alpha - 1.0)
+    )
+    return np.clip(
+        ten.prompt_len_min + excess.astype(np.int64),
+        ten.prompt_len_min, ten.prompt_len_max,
+    )
+
+
+def generate_trace(spec: TraceSpec) -> list[dict]:
+    """Generate the arrival events of ``spec`` — sorted by time, rids
+    assigned in that order. Deterministic: per-tenant Generators seeded
+    by ``(spec.seed, tenant index)``, fixed draw order."""
+    arrivals: list[tuple[float, str, int]] = []   # (t, tenant, length)
+    for ti, ten in enumerate(spec.tenants):
+        rng = np.random.default_rng([int(spec.seed), 7919, ti])
+        # Bursty base process: candidate clusters at the PEAK rate,
+        # thinned down to the diurnal curve (standard thinning — the
+        # accepted clusters are exactly inhomogeneous-Poisson).
+        peak = 1.0 + ten.diurnal_amplitude
+        cluster_rate = ten.rate_rps * peak / ten.burstiness
+        t = 0.0
+        times: list[float] = []
+        while True:
+            t += rng.exponential(1.0 / cluster_rate)
+            if t >= spec.duration_s:
+                break
+            keep = rng.random() < _diurnal(t, spec, ten) / peak
+            size = int(rng.geometric(1.0 / ten.burstiness))
+            jitter = np.cumsum(
+                rng.exponential(ten.burst_jitter_s, size=size)
+            )
+            if not keep:
+                continue     # draws above happen either way: one stream
+            for off in (0.0, *jitter[:-1]):
+                if t + off < spec.duration_s:
+                    times.append(t + off)
+        # Flash crowds: additive homogeneous Poisson inside the window.
+        for fi, fc in enumerate(spec.flash_crowds):
+            if fc.tenant != ten.name:
+                continue
+            crng = np.random.default_rng(
+                [int(spec.seed), 104659, ti, fi]
+            )
+            rate = ten.rate_rps * fc.multiplier
+            t = fc.t_s
+            while True:
+                t += crng.exponential(1.0 / rate)
+                if t >= min(fc.t_s + fc.duration_s, spec.duration_s):
+                    break
+                times.append(t)
+        times.sort()
+        for t, ln in zip(times, _lengths(rng, ten, len(times))):
+            arrivals.append((round(float(t), 6), ten.name, int(ln)))
+    arrivals.sort()
+    return [
+        {"rid": i, "t": t, "tenant": name, "prompt_len": ln}
+        for i, (t, name, ln) in enumerate(arrivals)
+    ]
+
+
+def synth_prompt(
+    seed: int, rid: int, length: int, vocab_size: int,
+) -> np.ndarray:
+    """The deterministic prompt content of one trace event: tokens in
+    ``[1, vocab_size)`` keyed by ``(trace seed, rid)`` — the trace file
+    stores only the length, the replayer resynthesizes the bytes."""
+    rng = np.random.default_rng([int(seed), 104729, int(rid)])
+    return rng.integers(
+        1, max(2, int(vocab_size)), size=int(length), dtype=np.int64
+    ).astype(np.int32)
+
+
+# --- the versioned JSONL trace format -----------------------------------
+
+
+def _dump(obj: dict) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def write_trace(
+    path, spec: TraceSpec, events: list[dict] | None = None,
+) -> list[dict]:
+    """Write ``spec``'s trace (generating it unless ``events`` is
+    given) as versioned JSONL. Byte-identical across runs for the same
+    spec — the regeneration identity the tier-1 tests pin."""
+    if events is None:
+        events = generate_trace(spec)
+    header = {
+        "kind": "ljst.loadgen.trace",
+        "trace_version": TRACE_VERSION,
+        "seed": int(spec.seed),
+        "duration_s": spec.duration_s,
+        "events": len(events),
+        "spec": dataclasses.asdict(spec),
+    }
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(_dump(header) + "\n")
+        for ev in events:
+            f.write(_dump(ev) + "\n")
+    return events
+
+
+def read_trace(path) -> tuple[dict, list[dict]]:
+    """Read a JSONL trace → ``(header, events)``; refuses unknown
+    versions (the format is a contract, not a suggestion)."""
+    with open(path) as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"empty trace file: {path}")
+    header = json.loads(lines[0])
+    ver = header.get("trace_version")
+    if ver != TRACE_VERSION:
+        raise ValueError(
+            f"trace {path}: version {ver!r}, this replayer speaks "
+            f"{TRACE_VERSION}"
+        )
+    events = [json.loads(ln) for ln in lines[1:]]
+    if len(events) != header.get("events"):
+        raise ValueError(
+            f"trace {path}: header promises {header.get('events')} "
+            f"events, file holds {len(events)}"
+        )
+    return header, events
+
+
+# --- the canonical 24h-compressed day -----------------------------------
+
+
+def canonical_day_spec() -> TraceSpec:
+    """One virtual day compressed to 24 replay-seconds (1 s ≙ 1 h):
+    midday-peaking interactive traffic with an evening flash crowd,
+    night-heavy batch with long heavy-tail prompts, a calm free tier.
+    Prompt lengths stay ≤ 40 so CONFIG_TINY (max_seq_len 64) can decode
+    16 fresh tokens on top."""
+    return TraceSpec(
+        duration_s=24.0,
+        seed=20,
+        tenants=(
+            TenantSpec(
+                "interactive", rate_rps=1.1, burstiness=2.0,
+                diurnal_amplitude=0.7, diurnal_phase=0.25,
+                prompt_len_min=4, prompt_len_tail=5.0,
+                prompt_len_alpha=2.5, prompt_len_max=24,
+            ),
+            TenantSpec(
+                "batch", rate_rps=0.7, burstiness=3.0,
+                diurnal_amplitude=0.5, diurnal_phase=0.75,
+                prompt_len_min=8, prompt_len_tail=10.0,
+                prompt_len_alpha=1.8, prompt_len_max=40,
+            ),
+            TenantSpec(
+                "free-tier", rate_rps=0.5, burstiness=1.0,
+                diurnal_amplitude=0.3, diurnal_phase=0.25,
+                prompt_len_min=3, prompt_len_tail=3.0,
+                prompt_len_alpha=3.0, prompt_len_max=12,
+            ),
+        ),
+        flash_crowds=(
+            FlashCrowd(
+                tenant="interactive", t_s=18.5, duration_s=1.5,
+                multiplier=8.0,
+            ),
+        ),
+    )
+
+
+def canonical_trace_path() -> pathlib.Path:
+    """The checked-in canonical trace (regenerate with
+    ``scripts/replay.py --regen``)."""
+    return (
+        pathlib.Path(__file__).resolve().parent.parent
+        / "data" / "traces" / "canonical_day.jsonl"
+    )
+
+
+# --- replay --------------------------------------------------------------
+
+
+def replay_trace(
+    router,
+    events: Sequence[dict],
+    *,
+    seed: int,
+    vocab_size: int,
+    speed: float = 1.0,
+    pace: bool = True,
+    on_tick: Callable[[float], None] | None = None,
+    max_iters: int = 500_000,
+) -> dict:
+    """Drive ``router`` with a generated/loaded trace.
+
+    Arrivals admit strictly in trace order through
+    ``FleetRouter.add_request(arrival_t=...)`` — paced mode stamps each
+    event's scheduled instant (``t0 + t/speed``) as its arrival, so
+    queue-wait telemetry measures offered-load truth; unpaced mode
+    (``pace=False``) admits every event immediately, which makes the
+    admission AND shed order a pure function of the trace (the
+    determinism tests' mode). Each event passes the
+    ``"loadgen.arrival"`` chaos seam first; a mutate fault may set
+    ``"copies": n`` to clone the arrival n-fold (clone rids offset by
+    ``_CLONE_RID_BASE`` — collision-free with trace rids). Fleet-level
+    sheds (:class:`AdmissionError`) are tallied, never raised.
+
+    Returns ``{"results", "admission_order", "tenant_of", "source_of",
+    "shed", "offered", "wall_s"}`` — results keyed by rid;
+    ``source_of`` maps every admitted rid (clones included) back to the
+    trace event that caused it. ``on_tick(elapsed_s)`` fires once per
+    replay loop iteration (the burn-timeline sampler's hook).
+    """
+    from learning_jax_sharding_tpu.models.serving import AdmissionError
+    from learning_jax_sharding_tpu.robustness.chaos import chaos_hook
+
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    events = sorted(events, key=lambda e: (e["t"], e["rid"]))
+    results: dict[int, Any] = {}
+    admission_order: list[int] = []
+    tenant_of: dict[int, str | None] = {}
+    source_of: dict[int, int] = {}
+    shed: list[dict] = []
+    t0 = time.perf_counter()
+    i = iters = 0
+    while i < len(events) or router.has_work():
+        while i < len(events):
+            ev = events[i]
+            due = ev["t"] / speed
+            if pace and due > time.perf_counter() - t0:
+                break
+            i += 1
+            ev = chaos_hook(
+                "loadgen.arrival", dict(ev),
+                rid=ev.get("rid"), tenant=ev.get("tenant"),
+            )
+            prompt = synth_prompt(
+                seed, ev["rid"], ev["prompt_len"], vocab_size
+            )
+            for c in range(max(1, int(ev.get("copies", 1)))):
+                rid = (
+                    ev["rid"] if c == 0
+                    else _CLONE_RID_BASE + ev["rid"] * 1000 + c
+                )
+                try:
+                    got = router.add_request(
+                        prompt, rid=rid, tenant=ev.get("tenant"),
+                        deadline_s=ev.get("deadline_s"),
+                        arrival_t=t0 + due if pace else None,
+                    )
+                except AdmissionError:
+                    shed.append({
+                        "rid": rid, "source_rid": ev["rid"],
+                        "tenant": ev.get("tenant"),
+                        "prompt_len": int(ev["prompt_len"]),
+                    })
+                    continue
+                admission_order.append(got)
+                tenant_of[got] = ev.get("tenant")
+                source_of[got] = ev["rid"]
+        if router.has_work():
+            router.step()
+            results.update(router.pop_finished())
+        elif pace and i < len(events):
+            # Idle gap before the next scheduled arrival: sleep a
+            # sliver of it instead of busy-spinning the admission poll.
+            time.sleep(min(2e-3, max(0.0, (
+                events[i]["t"] / speed - (time.perf_counter() - t0)
+            ))))
+        if on_tick is not None:
+            on_tick(time.perf_counter() - t0)
+        iters += 1
+        if iters > max_iters:
+            raise RuntimeError(
+                f"replay wedged: {iters} iterations, work remains"
+            )
+    results.update(router.pop_finished())
+    return {
+        "results": results,
+        "admission_order": admission_order,
+        "tenant_of": tenant_of,
+        "source_of": source_of,
+        "shed": shed,
+        "offered": len(events),
+        "wall_s": time.perf_counter() - t0,
+    }

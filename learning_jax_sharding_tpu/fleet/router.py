@@ -68,15 +68,16 @@ class _FleetRequest:
     death, because the replica that held the engine-side copy may not."""
 
     __slots__ = (
-        "rid", "prompt", "deadline_s", "arrival_t", "replica", "stage",
-        "reroutes", "predicted_hit",
+        "rid", "prompt", "deadline_s", "arrival_t", "tenant", "replica",
+        "stage", "reroutes", "predicted_hit",
     )
 
-    def __init__(self, rid, prompt, deadline_s, arrival_t):
+    def __init__(self, rid, prompt, deadline_s, arrival_t, tenant=None):
         self.rid = rid
         self.prompt = prompt
         self.deadline_s = deadline_s
         self.arrival_t = arrival_t
+        self.tenant = tenant         # cost-attribution label, hop-stable
         self.replica: str | None = None
         self.stage = "queued"        # prefill|handoff|decode|done
         self.reroutes = 0
@@ -276,6 +277,8 @@ class FleetRouter:
     def add_request(
         self, prompt, *, rid: int | None = None,
         deadline_s: float | None = None,
+        arrival_t: float | None = None,
+        tenant: str | None = None,
     ) -> int:
         """Admit one request to the fleet: fleet-level shedding first
         (``FleetPolicy.max_inflight``), then placement on the
@@ -283,6 +286,14 @@ class FleetRouter:
         sheds (bounded queue, ladder) is skipped for the next-best; only
         when every replica refuses does the arrival shed at fleet level.
         Raises :class:`AdmissionError` with nothing enqueued either way.
+
+        ``arrival_t`` (a ``perf_counter`` stamp) overrides the arrival
+        clock — the trace replayer stamps each event's SCHEDULED
+        instant so queue-wait telemetry measures offered-load truth,
+        not the Python admission loop's position. ``tenant`` labels the
+        request for per-tenant cost attribution and SLO burn accounting;
+        the label rides the canonical fleet record, so it survives
+        handoffs and failover requeues.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         if rid is None:
@@ -294,14 +305,18 @@ class FleetRouter:
         if self.policy.should_shed(self.inflight()):
             self._shed(rid, f"fleet at max_inflight "
                             f"({self.policy.max_inflight})")
-        freq = _FleetRequest(rid, p, deadline_s, time.perf_counter())
+        freq = _FleetRequest(
+            rid, p, deadline_s,
+            time.perf_counter() if arrival_t is None else arrival_t,
+            tenant,
+        )
         self._route(freq)
         self._requests[rid] = freq
         # The trace id is born HERE — router admission — and every hop
         # (placement, handoff, reroute, swap pin, retirement) appends to
         # it. _route's instant may have minted implicitly; this backfills
         # the canonical arrival stamp either way.
-        self.traces.mint(rid, arrival_t=freq.arrival_t)
+        self.traces.mint(rid, arrival_t=freq.arrival_t, tenant=tenant)
         self._c_requests.inc()
         self._g_inflight.set(self.inflight())
         return rid
@@ -330,6 +345,7 @@ class FleetRouter:
                 rep.engine.add_request(
                     freq.prompt, rid=freq.rid,
                     deadline_s=freq.deadline_s, arrival_t=freq.arrival_t,
+                    tenant=freq.tenant,
                 )
             except AdmissionError as e:   # replica-level shed: next best
                 last_err = str(e)
@@ -497,11 +513,13 @@ class FleetRouter:
         )
         self._completed.append({
             "rid": freq.rid,
+            "tenant": freq.tenant,
             "e2e": now - freq.arrival_t,
             "generated": (
                 int(len(result) - freq.prompt.size) if ok else 0
             ),
             "ok": ok,
+            "status": "ok" if ok else result.status,
             "reroutes": freq.reroutes,
             "prompt_tokens": int(freq.prompt.size),
             "prefix_predicted": freq.predicted_hit,
@@ -628,7 +646,7 @@ class FleetRouter:
             rep.engine.ingest_kv(
                 rep.params, freq.prompt, h["first"], rows, rid=freq.rid,
                 deadline_s=freq.deadline_s, arrival_t=freq.arrival_t,
-                admit_t=now, first_token_t=now,
+                admit_t=now, first_token_t=now, tenant=freq.tenant,
             )
             freq.replica = rep.name
             freq.stage = "decode"

@@ -201,6 +201,7 @@ class _Request:
     #                                       arrival_t but re-enqueues here)
     ingested: bool = False                # admitted via kv_ingest: the prefill
     #                                       happened on another replica
+    tenant: str | None = None             # cost-attribution / SLO label
 
 
 class ContinuousEngine:
@@ -2384,6 +2385,7 @@ class ContinuousEngine:
         deadline_s: float | None = None,
         arrival_t: float | None = None,
         adapter: str | None = None,
+        tenant: str | None = None,
     ) -> int:
         """Enqueue one request (the arrival process). Returns its id —
         the key ``pop_finished()`` will report it under, and (at
@@ -2408,6 +2410,11 @@ class ContinuousEngine:
         tenant-adapter merged weights inside the fused multi-LoRA step.
         The adapter is ACQUIRED here (refcounted — it cannot be evicted
         while this request is live) and released at retirement.
+
+        ``tenant`` labels the request for per-tenant cost attribution
+        and SLO burn accounting (round 20): the retirement's SLO
+        observations carry it, and the fleet's TraceStore record is
+        minted with it — purely observational, never a routing input.
         """
         p = np.asarray(prompt, np.int32).reshape(-1)
         self._validate_prompt(p)
@@ -2463,6 +2470,7 @@ class ContinuousEngine:
                 version=self.weights_version,
                 adapter=adapter,
                 enqueue_t=now,
+                tenant=tenant,
             )
         )
         self._c_requests.inc()
@@ -2472,7 +2480,9 @@ class ContinuousEngine:
             # minted at ROUTER admission and this is an idempotent
             # lookup (reroutes re-enqueue under the same rid → same
             # trace id, the continuity the tracecontext tests pin).
-            self.trace_sink.mint(rid, arrival_t=self._queue[-1].arrival_t)
+            self.trace_sink.mint(
+                rid, arrival_t=self._queue[-1].arrival_t, tenant=tenant,
+            )
         self.tracer.instant(
             "request.arrival", rid=rid, prompt_len=int(p.size)
         )
@@ -2673,6 +2683,7 @@ class ContinuousEngine:
         arrival_t: float | None = None,
         admit_t: float | None = None,
         first_token_t: float | None = None,
+        tenant: str | None = None,
     ) -> int:
         """EXTERNAL KV INGESTION: occupy a free slot with a request whose
         PREFILL RAN ON ANOTHER ENGINE — write its transferred cache
@@ -2726,6 +2737,7 @@ class ContinuousEngine:
                 arrival_t=now if arrival_t is None else arrival_t,
                 deadline_s=deadline_s,
                 version=self.weights_version,
+                tenant=tenant,
             )
             r.admit_t = now if admit_t is None else admit_t
             r.first_token_t = now if first_token_t is None else first_token_t
@@ -3040,14 +3052,17 @@ class ContinuousEngine:
                 ttft=rec["ttft"], e2e=rec["e2e"], version=r.version,
             )
             if self.slo is not None:
-                self.slo.observe("queue_wait", rec["queue_wait"])
-                self.slo.observe("e2e", rec["e2e"])
+                ten = r.tenant
+                self.slo.observe(
+                    "queue_wait", rec["queue_wait"], tenant=ten
+                )
+                self.slo.observe("e2e", rec["e2e"], tenant=ten)
                 if rec["ttft"] is not None:
-                    self.slo.observe("ttft", rec["ttft"])
+                    self.slo.observe("ttft", rec["ttft"], tenant=ten)
                 if rec["tpot"] is not None:
-                    self.slo.observe("tpot", rec["tpot"])
+                    self.slo.observe("tpot", rec["tpot"], tenant=ten)
                 for g in gaps:
-                    self.slo.observe("itl", g)
+                    self.slo.observe("itl", g, tenant=ten)
             if self.trace_sink is not None:
                 self._record_trace_legs(r, now, generated=n)
                 if self.trace_sink.auto_complete:

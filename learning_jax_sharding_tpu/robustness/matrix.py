@@ -27,6 +27,7 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | malformed_request | corrupted queued prompt           | admission re-check → fail+isolate |
 | overload_shed     | offered load > queue bound        | bounded queue + degradation ladder|
 | replica_kill      | engine replica dies mid-stream    | router failover + rerouted requeue|
+| flash_crowd       | loadgen arrival amplified 12×     | fleet-level admission shed        |
 | swap_mid_stream   | weight-swap staging dies mid-serve| swap abort → stay on old version  |
 | tier_miss_under_kill | replica with promoted peer-tier KV dies mid-stream | tier drop + recompute from prompt |
 | nan_logits_h4     | FloatingPointError at a FUSED (horizon=4) dispatch | quarantine within one horizon + ledger recovery |
@@ -515,6 +516,91 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             ),
         }
 
+    def flash_crowd():
+        # Workload observatory (round 20): a loadgen trace replayed
+        # through a 2-replica fleet, with the ``loadgen.arrival`` chaos
+        # seam amplifying one arrival into 12 simultaneous clones — a
+        # flash crowd the offered trace never promised. The
+        # fleet must shed the excess at the FLEET layer (admission
+        # control, ``fleet_shed_total``), never convert it into
+        # deadline misses or failures, and every survivor must stream
+        # bit-identically to a fault-free solo engine on the same
+        # prompts (clones share their source event's prompt, so they
+        # match the same reference).
+        from learning_jax_sharding_tpu.fleet import (
+            FleetPolicy,
+            FleetRouter,
+            TenantSpec,
+            TraceSpec,
+            generate_trace,
+            make_replicas,
+            replay_trace,
+            synth_prompt,
+        )
+
+        spec = TraceSpec(
+            duration_s=2.0, seed=5,
+            tenants=(TenantSpec(
+                "steady", rate_rps=5.0, prompt_len_min=3,
+                prompt_len_tail=2.0, prompt_len_max=8,
+            ),),
+        )
+        events = generate_trace(spec)
+        assert len(events) >= 3, "the cell needs a mid-trace event"
+        ref, _ = _drive(engine, params, {
+            ev["rid"]: synth_prompt(
+                spec.seed, ev["rid"], ev["prompt_len"], cfg.vocab_size
+            )
+            for ev in events
+        })
+        reps = make_replicas(
+            cfg, rules, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+            recorder=rec,
+        )
+        # Capacity sized to the TRACE: the offered events all fit, the
+        # crowd's clones do not — so every shed is the injection's.
+        # (Unpaced replay admits the whole trace up front, so the crowd
+        # rides the LAST arrival: amplifying an earlier one would push
+        # legitimate trailing events over the cap instead of clones.)
+        router = FleetRouter(
+            reps, recorder=rec,
+            policy=FleetPolicy(max_inflight=len(events)),
+        )
+        shed0 = router.registry.counter("fleet_shed_total").value
+        with ChaosInjector(
+            Fault(
+                "loadgen.arrival", "mutate", at=len(events) - 1,
+                count=1, mutate=lambda ev: {**ev, "copies": 12},
+            ),
+            recorder=rec,
+        ):
+            rep = replay_trace(
+                router, events, seed=spec.seed,
+                vocab_size=cfg.vocab_size, pace=False,
+            )
+        fleet_shed = (
+            router.registry.counter("fleet_shed_total").value - shed0
+        )
+        assert rep["shed"] and fleet_shed == len(rep["shed"]), (
+            "the crowd's excess must shed at the FLEET layer",
+            fleet_shed, rep["shed"],
+        )
+        assert all(
+            s["rid"] >= 1_000_000 for s in rep["shed"]
+        ), f"only injected clones may shed: {rep['shed']}"
+        for rid, v in rep["results"].items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, ref[rep["source_of"][rid]])
+        assert set(rep["results"]) == set(rep["admission_order"]), (
+            "every admitted request must complete"
+        )
+        return {
+            "offered": rep["offered"],
+            "admitted": len(rep["admission_order"]),
+            "shed": len(rep["shed"]),
+        }
+
     def tier_miss_kill():
         # KV economy (round 15): a replica HOLDING PROMOTED PEER-TIER
         # pages dies mid-stream. The dead replica's host tier must drop
@@ -770,6 +856,8 @@ def run_matrix(verbose: bool = False) -> list[dict]:
          "shed + degradation ladder", overload)
     cell("replica_kill", "engine replica dies mid-stream",
          "router failover + rerouted requeue", replica_kill)
+    cell("flash_crowd", "loadgen arrival amplified 12x (flash crowd)",
+         "fleet-level admission shed", flash_crowd)
     cell("swap_mid_stream", "weight-swap staging dies mid-serve",
          "swap abort, stay on old version", swap_mid_stream)
     cell("tier_miss_under_kill",

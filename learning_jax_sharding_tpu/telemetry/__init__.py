@@ -30,7 +30,12 @@ Stage 2 (PR 2) — diagnosis, four more:
   (persisted under ``analysis/profiles/``), per-source-line
   predicted-vs-measured collective attribution, and the compute /
   exposed-comm / overlapped-comm decomposition behind
-  ``GoodputLedger.overlap_report``.
+  ``GoodputLedger.overlap_report``;
+* :mod:`~.telemetry.economics` — round 20's workload observatory JOIN:
+  per-tenant cost attribution over TraceStore critical paths ×
+  GoodputLedger buckets × byte counters, with the tier-1-gated
+  conservation invariant (Σ tenant device-seconds == fleet device
+  bucket) and per-tenant SLO burn rates.
 
 Consumers: ``models.serving.ContinuousEngine`` (per-request span
 timeline, queue/page-pool gauges, SLO feed, flight-recorder lifecycle
@@ -63,6 +68,15 @@ from learning_jax_sharding_tpu.telemetry.devview import (  # noqa: F401
     memory_report,
     shard_imbalance,
 )
+from learning_jax_sharding_tpu.telemetry.economics import (  # noqa: F401
+    ATTRIBUTION_POLICY,
+    OVERHEAD_TENANT,
+    UNTAGGED_TENANT,
+    CostRates,
+    deterministic_view,
+    fleet_economics,
+    write_economics,
+)
 from learning_jax_sharding_tpu.telemetry.flight_recorder import (  # noqa: F401
     FlightRecorder,
     artifact_dir,
@@ -79,6 +93,8 @@ from learning_jax_sharding_tpu.telemetry.registry import (  # noqa: F401
     Histogram,
     MetricsRegistry,
     default_registry,
+    escape_label_value,
+    labeled_name,
 )
 from learning_jax_sharding_tpu.telemetry.slo import (  # noqa: F401
     SLOMonitor,

@@ -1,0 +1,338 @@
+"""Per-tenant cost attribution: the economics half of the workload
+observatory (round 20).
+
+The fleet already measures three things exhaustively — per-request
+critical paths (:class:`~.tracecontext.TraceStore`: queue / prefill /
+handoff / decode legs, wasted reroute legs), per-replica wall-clock
+buckets (:class:`~.ledger.GoodputLedger`: device / compile / sched /
+kv_handoff / swap / recovery / telemetry / idle, reconciling to the
+wall), and byte counters (handoff transfer plans, KV-economy tiers).
+What it could not answer is "what did tenant X's traffic COST". This
+module is the JOIN: :func:`fleet_economics` apportions every replica's
+ledger bucket seconds across tenants using each tenant's own trace-leg
+seconds on that replica as weights, prices the result with the
+:mod:`~..analysis.costmodel` device tables, and emits per-tenant
+device-seconds / tokens / bytes-moved / cost-per-token plus SLO burn
+rates.
+
+**The conservation invariant (tier-1-gated):** apportionment
+distributes each replica's measured bucket total — it never invents
+seconds — so Σ over tenants of attributed ``device`` seconds equals the
+fleet ledger's summed ``device`` bucket to within float rounding, and
+every admitted request lands in exactly one tenant's roll-up (ok,
+failed, rerouted, shed — no request is double-billed, none vanishes).
+
+**Amortization policy** (:data:`ATTRIBUTION_POLICY`, the documented
+choice the README tabulates): bucket seconds with a per-tenant signal
+apportion by that signal (``device`` and most buckets by non-queue leg
+seconds, ``kv_handoff`` by handoff bytes landed on the replica,
+``recovery`` by wasted-leg seconds); overhead buckets with no tenant
+signal on an idle replica (compile warm-up, idle, telemetry on a
+replica no tenant touched) book to the :data:`OVERHEAD_TENANT`
+pseudo-row rather than being smeared — visible overhead beats
+invisible subsidy.
+
+The ``economics.json`` artifact splits into a ``deterministic`` subtree
+(admission order, per-tenant request/token/byte tallies, pricing
+policy — byte-identical across replays of the same trace) and a
+``measured`` subtree (seconds, costs, burn — honest wall-clock, never
+identical across runs); the replay-determinism test compares the
+former and the conservation gate checks the latter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+#: Pseudo-tenant for fleet overhead no tenant's traffic can own —
+#: bucket seconds on replicas whose window saw no tenant legs at all
+#: (compile warm-up on a spare, pure idle). Kept visible as its own
+#: row: amortizing it into tenant bills silently would make every
+#: cost-per-token depend on which OTHER tenants happened to be quiet.
+OVERHEAD_TENANT = "(fleet-overhead)"
+
+#: Roll-up label for requests admitted without a tenant label.
+UNTAGGED_TENANT = "(untagged)"
+
+#: How each ledger bucket's seconds are split across tenants — the
+#: documented amortization policy (README "Workload observatory").
+ATTRIBUTION_POLICY = {
+    "device": "per-tenant non-queue trace-leg seconds on the replica",
+    "kv_handoff": "per-tenant handoff bytes landed on the replica "
+                  "(falls back to leg seconds when no handoffs)",
+    "recovery": "per-tenant wasted (thrown-away) leg seconds "
+                "(falls back to leg seconds when nothing was wasted)",
+    "compile": "per-tenant leg seconds (warm-up amortizes over the "
+               "window's actual traffic)",
+    "idle": "per-tenant leg seconds (idle capacity is billed to the "
+            "traffic that reserved the replica)",
+    "telemetry": "per-tenant leg seconds",
+    "*": "per-tenant leg seconds; replicas with zero tenant legs book "
+         f"to {OVERHEAD_TENANT!r}",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CostRates:
+    """Pricing knobs: a flat device-hour rate plus the costmodel device
+    profile whose ``link_bw`` prices bytes moved as wire-seconds (a
+    byte across the interconnect occupies the link like a second
+    occupies the chip)."""
+
+    usd_per_device_hour: float = 1.20
+    profile: str = "TPU v5 lite"
+
+
+def _tenant_of(rec: dict) -> str:
+    return rec.get("tenant") or UNTAGGED_TENANT
+
+
+def fleet_economics(
+    router,
+    *,
+    replay: dict | None = None,
+    rates: CostRates | None = None,
+    slo: Any | None = None,
+    eps: float | None = None,
+    register: bool = True,
+) -> dict:
+    """JOIN traces × ledger × counters into the per-tenant bill.
+
+    ``router`` is a drained :class:`~..fleet.router.FleetRouter` whose
+    current stats window covers the traffic to attribute; ``replay`` is
+    the :func:`~..fleet.loadgen.replay_trace` report (supplies the
+    admission order and fleet-level sheds); ``slo`` a tenant-fed
+    :class:`~.slo.SLOMonitor` for burn rates. ``register=True`` mirrors
+    each tenant's headline numbers into the router registry as
+    ``economics_*{tenant="..."}`` gauges (label values escaped), so the
+    bill scrapes like every other fleet metric.
+
+    Returns the economics document; ``measured.conservation.ok`` is the
+    tier-1 gate (Σ tenant device-seconds == fleet ledger device bucket
+    within ``eps``, default ``1e-6 · max(1, device_total)``).
+    """
+    from learning_jax_sharding_tpu.analysis.costmodel import table_profile
+
+    rates = rates or CostRates()
+    profile = table_profile(rates.profile)
+    replicas = sorted(router.replicas)
+
+    # --- gather per-replica per-tenant weights from the trace legs ----
+    # Spans are clipped to each replica ledger's current window: the
+    # TraceStore retains warm-up traffic's traces, but the buckets being
+    # apportioned start at reset_stats() — pre-window legs must carry
+    # zero weight or warm-up prompts would skew the bill.
+    win_t0 = {
+        n: router.replicas[n].engine.ledger.window_start
+        for n in replicas
+    }
+    leg_s = {n: {} for n in replicas}      # non-queue leg seconds
+    wasted_s = {n: {} for n in replicas}   # thrown-away leg seconds
+    handoff_b = {n: {} for n in replicas}  # handoff bytes landed (dst)
+    tenants: set[str] = set()
+    for rid in router.traces.rids():
+        rec = router.traces.record(rid)
+        ten = _tenant_of(rec)
+        tenants.add(ten)
+        for s in rec["spans"]:
+            if s["stage"] == "handoff":
+                # The router's span: both ends of the transfer. Bytes
+                # bill the DESTINATION replica's kv_handoff bucket —
+                # ingest is where the ledger books the time.
+                dst = s["attrs"].get("dst")
+                if dst in handoff_b and s["t1"] > win_t0[dst]:
+                    handoff_b[dst][ten] = (
+                        handoff_b[dst].get(ten, 0.0)
+                        + float(s["attrs"].get("bytes", 0))
+                    )
+                continue
+            if s["stage"] == "queue":
+                continue       # waiting costs no device-seconds
+            rep = s["replica"]
+            if rep not in leg_s:
+                continue       # replica-less spans own no ledger
+            dur = s["t1"] - max(s["t0"], win_t0[rep])
+            if dur <= 0.0:
+                continue       # warm-up leg, outside the window
+            leg_s[rep][ten] = leg_s[rep].get(ten, 0.0) + dur
+            if s["attrs"].get("wasted"):
+                wasted_s[rep][ten] = wasted_s[rep].get(ten, 0.0) + dur
+
+    # --- apportion each replica's ledger buckets ----------------------
+    ledger_buckets = {
+        n: dict(router.replicas[n].engine.ledger.window_buckets())
+        for n in replicas
+    }
+    tenant_buckets: dict[str, dict[str, float]] = {}
+
+    def _book(ten, bucket, secs):
+        tb = tenant_buckets.setdefault(ten, {})
+        tb[bucket] = tb.get(bucket, 0.0) + secs
+
+    for name in replicas:
+        for bucket, secs in ledger_buckets[name].items():
+            if secs <= 0.0:
+                continue
+            if bucket == "kv_handoff" and handoff_b[name]:
+                weights = handoff_b[name]
+            elif bucket == "recovery" and wasted_s[name]:
+                weights = wasted_s[name]
+            else:
+                weights = leg_s[name]
+            total = sum(weights.values())
+            if total <= 0.0:
+                _book(OVERHEAD_TENANT, bucket, secs)
+                continue
+            for ten, w in weights.items():
+                _book(ten, bucket, secs * (w / total))
+
+    # --- conservation: nothing invented, nothing dropped --------------
+    device_total = sum(
+        b.get("device", 0.0) for b in ledger_buckets.values()
+    )
+    attributed = sum(
+        tb.get("device", 0.0) for tb in tenant_buckets.values()
+    )
+    if eps is None:
+        eps = 1e-6 * max(1.0, device_total)
+    residual = abs(attributed - device_total)
+
+    # --- per-tenant request/token roll-up (deterministic) -------------
+    roll: dict[str, dict] = {}
+
+    def _roll(ten) -> dict:
+        return roll.setdefault(ten, {
+            "requests": 0, "ok": 0, "failed": {}, "shed": 0,
+            "reroutes": 0, "prompt_tokens": 0, "generated_tokens": 0,
+            "handoff_bytes": 0.0,
+        })
+
+    for c in router._completed:
+        r = _roll(c.get("tenant") or UNTAGGED_TENANT)
+        r["requests"] += 1
+        if c["ok"]:
+            r["ok"] += 1
+        else:
+            st = c.get("status") or "failed"
+            r["failed"][st] = r["failed"].get(st, 0) + 1
+        r["reroutes"] += int(c.get("reroutes", 0))
+        r["prompt_tokens"] += int(c.get("prompt_tokens", 0))
+        r["generated_tokens"] += int(c.get("generated", 0))
+    for shed in (replay or {}).get("shed", ()):
+        _roll(shed.get("tenant") or UNTAGGED_TENANT)["shed"] += 1
+    for name in replicas:
+        for ten, b in handoff_b[name].items():
+            _roll(ten)["handoff_bytes"] += b
+
+    # --- price it -----------------------------------------------------
+    rate_per_s = rates.usd_per_device_hour / 3600.0
+    burn = slo.tenant_burn_rates() if slo is not None else {}
+    measured_tenants: dict[str, dict] = {}
+    for ten in sorted(set(tenant_buckets) | set(roll)):
+        tb = tenant_buckets.get(ten, {})
+        secs = sum(tb.values())
+        bytes_moved = roll.get(ten, {}).get("handoff_bytes", 0.0)
+        wire_s = bytes_moved / profile.link_bw
+        cost = (secs + wire_s) * rate_per_s
+        gen = roll.get(ten, {}).get("generated_tokens", 0)
+        tburn = burn.get(ten, {})
+        measured_tenants[ten] = {
+            "bucket_seconds": {k: tb[k] for k in sorted(tb)},
+            "device_seconds": tb.get("device", 0.0),
+            "total_seconds": secs,
+            "wasted_seconds": sum(
+                wasted_s[n].get(ten, 0.0) for n in replicas
+            ),
+            "bytes_moved": bytes_moved,
+            "wire_seconds": wire_s,
+            "cost_usd": cost,
+            "cost_per_token_usd": cost / gen if gen > 0 else None,
+            "burn_rates": {k: tburn[k] for k in sorted(tburn)},
+            "worst_burn_rate": max(tburn.values(), default=0.0),
+        }
+
+    worst_tenant, worst_burn = None, 0.0
+    for ten, m in measured_tenants.items():
+        if m["worst_burn_rate"] >= worst_burn and ten != OVERHEAD_TENANT:
+            worst_tenant, worst_burn = ten, m["worst_burn_rate"]
+
+    goodput = router.goodput_report()
+    wall = goodput["fleet_wall_s"]
+    econ = {
+        "schema": "ljst.economics.v1",
+        "policy": dict(ATTRIBUTION_POLICY),
+        "pricing": {
+            "usd_per_device_hour": rates.usd_per_device_hour,
+            "profile": rates.profile,
+            "link_bw": profile.link_bw,
+        },
+        "deterministic": {
+            "admission_order": list(
+                (replay or {}).get("admission_order", ())
+            ),
+            "offered": (replay or {}).get("offered"),
+            "tenants": {t: {
+                k: roll[t][k] for k in sorted(roll[t])
+            } for t in sorted(roll)},
+        },
+        "measured": {
+            "fleet": {
+                "wall_s": wall,
+                "device_s": goodput["fleet_device_s"],
+                "goodput_ratio": (
+                    goodput["fleet_device_s"] / wall if wall > 0 else 0.0
+                ),
+                "host_share": goodput["host_share"],
+                "reconcile_ok": goodput["reconcile_ok"],
+                "replay_wall_s": (replay or {}).get("wall_s"),
+            },
+            "tenants": measured_tenants,
+            "worst_tenant": worst_tenant,
+            "worst_tenant_burn_rate": worst_burn,
+            "conservation": {
+                "ok": bool(residual <= eps),
+                "device_total_s": device_total,
+                "attributed_s": attributed,
+                "residual_s": residual,
+                "eps": eps,
+            },
+        },
+    }
+
+    if register:
+        from learning_jax_sharding_tpu.telemetry.registry import (
+            labeled_name,
+        )
+
+        reg = router.registry
+        for ten, m in measured_tenants.items():
+            reg.gauge(
+                labeled_name("economics_device_seconds", tenant=ten),
+                "attributed device-seconds this window",
+            ).set(m["device_seconds"])
+            reg.gauge(
+                labeled_name("economics_cost_usd", tenant=ten),
+                "attributed window cost",
+            ).set(m["cost_usd"])
+            if m["cost_per_token_usd"] is not None:
+                reg.gauge(
+                    labeled_name(
+                        "economics_cost_per_token_usd", tenant=ten
+                    ),
+                    "attributed cost per generated token",
+                ).set(m["cost_per_token_usd"])
+    return econ
+
+
+def deterministic_view(econ: dict) -> dict:
+    """The replay-determinism comparand: everything except the
+    ``measured`` subtree (wall-clock seconds are honest, therefore
+    never byte-identical across runs)."""
+    return {k: v for k, v in econ.items() if k != "measured"}
+
+
+def write_economics(path, econ: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(econ, f, indent=2, sort_keys=True)

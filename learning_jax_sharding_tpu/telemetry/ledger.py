@@ -210,6 +210,13 @@ class GoodputLedger:
         self._win_dev_family = dict(self._dev_family)
         self._win_dev_calls = dict(self._dev_calls)
 
+    @property
+    def window_start(self) -> float:
+        """Clock timestamp of the current window's start (creation time
+        until the first :meth:`begin_window`) — the cut economics uses to
+        keep pre-window (warm-up) trace legs out of attribution."""
+        return self._win_t
+
     def window_buckets(self) -> dict[str, float]:
         """Per-bucket seconds since :meth:`begin_window`, with derived
         ``idle`` — keys ordered canonically, zero buckets included."""

@@ -238,7 +238,10 @@ class MetricsRegistry:
         fmt = _fmt_value
 
         def parsed(name):
-            m = re.match(r"([^{]+?)(\{.*\})?$", name)
+            # re.S: a raw (pre-escaping) newline inside a label value
+            # must not crash the exporter — it degrades to an odd line,
+            # escape_label_value at construction is the real fix.
+            m = re.match(r"([^{]+?)(\{.*\})?$", name, re.S)
             return m.group(1), m.group(2) or ""
 
         fams: dict[str, list] = {}
@@ -282,6 +285,36 @@ def _fmt_value(v: float) -> str:
     return repr(float(v))
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-exposition label-value escaping: backslash,
+    double-quote, and newline must be escaped or the exposition line is
+    corrupt (a tenant named ``evil"} 1`` would otherwise terminate the
+    label set early and smuggle a fake sample). Escape at CONSTRUCTION
+    time — label sets live inside metric NAMES here, and a retro-escape
+    at render time could not tell an escaped backslash from a raw one."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def labeled_name(family: str, **labels) -> str:
+    """Compose a metric name carrying an inline Prometheus label set —
+    ``labeled_name("slo_e2e_burn_rate", tenant='a"b')`` →
+    ``'slo_e2e_burn_rate{tenant="a\\"b"}'`` — with every value escaped
+    via :func:`escape_label_value`. The one sanctioned way to build
+    labeled series from UNTRUSTED strings (tenant ids, adapter names);
+    the fixed internal labels (ledger buckets, trace stages) predate it
+    and are trusted literals."""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return f"{family}{{{inner}}}" if inner else family
+
+
 def snapshot_prometheus_text(snapshot: dict) -> str:
     """Prometheus text exposition (0.0.4) for a SNAPSHOT dict — the
     registry-independent renderer (round 11).
@@ -299,7 +332,7 @@ def snapshot_prometheus_text(snapshot: dict) -> str:
     import re
 
     def parsed(key):
-        m = re.match(r"([^{]+?)(\{.*\})?$", key)
+        m = re.match(r"([^{]+?)(\{.*\})?$", key, re.S)
         name, labels = m.group(1), m.group(2) or ""
         if name.endswith("__high_water"):
             name = name[: -len("__high_water")] + "_high_water"

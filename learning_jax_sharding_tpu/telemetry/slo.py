@@ -131,6 +131,12 @@ class SLOMonitor:
         self._burn_bad: dict[str, int] = {t.name: 0 for t in self.targets}
         self._handles: dict[str, tuple] = {}
         self._handles_registry: Any | None = None
+        # Per-tenant burn accounting (round 20) — created lazily on the
+        # first tenant-labeled observe(), so an unlabeled monitor stays
+        # BIT-compatible with the pre-tenant one: same state, same
+        # registry series, same snapshot.
+        self._tenants: dict[str, dict[str, dict]] = {}
+        self._tenant_handles: dict[tuple, tuple] = {}
 
     def estimator(self, metric: str) -> StreamingPercentile:
         est = self._est.get(metric)
@@ -161,7 +167,73 @@ class SLOMonitor:
             )
         return h
 
-    def observe(self, metric: str, value: float) -> None:
+    def _tenant_handles_for(self, t: SLOTarget, tenant: str):
+        if self.registry is None:
+            return None
+        if self._handles_registry is not self.registry:
+            self._handles = {}   # re-bound: stale handles point elsewhere
+            self._tenant_handles = {}
+            self._handles_registry = self.registry
+        key = (tenant, t.name)
+        h = self._tenant_handles.get(key)
+        if h is None:
+            from learning_jax_sharding_tpu.telemetry.registry import (
+                labeled_name,
+            )
+
+            h = self._tenant_handles[key] = (
+                self.registry.counter(
+                    labeled_name(
+                        f"slo_{t.name}_events_total", tenant=tenant
+                    ),
+                    "SLO-evaluated events",
+                ),
+                self.registry.counter(
+                    labeled_name(
+                        f"slo_{t.name}_breaches_total", tenant=tenant
+                    ),
+                    "events over the SLO threshold",
+                ),
+                self.registry.gauge(
+                    labeled_name(
+                        f"slo_{t.name}_burn_rate", tenant=tenant
+                    ),
+                    "windowed bad fraction over the error budget",
+                ),
+            )
+        return h
+
+    def _observe_tenant(self, t: SLOTarget, tenant: str, bad: bool):
+        per = self._tenants.setdefault(tenant, {})
+        s = per.get(t.name)
+        if s is None:
+            s = per[t.name] = {
+                "events": 0, "breaches": 0, "bad": 0,
+                "ring": collections.deque(maxlen=self._window),
+            }
+        s["events"] += 1
+        ring = s["ring"]
+        if len(ring) == ring.maxlen:
+            s["bad"] -= ring.popleft()
+        ring.append(bad)
+        s["bad"] += bad
+        if bad:
+            s["breaches"] += 1
+        h = self._tenant_handles_for(t, tenant)
+        if h is not None:
+            h[0].inc()
+            if bad:
+                h[1].inc()
+            h[2].set(self.tenant_burn_rate(t.name, tenant))
+
+    def observe(
+        self, metric: str, value: float, *, tenant: str | None = None,
+    ) -> None:
+        """Feed one observation. ``tenant`` additionally books it into
+        that tenant's OWN burn window and ``{tenant="..."}``-labeled
+        registry series (label values escaped) — the unlabeled series
+        keep aggregating every event exactly as before, so the
+        all-tenant view stays bit-compatible."""
         if value is None:
             return
         value = float(value)
@@ -187,9 +259,12 @@ class SLOMonitor:
                     self.recorder.record(
                         "slo_breach", target=t.name, metric=metric,
                         value=value, threshold=t.threshold,
+                        tenant=tenant,
                     )
             if handles is not None:
                 handles[2].set(self.burn_rate(t.name))
+            if tenant is not None:
+                self._observe_tenant(t, tenant, bad)
 
     def burn_rate(self, name: str) -> float:
         """Windowed breach fraction over the error budget ``1-objective``
@@ -201,6 +276,27 @@ class SLOMonitor:
             return 0.0
         frac = self._burn_bad[name] / len(ring)
         return frac / (1.0 - t.objective)
+
+    def tenant_burn_rate(self, name: str, tenant: str) -> float:
+        """One tenant's windowed burn rate for target ``name`` — 0.0
+        for a tenant (or target) that has no labeled observations yet."""
+        t = self._target(name)
+        s = self._tenants.get(tenant, {}).get(name)
+        if not s or not s["ring"]:
+            return 0.0
+        return (s["bad"] / len(s["ring"])) / (1.0 - t.objective)
+
+    def tenant_burn_rates(self) -> dict[str, dict[str, float]]:
+        """``{tenant: {target: burn_rate}}`` over every tenant that has
+        labeled observations — the per-tenant SLO burn timeline's
+        sample, and economics' worst-tenant pick."""
+        return {
+            tenant: {
+                name: self.tenant_burn_rate(name, tenant)
+                for name in per
+            }
+            for tenant, per in self._tenants.items()
+        }
 
     def _target(self, name: str) -> SLOTarget:
         for t in self.targets:
@@ -238,4 +334,20 @@ class SLOMonitor:
                 "burn_rate": br,
                 "healthy": br <= 1.0,
             }
-        return {"metrics": metrics, "targets": targets}
+        out = {"metrics": metrics, "targets": targets}
+        if self._tenants:
+            # Key present ONLY when tenant-labeled observations exist —
+            # an unlabeled monitor's snapshot is bit-identical to the
+            # pre-tenant format.
+            out["tenants"] = {
+                tenant: {
+                    name: {
+                        "events": s["events"],
+                        "breaches": s["breaches"],
+                        "burn_rate": self.tenant_burn_rate(name, tenant),
+                    }
+                    for name, s in per.items()
+                }
+                for tenant, per in self._tenants.items()
+            }
+        return out

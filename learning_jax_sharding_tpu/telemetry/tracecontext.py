@@ -89,8 +89,14 @@ class TraceStore:
 
     # --- minting -----------------------------------------------------------
 
-    def mint(self, rid: Any, *, arrival_t: float | None = None) -> str:
-        """Mint (or return the existing) trace id for ``rid``."""
+    def mint(
+        self, rid: Any, *, arrival_t: float | None = None,
+        tenant: str | None = None,
+    ) -> str:
+        """Mint (or return the existing) trace id for ``rid``.
+        ``tenant`` labels the whole journey (cost attribution, tenant
+        lanes in the Chrome export); like ``arrival_t`` it backfills an
+        implicit mint — the router's canonical stamp wins either way."""
         rec = self._recs.get(rid)
         if rec is None:
             self._next += 1
@@ -98,6 +104,7 @@ class TraceStore:
                 "trace_id": f"trace-{self._next:05d}",
                 "rid": rid,
                 "arrival_t": arrival_t,
+                "tenant": tenant,
                 "spans": [],
                 "events": [],
                 "done": False,
@@ -107,6 +114,8 @@ class TraceStore:
             self._recs[rid] = rec
         if arrival_t is not None and rec["arrival_t"] is None:
             rec["arrival_t"] = arrival_t
+        if tenant is not None and rec.get("tenant") is None:
+            rec["tenant"] = tenant
         return rec["trace_id"]
 
     def trace_of(self, rid: Any) -> str | None:
@@ -236,6 +245,7 @@ class TraceStore:
         return {
             "trace_id": rec["trace_id"],
             "rid": rid,
+            "tenant": rec.get("tenant"),
             "status": rec["status"],
             "e2e_s": e2e,
             "ttft_s": ttft,
@@ -270,7 +280,12 @@ class TraceStore:
         """One Perfetto timeline over every replica the store saw:
         replicas become named process tracks (``pid`` + process_name
         metadata), requests become ``tid`` rows within them, instants
-        render as markers. Load at https://ui.perfetto.dev."""
+        render as markers. Traces carrying a ``tenant`` label (round
+        20) additionally mirror onto per-tenant process lanes AFTER the
+        replica pids — "what did tenant X's traffic do, across every
+        replica it touched" as one track; a tenant-less store emits
+        exactly the pre-tenant document. Load at
+        https://ui.perfetto.dev."""
         replicas: list[str] = []
         for rec in self._recs.values():
             for s in rec["spans"]:
@@ -283,20 +298,34 @@ class TraceStore:
                     replicas.append(r)
         replicas.sort()
         pid_of = {r: i + 1 for i, r in enumerate(replicas)}
+        tenants = sorted({
+            rec["tenant"] for rec in self._recs.values()
+            if rec.get("tenant")
+        })
+        tenant_pid = {
+            t: len(replicas) + 1 + i for i, t in enumerate(tenants)
+        }
         events: list[dict] = [
             {
                 "name": "process_name", "ph": "M", "pid": pid,
                 "args": {"name": f"replica {r}" if r != "fleet" else "fleet"},
             }
             for r, pid in pid_of.items()
+        ] + [
+            {
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": f"tenant {t}"},
+            }
+            for t, pid in tenant_pid.items()
         ]
         base = self._t0
         for rec in self._recs.values():
             tid = rec["rid"] if isinstance(rec["rid"], int) else (
                 abs(hash(rec["rid"])) % 10_000
             )
+            lane = tenant_pid.get(rec.get("tenant"))
             for s in rec["spans"]:
-                events.append({
+                ev = {
                     "name": s["stage"],
                     "ph": "X",
                     "ts": (s["t0"] - base) * 1e6,
@@ -306,9 +335,18 @@ class TraceStore:
                     "args": {
                         "trace_id": rec["trace_id"], **s["attrs"],
                     },
-                })
+                }
+                events.append(ev)
+                if lane is not None:
+                    events.append({
+                        **ev, "pid": lane,
+                        "args": {
+                            **ev["args"],
+                            "replica": s["replica"] or "fleet",
+                        },
+                    })
             for e in rec["events"]:
-                events.append({
+                ev = {
                     "name": e["name"],
                     "ph": "i",
                     "s": "t",
@@ -318,7 +356,16 @@ class TraceStore:
                     "args": {
                         "trace_id": rec["trace_id"], **e["attrs"],
                     },
-                })
+                }
+                events.append(ev)
+                if lane is not None:
+                    events.append({
+                        **ev, "pid": lane,
+                        "args": {
+                            **ev["args"],
+                            "replica": e["replica"] or "fleet",
+                        },
+                    })
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",

@@ -181,6 +181,18 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      "exposed_comm_share_pct", False),
     (re.compile(r"comm prediction err ([\d,.]+)%"),
      "comm_model_err_pct", False),
+    # Round-20 workload-observatory gates (bench.py's `[bench] economics
+    # ...` line): fleet-wide cost per generated token on the canonical
+    # replayed day (lower — the economics JOIN pricing the same trace
+    # getting dearer means capacity got wasted somewhere); the worst
+    # tenant's SLO burn rate (lower; 0.00 on a clean round, and the
+    # zero-old floor above means any burn past the threshold fails the
+    # gate rather than sailing through on a div-by-zero pass). The
+    # line's `goodput_ratio ...%` is picked up by the round-14 pattern.
+    (re.compile(r"cost/token ([\d,.]+)\s*u\$"), "cost_per_token_uusd",
+     False),
+    (re.compile(r"worst tenant burn ([\d,.]+)"),
+     "worst_tenant_burn_rate", False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Longitudinal bench view: per-metric sparklines over BENCH_r*.json.
+
+``bench_compare.py`` gates two adjacent rounds; this script shows the
+WHOLE trajectory — every metric its pattern table can extract, one
+unicode sparkline per metric across all recorded rounds, with the
+first→last delta in the metric's own good/bad direction. The pattern
+table (and so the set of tracked metrics) is imported from
+``bench_compare.py`` — one source of truth, the history view can never
+drift from the gate.
+
+A metric absent from some rounds (benches come and go) renders a gap
+(``·``) at those rounds; metrics seen in fewer than ``--min-rounds``
+rounds are dropped (a one-round metric has no trajectory).
+
+Usage:
+    python scripts/bench_history.py [--repo DIR] [--filter SUBSTR]
+                                    [--last N] [--min-rounds 2] [--json]
+
+Exit codes: 0 ok, 2 fewer than two BENCH_r*.json found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import pathlib
+import re
+import sys
+
+_TICKS = "▁▂▃▄▅▆▇█"
+_GAP = "·"
+
+
+def _load_bench_compare():
+    """Import bench_compare.py by file path (scripts/ is not a
+    package) — its ``extract_metrics`` + ``_round_of`` are the single
+    source of metric truth."""
+    path = pathlib.Path(__file__).resolve().parent / "bench_compare.py"
+    spec = importlib.util.spec_from_file_location("bench_compare", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def sparkline(values: list[float | None]) -> str:
+    """Eight-level unicode sparkline; ``None`` renders as a gap. A flat
+    series sits mid-scale rather than dividing by zero."""
+    present = [v for v in values if v is not None]
+    if not present:
+        return _GAP * len(values)
+    lo, hi = min(present), max(present)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(_GAP)
+        elif span <= 0:
+            out.append(_TICKS[3])
+        else:
+            idx = int((v - lo) / span * (len(_TICKS) - 1))
+            out.append(_TICKS[idx])
+    return "".join(out)
+
+
+def collect_history(repo: pathlib.Path, last: int | None = None):
+    """``(rounds, {metric: {"values": [...], "higher": bool}})`` over
+    the repo's BENCH_r*.json, oldest first."""
+    bc = _load_bench_compare()
+    paths = sorted(repo.glob("BENCH_r*.json"), key=bc._round_of)
+    if last:
+        paths = paths[-last:]
+    rounds = [bc._round_of(p) for p in paths]
+    series: dict[str, dict] = {}
+    for i, p in enumerate(paths):
+        doc = json.loads(p.read_text())
+        for key, (val, higher) in bc.extract_metrics(doc).items():
+            s = series.setdefault(
+                key, {"values": [None] * len(paths), "higher": higher}
+            )
+            s["values"][i] = val
+    return rounds, series
+
+
+def render(rounds, series, *, min_rounds: int = 2) -> list[str]:
+    lines = [
+        f"bench_history: rounds r{rounds[0]:02d}..r{rounds[-1]:02d} "
+        f"({len(rounds)} recorded)"
+    ]
+    for key in sorted(series):
+        s = series[key]
+        vals = [v for v in s["values"] if v is not None]
+        if len(vals) < min_rounds:
+            continue
+        first, cur = vals[0], vals[-1]
+        delta = (cur - first) / (abs(first) if first else 1.0)
+        good = (delta >= 0) == s["higher"] or delta == 0
+        tag = "ok" if good else "WORSE"
+        arrow = "^" if delta > 0 else ("v" if delta < 0 else "=")
+        lines.append(
+            f"  {key:60s} {sparkline(s['values'])}  "
+            f"{first:>12.3f} -> {cur:>12.3f}  "
+            f"{arrow}{abs(delta) * 100.0:6.1f}%  {tag}"
+        )
+    return lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=".",
+                    help="directory holding BENCH_r*.json")
+    ap.add_argument("--filter", default=None,
+                    help="only metrics whose name contains this substring")
+    ap.add_argument("--last", type=int, default=None,
+                    help="only the most recent N rounds")
+    ap.add_argument("--min-rounds", type=int, default=2,
+                    help="drop metrics seen in fewer rounds (default 2)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    repo = pathlib.Path(args.repo)
+    rounds, series = collect_history(repo, last=args.last)
+    if len(rounds) < 2:
+        print(f"need >= 2 BENCH_r*.json in {repo}, found {len(rounds)}",
+              file=sys.stderr)
+        return 2
+    if args.filter:
+        pat = re.compile(re.escape(args.filter), re.I)
+        series = {k: v for k, v in series.items() if pat.search(k)}
+
+    if args.json:
+        print(json.dumps({
+            "rounds": rounds,
+            "metrics": {
+                k: {
+                    "values": v["values"],
+                    "higher_is_better": v["higher"],
+                    "sparkline": sparkline(v["values"]),
+                }
+                for k, v in sorted(series.items())
+                if sum(x is not None for x in v["values"])
+                >= args.min_rounds
+            },
+        }, indent=2))
+    else:
+        for ln in render(rounds, series, min_rounds=args.min_rounds):
+            print(ln)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,237 @@
+#!/usr/bin/env python
+"""Trace-driven fleet replay + per-tenant economics (PERF.md round 20).
+
+Replays a loadgen JSONL trace (default: the checked-in canonical
+24h-compressed day) through a K-replica unified fleet on the emulated
+8-device mesh, with per-tenant SLO burn sampling along the way, then
+JOINs traces × ledger windows × byte counters into the per-tenant bill
+(:func:`~learning_jax_sharding_tpu.telemetry.economics.fleet_economics`).
+
+Methodology matches the bench ladders: every replica is warmed past its
+compiles (two admission waves each + a routed handoff pass), stats
+reset, then ONE paced replay of the trace — arrivals admit at their
+trace instants (scaled by ``--speed``), so queue-wait and burn measure
+offered-load truth, not drain order.
+
+Artifacts under ``--out``: ``economics.json`` (the priced bill with the
+conservation verdict), ``burn_timeline.json`` (per-tenant SLO burn
+sampled ~2 Hz across the replay), ``replay_trace.json`` (the merged
+Perfetto timeline with tenant lanes).
+
+Usage:
+    python scripts/replay.py [--trace PATH] [--regen] [--speed S]
+                             [--k K] [--out DIR] [--bench-lines] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+force_emulated_devices(8)
+
+import dataclasses  # noqa: E402
+
+import flax.linen as nn  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+NEW = 16
+
+#: The replay's SLO book: thresholds sized to the emulated-CPU fleet so
+#: burn rates are informative (neither pinned at 0 nor all-breach).
+def _targets():
+    from learning_jax_sharding_tpu.telemetry import SLOTarget
+
+    return [
+        SLOTarget("queue_wait", 0.25, objective=0.9),
+        SLOTarget("ttft", 0.5, objective=0.9),
+        SLOTarget("e2e", 2.0, objective=0.9),
+    ]
+
+
+def _build():
+    from learning_jax_sharding_tpu.models.transformer import (
+        CONFIG_TINY,
+        Transformer,
+    )
+
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(0), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    return cfg, params
+
+
+def _warm(router, cfg):
+    """Compile-out warm: two admission waves per replica (first_refill
+    AND the steady-state refill_step) plus a routed pass through the
+    fleet seams — all before the stats window opens."""
+    rng = np.random.default_rng(7)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, size=(n,)).astype(np.int32)
+        for n in rng.integers(6, 14, size=8)
+    ]
+    for rep in router.replicas.values():
+        b = rep.engine._b
+        rep.engine.serve(
+            rep.params, [prompts[j % len(prompts)] for j in range(b + 1)]
+        )
+    for i in range(2 * len(router.replicas)):
+        router.add_request(prompts[i % len(prompts)])
+    router.drain(max_steps=2000)
+    router.pop_finished()
+
+
+def run_replay(
+    trace_path, *, k: int = 4, speed: float = 2.0, out_dir=None,
+):
+    from learning_jax_sharding_tpu.fleet import (
+        FleetRouter,
+        make_replicas,
+        read_trace,
+        replay_trace,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.telemetry import (
+        SLOMonitor,
+        fleet_economics,
+        write_economics,
+    )
+
+    header, events = read_trace(trace_path)
+    cfg, params = _build()
+    slo = SLOMonitor(_targets())
+    kw = dict(
+        batch_size=4, max_new_tokens=NEW, refill_chunk=16,
+        decode_block_steps=8, slo=slo,
+    )
+    reps = make_replicas(
+        cfg, RULES_DP_TP, params, count=k, mesh_shape=(1, 2), **kw,
+    )
+    router = FleetRouter(reps)
+    _warm(router, cfg)
+    router.reset_stats()
+
+    # ~2 Hz per-tenant burn sampler — the SLO burn TIMELINE artifact.
+    timeline: list[dict] = []
+    last = [-1.0]
+
+    def _tick(elapsed: float) -> None:
+        if elapsed - last[0] < 0.5:
+            return
+        last[0] = elapsed
+        timeline.append({
+            "t_s": round(elapsed, 3),
+            "burn": slo.tenant_burn_rates(),
+        })
+
+    rep = replay_trace(
+        router, events, seed=header["seed"], vocab_size=cfg.vocab_size,
+        speed=speed, pace=True, on_tick=_tick,
+    )
+    econ = fleet_economics(router, replay=rep, slo=slo)
+
+    if out_dir is not None:
+        out_dir = pathlib.Path(out_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        write_economics(out_dir / "economics.json", econ)
+        with open(out_dir / "burn_timeline.json", "w") as f:
+            json.dump(
+                {"speed": speed, "samples": timeline}, f, indent=2,
+            )
+        with open(out_dir / "replay_trace.json", "w") as f:
+            json.dump(router.merged_chrome_trace(), f)
+
+    m = econ["measured"]
+    gen = sum(
+        t["generated_tokens"]
+        for t in econ["deterministic"]["tenants"].values()
+    )
+    total_cost = sum(t["cost_usd"] for t in m["tenants"].values())
+    cpt = total_cost / gen if gen else 0.0
+    line = (
+        f"[bench] economics replay K={k} (canonical day, "
+        f"speed {speed:g}x): "
+        f"goodput_ratio {m['fleet']['goodput_ratio'] * 100:.1f}%, "
+        f"cost/token {cpt * 1e6:,.3f} u$, "
+        f"worst tenant burn {m['worst_tenant_burn_rate']:.2f} "
+        f"({m['worst_tenant']}), "
+        f"{len(rep['admission_order'])} requests "
+        f"({len(rep['shed'])} shed), {gen} tok"
+    )
+    summary = dict(
+        bench_line=line,
+        k=k, speed=speed, offered=rep["offered"],
+        admitted=len(rep["admission_order"]), shed=len(rep["shed"]),
+        generated_tokens=gen,
+        goodput_ratio=m["fleet"]["goodput_ratio"],
+        cost_per_token_usd=cpt,
+        worst_tenant=m["worst_tenant"],
+        worst_tenant_burn_rate=m["worst_tenant_burn_rate"],
+        conservation_ok=m["conservation"]["ok"],
+        replay_wall_s=rep["wall_s"],
+        timeline_samples=len(timeline),
+    )
+    return [line], summary, econ
+
+
+def main(argv=None) -> int:
+    from learning_jax_sharding_tpu.fleet import (
+        canonical_day_spec,
+        canonical_trace_path,
+        write_trace,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--trace", default=None,
+                    help="trace JSONL (default: the canonical day)")
+    ap.add_argument("--regen", action="store_true",
+                    help="regenerate the canonical trace in place first")
+    ap.add_argument("--speed", type=float, default=2.0,
+                    help="replay speedup over trace time (default 2x)")
+    ap.add_argument("--k", type=int, default=4,
+                    help="unified replicas on (1,2) sub-meshes")
+    ap.add_argument("--out", default=None,
+                    help="artifact directory (economics.json, "
+                         "burn_timeline.json, replay_trace.json)")
+    ap.add_argument("--bench-lines", action="store_true",
+                    help="print only the [bench] lines (for bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    if args.regen:
+        n = len(write_trace(canonical_trace_path(), canonical_day_spec()))
+        if not (args.bench_lines or args.json):
+            print(f"regenerated {canonical_trace_path()} ({n} events)")
+    trace = args.trace or canonical_trace_path()
+
+    t0 = time.perf_counter()
+    lines, summary, _ = run_replay(
+        trace, k=args.k, speed=args.speed, out_dir=args.out,
+    )
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        for ln in lines:
+            print(ln)
+    if not args.bench_lines and not args.json:
+        print(f"replay: done in {time.perf_counter() - t0:.1f} s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -431,6 +431,43 @@ class TestExtraction:
         assert not by["commscope_overlap_(8-dev_emulated)"
                       ":exposed_comm_share_pct"]["regressed"]
 
+    def test_economics_gates_direction_aware(self):
+        """The round-20 workload-observatory gates: cost per generated
+        token and the worst tenant's SLO burn rate regress UP; the
+        goodput ratio rides the round-14 pattern and regresses DOWN.
+        Burn holds at exactly 0.00 on a clean round, so the zero-old
+        1-unit floor is what makes a 0 → 1.5 burn jump FAIL the gate
+        instead of sailing through a div-by-zero pass."""
+        line = (
+            "[bench] economics replay K=4 (canonical day, speed 2x): "
+            "goodput_ratio 1.1%, cost/token 12.291 u$, worst tenant "
+            "burn 0.00 (interactive), 79 requests (0 shed), 1264 tok"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        name = "economics_replay_K=4_(canonical_day,_speed_2x)"
+        assert m[f"{name}:cost_per_token_uusd"] == (12.291, False)
+        assert m[f"{name}:worst_tenant_burn_rate"] == (0.0, False)
+        assert m[f"{name}:goodput_ratio_pct"] == (1.1, True)
+        worse = _doc([
+            line.replace("cost/token 12.291 u$", "cost/token 30.000 u$")
+            .replace("worst tenant burn 0.00", "worst tenant burn 1.50")
+            .replace("goodput_ratio 1.1%", "goodput_ratio 0.4%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{name}:cost_per_token_uusd"]["regressed"]
+        assert by[f"{name}:worst_tenant_burn_rate"]["regressed"]
+        assert by[f"{name}:goodput_ratio_pct"]["regressed"]
+        better = _doc([
+            line.replace("cost/token 12.291 u$", "cost/token 6.000 u$")
+            .replace("goodput_ratio 1.1%", "goodput_ratio 2.5%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), better, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert not by[f"{name}:cost_per_token_uusd"]["regressed"]
+        assert not by[f"{name}:worst_tenant_burn_rate"]["regressed"]
+        assert not by[f"{name}:goodput_ratio_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

@@ -1,0 +1,135 @@
+"""The longitudinal bench view: sparklines over BENCH_r*.json rounds.
+
+``scripts/bench_history.py`` renders the whole metric trajectory that
+``bench_compare.py`` only gates two rounds at a time; these tests pin
+what keeps it readable and honest — the metric set comes from
+bench_compare's own pattern table (one source of truth), gaps render as
+gaps instead of fabricated zeros, the first→last delta is judged in the
+metric's OWN good/bad direction, and rounds sort numerically.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_history",
+    pathlib.Path(__file__).resolve().parents[1] / "scripts"
+    / "bench_history.py",
+)
+bench_history = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_history)
+
+
+def _doc(tail_lines):
+    return {"tail": "\n".join(tail_lines)}
+
+
+def _write(tmp_path, n, doc):
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(doc))
+
+
+class TestSparkline:
+    def test_levels_and_gaps(self):
+        s = bench_history.sparkline([1.0, None, 8.0])
+        assert len(s) == 3
+        assert s[0] == "▁" and s[1] == "·" and s[2] == "█"
+
+    def test_flat_series_sits_mid_scale(self):
+        assert bench_history.sparkline([5.0, 5.0, 5.0]) == "▄▄▄"
+
+    def test_all_gaps(self):
+        assert bench_history.sparkline([None, None]) == "··"
+
+
+class TestCollect:
+    def test_history_across_rounds_with_gaps(self, tmp_path):
+        _write(tmp_path, 1, _doc(
+            ["[bench] decode: 1,000 tok/s, 2.0 ms/step"]
+        ))
+        _write(tmp_path, 2, _doc(["[bench] decode: 1,500 tok/s"]))
+        # r10 after r02/r09: numeric round order, not lexicographic.
+        _write(tmp_path, 10, _doc(
+            ["[bench] decode: 2,000 tok/s, 1.0 ms/step"]
+        ))
+        rounds, series = bench_history.collect_history(tmp_path)
+        assert rounds == [1, 2, 10]
+        assert series["decode:tok_s"]["values"] == [
+            1000.0, 1500.0, 2000.0,
+        ]
+        assert series["decode:tok_s"]["higher"] is True
+        # The round that dropped ms/step is a GAP, not a zero.
+        assert series["decode:ms_per_step"]["values"] == [
+            2.0, None, 1.0,
+        ]
+
+    def test_last_n_window(self, tmp_path):
+        for n, v in ((1, "1,000"), (2, "1,500"), (3, "2,000")):
+            _write(tmp_path, n, _doc([f"[bench] decode: {v} tok/s"]))
+        rounds, series = bench_history.collect_history(tmp_path, last=2)
+        assert rounds == [2, 3]
+        assert series["decode:tok_s"]["values"] == [1500.0, 2000.0]
+
+
+class TestRender:
+    def test_direction_aware_tags(self, tmp_path):
+        _write(tmp_path, 1, _doc(
+            ["[bench] decode: 1,000 tok/s, 2.0 ms/step"]
+        ))
+        _write(tmp_path, 2, _doc(
+            ["[bench] decode: 500 tok/s, 1.0 ms/step"]
+        ))
+        rounds, series = bench_history.collect_history(tmp_path)
+        out = "\n".join(bench_history.render(rounds, series))
+        tok = next(
+            ln for ln in out.splitlines() if "decode:tok_s" in ln
+        )
+        ms = next(
+            ln for ln in out.splitlines() if "decode:ms_per_step" in ln
+        )
+        # tok/s HALVED: worse. ms/step halved: better (lower is better).
+        assert "WORSE" in tok and "v  50.0%" in tok
+        assert "ok" in ms and "WORSE" not in ms
+
+    def test_min_rounds_drops_one_round_metrics(self, tmp_path):
+        _write(tmp_path, 1, _doc(["[bench] decode: 1,000 tok/s"]))
+        _write(tmp_path, 2, _doc(
+            ["[bench] decode: 1,100 tok/s",
+             "[bench] newcomer: 5.0 ms/step"]
+        ))
+        rounds, series = bench_history.collect_history(tmp_path)
+        out = "\n".join(bench_history.render(rounds, series))
+        assert "decode:tok_s" in out
+        assert "newcomer" not in out
+
+
+class TestMain:
+    def test_exit_codes_and_filter(self, tmp_path, capsys):
+        assert bench_history.main(["--repo", str(tmp_path)]) == 2
+        _write(tmp_path, 1, _doc(
+            ["[bench] decode: 1,000 tok/s, 2.0 ms/step"]
+        ))
+        _write(tmp_path, 2, _doc(
+            ["[bench] decode: 1,200 tok/s, 1.5 ms/step"]
+        ))
+        assert bench_history.main(["--repo", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "rounds r01..r02" in out and "decode:tok_s" in out
+        assert bench_history.main(
+            ["--repo", str(tmp_path), "--filter", "ms_per_step"]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "ms_per_step" in out and "tok_s" not in out
+
+    def test_json_output(self, tmp_path, capsys):
+        _write(tmp_path, 1, _doc(["[bench] decode: 1,000 tok/s"]))
+        _write(tmp_path, 2, _doc(["[bench] decode: 1,200 tok/s"]))
+        assert bench_history.main(
+            ["--repo", str(tmp_path), "--json"]
+        ) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["rounds"] == [1, 2]
+        m = doc["metrics"]["decode:tok_s"]
+        assert m["values"] == [1000.0, 1200.0]
+        assert m["higher_is_better"] is True
+        assert len(m["sparkline"]) == 2

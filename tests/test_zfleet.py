@@ -675,7 +675,7 @@ class TestGoodputTracing:
         doc = router.merged_chrome_trace()
         names = {
             e["args"]["name"] for e in doc["traceEvents"]
-            if e["ph"] == "M"
+            if e["ph"] == "M" and "name" in e["args"]
         }
         assert {"replica prefill0", "replica decode0"} <= names
         assert any(n.startswith("requests: ") for n in names)

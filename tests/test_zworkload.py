@@ -1,0 +1,441 @@
+"""Workload observatory (round 20): loadgen traces + per-tenant economics.
+
+Named to sort LAST alongside ``test_zfleet`` / ``test_zero_downtime``
+(same rationale: the end-to-end oracles build multi-replica fleets, and
+the tier-1 window should spend its budget on the fast oracles first).
+
+Four layers, cheapest first:
+
+* the TRACE FORMAT as a contract — generation is deterministic, the
+  JSONL bytes regenerate identically (including the checked-in canonical
+  day), prompt content resynthesizes from ``(seed, rid)`` alone, and the
+  reader refuses versions/counts it cannot honor;
+* the tenant-labeled SLO extension — an UNLABELED monitor stays
+  bit-compatible with the pre-tenant one, hostile tenant names cannot
+  corrupt the Prometheus exposition (the escaping satellite);
+* the CONSERVATION INVARIANT on a replayed K=2 fleet — Σ per-tenant
+  attributed device-seconds equals the fleet ledger's device bucket,
+  every admitted request lands in exactly ONE tenant roll-up (ok, shed,
+  rerouted — none double-billed, none vanish), and a mid-replay replica
+  kill books the wasted reroute legs to the ORIGINATING tenant;
+* REPLAY DETERMINISM — same seed + same trace through a fresh fleet
+  reproduces the admission order and the byte-identical
+  ``deterministic`` subtree of economics.json.
+"""
+
+import dataclasses
+import json
+import re
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.fleet import (
+    FleetPolicy,
+    FleetRouter,
+    FlashCrowd,
+    TenantSpec,
+    TraceSpec,
+    canonical_day_spec,
+    canonical_trace_path,
+    generate_trace,
+    make_replicas,
+    read_trace,
+    replay_trace,
+    synth_prompt,
+    write_trace,
+)
+from learning_jax_sharding_tpu.models.serving import RequestFailure
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+from learning_jax_sharding_tpu.robustness import ChaosInjector, Fault
+from learning_jax_sharding_tpu.telemetry import (
+    MetricsRegistry,
+    OVERHEAD_TENANT,
+    SLOMonitor,
+    SLOTarget,
+    deterministic_view,
+    fleet_economics,
+)
+from learning_jax_sharding_tpu.telemetry.registry import (
+    escape_label_value,
+    labeled_name,
+)
+
+#: A tenant name crafted to break Prometheus text exposition unless label
+#: values are escaped (terminates the label set early, smuggles a fake
+#: sample) — threaded through the FULL path: trace → fleet → SLO series
+#: → economics gauges.
+HOSTILE = 'evil"} 1'
+
+#: One sample per physical line, label set intact. Family names may
+#: carry dots (SLO target names embed thresholds: ``slo_e2e_le_0.2_…``);
+#: what must NEVER appear is a raw quote/newline escaping a label value.
+_EXPO_LINE = re.compile(r"^[A-Za-z_][\w:.]*(\{.*\})? [^ ]+$")
+
+
+def _assert_exposition_parses(text: str) -> None:
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _EXPO_LINE.match(line), (
+            f"corrupt exposition line: {line!r}"
+        )
+
+
+def _spec() -> TraceSpec:
+    return TraceSpec(
+        duration_s=2.0,
+        seed=9,
+        tenants=(
+            TenantSpec(
+                "alpha", rate_rps=3.0, prompt_len_min=3,
+                prompt_len_tail=2.0, prompt_len_max=10,
+            ),
+            TenantSpec(
+                "beta", rate_rps=2.0, burstiness=2.0, prompt_len_min=4,
+                prompt_len_tail=3.0, prompt_len_max=12,
+            ),
+            TenantSpec(
+                HOSTILE, rate_rps=1.5, prompt_len_min=3,
+                prompt_len_tail=2.0, prompt_len_max=8,
+            ),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def built():
+    cfg = dataclasses.replace(CONFIG_TINY, dtype=jnp.float32)
+    model = Transformer(cfg)
+    params = nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(5), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+    return cfg, params
+
+
+def _fleet(cfg, params, *, slo=None, max_inflight=None):
+    kw = dict(batch_size=2, max_new_tokens=6, refill_chunk=8)
+    if slo is not None:
+        kw["slo"] = slo
+    reps = make_replicas(
+        cfg, RULES_DP_TP, params, count=2, mesh_shape=(1, 1), **kw,
+    )
+    policy = (
+        FleetPolicy(max_inflight=max_inflight)
+        if max_inflight is not None else None
+    )
+    return reps, FleetRouter(reps, policy=policy)
+
+
+def _cap(events) -> int:
+    """Admission cap sized to force a few fleet-level sheds: unpaced
+    replay admits the whole trace up front, so exactly the trailing
+    ``len(events) - cap`` arrivals shed."""
+    return max(4, len(events) - 3)
+
+
+@pytest.fixture(scope="module")
+def replayed(built):
+    """ONE replayed K=2 fleet shared by the conservation-side tests:
+    trace in, economics out, with per-tenant SLO burn (threshold pinned
+    below any real e2e, so every retirement breaches — burn rates are
+    exactly budget⁻¹ = 2.0) and a shed-forcing admission cap."""
+    cfg, params = built
+    spec = _spec()
+    events = generate_trace(spec)
+    slo = SLOMonitor([SLOTarget("e2e", 1e-6, objective=0.5)])
+    reps, router = _fleet(
+        cfg, params, slo=slo, max_inflight=_cap(events),
+    )
+    rep = replay_trace(
+        router, events, seed=spec.seed, vocab_size=cfg.vocab_size,
+        pace=False,
+    )
+    econ = fleet_economics(router, replay=rep, slo=slo)
+    return spec, events, router, rep, econ
+
+
+class TestTraceFormat:
+    def test_generation_is_deterministic_and_sorted(self):
+        a, b = generate_trace(_spec()), generate_trace(_spec())
+        assert a == b
+        assert len(a) >= 8
+        assert [e["rid"] for e in a] == list(range(len(a)))
+        assert all(
+            a[i]["t"] <= a[i + 1]["t"] for i in range(len(a) - 1)
+        )
+        assert {e["tenant"] for e in a} == {"alpha", "beta", HOSTILE}
+
+    def test_write_trace_is_byte_identical(self, tmp_path):
+        p1, p2 = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        ev1 = write_trace(p1, _spec())
+        ev2 = write_trace(p2, _spec())
+        assert ev1 == ev2
+        assert p1.read_bytes() == p2.read_bytes()
+        header, events = read_trace(p1)
+        assert events == ev1
+        assert header["seed"] == 9 and header["events"] == len(ev1)
+
+    def test_canonical_trace_regenerates_byte_identical(self, tmp_path):
+        """The checked-in canonical day IS its spec's output — a drifted
+        generator (or a hand-edited trace) fails here, which is the
+        replayability guarantee bench_economics leans on."""
+        regen = tmp_path / "canonical.jsonl"
+        write_trace(regen, canonical_day_spec())
+        assert regen.read_bytes() == canonical_trace_path().read_bytes()
+
+    def test_reader_refuses_wrong_version_and_count(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        write_trace(p, _spec())
+        lines = p.read_text().splitlines()
+        header = json.loads(lines[0])
+        header["trace_version"] = 99
+        (tmp_path / "v.jsonl").write_text(
+            "\n".join([json.dumps(header)] + lines[1:]) + "\n"
+        )
+        with pytest.raises(ValueError, match="version"):
+            read_trace(tmp_path / "v.jsonl")
+        (tmp_path / "c.jsonl").write_text("\n".join(lines[:-1]) + "\n")
+        with pytest.raises(ValueError, match="promises"):
+            read_trace(tmp_path / "c.jsonl")
+
+    def test_synth_prompt_deterministic_keyed_by_rid(self):
+        a = synth_prompt(9, 3, 12, 256)
+        b = synth_prompt(9, 3, 12, 256)
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.int32 and a.shape == (12,)
+        assert a.min() >= 1 and a.max() < 256
+        assert not np.array_equal(a, synth_prompt(9, 4, 12, 256))
+        assert not np.array_equal(a, synth_prompt(10, 3, 12, 256))
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="rate_rps"):
+            TenantSpec("x", rate_rps=0.0)
+        with pytest.raises(ValueError, match="unique"):
+            TraceSpec(
+                duration_s=1.0,
+                tenants=(
+                    TenantSpec("x", rate_rps=1.0),
+                    TenantSpec("x", rate_rps=2.0),
+                ),
+            )
+        with pytest.raises(ValueError, match="unknown tenant"):
+            TraceSpec(
+                duration_s=1.0,
+                tenants=(TenantSpec("x", rate_rps=1.0),),
+                flash_crowds=(FlashCrowd("y", t_s=0.0, duration_s=1.0),),
+            )
+        with pytest.raises(ValueError, match="alpha"):
+            TenantSpec("x", rate_rps=1.0, prompt_len_alpha=1.0)
+
+    def test_flash_crowd_adds_arrivals_inside_window(self):
+        base = _spec()
+        crowd = dataclasses.replace(
+            base,
+            flash_crowds=(
+                FlashCrowd(
+                    "alpha", t_s=0.5, duration_s=1.0, multiplier=10.0
+                ),
+            ),
+        )
+        ev_base, ev_crowd = generate_trace(base), generate_trace(crowd)
+        extra = len(ev_crowd) - len(ev_base)
+        assert extra > 0
+        # The added arrivals all live inside the crowd's window, and the
+        # base process is untouched (additive, not reshaping).
+        base_times = [e["t"] for e in ev_base]
+        added = [e["t"] for e in ev_crowd if e["t"] not in base_times]
+        assert len(added) == extra
+        assert all(0.5 <= t < 1.5 for t in added)
+
+
+class TestTenantSLO:
+    def _feed(self, mon, tenants):
+        for i in range(20):
+            mon.observe(
+                "e2e", 0.1 + 0.2 * (i % 2),
+                tenant=tenants[i % len(tenants)] if tenants else None,
+            )
+
+    def test_unlabeled_monitor_bit_compatible(self):
+        t = [SLOTarget("e2e", 0.2, objective=0.5)]
+        plain, labeled = SLOMonitor(t), SLOMonitor(t)
+        self._feed(plain, [])
+        self._feed(labeled, ["a", "b"])
+        sp, sl = plain.snapshot(), labeled.snapshot()
+        # The aggregate (unlabeled) view is IDENTICAL — tenants only add.
+        assert sp["targets"] == sl["targets"]
+        assert sp["metrics"] == sl["metrics"]
+        assert "tenants" not in sp and "tenants" in sl
+        assert sl["tenants"]["a"]["e2e_le_0.2"]["events"] == 10
+
+    def test_tenant_burn_isolated(self):
+        mon = SLOMonitor([SLOTarget("e2e", 0.5, objective=0.5)])
+        for _ in range(8):
+            mon.observe("e2e", 1.0, tenant="hot")    # all breach
+            mon.observe("e2e", 0.1, tenant="cold")   # none breach
+        assert mon.tenant_burn_rate("e2e_le_0.5", "hot") == 2.0
+        assert mon.tenant_burn_rate("e2e_le_0.5", "cold") == 0.0
+        assert mon.tenant_burn_rate("e2e_le_0.5", "never-seen") == 0.0
+        assert mon.burn_rate("e2e_le_0.5") == 1.0   # aggregate: half bad
+        assert mon.tenant_burn_rates() == {
+            "hot": {"e2e_le_0.5": 2.0}, "cold": {"e2e_le_0.5": 0.0},
+        }
+
+    def test_escape_label_value_exact(self):
+        assert escape_label_value('evil"} 1') == 'evil\\"} 1'
+        assert escape_label_value("back\\slash") == "back\\\\slash"
+        assert escape_label_value("new\nline") == "new\\nline"
+        assert (
+            labeled_name("x_total", tenant='a"b\\c\nd')
+            == 'x_total{tenant="a\\"b\\\\c\\nd"}'
+        )
+
+    def test_hostile_tenant_cannot_corrupt_exposition(self):
+        reg = MetricsRegistry()
+        mon = SLOMonitor(
+            [SLOTarget("e2e", 0.2, objective=0.5)], registry=reg,
+        )
+        nasty = 'evil"} 1\n\\'
+        for _ in range(4):
+            mon.observe("e2e", 1.0, tenant=nasty)
+        text = reg.prometheus_text()
+        assert 'tenant="evil\\"} 1\\n\\\\"' in text
+        _assert_exposition_parses(text)
+
+
+class TestConservation:
+    def test_conservation_gate(self, replayed):
+        *_, econ = replayed
+        cons = econ["measured"]["conservation"]
+        assert cons["ok"], cons
+        assert cons["residual_s"] <= cons["eps"]
+        assert cons["device_total_s"] > 0
+        assert econ["measured"]["fleet"]["reconcile_ok"]
+
+    def test_every_request_in_exactly_one_rollup(self, replayed):
+        spec, events, router, rep, econ = replayed
+        rolls = econ["deterministic"]["tenants"]
+        assert sum(
+            r["requests"] for r in rolls.values()
+        ) == len(rep["admission_order"])
+        assert sum(r["shed"] for r in rolls.values()) == len(rep["shed"])
+        assert len(rep["shed"]) == len(events) - _cap(events) > 0
+        assert len(rep["admission_order"]) + len(rep["shed"]) == len(
+            events
+        ) == rep["offered"]
+        assert set(rolls) <= {"alpha", "beta", HOSTILE}
+        for ten, r in rolls.items():
+            assert r["ok"] + sum(r["failed"].values()) == r["requests"]
+            if r["ok"]:
+                assert r["generated_tokens"] > 0
+                assert r["prompt_tokens"] > 0
+
+    def test_attributed_seconds_and_burn_per_tenant(self, replayed):
+        *_, econ = replayed
+        m = econ["measured"]
+        served = {
+            t for t, r in econ["deterministic"]["tenants"].items()
+            if r["ok"]
+        }
+        for ten in served:
+            mt = m["tenants"][ten]
+            assert mt["device_seconds"] > 0
+            assert mt["cost_usd"] > 0
+            assert mt["cost_per_token_usd"] > 0
+            # Threshold pinned below any real e2e: every retirement
+            # breaches, so each served tenant burns exactly 1/budget.
+            assert mt["worst_burn_rate"] == pytest.approx(2.0)
+        assert m["worst_tenant"] in served
+        assert m["worst_tenant_burn_rate"] == pytest.approx(2.0)
+        assert m["worst_tenant"] != OVERHEAD_TENANT
+
+    def test_hostile_tenant_survives_full_path(self, replayed):
+        """The hostile name rode the trace → fleet → SLO → economics
+        path; the router registry's exposition must still parse."""
+        *_, router, rep, econ = replayed
+        assert HOSTILE in econ["deterministic"]["tenants"]
+        text = router.registry.prometheus_text()
+        assert 'tenant="evil\\"} 1"' in text
+        _assert_exposition_parses(text)
+
+
+class TestKillAttribution:
+    def test_mid_replay_kill_books_waste_to_originating_tenant(
+        self, built
+    ):
+        """A replica dies mid-replay: its partial work reroutes and
+        recomputes on the survivor; the thrown-away legs surface as
+        per-tenant ``wasted_seconds`` on the tenants whose requests
+        rerouted — and conservation still holds (the wasted seconds are
+        real ledger seconds, attributed, not invented or dropped)."""
+        cfg, params = built
+        spec = _spec()
+        events = generate_trace(spec)
+        reps, router = _fleet(cfg, params)
+        with ChaosInjector(
+            Fault("fleet.step", "raise", at=2, count=1),
+        ):
+            rep = replay_trace(
+                router, events, seed=spec.seed,
+                vocab_size=cfg.vocab_size, pace=False,
+            )
+        assert sum(not r.alive for r in reps) == 1
+        for rid, v in rep["results"].items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+        assert set(rep["results"]) == set(rep["admission_order"])
+
+        econ = fleet_economics(router, replay=rep, register=False)
+        cons = econ["measured"]["conservation"]
+        assert cons["ok"], cons
+        rolls = econ["deterministic"]["tenants"]
+        assert sum(
+            r["requests"] for r in rolls.values()
+        ) == len(rep["admission_order"])
+        assert sum(r["reroutes"] for r in rolls.values()) >= 1
+        wasted = {
+            t: m["wasted_seconds"]
+            for t, m in econ["measured"]["tenants"].items()
+            if m["wasted_seconds"] > 0
+        }
+        assert wasted, "the kill must surface wasted reroute legs"
+        # Waste books to the ORIGINATING tenant: only tenants whose own
+        # requests rerouted may carry wasted seconds.
+        for ten in wasted:
+            assert rolls[ten]["reroutes"] >= 1, (ten, wasted, rolls)
+
+
+class TestReplayDeterminism:
+    def test_same_seed_same_trace_same_economics(self, built, replayed):
+        """A FRESH fleet replaying the same trace reproduces the
+        admission order, the shed set, and the byte-identical
+        ``deterministic`` subtree of economics.json."""
+        cfg, params = built
+        spec, events, _, rep_a, econ_a = replayed
+        slo = SLOMonitor([SLOTarget("e2e", 1e-6, objective=0.5)])
+        reps, router = _fleet(
+            cfg, params, slo=slo, max_inflight=_cap(events),
+        )
+        rep_b = replay_trace(
+            router, events, seed=spec.seed, vocab_size=cfg.vocab_size,
+            pace=False,
+        )
+        assert rep_b["admission_order"] == rep_a["admission_order"]
+        assert rep_b["shed"] == rep_a["shed"]
+        assert rep_b["tenant_of"] == rep_a["tenant_of"]
+        econ_b = fleet_economics(router, replay=rep_b, register=False)
+        assert json.dumps(
+            deterministic_view(econ_b), sort_keys=True
+        ) == json.dumps(deterministic_view(econ_a), sort_keys=True)
+        # ... while the measured subtree is honest wall-clock (present,
+        # reconciled, never asserted identical).
+        assert econ_b["measured"]["conservation"]["ok"]
