@@ -669,6 +669,132 @@ def bench_commscope():
     }
 
 
+def bench_topology():
+    """Topology observatory (round 21): the two-tier interconnect model
+    (``analysis/topology.py``) closed against real execution three ways,
+    each a tracked bench_compare gate.
+
+    * ``topo err`` per searchable entry — the overlap-aware prediction
+      (``max(compute, memory) + exposed comm``) vs the measured step,
+      from ``scripts/shardcheck.py --pass topo --json`` on the emulated
+      8-device mesh (the same gate CI runs; the serial-sum error on the
+      same line is context, not a gate — serial is the honest upper
+      bound, not the claim).
+    * ``dcn B/token`` + ``overlap gap`` — what the static model says
+      the train step pushes across the slow tier per token, and how far
+      the profile's pinned overlap ratio sits from the ledger's realized
+      one (``decompose_overlap``); both lower-is-better drift signals.
+    * ``topo argmin gap`` — the seeded two-tier acceptance scenario
+      (``scripts/layout_search.py --topo-gap``, abstract pricing only):
+      flat pricing parks the hot all-reduce on the DCN tier, topology
+      pricing routes it onto ICI. Deterministic, so the gap collapsing
+      toward 0 can only mean hierarchy pricing lost its discrimination
+      power — gated HIGHER-is-better, the inverse of every error gate.
+    """
+    import os
+    import pathlib
+    import subprocess
+
+    root = pathlib.Path(__file__).resolve().parent
+    env = {**os.environ, "JAX_PLATFORMS": ""}
+    proc = subprocess.run(
+        [sys.executable, str(root / "scripts" / "shardcheck.py"),
+         "--pass", "topo", "--json"],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-5:])
+        raise RuntimeError(
+            f"shardcheck --pass topo exited {proc.returncode}: {tail}"
+        )
+    doc = json.loads(proc.stdout)
+    topo = doc.get("topo") or {}
+    programs = topo.get("programs", [])
+    entries: dict = {}
+    worst = 0.0
+    for pr in programs:
+        err = float(pr["err_topo_pct"])
+        worst = max(worst, err)
+        _log(
+            f"[bench] topo {pr['name']}: measured "
+            f"{pr['measured_s'] * 1e3:.2f} ms vs overlap-aware "
+            f"{pr['topo_predicted_s'] * 1e3:.2f} ms, topo err "
+            f"{err:.1f}% (serial-sum {pr['err_serial_pct']:.1f}%), "
+            f"dcn {pr['dcn_bytes'] / 1e3:.1f} kB predicted / "
+            f"{pr['observed_dcn_bytes'] / 1e3:.1f} kB contract"
+        )
+        entries[pr["name"]] = {
+            k: pr[k] for k in (
+                "measured_s", "topo_predicted_s", "serial_predicted_s",
+                "err_topo_pct", "err_serial_pct", "ici_bytes",
+                "dcn_bytes", "observed_dcn_bytes",
+            )
+        }
+    train = next(
+        (p for p in programs if p["name"] == "train_step"), None
+    )
+    dcn_per_token = None
+    if train and train.get("tokens_per_step"):
+        dcn_per_token = (
+            float(train["dcn_bytes"]) / float(train["tokens_per_step"])
+        )
+        _log(
+            f"[bench] topo dcn: train_step moves {dcn_per_token:,.1f} "
+            f"dcn B/token ({train['dcn_bytes']:.0f} B over "
+            f"{train['tokens_per_step']} tokens)"
+        )
+    overlap_gap_pp = None
+    if train:
+        used = train.get("overlap_ratio_used")
+        realized = (train.get("realized") or {}).get(
+            "realized_overlap_ratio"
+        )
+        if used is not None and realized is not None:
+            overlap_gap_pp = abs(float(used) - float(realized)) * 100.0
+            _log(
+                f"[bench] topo overlap: train_step profile predicts "
+                f"{float(used):.2f}, ledger realized "
+                f"{float(realized):.2f}, overlap gap "
+                f"{overlap_gap_pp:.1f} pp"
+            )
+    # The seeded two-tier canary: abstract pricing, nothing compiles.
+    proc2 = subprocess.run(
+        [sys.executable, str(root / "scripts" / "layout_search.py"),
+         "--topo-gap"],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    if proc2.returncode != 0:
+        tail = "\n".join((proc2.stderr or proc2.stdout).splitlines()[-5:])
+        raise RuntimeError(
+            f"layout_search --topo-gap exited {proc2.returncode}: {tail}"
+        )
+    argmin_block = None
+    for line in proc2.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+        elif line.startswith("[bench-json] "):
+            argmin_block = json.loads(line[len("[bench-json] "):])
+    if entries:
+        _log(
+            f"[bench] topo summary: worst of {len(entries)} entries, "
+            f"topo err {worst:.1f}%"
+        )
+    if not (entries or argmin_block):
+        return None
+    return {
+        "profile": (topo.get("topology") or {}).get("name"),
+        "entries": entries,
+        "worst_err_pct": worst,
+        "dcn_bytes_per_token": dcn_per_token,
+        "overlap_predicted_vs_realized_pp": overlap_gap_pp,
+        "argmin": argmin_block,
+        "findings": [
+            f for f in doc.get("findings", [])
+            if f.get("check") == "topo"
+        ],
+    }
+
+
 def bench_moe_125m():
     """MoE context line: 125M-class with E=8 top-2 routed FFs (GShard
     capacity routing, fp32 router — models/moe.py), same harness as the
@@ -1445,6 +1571,11 @@ def main():
     except Exception as e:
         _log(f"[bench] economics bench skipped: {type(e).__name__}: {e}")
         economics_block = None
+    try:
+        topology_block = bench_topology()
+    except Exception as e:
+        _log(f"[bench] topology bench skipped: {type(e).__name__}: {e}")
+        topology_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1513,6 +1644,13 @@ def main():
         # `goodput_ratio` / `cost/token` / `worst tenant burn`
         # patterns), with the tier-1 conservation verdict.
         "economics": economics_block,
+        # Round-21 topology observatory: the two-tier interconnect
+        # model's reconcile errors per searchable entry, the train
+        # step's priced DCN bytes/token and overlap prediction gap, and
+        # the seeded flat-vs-topo argmin canary (analysis/topology.py;
+        # gated by bench_compare's `topo err` / `dcn B/token` /
+        # `overlap gap` / `topo argmin gap` patterns).
+        "topology": topology_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

@@ -38,6 +38,10 @@ from learning_jax_sharding_tpu.analysis.shardflow import (
     CommEvent,
     ShardflowReport,
 )
+from learning_jax_sharding_tpu.analysis.topology import (
+    TIER_DCN,
+    TopologyProfile,
+)
 
 # ---------------------------------------------------------------------------
 # Platform profiles
@@ -334,6 +338,144 @@ def calibrate_axis_profiles(
     )
 
 
+def price_event_topo(
+    ev: CommEvent,
+    profile: Profile,
+    mesh_sizes: dict[str, int],
+    topology: TopologyProfile,
+) -> tuple[float, float, bool]:
+    """Tier-aware serial price for one predicted event: ``(seconds,
+    wire_bytes, is_dcn)``, both × trip for in-loop events.
+
+    The event's axes price under the TOPOLOGY's α–β (latencies add,
+    bandwidth is the slowest link — a ring with one DCN hop moves at
+    DCN speed); an event with any untagged axis falls back to the flat
+    :func:`price_event` path and stays in the ICI bucket, so an
+    untagged mesh prices exactly as the flat model. ``is_dcn`` marks
+    events whose ring crosses a DCN boundary — the bytes the topo pass
+    audits and the layout search minimizes."""
+    t = 0.0
+    wire_total = 0.0
+    is_dcn = False
+    for (op, _ax) in ev.realizations[:1]:
+        n = 1
+        for a in ev.axes:
+            n *= mesh_sizes.get(a, 1)
+        wire = ev.bytes * _ring_factor(op, n)
+        if wire <= 0:
+            t = 0.0
+            wire_total = 0.0
+            continue
+        wire_total = wire
+        ab = topology.alpha_beta(ev.axes)
+        if ab is not None:
+            is_dcn = topology.bucket(ev.axes) == TIER_DCN
+            t = ab[0] + wire / max(ab[1], 1.0)
+        else:
+            ab_flat = _axis_alpha_beta(profile, ev.axes)
+            if ab_flat is not None:
+                t = ab_flat[0] + wire / max(ab_flat[1], 1.0)
+            else:
+                t = wire / max(profile.link_bw, 1.0)
+    trip = (ev.trip or 1) if ev.in_loop else 1
+    return t * trip, wire_total * trip, is_dcn
+
+
+@dataclasses.dataclass(frozen=True)
+class TopoMultisetPrice:
+    """A tier-bucketed, overlap-discounted collective multiset price.
+
+    ``serial_s`` is what the flat model would bill under the tier-
+    correct α–β (every event end to end); ``collective_s`` is the
+    EXPOSED time after the realized-overlap discount — the number that
+    lands in a step-time prediction. Per-tier seconds/bytes carry the
+    split the gates consume (``dcn_bytes`` is the metric a hierarchy-
+    aware layout search drives down)."""
+
+    collective_s: float
+    serial_s: float
+    ici_s: float
+    dcn_s: float
+    ici_bytes: float
+    dcn_bytes: float
+    overlap_ratio: float | None
+    aborted: bool = False
+
+    @property
+    def wire_bytes(self) -> float:
+        return self.ici_bytes + self.dcn_bytes
+
+    def to_dict(self) -> dict:
+        return {
+            "collective_s": self.collective_s,
+            "serial_s": self.serial_s,
+            "ici_s": self.ici_s,
+            "dcn_s": self.dcn_s,
+            "ici_bytes": self.ici_bytes,
+            "dcn_bytes": self.dcn_bytes,
+            "overlap_ratio": self.overlap_ratio,
+            "aborted": self.aborted,
+        }
+
+
+def price_multiset_topo(
+    events: list,
+    profile: Profile,
+    mesh_sizes: dict[str, int],
+    *,
+    topology: TopologyProfile,
+    overlap_ratio: float | None = None,
+    abort_above: float | None = None,
+) -> TopoMultisetPrice:
+    """The topology/overlap mode of :func:`price_multiset`: every event
+    priced under its axes' TIER α–β, bucketed ICI vs DCN, and the
+    exposed total discounted by the program family's measured realized-
+    overlap ratio (``exposed = (1 − r) × serial``, applied per event so
+    ``abort_above`` prunes on the same quantity the caller compares).
+    ``overlap_ratio=None`` bills serial — the honest upper bound when
+    no measurement exists. Memoized alongside the flat path; the
+    topology's :meth:`~.topology.TopologyProfile.key` and the discount
+    join the memo key, so a re-tagged axis or a new overlap table can
+    never serve stale prices."""
+    r = 0.0 if overlap_ratio is None else min(max(overlap_ratio, 0.0), 1.0)
+    key_base = (
+        profile.name, profile.link_bw, profile.axis_profiles,
+        tuple(sorted(mesh_sizes.items())), topology.key(),
+    )
+    exposed = serial = 0.0
+    ici_s = dcn_s = 0.0
+    ici_b = dcn_b = 0.0
+    for ev in events:
+        trip = (ev.trip or 1) if ev.in_loop else 1
+        key = key_base + (
+            ev.realizations[:1], ev.axes, int(ev.bytes), trip,
+        )
+        row = _MULTISET_MEMO.get(key)
+        if row is None:
+            if len(_MULTISET_MEMO) >= _MULTISET_MEMO_MAX:
+                _MULTISET_MEMO.clear()
+            row = _MULTISET_MEMO[key] = price_event_topo(
+                ev, profile, mesh_sizes, topology,
+            )
+        t, wire, is_dcn = row
+        serial += t
+        exposed += t * (1.0 - r)
+        if is_dcn:
+            dcn_s += t
+            dcn_b += wire
+        else:
+            ici_s += t
+            ici_b += wire
+        if abort_above is not None and exposed > abort_above:
+            return TopoMultisetPrice(
+                exposed, serial, ici_s, dcn_s, ici_b, dcn_b,
+                overlap_ratio, aborted=True,
+            )
+    return TopoMultisetPrice(
+        exposed, serial, ici_s, dcn_s, ici_b, dcn_b, overlap_ratio,
+    )
+
+
 #: Per-(op, axes, bytes, trip) wire-seconds memo for :func:`price_multiset`,
 #: additionally keyed by (profile name, link bandwidth, mesh sizes) so a
 #: calibrated profile or a different mesh can never serve stale prices.
@@ -349,6 +491,8 @@ def price_multiset(
     mesh_sizes: dict[str, int],
     *,
     abort_above: float | None = None,
+    topology: TopologyProfile | None = None,
+    overlap_ratio: float | None = None,
 ) -> tuple[float, float, bool]:
     """Batch-price a collective event multiset with memoized per-(op,
     axes, bytes, trip) pricing — the layout search's inner loop
@@ -363,7 +507,19 @@ def price_multiset(
     exceeds it and ``aborted`` is True — the search's dominance prune: a
     candidate whose collective term alone already exceeds the incumbent's
     total step time cannot win, so the rest of its events go unpriced.
+
+    **Topology/overlap mode** (round 21): with ``topology`` set, every
+    event prices under its axes' TIER α–β and the total is the EXPOSED
+    time after the ``overlap_ratio`` discount — the delegation target
+    is :func:`price_multiset_topo`; use it directly when the ICI/DCN
+    split matters. Flat callers are bit-identical to before.
     """
+    if topology is not None:
+        tp = price_multiset_topo(
+            events, profile, mesh_sizes, topology=topology,
+            overlap_ratio=overlap_ratio, abort_above=abort_above,
+        )
+        return tp.collective_s, tp.wire_bytes, tp.aborted
     key_base = (
         profile.name, profile.link_bw, profile.axis_profiles,
         tuple(sorted(mesh_sizes.items())),
@@ -473,6 +629,116 @@ def price(
         hbm_bytes=report.hbm_bytes,
         wire_bytes=wire,
         profile=profile,
+        n_dev=n_dev,
+    )
+
+
+@dataclasses.dataclass
+class TopoPredictedCost:
+    """An overlap-aware, hierarchy-priced step estimate.
+
+    The flat model takes ``max(compute, memory, collective)`` — right
+    when comm fully hides OR fully dominates, wrong in between. The
+    overlap-aware form follows the round-19 ledger's decomposition
+    (``decompose_overlap``: device = compute + exposed + overlapped):
+    the overlapped share of the collective serial time hides under the
+    compute/memory roofline, the EXPOSED share adds on top —
+
+        ``predicted_s = max(compute_s, memory_s) + exposed collective``
+
+    With no measured overlap ratio the exposed share is the full
+    serial time, which upper-bounds the flat max — never optimistic.
+    """
+
+    name: str
+    compute_s: float
+    memory_s: float
+    comm: TopoMultisetPrice
+    flops: float
+    hbm_bytes: float
+    profile: Profile
+    topology: TopologyProfile
+    n_dev: int = 1
+
+    @property
+    def predicted_s(self) -> float:
+        return max(self.compute_s, self.memory_s) + self.comm.collective_s
+
+    @property
+    def serial_predicted_s(self) -> float:
+        """The flat combination under tier-correct α–β — what this
+        topology costs WITHOUT the overlap discount."""
+        return max(self.compute_s, self.memory_s, self.comm.serial_s)
+
+    @property
+    def bound(self) -> str:
+        best = max(
+            ("compute", self.compute_s),
+            ("memory", self.memory_s),
+            ("collective", self.comm.serial_s),
+            key=lambda kv: kv[1],
+        )
+        return best[0]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "predicted_s": self.predicted_s,
+            "serial_predicted_s": self.serial_predicted_s,
+            "bound": self.bound,
+            "compute_s": self.compute_s,
+            "memory_s": self.memory_s,
+            "collective_s": self.comm.collective_s,
+            "collective_serial_s": self.comm.serial_s,
+            "ici_s": self.comm.ici_s,
+            "dcn_s": self.comm.dcn_s,
+            "ici_bytes": self.comm.ici_bytes,
+            "dcn_bytes": self.comm.dcn_bytes,
+            "overlap_ratio": self.comm.overlap_ratio,
+            "flops": self.flops,
+            "hbm_bytes": self.hbm_bytes,
+            "profile": self.profile.name,
+            "topology": self.topology.name,
+        }
+
+
+def price_topo(
+    report: ShardflowReport,
+    profile: Profile | None = None,
+    *,
+    topology: TopologyProfile,
+    overlap_ratio: float | None = None,
+) -> TopoPredictedCost:
+    """Price one shardflow report under a two-tier topology with the
+    overlap-aware combination. ``overlap_ratio=None`` consults the
+    topology's own per-family table (keyed by the report name, then
+    ``"_default"``); pass an explicit ratio to override — the topo
+    pass feeds the ledger's measured per-family ratio here."""
+    if profile is None:
+        profile = current_profile()
+    if overlap_ratio is None:
+        overlap_ratio = topology.overlap_ratio(report.name)
+    mesh_sizes = dict(zip(report.mesh_axes, report.mesh_shape))
+    n_dev = max(1, math.prod(report.mesh_shape))
+    comm = price_multiset_topo(
+        report.events, profile, mesh_sizes, topology=topology,
+        overlap_ratio=overlap_ratio,
+    )
+    thin = min(report.flops_thin, report.flops)
+    thin_rate = profile.thin_flops or (profile.peak_flops * profile.mfu_eff)
+    compute = ((report.flops - thin) / n_dev) / max(
+        profile.peak_flops * profile.mfu_eff, 1.0
+    ) + (thin / n_dev) / max(thin_rate, 1.0)
+    memory = report.hbm_bytes / max(profile.hbm_bw * profile.mbu_eff, 1.0)
+    return TopoPredictedCost(
+        name=report.name,
+        compute_s=compute,
+        memory_s=memory,
+        comm=comm,
+        flops=report.flops,
+        hbm_bytes=report.hbm_bytes,
+        profile=profile,
+        topology=topology,
         n_dev=n_dev,
     )
 

@@ -214,6 +214,11 @@ class SearchResult:
     report: ShardflowReport
     baseline_report: ShardflowReport
     contract: Contract
+    # Hierarchy-aware mode (round 21): the two-tier profile candidates
+    # were priced under, None on a flat search. baseline/best are then
+    # costmodel.TopoPredictedCost (same predicted_s/to_dict surface,
+    # plus the ICI/DCN split in .comm).
+    topology: Any = None
     # HBM feasibility (populated only when search_layout ran with
     # hbm_budget_bytes set; fits is None on an unconstrained search).
     hbm_budget_bytes: float | None = None
@@ -265,6 +270,8 @@ class SearchResult:
             }
         return {
             "name": self.name,
+            **({"topology": self.topology.name}
+               if self.topology is not None else {}),
             **({"hbm": hbm} if hbm else {}),
             "mesh_axes": self.mesh_axes,
             "mesh_shape": self.mesh_shape,
@@ -337,6 +344,8 @@ def search_layout(
     hbm_budget_bytes: float | None = None,
     hbm_headroom: float = 0.8,
     donated: tuple = (),
+    topology: Any = None,
+    overlap_ratio: float | None = None,
     **kwargs,
 ) -> SearchResult:
     """Search the sharding layout of ``fn(*args)``'s argument leaves.
@@ -354,7 +363,16 @@ def search_layout(
     :func:`~.memflow.simulate_memflow` BEFORE pricing and layouts over
     the cap are rejected — the result is the cheapest layout that fits,
     with ``SearchResult.fits=False`` only when no enumerated candidate
-    fits within the budget (then the incumbent is reported as-is)."""
+    fits within the budget (then the incumbent is reported as-is).
+
+    With ``topology`` (a :class:`~.topology.TopologyProfile`), every
+    candidate prices under the two-tier α–β instead of the flat link
+    model (:func:`~.costmodel.price_multiset_topo`) and the returned
+    costs are :class:`~.costmodel.TopoPredictedCost` — the argmin then
+    keeps hot collectives on ICI and pushes only what must cross DCN,
+    and ``best.comm.dcn_bytes`` carries the priced cross-tier traffic.
+    ``overlap_ratio=None`` consults the topology's per-family table
+    (keyed by ``name``); serial when absent — never optimistic."""
     import jax
 
     from learning_jax_sharding_tpu.analysis import memflow
@@ -402,11 +420,27 @@ def search_layout(
         key=lambda d: (-group_bytes[d.group], d.group, -d.nbytes, d.path)
     )
 
+    # Resolve the overlap discount ONCE so every candidate (and the
+    # abort_above prune threshold) compares the same exposed quantity.
+    eff_overlap = overlap_ratio
+    if topology is not None and eff_overlap is None:
+        eff_overlap = topology.overlap_ratio(name)
+
     def evaluate(specs, abort_above=None):
         rep = simulate_jaxpr(
             name, closed, specs, mesh,
             while_trip_hint=while_trip_hint, arg_avals=leaves,
         )
+        if topology is not None:
+            tp = costmodel.price_multiset_topo(
+                rep.events, profile, mesh_sizes, topology=topology,
+                overlap_ratio=eff_overlap, abort_above=abort_above,
+            )
+            if tp.aborted:
+                return rep, None
+            return rep, costmodel.price_topo(
+                rep, profile, topology=topology, overlap_ratio=eff_overlap,
+            )
         coll, _wire, aborted = costmodel.price_multiset(
             rep.events, profile, mesh_sizes, abort_above=abort_above,
         )
@@ -525,6 +559,7 @@ def search_layout(
         report=best_report,
         baseline_report=base_report,
         contract=contract_from_report(best_report),
+        topology=topology,
         hbm_budget_bytes=hbm_budget_bytes,
         hbm_headroom=hbm_headroom,
         oom_rejected=oom_rejected,
@@ -583,11 +618,15 @@ def search_entry(
     hbm_budget_bytes: float | None = None,
     hbm_headroom: float = 0.8,
     donated: tuple = (),
+    topology: Any = None,
+    overlap_ratio: float | None = None,
 ) -> SearchResult:
     """Run the layout search for one searchable entry point
     (``entrypoints.SEARCHABLE_ENTRIES``), built by the SAME builders the
     contract pass compiles — the committed argument shardings are the
-    hand-tuned incumbent the search must beat or match."""
+    hand-tuned incumbent the search must beat or match. ``topology``
+    switches candidate pricing to the hierarchy-aware two-tier mode
+    (see :func:`search_layout`)."""
     from learning_jax_sharding_tpu.analysis.entrypoints import (
         build_search_inputs,
     )
@@ -608,5 +647,6 @@ def search_entry(
             budget=budget, profile=profile,
             while_trip_hint=t["while_trip_hint"],
             hbm_budget_bytes=hbm_budget_bytes, hbm_headroom=hbm_headroom,
-            donated=donated, **t["kwargs"],
+            donated=donated, topology=topology,
+            overlap_ratio=overlap_ratio, **t["kwargs"],
         )

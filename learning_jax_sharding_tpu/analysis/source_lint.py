@@ -60,6 +60,18 @@ structure matters:
   is a fault the flight recorder never saw. Catch the narrowest type
   and at least ``recorder.record(...)`` it; genuinely-intentional
   crash-path guards ride the baseline with a reason.
+* ``axis-literal`` — a bare ``"data"``/``"model"``/``"pipe"`` string
+  constant in the topology-aware surfaces (``fleet/``, ``analysis/``):
+  these modules plan placement against whatever axes the MESH and the
+  :class:`~.topology.TopologyProfile` actually carry, so a hardcoded
+  axis name silently breaks on a single-axis mesh or a renamed axis —
+  the planner prices the wrong tier and nobody notices. Import
+  ``DATA_AXIS``/``MODEL_AXIS``/``DEFAULT_AXIS_NAMES`` from
+  ``parallel.mesh`` (or thread the axis through from the mesh/profile
+  in scope). Scoped to fleet/ and analysis/ because the model/rules
+  layers (``parallel/logical.py``) are the canonical DEFINITION sites
+  of those names; definition-site and fixture literals ride the
+  baseline with reasons.
 
 Findings carry ``file:line`` and a stable rule id; pre-existing hits are
 carried in ``analysis/baseline.json`` — a (file, rule) → count budget —
@@ -534,6 +546,43 @@ class _Visitor(ast.NodeVisitor):
                 ))
 
 
+#: Mesh-axis names whose bare-literal spelling the ``axis-literal``
+#: rule flags, and the source surfaces it polices. Kept textually in
+#: sync with ``parallel.mesh.DATA_AXIS``/``MODEL_AXIS`` and
+#: ``parallel.pipeline.PIPE_AXIS`` (a deliberate copy: the lint must
+#: not import jax-loading modules to stay milliseconds-cheap).
+_AXIS_LITERALS = frozenset({"data", "model", "pipe"})
+_AXIS_LINT_DIRS = frozenset({"fleet", "analysis"})
+
+
+def _axis_literal_findings(path: str, tree: ast.AST) -> list[Finding]:
+    """``axis-literal`` over one parsed file — every string constant
+    spelling a mesh-axis name in a fleet/ or analysis/ source file.
+    Equality (not substring) keeps docstrings and prose out; the
+    path gate keeps the canonical definition sites (parallel/) and the
+    model layers out."""
+    parts = pathlib.PurePosixPath(path).parts
+    if not (_AXIS_LINT_DIRS & set(parts)):
+        return []
+    out: list[Finding] = []
+    for n in ast.walk(tree):
+        if (
+            isinstance(n, ast.Constant)
+            and isinstance(n.value, str)
+            and n.value in _AXIS_LITERALS
+        ):
+            out.append(Finding(
+                "ast", "axis-literal", f"{path}:{n.lineno}",
+                f"hardcoded mesh-axis name {n.value!r} in a "
+                "topology-aware surface — a single-axis mesh or a "
+                "renamed axis silently misprices the tier; import "
+                "DATA_AXIS/MODEL_AXIS/DEFAULT_AXIS_NAMES from "
+                "parallel.mesh or thread the axis from the "
+                "mesh/TopologyProfile in scope",
+            ))
+    return out
+
+
 def _raw_clock_findings(path: str, lines: list[str]) -> list[Finding]:
     out: list[Finding] = []
     for i, line in enumerate(lines):
@@ -567,7 +616,7 @@ def lint_source(path: str | pathlib.Path, text: str | None = None) -> list[Findi
         )]
     v = _Visitor(str(path), lines)
     v.visit(tree)
-    return out + v.findings
+    return out + _axis_literal_findings(str(path), tree) + v.findings
 
 
 def lint_tree(

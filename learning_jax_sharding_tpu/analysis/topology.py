@@ -1,0 +1,385 @@
+"""Two-tier interconnect topology: which mesh axes are ICI, which are DCN.
+
+Everything the analysis stack priced before round 21 assumed ONE
+uniform interconnect — every axis at ``Profile.link_bw`` (or its
+commscope-measured α–β), every collective serial-summed. A real
+multi-host fleet is a HIERARCHY (2211.05322 §2): devices inside a pod
+talk over ICI (high bandwidth, sub-µs latency), pods talk over DCN
+(an order of magnitude less bandwidth, orders more latency), and the
+partitioner's collectives are expected to OVERLAP with compute
+(2105.04663) rather than bill serially. This module is the shared
+vocabulary for that hierarchy:
+
+* :class:`AxisTier` / :class:`TopologyProfile` — per-mesh-axis tier tag
+  (``"ici"`` | ``"dcn"``) with that tier's own α–β link model, plus the
+  per-program-family REALIZED overlap ratios the round-19 ledger
+  measures (``telemetry.commscope.decompose_overlap``). Hashable, so
+  pricing memos can key on it; JSON round-trippable, so profiles
+  version under ``analysis/profiles/`` next to commscope's.
+* **Domain carving** — ``ici_domain_devices`` says how many CONSECUTIVE
+  flat-ordered devices share one ICI domain (``parallel.build_mesh``
+  reshapes ``jax.devices()`` row-major, so the leading mesh axis is the
+  one that crosses hosts). :meth:`TopologyProfile.domain_of` classifies
+  a device; :func:`segment_tier` classifies a resharding-plan segment —
+  the primitive ``fleet/replica.py::sub_meshes`` and the transfer-plan
+  DCN accounting both build on.
+* **Loading** — :func:`TopologyProfile.load` reads a versioned JSON;
+  :meth:`TopologyProfile.from_comm_profile` tags a measured commscope
+  profile with tiers; :func:`reference_two_tier` pins a synthetic
+  two-tier profile (ICI ≫ DCN) for searches and seeded acceptance
+  cases that must not depend on live calibration.
+
+The default tier map encodes the deployment this repo plans for:
+**data-parallel grad-sync crosses DCN, tensor-parallel stays on ICI**
+— the leading (``data``) axis spans hosts, every inner axis stays
+inside the pod. ``costmodel.price_multiset(topology=...)`` prices each
+event under its axes' tier α–β and discounts by the family's realized
+overlap; ``analysis.run_topo_pass`` gates the result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import pathlib
+from typing import Any, Iterable, Mapping
+
+TIER_ICI = "ici"
+TIER_DCN = "dcn"
+TIERS = (TIER_ICI, TIER_DCN)
+
+TOPOLOGY_VERSION = 1
+
+#: Where versioned topology profiles live, next to commscope's.
+PROFILE_DIR = pathlib.Path(__file__).resolve().parent / "profiles"
+
+#: The canonical axis→tier map for this repo's meshes: the leading
+#: data-parallel axis is the one that crosses hosts (grad-sync over
+#: DCN); tensor/pipeline-inner axes stay inside the pod on ICI. Axis
+#: names not listed default to ICI — the flat model's assumption, so an
+#: untagged mesh prices exactly as before.
+DEFAULT_TIERS: dict[str, str] = {
+    "data": TIER_DCN,
+    "model": TIER_ICI,
+    "pipe": TIER_ICI,
+}
+
+#: Reference link models (per 2211.05322 §2 / public v5e specs): ICI at
+#: tens of GB/s with sub-µs setup, DCN an order of magnitude down in
+#: bandwidth and orders up in latency. Used by
+#: :func:`reference_two_tier` so seeded searches price a hierarchy that
+#: looks like the real one without any live calibration.
+REFERENCE_LINKS: dict[str, tuple[float, float]] = {
+    TIER_ICI: (1e-6, 45e9),      # (alpha_s, beta_bytes_per_s)
+    TIER_DCN: (75e-6, 3.125e9),  # ~25 Gb/s effective per host NIC
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class AxisTier:
+    """One mesh axis's place in the hierarchy: its tier and that
+    link's α–β model (``t = α + wire_bytes/β``)."""
+
+    axis: str
+    tier: str
+    alpha_s: float
+    beta_bytes_per_s: float
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(
+                f"axis {self.axis!r}: tier must be one of {TIERS}, "
+                f"got {self.tier!r}"
+            )
+        if self.beta_bytes_per_s <= 0:
+            raise ValueError(
+                f"axis {self.axis!r}: beta must be > 0, "
+                f"got {self.beta_bytes_per_s}"
+            )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AxisTier":
+        return cls(
+            axis=d["axis"], tier=d["tier"], alpha_s=float(d["alpha_s"]),
+            beta_bytes_per_s=float(d["beta_bytes_per_s"]),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyProfile:
+    """The two-tier interconnect model for one mesh.
+
+    ``axes`` carries every mesh axis's tier + α–β; ``overlap`` carries
+    ``(program_family, realized_overlap_ratio)`` pairs measured by the
+    goodput ledger's :func:`~..telemetry.commscope.decompose_overlap`
+    (the ``"_default"`` family prices programs without their own
+    measurement; no entry at all → serial-sum, the honest upper bound).
+    ``ici_domain_devices`` is the flat-order carving grain: devices
+    ``[k·g, (k+1)·g)`` share ICI domain ``k``.
+
+    Frozen + tuple-typed on purpose: pricing memos
+    (``costmodel._MULTISET_MEMO``) key on :meth:`key`, and a mutable
+    profile could serve stale prices.
+    """
+
+    name: str
+    axes: tuple[AxisTier, ...]
+    ici_domain_devices: int
+    overlap: tuple[tuple[str, float], ...] = ()
+    version: int = TOPOLOGY_VERSION
+    source: str = "reference"
+
+    def __post_init__(self):
+        if self.ici_domain_devices < 1:
+            raise ValueError(
+                f"ici_domain_devices must be >= 1, "
+                f"got {self.ici_domain_devices}"
+            )
+        names = [a.axis for a in self.axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis entries: {names}")
+        for fam, r in self.overlap:
+            if not (0.0 <= r <= 1.0):
+                raise ValueError(
+                    f"overlap ratio for {fam!r} must be in [0, 1], got {r}"
+                )
+
+    # --- lookup ---------------------------------------------------------
+
+    def axis_tier(self, axis: str) -> AxisTier | None:
+        for a in self.axes:
+            if a.axis == axis:
+                return a
+        return None
+
+    def tier_of(self, axis: str) -> str:
+        """The axis's tier; untagged axes default to ICI (the flat
+        model's assumption — an unknown axis must not silently price
+        at DCN rates)."""
+        a = self.axis_tier(axis)
+        return a.tier if a is not None else TIER_ICI
+
+    def bucket(self, axes: Iterable[str]) -> str:
+        """A collective's tier bucket: DCN if ANY of its axes crosses
+        a DCN boundary — the slow hop dominates the ring."""
+        return (
+            TIER_DCN
+            if any(self.tier_of(a) == TIER_DCN for a in axes)
+            else TIER_ICI
+        )
+
+    def alpha_beta(self, axes: Iterable[str]) -> tuple[float, float] | None:
+        """Combined (α, β) over the event's axes — latencies add
+        (sequential ring phases), bandwidth is the slowest link; the
+        same combination rule as ``costmodel._axis_alpha_beta``. None
+        when any axis is untagged: the caller falls back to its flat
+        pricing path rather than guessing a tier."""
+        alpha, beta, seen = 0.0, math.inf, False
+        for ax in axes:
+            a = self.axis_tier(ax)
+            if a is None:
+                return None
+            alpha += a.alpha_s
+            beta = min(beta, a.beta_bytes_per_s)
+            seen = True
+        return (alpha, beta) if seen else None
+
+    def dcn_axes(self) -> tuple[str, ...]:
+        return tuple(a.axis for a in self.axes if a.tier == TIER_DCN)
+
+    def dcn_alpha_beta(self) -> tuple[float, float]:
+        """The (α, β) a cross-domain hop pays — worst α, slowest β over
+        the DCN-tier axes; the reference DCN link when none is tagged
+        (so KV peer-traffic pricing never silently returns free)."""
+        dcn = [a for a in self.axes if a.tier == TIER_DCN]
+        if not dcn:
+            return REFERENCE_LINKS[TIER_DCN]
+        return (
+            max(a.alpha_s for a in dcn),
+            min(a.beta_bytes_per_s for a in dcn),
+        )
+
+    def dcn_seconds(self, nbytes: float) -> float:
+        """Seconds one cross-domain (DCN) hop of ``nbytes`` costs."""
+        if nbytes <= 0:
+            return 0.0
+        alpha, beta = self.dcn_alpha_beta()
+        return alpha + nbytes / beta
+
+    def overlap_ratio(self, family: str | None) -> float | None:
+        """The realized overlap ratio for one program family (exact
+        match, else ``"_default"``, else None → serial-sum)."""
+        table = dict(self.overlap)
+        if family is not None and family in table:
+            return table[family]
+        return table.get("_default")
+
+    # --- domain carving -------------------------------------------------
+
+    def domain_of_id(self, device_id: int) -> int:
+        return int(device_id) // self.ici_domain_devices
+
+    def domain_of(self, device: Any) -> int:
+        """The ICI domain a device belongs to. Flat consecutive
+        carving on ``device.id`` — the same row-major order
+        ``build_mesh`` / ``sub_meshes`` consume ``jax.devices()`` in."""
+        return self.domain_of_id(getattr(device, "id", device))
+
+    # --- identity / serialization --------------------------------------
+
+    def key(self) -> tuple:
+        """Hashable identity for pricing memos: every field that can
+        change a price participates."""
+        return (
+            self.name, self.version, self.ici_domain_devices,
+            tuple((a.axis, a.tier, a.alpha_s, a.beta_bytes_per_s)
+                  for a in self.axes),
+            self.overlap,
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "source": self.source,
+            "ici_domain_devices": self.ici_domain_devices,
+            "axes": [a.to_dict() for a in self.axes],
+            "overlap": {fam: r for fam, r in self.overlap},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TopologyProfile":
+        ver = int(d.get("version", TOPOLOGY_VERSION))
+        if ver != TOPOLOGY_VERSION:
+            raise ValueError(
+                f"topology profile version {ver} unsupported "
+                f"(this build reads {TOPOLOGY_VERSION})"
+            )
+        return cls(
+            name=d["name"],
+            version=ver,
+            source=d.get("source", "file"),
+            ici_domain_devices=int(d["ici_domain_devices"]),
+            axes=tuple(AxisTier.from_dict(a) for a in d["axes"]),
+            overlap=tuple(sorted(
+                (str(k), float(v))
+                for k, v in d.get("overlap", {}).items()
+            )),
+        )
+
+    def save(self, path: str | pathlib.Path) -> pathlib.Path:
+        path = pathlib.Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(self.to_dict(), indent=2) + "\n")
+        return path
+
+    @classmethod
+    def load(cls, path: str | pathlib.Path) -> "TopologyProfile":
+        return cls.from_dict(json.loads(pathlib.Path(path).read_text()))
+
+    @staticmethod
+    def default_path(
+        platform: str, mesh_shape: tuple[int, ...]
+    ) -> pathlib.Path:
+        shape = "x".join(str(s) for s in mesh_shape)
+        return PROFILE_DIR / f"topology_{platform}_{shape}.json"
+
+    # --- constructors ---------------------------------------------------
+
+    @classmethod
+    def from_comm_profile(
+        cls,
+        comm_profile: Any,
+        *,
+        tiers: Mapping[str, str] | None = None,
+        overlap: Mapping[str, float] | None = None,
+        name: str | None = None,
+    ) -> "TopologyProfile":
+        """Tag a measured :class:`~..telemetry.commscope.CommProfile`
+        with tiers: the α–β per axis are the MEASURED ones (this is the
+        calibrated path — on the emulated container both tiers are
+        memcpys and the numbers say so honestly), the tier tags come
+        from ``tiers`` (default :data:`DEFAULT_TIERS`). The ICI domain
+        grain is the product of the ICI-tagged axis extents — the
+        devices one pod holds."""
+        tiers = dict(DEFAULT_TIERS if tiers is None else tiers)
+        sizes = dict(zip(comm_profile.mesh_axes, comm_profile.mesh_shape))
+        axes = []
+        grain = 1
+        for ax, alpha, beta in comm_profile.axis_alpha_beta():
+            tier = tiers.get(ax, TIER_ICI)
+            axes.append(AxisTier(ax, tier, alpha, beta))
+            if tier == TIER_ICI:
+                grain *= sizes.get(ax, 1)
+        return cls(
+            name=name or f"measured:{comm_profile.platform}",
+            axes=tuple(axes),
+            ici_domain_devices=max(1, grain),
+            overlap=tuple(sorted(
+                (str(k), float(v)) for k, v in (overlap or {}).items()
+            )),
+            source="commscope",
+        )
+
+
+def reference_two_tier(
+    mesh_axes: tuple[str, ...],
+    mesh_shape: tuple[int, ...],
+    *,
+    tiers: Mapping[str, str] | None = None,
+    overlap: Mapping[str, float] | None = None,
+    name: str = "reference-two-tier",
+) -> TopologyProfile:
+    """A pinned synthetic two-tier profile for ``mesh_axes``: tier tags
+    from ``tiers`` (default: leading axis DCN, the rest ICI — the
+    "grad-sync crosses hosts" deployment), link models from
+    :data:`REFERENCE_LINKS`. Deterministic, calibration-free — the
+    seeded acceptance cases and searches price against THIS so their
+    argmin never depends on what the host's memcpy did today."""
+    if len(mesh_axes) != len(mesh_shape):
+        raise ValueError(
+            f"axes/shape mismatch: {mesh_axes} vs {mesh_shape}"
+        )
+    if tiers is None:
+        tiers = {ax: (TIER_DCN if i == 0 else TIER_ICI)
+                 for i, ax in enumerate(mesh_axes)}
+    axes = []
+    grain = 1
+    for ax, n in zip(mesh_axes, mesh_shape):
+        tier = tiers.get(ax, TIER_ICI)
+        alpha, beta = REFERENCE_LINKS[tier]
+        axes.append(AxisTier(ax, tier, alpha, beta))
+        if tier == TIER_ICI:
+            grain *= n
+    return TopologyProfile(
+        name=name,
+        axes=tuple(axes),
+        ici_domain_devices=max(1, grain),
+        overlap=tuple(sorted(
+            (str(k), float(v)) for k, v in (overlap or {}).items()
+        )),
+        source="reference",
+    )
+
+
+def segment_tier(segment: Any, topology: TopologyProfile) -> str:
+    """Which tier a transfer-plan segment's bytes ride: ``"dcn"`` when
+    BOTH endpoints are devices in different ICI domains, ``"ici"``
+    otherwise. A host endpoint (:class:`~..parallel.resharding.
+    HostBuffer` staging, checkpoint restore) classifies by the device
+    end alone — the staging host is local to that device's domain, and
+    charging it as DCN would double-count the explicit host hop the
+    plan already reports."""
+    src = getattr(segment.src_device, "id", None)
+    dst = getattr(segment.dst_device, "id", None)
+    if src is None or dst is None:
+        return TIER_ICI
+    return (
+        TIER_DCN
+        if topology.domain_of_id(src) != topology.domain_of_id(dst)
+        else TIER_ICI
+    )

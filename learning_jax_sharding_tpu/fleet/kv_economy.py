@@ -217,6 +217,11 @@ class KvEconomy:
         self._c_peer = r.counter(
             "fleet_tier_peer_promotions_total",
             "promoted pages sourced from a PEER replica (host or HBM)")
+        self._c_peer_dcn_bytes = r.counter(
+            "fleet_tier_peer_dcn_bytes_total",
+            "peer-promotion bytes whose source replica sits in a "
+            "different ICI domain (a DCN hop under router.topology; "
+            "always 0 without a profile)")
         self._c_evictions = r.counter(
             "fleet_tier_evictions_total",
             "host-tier entries LRU-evicted past the byte budget")
@@ -322,9 +327,9 @@ class KvEconomy:
         for key in chain:
             if eng.prefix_hash(key) in digest:
                 continue
-            rows, src = tier.get(key, version=version), "host"
+            rows, src, peer = tier.get(key, version=version), "host", None
             if rows is None and self.peer_fill:
-                rows, src = self._peer_read(name, key, version)
+                rows, src, peer = self._peer_read(name, key, version)
             if rows is None:
                 break          # chain broken: deeper pages are unusable
             try:
@@ -334,21 +339,56 @@ class KvEconomy:
             promoted += 1
             self._c_promotions.inc()
             self._c_fill_bytes.inc(st["bytes"])
+            extra = {}
             if src == "peer":
                 self._c_peer.inc()
+                if peer is not None and self._peer_is_dcn(name, peer):
+                    self._c_peer_dcn_bytes.inc(st["bytes"])
+                    extra = {
+                        "peer": peer, "dcn": True,
+                        "priced_s": self._router.topology.dcn_seconds(
+                            st["bytes"]),
+                    }
             self._router.recorder.record(
                 "fleet.kv_promote", replica=name, src=src,
-                bytes=st["bytes"],
+                bytes=st["bytes"], **extra,
             )
         return promoted
+
+    def _peer_is_dcn(self, name: str, peer_name: str) -> bool:
+        """Does a ``peer_name`` → ``name`` page read cross an ICI
+        domain? Replicas carved by ``sub_meshes(topology=)`` each live
+        inside one domain, so the test is whether the two engines'
+        device sets share any domain at all — disjoint domains means
+        the page rode DCN."""
+        topo = getattr(self._router, "topology", None)
+        if topo is None:
+            return False
+        def domains(rep):
+            return {
+                int(topo.domain_of(d))
+                for d in rep.engine._mesh.devices.flat
+            }
+        a = self._router.replicas.get(name)
+        b = self._router.replicas.get(peer_name)
+        if a is None or b is None:
+            return False
+        return not (domains(a) & domains(b))
 
     def _peer_read(self, name: str, key: bytes, version: int):
         """The third tier rung: a live peer's host tier, else a
         non-destructive spill of the peer's OWN resident page — the
-        peer keeps serving its copy; we pay the (counted) wire bytes."""
-        for peer_name in sorted(self._tiers):
-            if peer_name == name:
-                continue
+        peer keeps serving its copy; we pay the (counted) wire bytes.
+        Returns ``(rows, src, peer_name)``. With ``router.topology``
+        set, SAME-DOMAIN peers are tried first: a page on a neighbor's
+        ICI rail beats the identical page across DCN, so the sort key —
+        not a filter — keeps the cross-domain copy as the fallback it
+        should be."""
+        cands = [p for p in sorted(self._tiers) if p != name]
+        topo = getattr(self._router, "topology", None)
+        if topo is not None:
+            cands.sort(key=lambda p: (self._peer_is_dcn(name, p), p))
+        for peer_name in cands:
             peer = self._router.replicas.get(peer_name)
             if peer is None or not peer.alive:
                 continue
@@ -356,14 +396,14 @@ class KvEconomy:
                 continue       # mixed-version fleet: never cross-fill
             rows = self._tiers[peer_name].peek(key, version=version)
             if rows is not None:
-                return rows, "peer"
+                return rows, "peer", peer_name
             if peer.engine.prefix_hash(key) in peer.engine.prefix_digest()[1]:
                 try:
                     rows, _ = peer.engine.spill_page(key, drop=False)
                 except (KeyError, RuntimeError):
                     continue   # raced away / not readable — next peer
-                return rows, "peer"
-        return None, "none"
+                return rows, "peer", peer_name
+        return None, "none", None
 
     # --- demotion ---------------------------------------------------------
 

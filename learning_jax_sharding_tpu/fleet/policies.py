@@ -53,11 +53,24 @@ class FleetPolicy:
     overlapping traffic onto warm replicas, not enough to pile every
     request onto one replica past its queue. With no hints the policy
     is exactly the prefix-blind round-11 behaviour.
+
+    TOPOLOGY-AWARE placement (round 21): when the router carries a
+    :class:`~..analysis.topology.TopologyProfile` it prices each
+    candidate's cross-domain traffic (the KV handoff that would ride
+    DCN) in seconds and the score ADDS ``dcn_weight × dcn_s``. The
+    default weight makes 1 ms of priced DCN time as repellent as one
+    queued request — on a healthy profile a megabyte-scale handoff
+    (~0.3 ms at the reference 3.1 GB/s) loses ties but cannot override
+    real load skew, while a DEGRADED cross-domain link (the
+    ``dcn_degrade`` matrix cell: β collapses mid-run) inflates dcn_s
+    a thousandfold and placement visibly shifts intra-domain. With no
+    profile the policy is exactly the round-15 behaviour.
     """
 
     depth_weight: float = 1.0
     burn_weight: float = 4.0
     prefix_weight: float = 0.02
+    dcn_weight: float = 1000.0
     max_inflight: int | None = None
 
     def __post_init__(self):
@@ -78,13 +91,16 @@ class FleetPolicy:
         """Can this replica take NEW work right now?"""
         return replica.alive and replica.engine.degradation_level < 3
 
-    def score(self, replica, *, hit_tokens: float = 0.0) -> float:
+    def score(
+        self, replica, *, hit_tokens: float = 0.0, dcn_s: float = 0.0,
+    ) -> float:
         eng = replica.engine
         depth = eng.queue_depth() + eng.occupied_slots()
         return (
             self.depth_weight * depth
             + self.burn_weight * self.burn_rate(replica)
             - self.prefix_weight * hit_tokens
+            + self.dcn_weight * dcn_s
         )
 
     def rank(self, replicas, hits: dict | None = None) -> list:

@@ -34,7 +34,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from learning_jax_sharding_tpu.models.serving import ContinuousEngine
-from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel import DEFAULT_AXIS_NAMES, build_mesh
 
 ROLES = ("unified", "prefill", "decode")
 
@@ -83,13 +83,23 @@ class EngineReplica:
 def sub_meshes(
     count: int,
     shape: Sequence[int] = (1, 2),
-    axis_names: Sequence[str] = ("data", "model"),
+    axis_names: Sequence[str] = DEFAULT_AXIS_NAMES,
     *,
     devices: Sequence[jax.Device] | None = None,
     offset: int = 0,
+    topology: Any = None,
 ) -> list[Mesh]:
     """``count`` disjoint consecutive sub-meshes of ``shape`` carved out
-    of ``devices`` (default: all), starting ``offset`` devices in."""
+    of ``devices`` (default: all), starting ``offset`` devices in.
+
+    With ``topology`` (an ``analysis.topology.TopologyProfile``), the
+    carve is hierarchy-aware: every sub-mesh lands entirely inside one
+    ICI domain (``topology.domain_of_id``), so a replica's internal
+    collectives never cross DCN — only the router's explicit KV
+    handoffs do. The flat carve can straddle a domain boundary whenever
+    ``offset + i*per`` isn't domain-aligned; with the profile in hand
+    that's a placement bug, so a shape too big for one domain raises
+    instead of silently paying DCN on every decode step."""
     import math
 
     devices = list(jax.devices()) if devices is None else list(devices)
@@ -100,6 +110,31 @@ def sub_meshes(
             f"{count} sub-meshes of shape {tuple(shape)} from offset "
             f"{offset} need {need} devices, have {len(devices)}"
         )
+    if topology is not None:
+        dom = int(topology.ici_domain_devices)
+        if per > dom:
+            raise ValueError(
+                f"sub-mesh shape {tuple(shape)} needs {per} devices but "
+                f"one ICI domain holds {dom}: a single replica would "
+                "straddle DCN on every collective; shrink the shape or "
+                "carve without a topology"
+            )
+        by_dom: dict[int, list[jax.Device]] = {}
+        for d in devices[offset:]:
+            by_dom.setdefault(int(topology.domain_of_id(d.id)), []).append(d)
+        groups: list[list[jax.Device]] = []
+        for _, members in sorted(by_dom.items()):
+            while len(members) >= per and len(groups) < count:
+                groups.append(members[:per])
+                members = members[per:]
+        if len(groups) < count:
+            raise ValueError(
+                f"{count} intra-domain sub-meshes of shape {tuple(shape)} "
+                f"don't fit: {len(devices) - offset} devices past offset "
+                f"{offset} in domains of {dom} yield only "
+                f"{len(groups)} whole groups"
+            )
+        return [build_mesh(shape, axis_names, devices=g) for g in groups]
     return [
         build_mesh(
             shape, axis_names,
@@ -119,6 +154,7 @@ def make_replicas(
     role: str = "unified",
     prefix: str | None = None,
     offset: int = 0,
+    topology: Any = None,
     devices: Sequence[jax.Device] | None = None,
     draft_params: Any = None,
     place_params: bool = True,
@@ -130,11 +166,14 @@ def make_replicas(
     (batch_size, max_new_tokens, refill_chunk, recorder, slo, ...).
     ``place_params=True`` replicates ``params`` (and ``draft_params``)
     onto each sub-mesh; pass ``False`` when the trees are already placed.
+    ``topology`` makes the carve ICI-domain-aware (see
+    :func:`sub_meshes`).
     """
     prefix = role if prefix is None else prefix
     out = []
     for i, mesh in enumerate(
-        sub_meshes(count, mesh_shape, devices=devices, offset=offset)
+        sub_meshes(count, mesh_shape, devices=devices, offset=offset,
+                   topology=topology)
     ):
         p = replicated_params(params, mesh) if place_params else params
         d = (

@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Any, Sequence
 
+import jax
 import numpy as np
 
 from learning_jax_sharding_tpu.fleet.kv_transfer import transfer_tree
@@ -111,6 +112,7 @@ class FleetRouter:
         kv_page_tokens: int = 64,
         max_pending_handoffs: int | None = None,
         kv_economy: Any | None = None,
+        topology: Any | None = None,
     ):
         reps = list(replicas)
         if not reps:
@@ -162,6 +164,10 @@ class FleetRouter:
             raise ValueError(f"replicas disagree on eos_id: {eos}")
         (self.eos_id,) = eos
         self.kv_page_tokens = kv_page_tokens
+        # Interconnect hierarchy (analysis.topology.TopologyProfile):
+        # when set, every KV movement that crosses an ICI domain is
+        # counted (and kv_economy-priced) as a DCN hop.
+        self.topology = topology
         # Backpressure on the handoff stage: each parked entry pins one
         # exported KV-row tree, so the queue is bounded (default: two
         # waves of the fleet's decode slots) — at the bound the router
@@ -192,6 +198,10 @@ class FleetRouter:
         self._c_kv_segments = r.counter(
             "fleet_kv_transfer_segments_total",
             "page-granular transfer-plan segments copied")
+        self._c_kv_dcn_bytes = r.counter(
+            "fleet_kv_dcn_bytes_total",
+            "cross-ICI-domain (DCN) share of the KV handoff bytes — "
+            "always 0 without a topology profile")
         self._c_swaps = r.counter(
             "fleet_swaps_total",
             "replica weight swaps committed by rolling_swap")
@@ -594,8 +604,49 @@ class FleetRouter:
                 keep.append(h)
         self._handoffs = keep
 
+    def _handoff_dcn_s(self, h, rep) -> float:
+        """Priced DCN seconds this handoff would pay if placed on
+        ``rep``: 0 without a topology profile or when the prefill
+        source shares an ICI domain with the candidate; otherwise the
+        exported rows' bytes through the profile's cross-domain link.
+        Re-priced per flush on the LIVE profile, so a mid-run
+        degradation (the dcn_degrade chaos cell) immediately steers
+        placement intra-domain."""
+        if self.topology is None:
+            return 0.0
+        src = self.replicas.get(h["src"])
+        if src is None:
+            return 0.0
+        topo = self.topology
+
+        def domains(r):
+            return {
+                int(topo.domain_of(d))
+                for d in r.engine._mesh.devices.flat
+            }
+
+        if domains(src) & domains(rep):
+            return 0.0
+        nbytes = sum(
+            getattr(x, "nbytes", 0) for x in jax.tree.leaves(h["rows"])
+        )
+        return float(topo.dcn_seconds(nbytes))
+
     def _flush_handoffs(self):
         self._sweep_handoff_deadlines()
+        if self.topology is not None:
+            # Chaos seam: a mid-run interconnect event (the dcn_degrade
+            # matrix cell mutates the profile — cross-domain β collapse)
+            # lands here, so the very NEXT placement re-prices against
+            # the degraded link; a swapped profile is a recorded fleet
+            # event, same as a failover.
+            new = chaos_hook("fleet.topology", self.topology)
+            if new is not self.topology:
+                self.topology = new
+                self.recorder.record(
+                    "fleet.topology_change",
+                    profile=getattr(new, "name", None),
+                )
         while self._handoffs:
             decodes = [
                 r for r in self._by_role("decode")
@@ -627,9 +678,14 @@ class FleetRouter:
             # traffic means a frozen burn window), so waiting on it
             # would wedge the fleet. Rank ALIVE free-slot replicas by
             # the placement score only.
+            h0 = self._handoffs[0]
             ranked = sorted(
                 (r for r in decodes if r.engine.free_slots() > 0),
-                key=lambda r: (self.policy.score(r), r.name),
+                key=lambda r: (
+                    self.policy.score(
+                        r, dcn_s=self._handoff_dcn_s(h0, r)),
+                    r.name,
+                ),
             )
             if not ranked:
                 return               # every decode slot busy: try later
@@ -642,6 +698,7 @@ class FleetRouter:
                 stop=h["length"], seq_dims=seq_dims,
                 page_tokens=self.kv_page_tokens,
                 plan_cache=self._plan_cache,
+                topology=self.topology,
             )
             rep.engine.ingest_kv(
                 rep.params, freq.prompt, h["first"], rows, rid=freq.rid,
@@ -653,6 +710,7 @@ class FleetRouter:
             self._c_handoffs.inc()
             self._c_kv_bytes.inc(stats["bytes"])
             self._c_kv_segments.inc(stats["segments"])
+            self._c_kv_dcn_bytes.inc(stats.get("dcn_bytes", 0))
             # The handoff leg is the ROUTER's span: it alone saw both
             # ends — export on the prefill replica through ingest on the
             # decode replica (park time in the queue included: that wait

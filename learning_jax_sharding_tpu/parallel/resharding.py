@@ -137,6 +137,50 @@ class TransferPlan:
             "page_tokens": self.page_tokens,
         }
 
+    def domain_split(self, topology: Any) -> dict:
+        """Split the plan's wire volume by interconnect tier under a
+        two-tier ``topology`` (any object with ``domain_of_id`` — in
+        practice :class:`~..analysis.topology.TopologyProfile`; duck-
+        typed so ``parallel`` never imports ``analysis``).
+
+        A segment whose endpoints are devices in DIFFERENT ICI domains
+        is a DCN (cross-domain) hop; everything else — intra-domain
+        copies and host-staged (:class:`HostBuffer`) endpoints, whose
+        staging host is local to the device's domain — is ICI. The
+        split is exhaustive and exclusive by construction:
+        ``ici_bytes + dcn_bytes == bytes_total`` always, so the DCN
+        accounting can never invent or lose a byte the plan counted.
+        """
+        ici_b = dcn_b = 0
+        ici_s = dcn_s = 0
+        for seg in self.segments:
+            nbytes = seg.elements * self.itemsize
+            if _crosses_domain(seg, topology):
+                dcn_b += nbytes
+                dcn_s += 1
+            else:
+                ici_b += nbytes
+                ici_s += 1
+        return {
+            "ici_bytes": ici_b, "dcn_bytes": dcn_b,
+            "ici_segments": ici_s, "dcn_segments": dcn_s,
+            "bytes_total": self.bytes_total,
+        }
+
+
+def _crosses_domain(seg: Segment, topology: Any) -> bool:
+    """Does this segment's copy cross an ICI-domain (DCN) boundary?
+    Host-staged endpoints classify by the device end alone — charging
+    the local staging hop as DCN would double-count the explicit host
+    bytes the plan already reports."""
+    src = getattr(seg.src_device, "id", None)
+    dst = getattr(seg.dst_device, "id", None)
+    return (
+        src is not None
+        and dst is not None
+        and topology.domain_of_id(src) != topology.domain_of_id(dst)
+    )
+
 
 def _norm_box(idx: Sequence, shape: Sequence[int]) -> Box:
     # devices_indices_map yields per-dim slices (possibly None-bounded);
@@ -220,7 +264,8 @@ def plan_transfer(
 
 
 def execute_transfer(
-    plan: TransferPlan, x: jax.Array, *, stop: int | None = None
+    plan: TransferPlan, x: jax.Array, *, stop: int | None = None,
+    topology: Any | None = None,
 ) -> tuple[jax.Array, dict]:
     """Run ``plan`` on ``x``: assemble every destination shard from its
     source-shard slices and commit the result under the destination
@@ -231,6 +276,10 @@ def execute_transfer(
 
     Returns ``(array, stats)`` with ``stats = {"bytes", "segments",
     "segments_skipped"}`` — the actual wire volume of THIS transfer.
+    With ``topology`` set (two-tier domain carving), stats also carry
+    ``"dcn_bytes"``: the subset of the actual (clipped) bytes whose
+    segment crossed an ICI-domain boundary — what the fleet meters as
+    cross-host traffic.
     """
     shape, dtype = plan.shape, x.dtype
     if tuple(x.shape) != shape:
@@ -261,7 +310,7 @@ def execute_transfer(
             dst_bufs[dbox] = np.zeros(
                 tuple(hi - lo for lo, hi in dbox), dtype
             )
-    copied = skipped = nbytes = 0
+    copied = skipped = nbytes = dcn_bytes = 0
     for seg in plan.segments:
         box = seg.box
         if stop is not None and plan.seq_dim is not None:
@@ -285,11 +334,16 @@ def execute_transfer(
         )
         dst_bufs[seg.dst_box][dst_sl] = src[src_sl]
         copied += 1
-        nbytes += math.prod(hi - lo for lo, hi in box) * plan.itemsize
+        seg_bytes = math.prod(hi - lo for lo, hi in box) * plan.itemsize
+        nbytes += seg_bytes
+        if topology is not None and _crosses_domain(seg, topology):
+            dcn_bytes += seg_bytes
 
     stats = {
         "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
     }
+    if topology is not None:
+        stats["dcn_bytes"] = dcn_bytes
     if isinstance(plan.dst_sharding, HostBuffer):
         # Host destination: one full-array box; hand back the assembled
         # numpy buffer — nothing to commit to a device.
@@ -310,6 +364,7 @@ def transfer_tree(
     seq_dims: Any | None = None,
     page_tokens: int | None = DEFAULT_PAGE_TOKENS,
     plan_cache: dict | None = None,
+    topology: Any | None = None,
 ) -> tuple[Any, dict]:
     """Redistribute a whole exported cache-row tree (``export_kv``) into
     ``dst_shardings`` (``kv_row_shardings`` of the destination engine).
@@ -323,9 +378,13 @@ def transfer_tree(
     rank ≥ 2 leaf is ASSUMED sequence-major on dim 0 — only safe for
     dense-backend rows or plain arrays. ``plan_cache`` (any dict)
     memoizes plans across handoffs of the same layout. Returns
-    ``(tree, stats)`` with the summed bytes/segments telemetry.
+    ``(tree, stats)`` with the summed bytes/segments telemetry; with
+    ``topology`` set the totals also carry ``"dcn_bytes"`` — the
+    cross-ICI-domain share of the moved bytes.
     """
     totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
+    if topology is not None:
+        totals["dcn_bytes"] = 0
     if seq_dims is None:
         seq_dims = jax.tree.map(
             lambda x: 0 if getattr(x, "ndim", 0) >= 2 else -1, rows,
@@ -347,7 +406,8 @@ def transfer_tree(
             if plan_cache is not None:
                 plan_cache[key] = plan
         out, stats = execute_transfer(
-            plan, x, stop=stop if seq_dim is not None else None
+            plan, x, stop=stop if seq_dim is not None else None,
+            topology=topology,
         )
         for k in totals:
             totals[k] += stats[k]

@@ -34,6 +34,7 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | hung_dispatch_h4  | hang-watchdog abort at a fused dispatch | quarantine within one horizon + ledger recovery |
 | overload_h4       | offered load > bound, horizon=4   | shed + ladder at horizon boundaries |
 | boundary_preempt  | SIGTERM while a horizon is in flight | boundary drain: commit the horizon, requeue, zero token loss |
+| dcn_degrade       | cross-domain (DCN) link degrades mid-run | topology-aware placement shifts intra-domain, DCN bytes stop |
 
 The ``*_h4`` rows are the round-16 multi-step variants: with ``horizon=4``
 the host dispatches ONE fused program per 4 engine iterations, so every
@@ -686,6 +687,95 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             "prefix_hit_rate": round(stats.get("prefix_hit_rate", 0.0), 3),
         }
 
+    def dcn_degrade():
+        # Topology observatory (round 21): the fleet's CROSS-DOMAIN
+        # (DCN) link degrades mid-run — β collapses a thousandfold, α
+        # jumps to half a second (a congested or flapping inter-pod
+        # link). The router re-prices every KV handoff on the LIVE
+        # profile, so placement must visibly shift intra-domain: under
+        # the healthy profile load-balancing pays the ~75 µs hop to the
+        # cross-domain decoder, after the event every handoff stays
+        # inside the prefill's ICI domain, no further DCN bytes move,
+        # the profile swap is a recorded fleet event, and every stream
+        # still comes out bit-identical to the fault-free solo engine.
+        from learning_jax_sharding_tpu.analysis.topology import (
+            reference_two_tier,
+        )
+        from learning_jax_sharding_tpu.fleet import FleetRouter, make_replicas
+
+        topo = reference_two_tier(("data", "model"), (2, 2))
+        assert topo.ici_domain_devices == 2  # devices {0,1} | {2,3}
+        pre = make_replicas(
+            cfg, rules, params, count=1, mesh_shape=(1, 1),
+            role="prefill", batch_size=2, max_new_tokens=1,
+            refill_chunk=8, recorder=rec,
+        )
+        dec = make_replicas(
+            cfg, rules, params, count=2, mesh_shape=(1, 1),
+            role="decode", offset=1, batch_size=2, max_new_tokens=NEW,
+            refill_chunk=8, recorder=rec,
+        )
+        # prefill0 (device 0) and decode0 (device 1) share ICI domain
+        # 0; decode1 (device 2) sits across the DCN boundary.
+        router = FleetRouter(pre + dec, recorder=rec, topology=topo)
+        dcn_ctr = router.registry.counter("fleet_kv_dcn_bytes_total")
+        hand0 = count("fleet.handoff")
+        tc0 = count("fleet.topology_change")
+        # Phase 1 (healthy link): the first handoff takes the free
+        # intra-domain decoder; with decode0 then occupied, one queued
+        # request outweighs the ~75 µs priced hop and the second PAYS
+        # the healthy DCN leg to idle decode1 — cross-domain capacity
+        # is used under load, and its bytes are counted.
+        router.add_request(prompts[0], rid=0)
+        router.add_request(prompts[1], rid=1)
+        out = router.drain(max_steps=400)
+        dsts1 = sorted(
+            e["dst"] for e in rec.events("fleet.handoff")[hand0:]
+        )
+        assert dsts1 == ["decode0", "decode1"], dsts1
+        healthy_dcn = int(dcn_ctr.value)
+        assert healthy_dcn > 0, "healthy cross-domain handoff must count"
+
+        def degrade(t):
+            axes = tuple(
+                dataclasses.replace(
+                    a, alpha_s=0.5,
+                    beta_bytes_per_s=a.beta_bytes_per_s / 1e3,
+                ) if a.tier == "dcn" else a
+                for a in t.axes
+            )
+            return dataclasses.replace(t, name="degraded:dcn", axes=axes)
+
+        # Phase 2: the profile mutates at the router's fleet.topology
+        # seam — the very next flush re-prices against the degraded
+        # link (dcn_weight × 0.5 s ≫ any load skew), so BOTH handoffs
+        # stack onto the intra-domain decode0 and the DCN byte counter
+        # stays flat.
+        with ChaosInjector(
+            Fault("fleet.topology", "mutate", at=0, count=1,
+                  mutate=degrade),
+            recorder=rec,
+        ):
+            router.add_request(prompts[2], rid=2)
+            router.add_request(prompts[3], rid=3)
+            out.update(router.drain(max_steps=400))
+        assert router.topology.name == "degraded:dcn"
+        assert count("fleet.topology_change") == tc0 + 1
+        dsts2 = [
+            e["dst"] for e in rec.events("fleet.handoff")[hand0 + 2:]
+        ]
+        assert dsts2 == ["decode0", "decode0"], dsts2
+        assert int(dcn_ctr.value) == healthy_dcn, (
+            "no DCN bytes may move on the degraded link"
+        )
+        survivors_match(out, set())
+        return {
+            "healthy_dsts": dsts1,
+            "degraded_dsts": dsts2,
+            "healthy_dcn_bytes": healthy_dcn,
+            "profile": router.topology.name,
+        }
+
     def swap_mid_stream():
         # Zero-downtime weight swap (round 12) interrupted at the
         # staging seam, mid-serve: the swap must ABORT — the engine
@@ -863,6 +953,8 @@ def run_matrix(verbose: bool = False) -> list[dict]:
     cell("tier_miss_under_kill",
          "replica holding promoted peer-tier KV dies mid-stream",
          "tier drop + recompute from prompt", tier_miss_kill)
+    cell("dcn_degrade", "cross-domain (DCN) link degrades mid-run",
+         "topology-aware placement shifts intra-domain", dcn_degrade)
     cell("nan_logits_h4", "NaN in logits at a fused horizon=4 dispatch",
          "quarantine within one horizon", nan_logits_h4)
     cell("hung_dispatch_h4", "hung fused dispatch (watchdog abort)",

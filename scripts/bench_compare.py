@@ -193,6 +193,30 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      False),
     (re.compile(r"worst tenant burn ([\d,.]+)"),
      "worst_tenant_burn_rate", False),
+    # Round-21 topology gates (bench.py's `[bench] topo ...` lines):
+    # `topo err` is the overlap-aware two-tier prediction's error vs the
+    # measured step per searchable entry (lower; phrased distinctly from
+    # `model err` / `layout err` / `memflow err` / `comm prediction
+    # err` so the five analyzer gates never double-match one line);
+    # `dcn B/token` is what the static model prices across the slow
+    # tier per trained token (lower — growth means a layout or
+    # propagation change started shipping gradients over DCN); `overlap
+    # gap` is the pinned profile overlap ratio vs the ledger's realized
+    # one in percentage points (lower — drift means the overlap table
+    # no longer describes this host). `topo argmin gap` is the seeded
+    # two-tier canary: flat-argmin re-priced under the hierarchy vs the
+    # topology-aware argmin — deterministic abstract pricing, so it is
+    # the one HIGHER-is-better analyzer gate (the gap collapsing to 0
+    # means hierarchy pricing lost its discrimination power, not that
+    # anything got faster).
+    (re.compile(r"topo err ([\d,.]+)%"), "topo_reconcile_err_pct",
+     False),
+    (re.compile(r"([\d,.]+)\s*dcn B/token"), "dcn_bytes_per_token",
+     False),
+    (re.compile(r"overlap gap ([\d,.]+)\s*pp"),
+     "overlap_predicted_vs_realized_pp", False),
+    (re.compile(r"topo argmin gap ([\d,.]+)%"), "topo_argmin_gap_pct",
+     True),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -220,14 +220,112 @@ def _bench_lines(args) -> int:
     return 0
 
 
+def _topo_gap(args) -> int:
+    """The bench_topology leg: the round-21 seeded two-tier acceptance
+    case as a tracked canary. Flat pricing routes the hot matmul
+    all-reduce onto the SMALL 'data' axis (the ring factor 2(n-1)/n
+    favors n=2) — which is exactly the DCN tier; hierarchy-aware
+    pricing must route it onto ICI. Pure abstract pricing, nothing
+    compiles, deterministic by construction — so the tracked numbers
+    are exact, and the gate they feed (`topo argmin gap`, higher is
+    better) fires only when topology pricing LOSES its discrimination
+    power: the gap collapsing toward 0 means ``price_multiset_topo``
+    or the search's topology plumbing stopped steering bytes off the
+    slow tier, a correctness regression that no timing noise can
+    excuse."""
+    shape = _parse_mesh(args.mesh)
+    try:
+        force_emulated_devices(shape[0] * shape[1])
+    except RuntimeError as e:
+        print(f"layout_search: {e}", file=sys.stderr)
+        return 2
+
+    import numpy as np
+
+    from learning_jax_sharding_tpu.analysis import costmodel
+    from learning_jax_sharding_tpu.analysis.layout_search import (
+        search_layout,
+    )
+    from learning_jax_sharding_tpu.analysis.topology import (
+        reference_two_tier,
+    )
+    from learning_jax_sharding_tpu.parallel import (
+        build_mesh,
+        mesh_sharding,
+        put,
+    )
+
+    mesh = build_mesh(shape, ("data", "model"))
+    topo = reference_two_tier(("data", "model"), shape)
+    profile = (
+        costmodel.current_profile() if args.profile == "live"
+        else costmodel.table_profile(args.profile)
+    )
+
+    def mm(x, w):
+        import jax.numpy as jnp
+
+        return jnp.einsum("bh,hd->bd", x, w)
+
+    # Seeded incumbent: contraction pinned on the DCN-tier 'data' axis.
+    # B=2 is divisible only by 'data' and D=7 by nothing, so the
+    # search's one real decision is which mesh axis the all-reduce
+    # crosses (tests/test_layout_search.py::TestTopologySearch pins the
+    # same scenario as the pass/fail acceptance case).
+    x = put(np.ones((2, 1024), np.float32),
+            mesh_sharding(mesh, None, "data"))
+    w = put(np.ones((1024, 7), np.float32),
+            mesh_sharding(mesh, "data", None))
+
+    flat = search_layout(
+        "topo_gap_flat", mm, x, w, mesh=mesh, budget=args.budget,
+        profile=profile,
+    )
+    hier = search_layout(
+        "topo_gap_topo", mm, x, w, mesh=mesh, budget=args.budget,
+        profile=profile, topology=topo,
+    )
+    # Re-price the FLAT argmin under the two-tier model: the bytes its
+    # layout would really move across DCN, and what the hierarchy-aware
+    # model says that layout really costs.
+    flat_topo = costmodel.price_topo(
+        flat.report, profile, topology=topo,
+    )
+    best = hier.best
+    gap_pct = (
+        100.0 * (flat_topo.predicted_s - best.predicted_s)
+        / best.predicted_s if best.predicted_s > 0 else 0.0
+    )
+    print(f"[bench] topo argmin: flat argmin moves "
+          f"{flat_topo.comm.dcn_bytes / 1e3:.1f} kB over DCN, topo "
+          f"argmin {best.comm.dcn_bytes / 1e3:.1f} kB; topo argmin gap "
+          f"{gap_pct:.1f}% ({args.mesh} two-tier seeded, budget "
+          f"{args.budget}: flat argmin re-priced two-tier "
+          f"{flat_topo.predicted_s * 1e3:.3f} -> topo argmin "
+          f"{best.predicted_s * 1e3:.3f} ms, {profile.name})")
+    print("[bench-json] " + json.dumps({
+        "mesh": args.mesh,
+        "budget": args.budget,
+        "flat_argmin_dcn_bytes": round(flat_topo.comm.dcn_bytes),
+        "topo_argmin_dcn_bytes": round(best.comm.dcn_bytes),
+        "flat_argmin_topo_priced_s": flat_topo.predicted_s,
+        "topo_argmin_priced_s": best.predicted_s,
+        "topo_argmin_gap_pct": round(gap_pct, 2),
+        "profile": profile.name,
+        "topology": topo.name,
+    }))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     from learning_jax_sharding_tpu.analysis.entrypoints import (
         SEARCHABLE_ENTRIES,
     )
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--entry", required=True, choices=SEARCHABLE_ENTRIES,
-                    help="entry point whose layout to search")
+    ap.add_argument("--entry", default=None, choices=SEARCHABLE_ENTRIES,
+                    help="entry point whose layout to search "
+                    "(required except with --topo-gap)")
     ap.add_argument("--mesh", default="2x4", metavar="RxC",
                     help="mesh shape as data x model (default 2x4)")
     ap.add_argument("--budget", type=int, default=96,
@@ -257,7 +355,19 @@ def main(argv: list[str] | None = None) -> int:
         "and print `[bench] layout_search ...` lines (gap + "
         "predicted-vs-measured err) plus one `[bench-json] {...}` line",
     )
+    ap.add_argument(
+        "--topo-gap", action="store_true",
+        help="bench mode for bench.py: run the seeded two-tier "
+        "acceptance scenario twice (flat vs topology-aware pricing), "
+        "abstract only — nothing compiles — and print the `[bench] "
+        "topo argmin ...` canary line plus one `[bench-json] {...}` "
+        "line (--entry is ignored)",
+    )
     args = ap.parse_args(argv)
+    if args.topo_gap:
+        return _topo_gap(args)
+    if args.entry is None:
+        ap.error("--entry is required (except with --topo-gap)")
     if args.bench_lines:
         return _bench_lines(args)
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""topo_profile: build the two-tier (ICI|DCN) interconnect profile.
+
+Tags a measured commscope profile (the checked-in
+``analysis/profiles/comm_profile_<platform>_<shape>.json`` by default,
+or a fresh calibration ladder with ``--calibrate``) with per-axis tier
+assignments and an optional per-program-family realized-overlap table,
+and saves the result as the versioned ``TopologyProfile`` JSON that
+``shardcheck --topo``, ``layout_search(topology=)`` and
+``fleet.replica.sub_meshes(topology=)`` consume
+(``analysis/profiles/topology_<platform>_<shape>.json``).
+
+Usage::
+
+    python scripts/topo_profile.py                        # 2x4, defaults
+    python scripts/topo_profile.py --calibrate            # fresh ladder
+    python scripts/topo_profile.py --tiers data=dcn,model=ici
+    python scripts/topo_profile.py --overlap _default=0.0,train_step=0.2
+    python scripts/topo_profile.py --reference             # pinned α/β
+
+Tier semantics: the leading data-parallel axis is the one that crosses
+hosts (grad-sync over DCN); tensor/pipeline axes stay inside the pod on
+ICI. On the emulated-CPU container both tiers measure as memcpys — the
+α/β are honest for THIS host, the tier TAGS encode the production
+hierarchy the planner must respect. ``--reference`` skips measurement
+entirely and pins the reference TPU-class links
+(``analysis.topology.REFERENCE_LINKS``).
+
+Exit codes: 0 profile written, 2 bad arguments / infrastructure error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from learning_jax_sharding_tpu.parallel import force_emulated_devices  # noqa: E402
+
+
+def _parse_mesh(text: str):
+    try:
+        shape = tuple(int(p) for p in text.lower().split("x"))
+    except ValueError:
+        shape = ()
+    if not shape or any(s < 1 for s in shape):
+        raise SystemExit(
+            f"topo_profile: --mesh must look like 2x4 (data x model), "
+            f"got {text!r}"
+        )
+    return shape
+
+
+def _parse_kv(text: str | None, cast) -> dict:
+    out: dict = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        if not k or not v:
+            raise SystemExit(
+                f"topo_profile: expected key=value, got {part!r}")
+        out[k] = cast(v)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mesh", default="2x4",
+                    help="mesh shape, data x model (default 2x4)")
+    ap.add_argument("--tiers", default=None,
+                    help="comma-separated axis=tier tags (default: "
+                    "analysis.topology.DEFAULT_TIERS — data crosses "
+                    "DCN, everything else is ICI)")
+    ap.add_argument("--overlap", default=None,
+                    help="comma-separated family=ratio realized-overlap "
+                    "entries ('_default' applies to unlisted families); "
+                    "omit to bill serial — the honest upper bound")
+    ap.add_argument("--comm-profile", default=None,
+                    help="commscope JSON to tag (default: the checked-in "
+                    "analysis/profiles/comm_profile_<platform>_<shape>"
+                    ".json)")
+    ap.add_argument("--calibrate", action="store_true",
+                    help="run a fresh reduced commscope ladder instead "
+                    "of loading a saved comm profile")
+    ap.add_argument("--reference", action="store_true",
+                    help="skip measurement: pin the reference TPU-class "
+                    "two-tier links (REFERENCE_LINKS)")
+    ap.add_argument("--out", default=None,
+                    help="output JSON path (default: analysis/profiles/"
+                    "topology_<platform>_<shape>.json)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+
+    shape = _parse_mesh(args.mesh)
+    ndev = 1
+    for s in shape:
+        ndev *= s
+    try:
+        force_emulated_devices(ndev)
+    except RuntimeError as e:  # backend already initialized differently
+        print(f"topo_profile: {e}", file=sys.stderr)
+        return 2
+
+    import jax
+
+    from learning_jax_sharding_tpu.analysis import topology as topo
+    from learning_jax_sharding_tpu.parallel import build_mesh
+    from learning_jax_sharding_tpu.telemetry import commscope
+
+    axis_names = ("data", "model")[: len(shape)] if len(shape) <= 2 else \
+        tuple(f"ax{i}" for i in range(len(shape)))
+    tiers = _parse_kv(args.tiers, str) or None
+    overlap = _parse_kv(args.overlap, float) or None
+    platform = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    if args.reference:
+        profile = topo.reference_two_tier(
+            axis_names, shape, tiers=tiers, overlap=overlap,
+        )
+    elif args.calibrate:
+        mesh = build_mesh(shape, axis_names)
+        cp = commscope.calibrate_mesh(
+            mesh,
+            ops=("psum", "all_gather", "ppermute"),
+            sizes_bytes=(1 << 16, 1 << 19, 1 << 22),
+        )
+        profile = topo.TopologyProfile.from_comm_profile(
+            cp, tiers=tiers, overlap=overlap,
+        )
+    else:
+        cpath = pathlib.Path(
+            args.comm_profile
+            or topo.PROFILE_DIR / (
+                f"comm_profile_{platform}_"
+                f"{'x'.join(str(s) for s in shape)}.json"
+            )
+        )
+        if not cpath.exists():
+            print(f"topo_profile: no comm profile at {cpath} — run "
+                  "scripts/commscope.py first, or pass --calibrate / "
+                  "--reference", file=sys.stderr)
+            return 2
+        cp = commscope.CommProfile.load(cpath)
+        profile = topo.TopologyProfile.from_comm_profile(
+            cp, tiers=tiers, overlap=overlap,
+        )
+    wall = time.perf_counter() - t0
+
+    out = pathlib.Path(
+        args.out or topo.TopologyProfile.default_path(platform, shape)
+    )
+    profile.save(out)
+    if args.json:
+        print(json.dumps({
+            "path": str(out),
+            "wall_seconds": round(wall, 2),
+            "profile": profile.to_dict(),
+        }, indent=2))
+        return 0
+    print(f"topo_profile: {profile.name} "
+          f"({'x'.join(str(s) for s in shape)}, source "
+          f"{profile.source}) in {wall:.1f}s -> {out}")
+    for ax in profile.axes:
+        print(f"[topo] axis {ax.axis}: tier {ax.tier}, "
+              f"alpha {ax.alpha_s * 1e6:.1f} us, "
+              f"beta {ax.beta_bytes_per_s / 1e9:.2f} GB/s")
+    print(f"[topo] ici domain = {profile.ici_domain_devices} device(s); "
+          f"overlap table: "
+          f"{dict(profile.overlap) if profile.overlap else 'serial'}")
+    if platform == "cpu":
+        print("[topo] note: emulated-CPU mesh — α/β are host memcpy "
+              "numbers; the tier TAGS carry the production hierarchy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

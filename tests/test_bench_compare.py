@@ -468,6 +468,79 @@ class TestExtraction:
         assert not by[f"{name}:worst_tenant_burn_rate"]["regressed"]
         assert not by[f"{name}:goodput_ratio_pct"]["regressed"]
 
+    def test_topology_gates_direction_aware(self):
+        """The round-21 topology gates: the overlap-aware reconcile
+        error per entry, the train step's priced DCN bytes/token, and
+        the profile-vs-ledger overlap gap all regress UP; the seeded
+        flat-vs-topo argmin canary is the one HIGHER-is-better analyzer
+        gate — deterministic abstract pricing, so it only moves when
+        hierarchy pricing loses its discrimination power. `topo err`
+        must not ride `model err` / `layout err` / `memflow err` /
+        `comm prediction err`, and the serial-sum context number on the
+        same line stays ungated (serial is the upper bound, not the
+        claim)."""
+        lines = [
+            "[bench] topo train_step: measured 21.77 ms vs "
+            "overlap-aware 23.50 ms, topo err 8.0% (serial-sum "
+            "196.8%), dcn 983.0 kB predicted / 2670.6 kB contract",
+            "[bench] topo dcn: train_step moves 320.1 dcn B/token "
+            "(983040 B over 3072 tokens)",
+            "[bench] topo overlap: train_step profile predicts 0.68, "
+            "ledger realized 0.65, overlap gap 3.0 pp",
+            "[bench] topo argmin: flat argmin moves 0.1 kB over DCN, "
+            "topo argmin 0.0 kB; topo argmin gap 7304.8% (2x4 "
+            "two-tier seeded, budget 96)",
+            "[bench] topo summary: worst of 4 entries, topo err 56.5%",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        assert m["topo_train_step:topo_reconcile_err_pct"] == (8.0, False)
+        assert m["topo_summary:topo_reconcile_err_pct"] == (56.5, False)
+        assert m["topo_dcn:dcn_bytes_per_token"] == (320.1, False)
+        assert m["topo_overlap"
+                 ":overlap_predicted_vs_realized_pp"] == (3.0, False)
+        assert m["topo_argmin:topo_argmin_gap_pct"] == (7304.8, True)
+        # No cross-matching into the other four analyzer error gates,
+        # and the serial-sum context number is extracted by nothing.
+        assert not any(
+            k.endswith(":predicted_vs_measured_pct")
+            or k.endswith(":layout_predicted_vs_measured_pct")
+            or k.endswith(":memflow_predicted_vs_measured_pct")
+            or k.endswith(":comm_model_err_pct")
+            for k in m
+        )
+        assert not any("196" in str(v[0]) for v in m.values())
+        worse = _doc([
+            lines[0].replace("topo err 8.0%", "topo err 40.0%"),
+            lines[1].replace("320.1 dcn B/token", "900.0 dcn B/token"),
+            lines[2].replace("overlap gap 3.0 pp", "overlap gap 25.0 pp"),
+            lines[3].replace("topo argmin gap 7304.8%",
+                             "topo argmin gap 0.0%"),
+            lines[4],
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by["topo_train_step:topo_reconcile_err_pct"]["regressed"]
+        assert by["topo_dcn:dcn_bytes_per_token"]["regressed"]
+        assert by["topo_overlap"
+                  ":overlap_predicted_vs_realized_pp"]["regressed"]
+        assert by["topo_argmin:topo_argmin_gap_pct"]["regressed"]
+        assert not by["topo_summary:topo_reconcile_err_pct"]["regressed"]
+        better = _doc([
+            lines[0].replace("topo err 8.0%", "topo err 2.0%"),
+            lines[1].replace("320.1 dcn B/token", "100.0 dcn B/token"),
+            lines[2].replace("overlap gap 3.0 pp", "overlap gap 0.5 pp"),
+            lines[3].replace("topo argmin gap 7304.8%",
+                             "topo argmin gap 9000.0%"),
+            lines[4],
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), better, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert not by["topo_train_step:topo_reconcile_err_pct"]["regressed"]
+        assert not by["topo_dcn:dcn_bytes_per_token"]["regressed"]
+        assert not by["topo_overlap"
+                      ":overlap_predicted_vs_realized_pp"]["regressed"]
+        assert not by["topo_argmin:topo_argmin_gap_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

@@ -21,10 +21,14 @@ from learning_jax_sharding_tpu.analysis.layout_search import (
     search_layout,
 )
 from learning_jax_sharding_tpu.analysis.shardflow import trace_shardflow
+from learning_jax_sharding_tpu.analysis.topology import reference_two_tier
 from learning_jax_sharding_tpu.parallel import build_mesh, mesh_sharding, put
 
 PROFILE = costmodel.table_profile("TPU v5 lite")
 SIZES_24 = {"data": 2, "model": 4}
+# Two-tier view of the same mesh: leading axis 'data' crosses DCN,
+# 'model' stays on ICI (reference α/β).
+TOPO_24 = reference_two_tier(("data", "model"), (2, 4))
 
 
 @pytest.fixture(scope="module")
@@ -204,6 +208,110 @@ class TestSearch:
         assert not default_vary(".b", np.ones((8,), np.float32))
         assert not default_vary(".t", np.ones((4, 4), np.int32))
         assert not default_vary(".s", 3.0)
+
+
+def _mm(x, w):
+    import jax.numpy as jnp
+
+    return jnp.einsum("bh,hd->bd", x, w)
+
+
+def _mm_args(mesh):
+    """The seeded two-tier scenario: B=2 is divisible only by 'data',
+    D=7 by nothing — every searchable placement lands on the
+    contraction dim H, so the search's ONLY real decision is which
+    mesh axis the matmul's all-reduce crosses. The incumbent pins both
+    contraction shardings on 'data' (the DCN tier)."""
+    x = put(np.ones((2, 1024), np.float32),
+            mesh_sharding(mesh, None, "data"))
+    w = put(np.ones((1024, 7), np.float32),
+            mesh_sharding(mesh, "data", None))
+    return x, w
+
+
+def _dcn_bytes_of(report):
+    """Price a (possibly flat-searched) report under the two-tier
+    profile — the cross-tier bytes its layout would really move."""
+    return costmodel.price_multiset_topo(
+        report.events, PROFILE, SIZES_24, topology=TOPO_24,
+    ).dcn_bytes
+
+
+class TestTopologySearch:
+    """The ISSUE-18 seeded acceptance case: flat pricing prefers the
+    all-reduce on the SMALLER axis (ring factor 2(n-1)/n favors n=2 =
+    'data'), which is exactly the DCN tier; hierarchy-aware pricing
+    must route the hot all-reduce onto ICI instead."""
+
+    def test_flat_argmin_is_dcn_heavy(self, mesh):
+        res = search_layout(
+            "t_flat_tier", _mm, *_mm_args(mesh), mesh=mesh,
+            budget=96, profile=PROFILE,
+        )
+        # Flat pricing keeps the seeded data-axis contraction: the
+        # n=2 all-reduce is the cheapest wire under a uniform link.
+        ops = {r for ev in res.report.events for r in ev.realizations[:1]}
+        assert ("all-reduce", "data") in ops
+        assert _dcn_bytes_of(res.report) > 0
+
+    def test_topo_argmin_strictly_lower_dcn_bytes(self, mesh):
+        flat = search_layout(
+            "t_flat_tier", _mm, *_mm_args(mesh), mesh=mesh,
+            budget=96, profile=PROFILE,
+        )
+        topo = search_layout(
+            "t_topo_tier", _mm, *_mm_args(mesh), mesh=mesh,
+            budget=96, profile=PROFILE, topology=TOPO_24,
+        )
+        assert isinstance(topo.best, costmodel.TopoPredictedCost)
+        assert topo.topology is TOPO_24
+        # Strictly lower priced DCN bytes than the flat argmin — the
+        # acceptance criterion. Here the search gets all the way to
+        # zero: the all-reduce moves to the ICI axis.
+        assert topo.best.comm.dcn_bytes < _dcn_bytes_of(flat.report)
+        assert topo.best.comm.dcn_bytes == 0
+        ops = {r for ev in topo.report.events for r in ev.realizations[:1]}
+        assert ("all-reduce", "model") in ops
+        assert ("all-reduce", "data") not in ops
+        # ... and it really moved leaves to get there.
+        assert topo.changed != {}
+
+    def test_topo_search_deterministic(self, mesh):
+        runs = [
+            search_layout("t_topo_det", _mm, *_mm_args(mesh), mesh=mesh,
+                          budget=96, profile=PROFILE, topology=TOPO_24)
+            for _ in range(2)
+        ]
+        assert runs[0].assignment == runs[1].assignment
+        assert runs[0].evaluated == runs[1].evaluated
+        assert runs[0].best.comm.to_dict() == runs[1].best.comm.to_dict()
+
+    def test_to_dict_carries_topology_and_split(self, mesh):
+        res = search_layout("t_topo_dict", _mm, *_mm_args(mesh), mesh=mesh,
+                            budget=32, profile=PROFILE, topology=TOPO_24)
+        d = res.to_dict()
+        assert d["topology"] == TOPO_24.name
+        assert "dcn_bytes" in d["best_cost"]
+        assert "overlap_ratio" in d["best_cost"]
+
+    def test_overlap_discount_tightens_prediction(self, mesh):
+        """Overlap-aware prediction sits between the serial upper
+        bound and the compute/memory floor, and a higher measured
+        overlap ratio only ever lowers it (monotone discount)."""
+        x, w = _mm_args(mesh)
+        rep = trace_shardflow("t_overlap", _mm, x, w, mesh=mesh)
+        serial = costmodel.price_topo(
+            rep, PROFILE, topology=TOPO_24, overlap_ratio=0.0)
+        half = costmodel.price_topo(
+            rep, PROFILE, topology=TOPO_24, overlap_ratio=0.5)
+        full = costmodel.price_topo(
+            rep, PROFILE, topology=TOPO_24, overlap_ratio=1.0)
+        assert serial.predicted_s > half.predicted_s > full.predicted_s
+        assert full.predicted_s == pytest.approx(
+            max(full.compute_s, full.memory_s))
+        assert serial.predicted_s == pytest.approx(
+            serial.serial_predicted_s, rel=1e-6, abs=1e-12,
+        ) or serial.predicted_s >= max(serial.compute_s, serial.memory_s)
 
 
 class TestSearchEntry:

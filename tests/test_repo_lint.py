@@ -86,6 +86,54 @@ def test_unbounded_host_buffer_rule_is_live():
     ]
 
 
+def test_axis_literal_rule_fires_in_scoped_dirs():
+    """The round-21 rule on its target pattern: a bare mesh-axis name
+    in a fleet/ (or analysis/) source file — one finding per literal,
+    line-attributed."""
+    import textwrap
+
+    from learning_jax_sharding_tpu.analysis.source_lint import lint_source
+
+    src = textwrap.dedent(
+        """
+        def carve(shape=(1, 2), axis_names=("data", "model")):
+            return axis_names
+
+        SPEC = {"pipe": 4}
+        """
+    )
+    found = lint_source("learning_jax_sharding_tpu/fleet/demo.py", src)
+    assert [f.rule for f in found] == ["axis-literal"] * 3
+    lines = sorted(int(f.where.rsplit(":", 1)[1]) for f in found)
+    assert lines == [2, 2, 5]
+
+
+def test_axis_literal_rule_is_scoped_and_exact():
+    """No findings outside fleet//analysis/ (the model and parallel
+    layers legitimately DEFINE the names), and equality — not substring
+    — matching keeps docstrings, prose, and near-miss strings out."""
+    import textwrap
+
+    from learning_jax_sharding_tpu.analysis.source_lint import lint_source
+
+    axisy = 'AXES = ("data", "model", "pipe")\n'
+    assert not lint_source(
+        "learning_jax_sharding_tpu/parallel/demo.py", axisy
+    )
+    assert not lint_source("scripts/demo.py", axisy)
+
+    benign = textwrap.dedent(
+        '''
+        def plan():
+            """Shards the batch over the "data" axis."""
+            return ("dataset", "modeling", "pipeline", "DATA")
+        '''
+    )
+    assert not lint_source(
+        "learning_jax_sharding_tpu/fleet/demo.py", benign
+    )
+
+
 def test_jaxpr_budgets_reference_live_entry_points_and_rules():
     """The symmetric audit for the OTHER budget section (round 13):
     ``jaxpr_budgets`` keys on (entry-point name → rule → count), and a

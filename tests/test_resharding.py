@@ -4,7 +4,9 @@ The KV-handoff shape of the core (seq dims, stop clipping, page splits)
 is pinned by tests/test_zfleet.py; this module pins the WEIGHT-HOT-SWAP
 shape: uneven (non-divisible) shard boundaries, replicated↔sharded in
 both directions, dtype preservation for quantized trees, host (numpy)
-leaves, and the device fast path's bit-identity + jit-cache reuse.
+leaves, and the device fast path's bit-identity + jit-cache reuse —
+plus (round 21) the two-tier DOMAIN SPLIT: every plan's wire volume
+partitions exactly into intra-ICI-domain vs cross-domain (DCN) bytes.
 """
 
 import jax
@@ -13,11 +15,13 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from learning_jax_sharding_tpu.analysis.topology import reference_two_tier
 from learning_jax_sharding_tpu.parallel import build_mesh
 from learning_jax_sharding_tpu.parallel.resharding import (
     device_reshard,
     plan_transfer,
     reshard_tree,
+    transfer_tree,
 )
 
 
@@ -180,3 +184,111 @@ def test_plan_transfer_whole_leaf_matches_nbytes(mesh24):
     plan = plan_transfer((8, 8), 4, sh, _ns(mesh24, "y", None))
     # Destination leaves x unused → every byte lands on 2 replicas.
     assert plan.bytes_total == 2 * 8 * 8 * 4
+
+
+# --- two-tier domain split (round 21) -----------------------------------
+
+#: (2,4) 'x','y' with the leading axis crossing hosts: devices 0–3 are
+#: ICI domain 0, devices 4–7 domain 1 — build_mesh's row-major carving.
+TOPO_24 = reference_two_tier(("x", "y"), (2, 4))
+
+
+class TestDomainSplit:
+    def test_split_sums_to_plan_bytes(self, mesh24, mesh13):
+        """Cross-sub-mesh plan (3-device mesh → full 2×4 mesh, uneven
+        boundaries): the ICI/DCN partition is exhaustive and exclusive
+        — the two buckets sum EXACTLY to bytes_total, segments too."""
+        x = jnp.arange(24, dtype=jnp.float32).reshape(6, 4)
+        src = jax.device_put(x, _ns(mesh13, "model", None))
+        plan = plan_transfer(
+            (6, 4), 4, src.sharding, _ns(mesh24, "x", "y"),
+        )
+        split = plan.domain_split(TOPO_24)
+        assert split["ici_bytes"] + split["dcn_bytes"] == plan.bytes_total
+        assert (
+            split["ici_segments"] + split["dcn_segments"]
+            == len(plan.segments)
+        )
+        # Sources live on devices 0–2 (domain 0); the x=1 half of the
+        # destination lives on devices 4–7 (domain 1) — bytes MUST
+        # cross, and the intra-domain half must not be billed as DCN.
+        assert split["dcn_bytes"] > 0
+        assert split["ici_bytes"] > 0
+
+    def test_cross_sub_mesh_handoff_is_all_dcn(self):
+        """Two disjoint sub-meshes in different ICI domains (the
+        disaggregated prefill→decode shape): every handoff byte is a
+        cross-domain hop — and a finer-grained topology that puts both
+        sub-meshes in ONE domain prices the same plan at zero DCN."""
+        devs = jax.devices()
+        a = build_mesh((1, 2), ("data", "model"), devices=devs[:2])
+        b = build_mesh((1, 2), ("data", "model"), devices=devs[4:6])
+        x = jnp.arange(16, dtype=jnp.float32).reshape(2, 8)
+        src = jax.device_put(
+            x, NamedSharding(a, P(None, "model")),
+        )
+        plan = plan_transfer(
+            (2, 8), 4, src.sharding, NamedSharding(b, P(None, "model")),
+        )
+        split = plan.domain_split(TOPO_24)        # grain 4: a vs b cross
+        assert split["dcn_bytes"] == plan.bytes_total == x.nbytes
+        assert split["ici_bytes"] == 0
+        one_domain = reference_two_tier(("x", "y"), (1, 8))   # grain 8
+        merged = plan.domain_split(one_domain)
+        assert merged["dcn_bytes"] == 0
+        assert merged["ici_bytes"] == plan.bytes_total
+
+    def test_replicated_source_dedup_no_dcn_double_charge(self, mesh24):
+        """A fully-replicated source elects ONE owner; the cross-domain
+        bill is only the bytes that actually land in the OTHER domain —
+        not one copy per source replica (which would double-charge DCN
+        8×)."""
+        x = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+        src = jax.device_put(x, _ns(mesh24))
+        plan = plan_transfer(
+            (4, 4), 4, src.sharding, _ns(mesh24, "x", "y"),
+        )
+        assert plan.bytes_total == x.nbytes      # dedup: one copy total
+        split = plan.domain_split(TOPO_24)
+        # The elected owner sits in one domain; exactly the x=1 half of
+        # the destination (half the array) lives in the other.
+        assert split["dcn_bytes"] == x.nbytes // 2
+        assert split["ici_bytes"] == x.nbytes - x.nbytes // 2
+
+    @pytest.mark.parametrize("dtype", ["int8", "int4", "bfloat16"])
+    def test_quantized_tree_preserves_split(self, mesh24, dtype):
+        """The domain split is itemsize-exact for quantized leaves, and
+        transfer_tree's topology-aware totals agree with the static
+        per-plan split (whole-leaf move: actuals == plan)."""
+        dt = jnp.dtype(dtype)
+        vals = np.arange(-8, 8).reshape(4, 4)
+        x = jax.device_put(jnp.asarray(vals, dt), _ns(mesh24, "x", None))
+        dst = _ns(mesh24, None, "y")
+        plan = plan_transfer(
+            (4, 4), dt.itemsize, x.sharding, dst,
+        )
+        split = plan.domain_split(TOPO_24)
+        assert split["ici_bytes"] + split["dcn_bytes"] == plan.bytes_total
+        out, stats = transfer_tree(
+            [x], [dst], seq_dims=[-1], topology=TOPO_24,
+        )
+        assert out[0].dtype == dt
+        assert stats["bytes"] == plan.bytes_total
+        assert stats["dcn_bytes"] == split["dcn_bytes"]
+
+    def test_host_endpoints_stay_intra_domain(self, mesh24):
+        """A device→host spill plan has no device pair to cross — the
+        host hop is already explicit in the plan's own bytes, so the
+        DCN bucket must stay empty (no double count)."""
+        from learning_jax_sharding_tpu.parallel.resharding import (
+            HostBuffer,
+        )
+
+        x = jax.device_put(
+            jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+            _ns(mesh24, "x", None),
+        )
+        plan = plan_transfer((4, 4), 4, x.sharding, HostBuffer())
+        split = plan.domain_split(TOPO_24)
+        assert split["dcn_bytes"] == 0
+        assert split["ici_bytes"] == plan.bytes_total

@@ -1,0 +1,251 @@
+"""Unit tests for the two-tier interconnect model (round 21).
+
+The integration surface — ``shardcheck --topo`` reconcile, the seeded
+layout-search canary, the ``dcn_degrade`` matrix cell — lives in
+``test_layout_search.py`` / ``test_resharding.py`` /
+``test_zero_downtime.py``.  This file pins the MODEL itself: the
+profile's tier algebra, its JSON contract (the checked-in profile must
+keep loading), and the tier-bucketed overlap-discounted pricing math
+that every consumer leans on.
+"""
+
+import math
+
+import pytest
+
+from learning_jax_sharding_tpu.analysis import costmodel
+from learning_jax_sharding_tpu.analysis.costmodel import (
+    _ring_factor,
+    price_event,
+    price_event_topo,
+    price_multiset,
+    price_multiset_topo,
+    table_profile,
+)
+from learning_jax_sharding_tpu.analysis.shardflow import CommEvent
+from learning_jax_sharding_tpu.analysis.topology import (
+    DEFAULT_TIERS,
+    REFERENCE_LINKS,
+    TIER_DCN,
+    TIER_ICI,
+    TopologyProfile,
+    reference_two_tier,
+    segment_tier,
+)
+
+TOPO = reference_two_tier(("data", "model"), (2, 4))
+PROFILE = table_profile("TPU v5 lite")
+SIZES = {"data": 2, "model": 4}
+
+
+def _ev(axes, nbytes=1 << 20, op="all-reduce", in_loop=False, trip=None):
+    return CommEvent(
+        kind="reduce",
+        axes=tuple(axes),
+        bytes=nbytes,
+        where="test:1",
+        primitive="dot_general",
+        reason="test event",
+        realizations=((op, "+".join(axes)),),
+        in_loop=in_loop,
+        trip=trip,
+    )
+
+
+class TestProfileAlgebra:
+    def test_reference_two_tier_tags_leading_axis_dcn(self):
+        assert TOPO.tier_of("data") == TIER_DCN
+        assert TOPO.tier_of("model") == TIER_ICI
+        # ICI-domain grain = product of ICI-axis extents.
+        assert TOPO.ici_domain_devices == 4
+        a = TOPO.axis_tier("data")
+        assert (a.alpha_s, a.beta_bytes_per_s) == REFERENCE_LINKS[TIER_DCN]
+
+    def test_untagged_axis_defaults_to_ici(self):
+        # An unknown axis must not silently price at DCN rates.
+        assert TOPO.tier_of("ghost") == TIER_ICI
+        assert TOPO.alpha_beta(("ghost",)) is None
+
+    def test_bucket_any_dcn_axis_wins(self):
+        assert TOPO.bucket(("model",)) == TIER_ICI
+        assert TOPO.bucket(("data",)) == TIER_DCN
+        # The slow hop dominates the ring.
+        assert TOPO.bucket(("model", "data")) == TIER_DCN
+
+    def test_alpha_beta_adds_latency_takes_slowest_link(self):
+        a_d, b_d = REFERENCE_LINKS[TIER_DCN]
+        a_i, b_i = REFERENCE_LINKS[TIER_ICI]
+        assert TOPO.alpha_beta(("data", "model")) == (a_d + a_i, min(b_d, b_i))
+
+    def test_domain_carving(self):
+        # grain 4 on 8 devices: {0..3} | {4..7}.
+        assert [TOPO.domain_of_id(i) for i in range(8)] == [0] * 4 + [1] * 4
+        ici_only = reference_two_tier(
+            ("data", "model"), (2, 4), tiers={"data": TIER_ICI}
+        )
+        assert ici_only.ici_domain_devices == 8
+        assert ici_only.dcn_axes() == ()
+        # No DCN axis tagged → the reference DCN link, never free.
+        assert ici_only.dcn_alpha_beta() == REFERENCE_LINKS[TIER_DCN]
+
+    def test_dcn_seconds(self):
+        alpha, beta = TOPO.dcn_alpha_beta()
+        assert TOPO.dcn_seconds(0) == 0.0
+        assert TOPO.dcn_seconds(1 << 20) == pytest.approx(
+            alpha + (1 << 20) / beta
+        )
+
+    def test_overlap_ratio_lookup(self):
+        t = reference_two_tier(
+            ("data",), (2,), overlap={"train_step": 0.7, "_default": 0.2}
+        )
+        assert t.overlap_ratio("train_step") == 0.7
+        assert t.overlap_ratio("decode_step") == 0.2
+        assert t.overlap_ratio(None) == 0.2
+        assert TOPO.overlap_ratio("train_step") is None
+
+
+class TestProfileSerialization:
+    def test_round_trip_preserves_identity(self):
+        t = reference_two_tier(
+            ("data", "model"), (2, 4), overlap={"train_step": 0.68}
+        )
+        assert TopologyProfile.from_dict(t.to_dict()).key() == t.key()
+
+    def test_version_gate(self):
+        d = TOPO.to_dict()
+        d["version"] = 999
+        with pytest.raises(ValueError, match="version 999"):
+            TopologyProfile.from_dict(d)
+
+    def test_save_load(self, tmp_path):
+        p = TOPO.save(tmp_path / "profiles" / "t.json")
+        assert TopologyProfile.load(p).key() == TOPO.key()
+
+    def test_default_path_shape(self):
+        p = TopologyProfile.default_path("cpu", (2, 4))
+        assert p.name == "topology_cpu_2x4.json"
+        assert p.parent.name == "profiles"
+
+    def test_checked_in_profile_loads(self):
+        # The versioned profile the topo pass ships with must keep
+        # loading — this is the JSON contract the pass depends on.
+        path = TopologyProfile.default_path("cpu", (2, 4))
+        t = TopologyProfile.load(path)
+        assert t.tier_of("data") == TIER_DCN
+        assert t.tier_of("model") == TIER_ICI
+        assert t.ici_domain_devices == 4
+        assert 0.0 < t.overlap_ratio("train_step") <= 1.0
+
+    def test_default_tiers_cover_canonical_axes(self):
+        assert DEFAULT_TIERS["data"] == TIER_DCN
+        assert DEFAULT_TIERS["model"] == TIER_ICI
+
+
+class TestTopoPricing:
+    def test_event_buckets_by_tier(self):
+        t_ici, wire_i, dcn_i = price_event_topo(
+            _ev(("model",)), PROFILE, SIZES, TOPO
+        )
+        t_dcn, wire_d, dcn_d = price_event_topo(
+            _ev(("data",)), PROFILE, SIZES, TOPO
+        )
+        assert not dcn_i and dcn_d
+        # Same op, same bytes: the DCN tier must price strictly slower
+        # (75µs vs 1µs α, 3.125 vs 45 GB/s β) even though its ring
+        # moves FEWER bytes (n=2 vs n=4 ring factor).
+        assert wire_d < wire_i
+        assert t_dcn > t_ici
+
+    def test_event_matches_tier_alpha_beta(self):
+        ev = _ev(("data",), nbytes=1 << 20)
+        t, wire, _ = price_event_topo(ev, PROFILE, SIZES, TOPO)
+        alpha, beta = REFERENCE_LINKS[TIER_DCN]
+        expect_wire = (1 << 20) * _ring_factor("all-reduce", 2)
+        assert wire == pytest.approx(expect_wire)
+        assert t == pytest.approx(alpha + expect_wire / beta)
+
+    def test_untagged_axis_falls_back_flat(self):
+        ev = _ev(("ghost",), nbytes=1 << 20)
+        sizes = dict(SIZES, ghost=4)
+        t, _, is_dcn = price_event_topo(ev, PROFILE, sizes, TOPO)
+        assert not is_dcn
+        assert t == pytest.approx(price_event(ev, PROFILE, sizes))
+
+    def test_in_loop_trip_multiplies(self):
+        once = price_event_topo(_ev(("data",)), PROFILE, SIZES, TOPO)
+        looped = price_event_topo(
+            _ev(("data",), in_loop=True, trip=8), PROFILE, SIZES, TOPO
+        )
+        assert looped[0] == pytest.approx(8 * once[0])
+        assert looped[1] == pytest.approx(8 * once[1])
+
+    def test_multiset_overlap_discount(self):
+        events = [_ev(("data",)), _ev(("model",)), _ev(("model",), 1 << 18)]
+        tp = price_multiset_topo(
+            events, PROFILE, SIZES, topology=TOPO, overlap_ratio=0.75
+        )
+        # exposed = (1 − r) · serial; buckets partition the totals.
+        assert tp.collective_s == pytest.approx(0.25 * tp.serial_s)
+        assert tp.serial_s == pytest.approx(tp.ici_s + tp.dcn_s)
+        assert tp.wire_bytes == pytest.approx(tp.ici_bytes + tp.dcn_bytes)
+        assert tp.dcn_bytes > 0 and tp.ici_bytes > 0
+
+    def test_multiset_none_ratio_bills_serial(self):
+        events = [_ev(("data",))]
+        tp = price_multiset_topo(events, PROFILE, SIZES, topology=TOPO)
+        assert tp.overlap_ratio is None
+        assert tp.collective_s == pytest.approx(tp.serial_s)
+        # Out-of-range ratios clip instead of going negative.
+        clipped = price_multiset_topo(
+            events, PROFILE, SIZES, topology=TOPO, overlap_ratio=1.5
+        )
+        assert clipped.collective_s == 0.0
+
+    def test_flat_path_unchanged_and_topo_delegates(self):
+        events = [_ev(("data",)), _ev(("model",))]
+        flat_s, flat_b, _ = price_multiset(events, PROFILE, SIZES)
+        assert flat_s == pytest.approx(
+            sum(price_event(e, PROFILE, SIZES) for e in events)
+        )
+        topo_s, topo_b, _ = price_multiset(
+            events, PROFILE, SIZES, topology=TOPO, overlap_ratio=0.5
+        )
+        tp = price_multiset_topo(
+            events, PROFILE, SIZES, topology=TOPO, overlap_ratio=0.5
+        )
+        assert (topo_s, topo_b) == (tp.collective_s, tp.wire_bytes)
+
+    def test_memo_respects_topology_identity(self):
+        # A re-tagged axis must never serve the other profile's price.
+        ev = [_ev(("data",))]
+        base = price_multiset_topo(ev, PROFILE, SIZES, topology=TOPO)
+        flipped = reference_two_tier(
+            ("data", "model"), (2, 4),
+            tiers={"data": TIER_ICI, "model": TIER_DCN},
+        )
+        other = price_multiset_topo(ev, PROFILE, SIZES, topology=flipped)
+        assert other.dcn_bytes == 0 and base.dcn_bytes > 0
+        assert other.serial_s != pytest.approx(base.serial_s)
+
+
+class _Dev:
+    def __init__(self, id):
+        self.id = id
+
+
+class _Seg:
+    def __init__(self, src, dst):
+        self.src_device = src
+        self.dst_device = dst
+
+
+class TestSegmentTier:
+    def test_cross_domain_is_dcn(self):
+        assert segment_tier(_Seg(_Dev(0), _Dev(4)), TOPO) == TIER_DCN
+        assert segment_tier(_Seg(_Dev(1), _Dev(3)), TOPO) == TIER_ICI
+
+    def test_host_endpoint_classifies_ici(self):
+        # Host staging is local to the device end's domain — charging
+        # it DCN would double-count the explicit host hop.
+        assert segment_tier(_Seg(object(), _Dev(5)), TOPO) == TIER_ICI
