@@ -1280,6 +1280,40 @@ def bench_kv_economy():
             _log(line)
 
 
+def bench_compression():
+    """Comm compression A/B (round 22): the quantized TP all-reduce
+    (plain vs int8 block-scaled mixed engine, with the greedy-agreement
+    check the drift oracle holds at 100%) and the compressed KV tier
+    ladder (K=2 fleet, ``int8_delta`` page codec — wire vs raw kB per
+    request and their ratio).
+
+    Codec passes and wire accounting are host machinery, nothing
+    chip-specific, so the A/B runs on the emulated 8-device mesh in a
+    subprocess (``scripts/perf_compression.py --bench-lines``) whose
+    lines are relayed, exactly like ``bench_fleet``. All four numbers
+    (compressed tok/s, q8 agreement, kv wire kB/req, compression
+    ratio) are gated direction-aware by ``scripts/bench_compare.py``."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent
+        / "scripts" / "perf_compression.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--bench-lines"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.splitlines()[-5:])
+        raise RuntimeError(f"perf_compression exited {proc.returncode}: {tail}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("[bench]"):
+            _log(line)
+
+
 def bench_tenancy():
     """Tenancy (round 12): zero-downtime weight hot-swap under load at
     125M, plus the multi-LoRA mixed-batch ladder.
@@ -1530,6 +1564,10 @@ def main():
         bench_kv_economy()
     except Exception as e:
         _log(f"[bench] kv economy bench skipped: {type(e).__name__}: {e}")
+    try:
+        bench_compression()
+    except Exception as e:
+        _log(f"[bench] compression bench skipped: {type(e).__name__}: {e}")
     try:
         bench_tenancy()
     except Exception as e:

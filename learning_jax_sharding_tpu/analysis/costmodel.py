@@ -260,6 +260,80 @@ def _ring_factor(op: str, n: int) -> float:
     return 1.0
 
 
+def quantized_variant(
+    ev: CommEvent, *, itemsize: int = 4, block: int = 32
+) -> CommEvent:
+    """The int8 block-scaled wire form of one predicted reduce event —
+    what ``parallel/compression.py``'s codec would actually put on the
+    link: int8 payloads plus one fp32 scale per ``block`` elements, so
+    ``bytes × wire_scale(itemsize, block)`` (≈ 0.28 × for fp32 inputs,
+    a 3.6× wire reduction). The semantic event is unchanged — same
+    axes, same cause, same realization ops — only the wire weight
+    moves, which is exactly how the engine's quantized TP matmul and
+    the ZeRO-1 int8 ring behave."""
+    from learning_jax_sharding_tpu.parallel.compression import wire_scale
+
+    return dataclasses.replace(
+        ev,
+        bytes=int(math.ceil(ev.bytes * wire_scale(itemsize, block))),
+        reason=ev.reason + " [int8 block-scaled wire]",
+    )
+
+
+def _quantizable(ev: CommEvent, axes: set[str]) -> bool:
+    # The codec seams the stack actually ships quantize REDUCTIONS (the
+    # ZeRO ring, the TP matmul's all-reduce site): pure data movement
+    # (permutes, reshars gathers) has cheap exact alternatives and the
+    # searchable move stays honest by not claiming them.
+    return bool(
+        ev.realizations
+        and ev.realizations[0][0] in ("all-reduce", "reduce-scatter")
+        and set(ev.axes) & axes
+        and "[int8 block-scaled wire]" not in ev.reason
+    )
+
+
+def quantize_events(
+    events: list, axes: Iterable[str], *, itemsize: int = 4,
+    block: int = 32,
+) -> list:
+    """Re-weight a predicted multiset as if every reduce-family event
+    touching one of ``axes`` ran through the int8 codec. Non-reduce
+    events and other axes pass through untouched — this is the
+    transform behind the layout search's "quantize this axis's
+    collective" move."""
+    q = set(axes)
+    return [
+        quantized_variant(ev, itemsize=itemsize, block=block)
+        if _quantizable(ev, q) else ev
+        for ev in events
+    ]
+
+
+def codec_overhead_s(
+    events: list, axes: Iterable[str], profile: Profile, *,
+    block: int = 32,
+) -> float:
+    """Seconds of elementwise codec work the quantized variants add:
+    quantize before the wire and dequantize after are each a read+write
+    pass over the raw buffer, ≈ 4 × raw bytes of HBM traffic per
+    quantized event (× trip in loops). Charged against the profile's
+    achieved HBM rate — on hosts where the "link" IS memory bandwidth
+    (the CPU tier-1 environment) this is what makes flat pricing
+    honestly DECLINE quantization: the codec passes cost more than the
+    wire they save."""
+    q = set(axes)
+    t = 0.0
+    for ev in events:
+        if not _quantizable(ev, q):
+            continue
+        trip = (ev.trip or 1) if ev.in_loop else 1
+        t += trip * (4.0 * ev.bytes) / max(
+            profile.hbm_bw * profile.mbu_eff, 1.0
+        )
+    return t
+
+
 def _axis_alpha_beta(
     profile: Profile, axes: tuple[str, ...]
 ) -> tuple[float, float] | None:

@@ -206,7 +206,7 @@ def _sharded_serving_params(model, mesh, rules):
 
 def _engine_programs(
     *, speculative: bool, mixed: bool = False, adapters: bool = False,
-    horizon: int = 1,
+    horizon: int = 1, compression: bool = False,
 ) -> list[EntryProgram]:
     """Prefill + decode via a real (tiny) ContinuousEngine: one short
     serve populates the dispatch-arg caches, then each program relowers
@@ -229,7 +229,14 @@ def _engine_programs(
     ``spec_adapter_multi_step`` golden — the contract that fusing N
     iterations into one ``lax.scan`` adds ZERO collectives over N× the
     single-step multiset (shardflow prices the scanned body at the
-    horizon trip count)."""
+    horizon trip count). With ``compression`` (round 22) the engine
+    carries ``comm_compression=CommCompression()`` and the contract is
+    the ``_q8`` variant (``mixed_step_q8`` / ``multi_step_q8``): the
+    golden pins the quantized TP matmul's collective shape — the FF
+    block's fp all-gather replaced by int8-payload + fp32-scale
+    all-gathers — so a regression that silently falls back to the
+    uncompressed reduction (or adds an unpriced collective around the
+    codec) fails the contract, not just the bench."""
     import dataclasses as dc
 
     from learning_jax_sharding_tpu.models.serving import ContinuousEngine
@@ -249,6 +256,12 @@ def _engine_programs(
         kwargs: dict = dict(mixed=mixed) if mixed else {}
         if horizon > 1:
             kwargs["horizon"] = horizon
+        if compression:
+            from learning_jax_sharding_tpu.parallel.compression import (
+                CommCompression,
+            )
+
+            kwargs["comm_compression"] = CommCompression()
         d_params = None
         if speculative:
             d_cfg = dc.replace(cfg, num_layers=1)
@@ -303,7 +316,12 @@ def _engine_programs(
             built["sf"] = built["eng"].explain_collectives()
         return built["sf"]
 
-    if adapters and horizon > 1:
+    if compression:
+        # The q8 engines contribute only their fused-family golden (the
+        # engine names them itself: contract_name suffixes _q8 while the
+        # compression is live).
+        names = ("multi_step_q8",) if horizon > 1 else ("mixed_step_q8",)
+    elif adapters and horizon > 1:
         names = (
             ("spec_adapter_multi_step",) if speculative
             else ("adapter_multi_step",)
@@ -349,6 +367,14 @@ def _serving_programs() -> list[EntryProgram]:
         ),
         *_engine_programs(
             speculative=True, mixed=True, adapters=True, horizon=4
+        ),
+        # The comm-compression regime (round 22): the fused families
+        # recompiled with the quantized TP all-reduce — their own
+        # goldens, because the int8-payload collectives are a DIFFERENT
+        # multiset from the fp programs they stand in for.
+        *_engine_programs(speculative=False, mixed=True, compression=True),
+        *_engine_programs(
+            speculative=False, mixed=True, horizon=4, compression=True
         ),
     ]
 
@@ -413,7 +439,7 @@ def _kv_transfer_programs() -> list[EntryProgram]:
     ]
 
 
-def _kv_page_programs() -> list[EntryProgram]:
+def _kv_page_programs(*, compression: bool = False) -> list[EntryProgram]:
     """The KV tier ladder's device programs (round 15 —
     ``fleet/kv_economy.py`` rides between them): ``kv_page_spill``
     gathers one physical page's K/V leaves for demotion to the host
@@ -425,7 +451,13 @@ def _kv_page_programs() -> list[EntryProgram]:
     but on a PAGED prefix-cache engine (the only kind that tiers): one
     short serve retains a prefix chain, spill + fill of its deepest
     page populate the dispatch-arg caches, then each program relowers
-    AOT under its contract name."""
+    AOT under its contract name. With ``compression`` the engine
+    carries the KV codec (``CommCompression(collectives=False)``) and
+    the goldens are ``kv_page_spill_q8``/``kv_page_fill_q8`` —
+    bit-identical DEVICE programs to the uncompressed pair (the codec
+    runs in the host plan, after the gather / before the write), named
+    apart because they pin the byte-movement regime the page rows were
+    audited under."""
     from learning_jax_sharding_tpu.models.serving import ContinuousEngine
     from learning_jax_sharding_tpu.models.transformer import Transformer
     from learning_jax_sharding_tpu.parallel.logical import RULES_TP_SERVING
@@ -440,10 +472,17 @@ def _kv_page_programs() -> list[EntryProgram]:
         params = _sharded_serving_params(
             Transformer(cfg), mesh, RULES_TP_SERVING
         )
+        kwargs: dict = {}
+        if compression:
+            from learning_jax_sharding_tpu.parallel.compression import (
+                CommCompression,
+            )
+
+            kwargs["comm_compression"] = CommCompression(collectives=False)
         eng = ContinuousEngine(
             cfg, mesh, RULES_TP_SERVING,
             batch_size=2, max_new_tokens=4, refill_chunk=16,
-            paged_pages=10, page_size=4, prefix_cache=True,
+            paged_pages=10, page_size=4, prefix_cache=True, **kwargs,
         )
         rng = np.random.default_rng(0)
         prompt = rng.integers(
@@ -470,7 +509,10 @@ def _kv_page_programs() -> list[EntryProgram]:
             name, mesh, lambda name=name: ensure()[name],
             shardflow=lambda name=name: explain()[name],
         )
-        for name in ("kv_page_spill", "kv_page_fill")
+        for name in (
+            ("kv_page_spill_q8", "kv_page_fill_q8") if compression
+            else ("kv_page_spill", "kv_page_fill")
+        )
     ]
 
 
@@ -827,6 +869,7 @@ def build_entry_programs(names: list[str] | None = None) -> list[EntryProgram]:
         *_serving_programs(),
         *_kv_transfer_programs(),
         *_kv_page_programs(),
+        *_kv_page_programs(compression=True),
         *_swap_reshard_programs(),
         _moe_dispatch(),
         _seq_attention("ring_attention"),

@@ -227,6 +227,17 @@ class SearchResult:
     peak_bytes: int | None = None
     baseline_peak_bytes: int | None = None
     fits: bool | None = None
+    # The compression dimension (round 22): mesh axes whose reduce-
+    # family collectives the search chose to run through the int8
+    # block-scaled codec — chosen only when the quantized wire plus the
+    # codec's HBM passes price strictly cheaper than the fp wire, so a
+    # flat (single-tier) profile typically declines and a two-tier
+    # profile flips the DCN-crossing reductions. Advisory, like every
+    # other search output: committing it means building the engine with
+    # ``comm_compression=`` (or the ZeRO step with
+    # ``quantized_comm=True``), whose ``*_q8`` goldens then pin it.
+    quantized_axes: tuple = ()
+    quantize_comm_s: dict | None = None
 
     @property
     def gap_pct(self) -> float:
@@ -290,6 +301,9 @@ class SearchResult:
             "budget": self.budget,
             "sweeps": self.sweeps,
             "exhausted": self.exhausted,
+            "quantized_axes": list(self.quantized_axes),
+            **({"quantize_comm_s": self.quantize_comm_s}
+               if self.quantize_comm_s else {}),
             "contract": self.contract.to_json(),
         }
 
@@ -346,6 +360,8 @@ def search_layout(
     donated: tuple = (),
     topology: Any = None,
     overlap_ratio: float | None = None,
+    quantize_collectives: bool = True,
+    quantize_itemsize: int = 4,
     **kwargs,
 ) -> SearchResult:
     """Search the sharding layout of ``fn(*args)``'s argument leaves.
@@ -372,7 +388,23 @@ def search_layout(
     keeps hot collectives on ICI and pushes only what must cross DCN,
     and ``best.comm.dcn_bytes`` carries the priced cross-tier traffic.
     ``overlap_ratio=None`` consults the topology's per-family table
-    (keyed by ``name``); serial when absent — never optimistic."""
+    (keyed by ``name``); serial when absent — never optimistic.
+
+    With ``quantize_collectives`` (default on), the search runs one
+    extra dimension AFTER the sharding sweep: per mesh axis, price the
+    argmin layout's reduce-family collectives through the int8
+    block-scaled codec (:func:`~.costmodel.quantize_events`) plus the
+    codec's own HBM passes (:func:`~.costmodel.codec_overhead_s`), and
+    keep the axis only when that total is STRICTLY cheaper than the fp
+    wire. The sharding choice is untouched — compression is a codec
+    knob per axis, reported in ``SearchResult.quantized_axes`` — and
+    the pricing is honest both ways: a flat profile whose link rate is
+    memory rate (the CPU tier-1 host) declines, a two-tier profile
+    whose DCN β is orders below HBM flips the DCN-crossing reductions.
+    ``quantize_itemsize`` is the element width the wire would otherwise
+    carry (4 for fp32 grads/activations, 2 for bf16 — bf16's 1.8×
+    wire win has to clear the same codec overhead, which is how "keep
+    bf16 on flat pricing" falls out)."""
     import jax
 
     from learning_jax_sharding_tpu.analysis import memflow
@@ -535,6 +567,48 @@ def search_layout(
                 base_report, base_cost, base_peak
             )
 
+    # The compression dimension: greedy per-axis "quantize this axis's
+    # reduce collectives" on the argmin layout. Pure repricing of the
+    # already-simulated multiset — no extra simulate_jaxpr calls, so it
+    # costs microseconds against the sweep's budget.
+    quantized_axes: list[str] = []
+    quantize_comm_s: dict | None = None
+
+    def _comm_of(evs):
+        if topology is not None:
+            return costmodel.price_multiset_topo(
+                evs, profile, mesh_sizes, topology=topology,
+                overlap_ratio=eff_overlap,
+            ).collective_s
+        coll, _wire, _aborted = costmodel.price_multiset(
+            evs, profile, mesh_sizes,
+        )
+        return coll
+
+    if quantize_collectives and best_report is not None:
+        cur_events = list(best_report.events)
+        cur_comm = base_comm_s = _comm_of(cur_events)
+        overhead = 0.0
+        for ax in sorted(mesh_sizes):
+            if mesh_sizes[ax] <= 1:
+                continue
+            trial_over = overhead + costmodel.codec_overhead_s(
+                cur_events, (ax,), profile,
+            )
+            trial_events = costmodel.quantize_events(
+                cur_events, (ax,), itemsize=quantize_itemsize,
+            )
+            if _comm_of(trial_events) + trial_over < cur_comm + overhead:
+                quantized_axes.append(ax)
+                cur_events, overhead = trial_events, trial_over
+                cur_comm = _comm_of(cur_events)
+        if quantized_axes:
+            quantize_comm_s = {
+                "fp_wire_s": base_comm_s,
+                "q8_wire_s": cur_comm,
+                "codec_overhead_s": overhead,
+            }
+
     assignment = {
         d.path: current[d.index].dims
         for d in sorted(decisions, key=lambda d: d.path)
@@ -566,6 +640,8 @@ def search_layout(
         peak_bytes=None if best_peak is None else int(best_peak),
         baseline_peak_bytes=None if base_peak is None else int(base_peak),
         fits=fits,
+        quantized_axes=tuple(quantized_axes),
+        quantize_comm_s=quantize_comm_s,
     )
 
 

@@ -73,6 +73,19 @@ structure matters:
   of those names; definition-site and fixture literals ride the
   baseline with reasons.
 
+* ``uncounted-compression`` — a direct call to the wire codec's
+  primitives (``quantize_blocks``/``quantize_absmax`` and friends, or
+  ``<codec>.encode``/``<codec>.decode`` on a codec-named receiver)
+  OUTSIDE the counted seams (``parallel/compression.py`` defines them,
+  ``parallel/resharding.py``'s ``execute_transfer`` and
+  ``parallel/collectives.py``'s quantized ring book every byte they
+  move): compression applied anywhere else produces wire traffic the
+  ``*_raw_bytes`` counters and ``compression_ratio`` gauges never see,
+  so the byte accounting the whole observability story gates on
+  silently understates what crossed the link. Route the payload
+  through ``plan_transfer(codec=...)``/``execute_transfer`` or the
+  collectives seam instead.
+
 Findings carry ``file:line`` and a stable rule id; pre-existing hits are
 carried in ``analysis/baseline.json`` — a (file, rule) → count budget —
 so the repo gates on NEW findings without a flag-day cleanup.
@@ -583,6 +596,53 @@ def _axis_literal_findings(path: str, tree: ast.AST) -> list[Finding]:
     return out
 
 
+#: The modules allowed to touch codec primitives directly: the codec's
+#: own definition site plus the two seams that COUNT what they move
+#: (execute_transfer's wire/raw stats, the quantized ring's ledgered
+#: payloads). Everything else must go through them.
+_COMPRESSION_SEAMS = frozenset({
+    "learning_jax_sharding_tpu/parallel/compression.py",
+    "learning_jax_sharding_tpu/parallel/resharding.py",
+    "learning_jax_sharding_tpu/parallel/collectives.py",
+})
+
+_CODEC_PRIMITIVES = frozenset({
+    "quantize_blocks", "dequantize_blocks",
+    "quantize_absmax", "dequantize_absmax",
+})
+
+
+def _compression_findings(path: str, tree: ast.AST) -> list[Finding]:
+    """``uncounted-compression`` over one parsed file: direct codec
+    primitive calls, or ``.encode``/``.decode`` on a codec-named
+    receiver, outside the counted seams. The receiver-name gate keeps
+    ``str.encode`` and tokenizer methods out — only a name/attribute
+    ending in ``codec`` (``self._kv_codec.encode(...)``) counts."""
+    if pathlib.PurePosixPath(path).as_posix() in _COMPRESSION_SEAMS:
+        return []
+    out: list[Finding] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        dotted = _dotted(n.func)
+        tail = dotted.rsplit(".", 1)[-1]
+        hit = tail in _CODEC_PRIMITIVES
+        if not hit and tail in ("encode", "decode") and "." in dotted:
+            recv = dotted.rsplit(".", 1)[0].rsplit(".", 1)[-1]
+            hit = recv.lower().endswith("codec")
+        if hit:
+            out.append(Finding(
+                "ast", "uncounted-compression", f"{path}:{n.lineno}",
+                f"direct codec call {dotted!r} outside the counted "
+                "compression seams — bytes it produces never reach the "
+                "*_raw_bytes counters or compression_ratio gauges; "
+                "route the payload through plan_transfer(codec=...)/"
+                "execute_transfer or parallel.collectives' quantized "
+                "ring so the wire accounting stays whole",
+            ))
+    return out
+
+
 def _raw_clock_findings(path: str, lines: list[str]) -> list[Finding]:
     out: list[Finding] = []
     for i, line in enumerate(lines):
@@ -616,7 +676,12 @@ def lint_source(path: str | pathlib.Path, text: str | None = None) -> list[Findi
         )]
     v = _Visitor(str(path), lines)
     v.visit(tree)
-    return out + _axis_literal_findings(str(path), tree) + v.findings
+    return (
+        out
+        + _axis_literal_findings(str(path), tree)
+        + _compression_findings(str(path), tree)
+        + v.findings
+    )
 
 
 def lint_tree(

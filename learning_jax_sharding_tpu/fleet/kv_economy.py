@@ -36,7 +36,11 @@ HBM. Two mechanisms, one module:
   only usable contiguously. Tier entries are stamped with the spilling
   engine's ``weights_version``; a version mismatch is a MISS and drops
   the entry (stale K/V is never served — the swap-commit registry flush
-  invalidates digests the same way).
+  invalidates digests the same way). Stale entries DO still earn their
+  RAM once before dropping: a re-demotion passes them to
+  ``spill_page(base_rows=...)`` as the delta codec's base, so engines
+  built with ``comm_compression`` ship only the blocks the version
+  bump actually changed.
 
 Host-side policy only: nothing here dispatches device code — the
 engine's golden-pinned ``kv_page_spill``/``kv_page_fill`` programs and
@@ -96,6 +100,16 @@ class TierStore:
             self.evictions += 1
             evicted += ent["bytes"]
         return evicted
+
+    def base_rows(self, key: bytes):
+        """Rows for ``key`` at ANY version, no LRU refresh — the delta
+        codec's version-stamped base. A stale entry is useless to serve
+        (:meth:`get` drops it) but perfect to diff against: a page
+        re-spilled after a weights bump shares most of its blocks with
+        the copy the tier already holds, so ``spill_page(...,
+        base_rows=...)`` ships only the changed blocks over the wire."""
+        ent = self._pages.get(key)
+        return None if ent is None else ent["rows"]
 
     def get(self, key: bytes, *, version: int):
         """Rows for ``key`` at ``version``, LRU-refreshed — or ``None``.
@@ -227,10 +241,21 @@ class KvEconomy:
             "host-tier entries LRU-evicted past the byte budget")
         self._c_spill_bytes = r.counter(
             "fleet_tier_spill_bytes_total",
-            "bytes moved HBM → host by demotion sweeps")
+            "WIRE bytes moved HBM → host by demotion sweeps (post-codec "
+            "when the engines carry a comm_compression KV codec)")
         self._c_fill_bytes = r.counter(
             "fleet_tier_fill_bytes_total",
-            "bytes moved into HBM by promotions")
+            "WIRE bytes moved into HBM by promotions")
+        self._c_raw_bytes = r.counter(
+            "fleet_tier_raw_bytes_total",
+            "pre-codec bytes the tier transfers represented — the "
+            "compression denominator; equals wire bytes on "
+            "uncompressed fleets")
+        self._g_ratio = r.gauge(
+            "fleet_tier_compression_ratio",
+            "raw/wire ratio of the last tier transfer (1.0 when the "
+            "engines ship uncompressed)")
+        self._g_ratio.set(1.0)
         self._c_pred_tokens = r.counter(
             "fleet_prefix_predicted_tokens_total",
             "prefix-hit tokens the placement score predicted")
@@ -339,6 +364,10 @@ class KvEconomy:
             promoted += 1
             self._c_promotions.inc()
             self._c_fill_bytes.inc(st["bytes"])
+            raw = st.get("raw_bytes", st["bytes"])
+            self._c_raw_bytes.inc(raw)
+            if st["bytes"]:
+                self._g_ratio.set(raw / st["bytes"])
             extra = {}
             if src == "peer":
                 self._c_peer.inc()
@@ -351,7 +380,7 @@ class KvEconomy:
                     }
             self._router.recorder.record(
                 "fleet.kv_promote", replica=name, src=src,
-                bytes=st["bytes"], **extra,
+                bytes=st["bytes"], raw_bytes=raw, **extra,
             )
         return promoted
 
@@ -447,21 +476,32 @@ class KvEconomy:
                 ):
                     continue
                 try:
-                    rows, st = eng.spill_page(key, drop=hot)
+                    # A stale same-key tier entry (version bump since the
+                    # last demotion) is the delta codec's base: only the
+                    # blocks the new version changed ride the wire.
+                    rows, st = eng.spill_page(
+                        key, drop=hot, base_rows=tier.base_rows(key),
+                    )
                 except (KeyError, RuntimeError):
                     continue   # became shared/unregistered since listing
+                raw = st.get("raw_bytes", st["bytes"])
+                # The tier budgets what host RAM actually HOLDS — the
+                # decoded rows — not the wire bytes the transfer paid.
                 evicted = tier.put(
                     key, rows,
-                    version=eng.weights_version, nbytes=st["bytes"],
+                    version=eng.weights_version, nbytes=raw,
                 )
                 demoted += 1
                 self._c_demotions.inc()
                 self._c_spill_bytes.inc(st["bytes"])
+                self._c_raw_bytes.inc(raw)
+                if st["bytes"]:
+                    self._g_ratio.set(raw / st["bytes"])
                 if evicted:
                     self._c_evictions.inc()
                 self._router.recorder.record(
                     "fleet.kv_demote", replica=name, bytes=st["bytes"],
-                    host_evicted_bytes=evicted,
+                    raw_bytes=raw, host_evicted_bytes=evicted,
                 )
         self._g_host_pages.set(sum(len(t) for t in self._tiers.values()))
         self._g_host_bytes.set(
@@ -526,6 +566,8 @@ class KvEconomy:
             "host_evictions": int(self._c_evictions.value),
             "spill_bytes": int(self._c_spill_bytes.value),
             "fill_bytes": int(self._c_fill_bytes.value),
+            "raw_bytes": int(self._c_raw_bytes.value),
+            "compression_ratio": float(self._g_ratio.value),
             "predicted_tokens": int(self._c_pred_tokens.value),
             "realized_tokens": int(self._c_real_tokens.value),
             "misroutes": int(self._c_misroutes.value),

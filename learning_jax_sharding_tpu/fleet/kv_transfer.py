@@ -20,6 +20,13 @@ import surface:
   summed bytes/segment telemetry the router's
   ``fleet_kv_transfer_bytes_total`` counters feed on.
 
+All three take the ``codec=`` seam from ``parallel/compression.py``:
+``FleetRouter(kv_codec="int8")`` ships prefill→decode handoffs as
+block-scaled int8 (``"int8_delta"`` additionally diffs against a
+version-stamped base), and the returned stats split ``bytes`` (wire)
+from ``raw_bytes`` (pre-codec) so the fleet counters report what
+actually crossed DCN, not what the arrays weighed.
+
 The plan moves HOST-VISIBLE bytes on purpose: the two DEVICE-side
 programs of the handoff (``ContinuousEngine``'s ``kv_export`` gather and
 ``kv_ingest`` update) each carry a shardcheck golden pinning ZERO

@@ -113,6 +113,7 @@ class FleetRouter:
         max_pending_handoffs: int | None = None,
         kv_economy: Any | None = None,
         topology: Any | None = None,
+        kv_codec: Any | None = None,
     ):
         reps = list(replicas)
         if not reps:
@@ -168,6 +169,17 @@ class FleetRouter:
         # when set, every KV movement that crosses an ICI domain is
         # counted (and kv_economy-priced) as a DCN hop.
         self.topology = topology
+        # KV wire codec (comm-compression layer): a codec name
+        # ("int8" / "int8_delta") or instance threaded into every
+        # prefill→decode handoff's transfer plan — the fleet_kv_*
+        # byte counters then report WIRE bytes, with the raw total and
+        # the realized ratio alongside. None ships raw (exact) bytes.
+        from learning_jax_sharding_tpu.parallel.compression import get_codec
+
+        self._kv_codec = (
+            get_codec(kv_codec) if isinstance(kv_codec, str) or kv_codec is None
+            else kv_codec
+        )
         # Backpressure on the handoff stage: each parked entry pins one
         # exported KV-row tree, so the queue is bounded (default: two
         # waves of the fleet's decode slots) — at the bound the router
@@ -202,6 +214,14 @@ class FleetRouter:
             "fleet_kv_dcn_bytes_total",
             "cross-ICI-domain (DCN) share of the KV handoff bytes — "
             "always 0 without a topology profile")
+        self._c_kv_raw_bytes = r.counter(
+            "fleet_kv_raw_bytes_total",
+            "pre-codec bytes of the KV handoffs (equal to "
+            "fleet_kv_transfer_bytes_total when no kv_codec is set)")
+        self._g_kv_ratio = r.gauge(
+            "fleet_kv_compression_ratio",
+            "raw/wire byte ratio of the most recent KV handoff")
+        self._g_kv_ratio.set(1.0)
         self._c_swaps = r.counter(
             "fleet_swaps_total",
             "replica weight swaps committed by rolling_swap")
@@ -699,6 +719,7 @@ class FleetRouter:
                 page_tokens=self.kv_page_tokens,
                 plan_cache=self._plan_cache,
                 topology=self.topology,
+                codec=self._kv_codec,
             )
             rep.engine.ingest_kv(
                 rep.params, freq.prompt, h["first"], rows, rid=freq.rid,
@@ -711,6 +732,10 @@ class FleetRouter:
             self._c_kv_bytes.inc(stats["bytes"])
             self._c_kv_segments.inc(stats["segments"])
             self._c_kv_dcn_bytes.inc(stats.get("dcn_bytes", 0))
+            raw = stats.get("raw_bytes", stats["bytes"])
+            self._c_kv_raw_bytes.inc(raw)
+            if stats["bytes"]:
+                self._g_kv_ratio.set(raw / stats["bytes"])
             # The handoff leg is the ROUTER's span: it alone saw both
             # ends — export on the prefill replica through ingest on the
             # decode replica (park time in the queue included: that wait
@@ -718,12 +743,13 @@ class FleetRouter:
             self.traces.leg(
                 freq.rid, "handoff", h["t_export"], time.perf_counter(),
                 src=h["src"], dst=rep.name, bytes=stats["bytes"],
-                segments=stats["segments"], length=h["length"],
+                raw_bytes=raw, segments=stats["segments"],
+                length=h["length"],
             )
             self.recorder.record(
                 "fleet.handoff", rid=freq.rid, src=h["src"],
                 dst=rep.name, length=h["length"], bytes=stats["bytes"],
-                segments=stats["segments"],
+                raw_bytes=raw, segments=stats["segments"],
             )
 
     # --- zero-downtime rolling weight swap (round 12) -----------------------

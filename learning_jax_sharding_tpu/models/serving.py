@@ -108,6 +108,11 @@ from learning_jax_sharding_tpu.models.transformer import (
     Transformer,
     TransformerConfig,
 )
+from learning_jax_sharding_tpu.parallel.compression import (
+    CommCompression,
+    get_codec,
+    make_compressed_matmul_fn,
+)
 from learning_jax_sharding_tpu.parallel.logical import Rules, activate
 from learning_jax_sharding_tpu.robustness.chaos import InjectedFault, chaos_hook
 from learning_jax_sharding_tpu.telemetry import (
@@ -446,6 +451,20 @@ class ContinuousEngine:
       device programs), and the decode engine continues the stream
       bit-identically to a single engine of the same mesh shape.
       Unpaged, non-speculative engines only.
+
+    * ``comm_compression=CommCompression(...)`` turns on the COMM
+      COMPRESSION layer: the fused-step families compile the serving
+      block's one TP all-reduce (the FF down projection) as a
+      block-scaled int8 gather (~``1/itemsize`` of the wire bytes), and
+      every counted host transfer — page spill/fill, disaggregated KV
+      handoff via the fleet, cross-device-set swap staging — ships
+      int8 (or delta-vs-base) blocks through the
+      ``parallel.resharding`` codec seam, with wire AND raw bytes
+      booked. A drift governor probes the compressed apply against a
+      plain oracle every ``drift_check_every`` dispatches; breaching
+      ``drift_budget`` trips a dedicated degradation ladder that
+      disables compression and retraces every program back to the
+      bit-identical plain contraction.
     """
 
     def __init__(
@@ -485,6 +504,7 @@ class ContinuousEngine:
         degradation: Any | None = None,
         max_dispatch_strikes: int = 2,
         adapter_pool: Any | None = None,
+        comm_compression: Any | None = None,
     ):
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -571,6 +591,24 @@ class ContinuousEngine:
                     "ladder's split-program fallbacks would serve adapter "
                     "rows with the base weights"
                 )
+        # Comm compression (this PR): quantized serving collectives +
+        # compressed KV movement. ``True`` means the defaults; anything
+        # else must be a ``CommCompression`` so the knobs are validated
+        # in one place (its ``__post_init__``).
+        comp = CommCompression() if comm_compression is True else comm_compression
+        if comp is not None:
+            if not isinstance(comp, CommCompression):
+                raise ValueError(
+                    "comm_compression must be True or a "
+                    f"parallel.compression.CommCompression, got {comp!r}"
+                )
+            if comp.collectives and not mixed:
+                raise ValueError(
+                    "comm_compression with collectives=True requires "
+                    "mixed=True: the quantized TP matmul is compiled into "
+                    "the fused step families, and the drift governor "
+                    "probes at fused-dispatch granularity"
+                )
 
         def check_paged(name, c):
             # ONE copy of the paged preconditions, applied to the target and
@@ -606,6 +644,20 @@ class ContinuousEngine:
         cfg, fused = apply_dequantize_policy(cfg, dequantize, mesh, rules)
         if paged:
             cfg = pagedify(cfg)
+        if comp is not None and comp.collectives:
+            # Compile the quantized TP all-reduce into every apply-family
+            # program: the FF down projection — the serving block's one
+            # all-reduce site — routes through the block-scaled int8
+            # gather (``parallel.compression.make_compressed_matmul_fn``).
+            # The injected fn reads ``comp.enabled`` at TRACE time, so a
+            # drift-budget trip + cache clear retraces every program back
+            # to the plain (bit-identical) contraction.
+            cfg = dataclasses.replace(
+                cfg,
+                comm_compress_fn=make_compressed_matmul_fn(
+                    mesh, rules, comp
+                ),
+            )
         model = Transformer(cfg)
         apply = make_cached_apply(
             model, dequantize=bool(dequantize) and not fused,
@@ -637,6 +689,34 @@ class ContinuousEngine:
         else:
             d_apply = None
             d_cast = maybe_cast
+
+        comp_probe = None
+        if comp is not None and comp.collectives:
+            # Drift oracle: the SAME weights and cache served through a
+            # plain-collective apply (``comm_compress_fn=None`` — same
+            # param tree, since _CompressedDense declares the identical
+            # down/kernel). The probe runs one greedy decode step under
+            # both applies and counts active rows whose argmax diverged;
+            # the caches it produces are discarded, so probing never
+            # perturbs the served stream.
+            oracle_apply = make_cached_apply(
+                Transformer(
+                    dataclasses.replace(cfg, comm_compress_fn=None)
+                ),
+                dequantize=bool(dequantize) and not fused,
+                dequant_dtype=cfg.param_dtype,
+            )
+
+            @jax.jit
+            def comp_probe(params, cache, tok, active):
+                lc, _ = apply(params, cache, tok[:, None], active)
+                lo, _ = oracle_apply(params, cache, tok[:, None], active)
+                agree = (
+                    jnp.argmax(lc[:, -1], axis=-1)
+                    == jnp.argmax(lo[:, -1], axis=-1)
+                )
+                live = active == 1
+                return jnp.sum(live), jnp.sum(live & ~agree)
 
         def _greedy(logits):
             return greedy_pick(logits, vocab_limit)
@@ -1481,6 +1561,27 @@ class ContinuousEngine:
         self._kv_ingest_fn = kv_ingest
         self._kv_page_spill_fn = kv_page_spill
         self._kv_page_fill_fn = kv_page_fill
+        # Comm compression: the validated config, the drift probe, and
+        # the host-side KV codec every counted transfer threads through.
+        # The drift ladder is a dedicated one-level DegradationLadder —
+        # same hysteresis machinery as the SLO ladder (round 10), driven
+        # by drift-rate burn instead of SLO burn; level 1 means the
+        # budget is breached and compression turns itself off.
+        self._comp = comp
+        self._comp_probe_fn = comp_probe
+        if comp is not None and comp.collectives:
+            from learning_jax_sharding_tpu.robustness.policies import (
+                DegradationLadder,
+            )
+
+            self._comp_ladder = DegradationLadder(patience=1, max_level=1)
+        else:
+            self._comp_ladder = None
+        self._comp_n = 0
+        self._kv_codec = (
+            get_codec(comp.kv_codec, block=comp.block)
+            if comp is not None else None
+        )
 
         # --- persistent state ---------------------------------------------
         self.rng = jax.random.key(0)
@@ -1682,6 +1783,22 @@ class ContinuousEngine:
         self._c_pg_bytes_in = r.counter(
             "engine_kv_page_fill_bytes_total",
             "bytes moved host → HBM promoting prefix pages")
+        self._c_kv_raw_bytes = r.counter(
+            "engine_kv_raw_bytes_total",
+            "pre-codec bytes of counted KV/page/swap host transfers — "
+            "the *_bytes_total counters book WIRE bytes, so the gap to "
+            "this counter is what the codec saved")
+        self._c_comp_probes = r.counter(
+            "engine_comp_drift_probes_total",
+            "compressed-vs-plain-oracle drift probes run")
+        self._c_comp_disagree = r.counter(
+            "engine_comp_drift_disagreements_total",
+            "active rows whose greedy pick diverged from the plain "
+            "oracle during a drift probe")
+        self._c_comp_trips = r.counter(
+            "engine_comp_drift_trips_total",
+            "drift-budget breaches that auto-disabled the quantized "
+            "serving collectives (one-way until an operator re-enables)")
         self._c_pfx_expected = r.counter(
             "engine_prefix_expected_total",
             "admissions the router placed expecting a prefix hit")
@@ -1723,6 +1840,17 @@ class ContinuousEngine:
         self._g_retained = r.gauge(
             "engine_prefix_pages_retained",
             "reference-free retained prefix pages")
+        self._g_comp_on = r.gauge(
+            "engine_comm_compression_active",
+            "1 while quantized serving collectives are compiled in")
+        self._g_comp_ratio = r.gauge(
+            "engine_kv_compression_ratio",
+            "raw/wire byte ratio of the most recent counted KV transfer "
+            "batch (1.0 when no codec is attached)")
+        self._g_comp_on.set(
+            1 if (self._comp is not None and self._comp.active) else 0
+        )
+        self._g_comp_ratio.set(1.0)
         self._h_ttft = r.histogram(
             "engine_ttft_seconds", "arrival to first visible token")
         self._h_tpot = r.histogram(
@@ -2289,10 +2417,17 @@ class ContinuousEngine:
                 # dispatch places it like any initial params.
                 return tree, 0
             dst = jax.tree.map(lambda x: x.sharding, ref_tree)
+            # The engine's KV codec rides the swap too: the intra-mesh
+            # device fast path stays exact (the swap_reshard golden's
+            # program), but a cross-device-set HOST leg ships weights as
+            # block-scaled int8 — the quantized grad-sync premise
+            # (zero.py) applied to staging traffic, and the staged tree
+            # is what every later dispatch AND recompute serves, so
+            # version attribution stays exact.
             with activate(self._mesh, self._rules):
                 out, stats = reshard_tree(
                     tree, dst, plan_cache=self._swap_plan_cache,
-                    jit_cache=self._swap_jit_cache,
+                    jit_cache=self._swap_jit_cache, codec=self._kv_codec,
                 )
             return out, int(stats["bytes"])
 
@@ -2876,7 +3011,7 @@ class ContinuousEngine:
                 )
         return rows
 
-    def spill_page(self, key: bytes, *, drop: bool = True):
+    def spill_page(self, key: bytes, *, drop: bool = True, base_rows=None):
         """DEMOTE one retained prefix page out of HBM: gather its K/V
         rows (``kv_page_spill``, one fixed-shape executable) and move
         them to host numpy through the counted
@@ -2887,7 +3022,17 @@ class ContinuousEngine:
         read — the peer-tier path, where another replica copies this
         replica's warm page without disturbing it. Returns
         ``(rows, stats)``: flatten-ordered host page rows (the
-        ``fill_page`` input) and ``{"bytes", "segments"}``."""
+        ``fill_page`` input) and ``{"bytes", "raw_bytes", "segments"}``
+        — ``bytes`` is WIRE bytes: with a ``comm_compression`` KV codec
+        attached the rows ship as block-scaled int8 through the plan's
+        codec seam and land decoded (on the int8 grid) host-side, so a
+        later re-spill of the same rows is bit-identical (quantization
+        is a fixed point on its own image). ``base_rows`` (same
+        flatten order, or ``None``) is the delta codec's
+        version-stamped base: with ``kv_codec="int8_delta"`` only
+        blocks that changed since the base version ship, so a tier
+        re-demotion after a version bump pays for the novel suffix,
+        not the whole page."""
         self._check_tier_supported("spill_page")
         pid = self._prefix_registry.get(key)
         if pid is None:
@@ -2912,20 +3057,29 @@ class ContinuousEngine:
             # Live-cache closure (see export_kv): relowering reads the
             # engine's CURRENT cache, never a pinned stale copy.
             self._last_kv_page_spill_args = lambda: (self._cache, pid_j)
+            codec = self._kv_codec
+            ckey = (
+                (codec.name, getattr(codec, "block", 0))
+                if codec is not None else None
+            )
             host = HostBuffer()
-            rows, nbytes, nsegs = [], 0, 0
-            for x in dev_rows:
-                pkey = (tuple(x.shape), str(x.dtype), x.sharding, "spill")
+            rows, nbytes, raw_bytes, nsegs = [], 0, 0, 0
+            for i, x in enumerate(dev_rows):
+                base = base_rows[i] if base_rows is not None else None
+                pkey = (
+                    tuple(x.shape), str(x.dtype), x.sharding, "spill", ckey,
+                )
                 plan = self._page_plan_cache.get(pkey)
                 if plan is None:
                     plan = plan_transfer(
                         x.shape, x.dtype.itemsize, x.sharding, host,
-                        seq_dim=None, page_tokens=None,
+                        seq_dim=None, page_tokens=None, codec=codec,
                     )
                     self._page_plan_cache[pkey] = plan
-                buf, stats = execute_transfer(plan, x)
+                buf, stats = execute_transfer(plan, x, base=base)
                 rows.append(buf)
                 nbytes += stats["bytes"]
+                raw_bytes += stats.get("raw_bytes", stats["bytes"])
                 nsegs += stats["segments"]
             if drop:
                 del self._cached_lru[pid]
@@ -2936,11 +3090,16 @@ class ContinuousEngine:
                 self._update_high_water()
             self._c_pg_spills.inc()
             self._c_pg_bytes_out.inc(nbytes)
+            self._c_kv_raw_bytes.inc(raw_bytes)
+            if nbytes:
+                self._g_comp_ratio.set(raw_bytes / nbytes)
             self.recorder.record(
                 "engine.kv_page_spill", pid=pid, bytes=nbytes,
-                segments=nsegs, dropped=drop,
+                raw_bytes=raw_bytes, segments=nsegs, dropped=drop,
             )
-        return rows, {"bytes": nbytes, "segments": nsegs}
+        return rows, {
+            "bytes": nbytes, "raw_bytes": raw_bytes, "segments": nsegs,
+        }
 
     def fill_page(self, key: bytes, rows) -> dict:
         """PROMOTE a spilled page back into HBM: take a physical page
@@ -2949,9 +3108,13 @@ class ContinuousEngine:
         plan, write them in with ``kv_page_fill``, and register ``key``
         as a reference-free retained page (LRU-newest). The next
         admission whose prompt chain reaches ``key`` maps it like any
-        HBM-resident prefix page. Returns ``{"bytes", "segments",
-        "pid"}``; raises if ``key`` is already resident (promotion is
-        not idempotent — check the digest first)."""
+        HBM-resident prefix page. Returns ``{"bytes", "raw_bytes",
+        "segments", "pid"}`` (``bytes`` is wire bytes — the same codec
+        seam as :meth:`spill_page`, and re-encoding already-quantized
+        spill output is exact, so a spill → fill → spill round trip is
+        bit-stable at page boundaries); raises if ``key`` is already
+        resident (promotion is not idempotent — check the digest
+        first)."""
         self._check_tier_supported("fill_page")
         if key in self._prefix_registry:
             raise ValueError("fill_page: key is already resident")
@@ -2969,21 +3132,27 @@ class ContinuousEngine:
         with self.ledger.measure("kv_handoff"):
             with self.ledger.measure("page_alloc"):
                 pid = self._take_page()
+            codec = self._kv_codec
+            ckey = (
+                (codec.name, getattr(codec, "block", 0))
+                if codec is not None else None
+            )
             host = HostBuffer()
-            dev_rows, nbytes, nsegs = [], 0, 0
+            dev_rows, nbytes, raw_bytes, nsegs = [], 0, 0, 0
             for x, dst in zip(rows, self._page_row_shardings()):
                 buf = np.asarray(x)
-                pkey = (tuple(buf.shape), str(buf.dtype), dst, "fill")
+                pkey = (tuple(buf.shape), str(buf.dtype), dst, "fill", ckey)
                 plan = self._page_plan_cache.get(pkey)
                 if plan is None:
                     plan = plan_transfer(
                         buf.shape, buf.dtype.itemsize, host, dst,
-                        seq_dim=None, page_tokens=None,
+                        seq_dim=None, page_tokens=None, codec=codec,
                     )
                     self._page_plan_cache[pkey] = plan
                 out, stats = execute_transfer(plan, buf)
                 dev_rows.append(out)
                 nbytes += stats["bytes"]
+                raw_bytes += stats.get("raw_bytes", stats["bytes"])
                 nsegs += stats["segments"]
             pid_j = jnp.int32(pid)
             with activate(self._mesh, self._rules):
@@ -3003,10 +3172,17 @@ class ContinuousEngine:
             self._update_high_water()
             self._c_pg_fills.inc()
             self._c_pg_bytes_in.inc(nbytes)
+            self._c_kv_raw_bytes.inc(raw_bytes)
+            if nbytes:
+                self._g_comp_ratio.set(raw_bytes / nbytes)
             self.recorder.record(
-                "engine.kv_page_fill", pid=pid, bytes=nbytes, segments=nsegs,
+                "engine.kv_page_fill", pid=pid, bytes=nbytes,
+                raw_bytes=raw_bytes, segments=nsegs,
             )
-        return {"bytes": nbytes, "segments": nsegs, "pid": pid}
+        return {
+            "bytes": nbytes, "raw_bytes": raw_bytes, "segments": nsegs,
+            "pid": pid,
+        }
 
     def _retire(self, slot, now, retired):
         r = self._slot_req[slot]
@@ -4668,6 +4844,84 @@ class ContinuousEngine:
             )
 
     @property
+    def comm_compression_active(self) -> bool:
+        """True while the quantized serving collectives are compiled in
+        (False when never enabled, or after a drift-budget trip)."""
+        return self._comp is not None and self._comp.active
+
+    def _comp_maintain(self, params):
+        """Drift governor for the compressed serving collectives: every
+        ``drift_check_every``-th dispatched step with active decode rows,
+        run one greedy decode step under BOTH applies (compressed and
+        plain oracle) on the live cache and count diverging rows. The
+        drift rate over the budget feeds a dedicated one-level
+        :class:`~learning_jax_sharding_tpu.robustness.policies.
+        DegradationLadder`; a trip disables compression and clears every
+        apply-family executable cache, so the NEXT dispatch retraces to
+        the plain — bit-identical — contraction. Probe caches are
+        discarded; the served stream never observes the probe."""
+        comp = self._comp
+        if (
+            comp is None or not comp.active
+            or self._comp_probe_fn is None or self._comp_ladder is None
+            or self._cache is None or not self._active.any()
+        ):
+            return
+        self._comp_n += 1
+        if self._comp_n % comp.drift_check_every:
+            return
+        # Observability tax, like _retire's booking: the probe is an
+        # extra (cached) program dispatch, not serving work.
+        with self.ledger.measure("telemetry"):
+            cache = self._cache[0] if self._speculative else self._cache
+            tok = jnp.asarray(self._tok, jnp.int32)
+            act = jnp.asarray(self._active.astype(np.int32))
+            with activate(self._mesh, self._rules):
+                n_live, n_diff = self._comp_probe_fn(
+                    params, cache, tok, act
+                )
+            n_live, n_diff = int(n_live), int(n_diff)
+            self._c_comp_probes.inc()
+            self._c_comp_disagree.inc(n_diff)
+            frac = (n_diff / n_live) if n_live else 0.0
+            # drift_budget <= 0 is the deterministic test hook: every
+            # probe reads as breached, so the first probe trips.
+            burn = (
+                frac / comp.drift_budget if comp.drift_budget > 0
+                else float("inf")
+            )
+            self.recorder.record(
+                "engine.comp_drift_probe", active=n_live,
+                disagreements=n_diff, drift=frac,
+            )
+            if self._comp_ladder.update(burn) >= 1:
+                self._trip_compression(frac)
+
+    def _trip_compression(self, frac: float):
+        comp = self._comp
+        if comp is None or not comp.enabled:
+            return
+        comp.enabled = False
+        cleared = 0
+        for attr, _ in self._FN_FAMILY_ATTRS:
+            if attr.startswith("_kv_"):
+                continue  # handoff/page programs never embed the apply
+            fn = getattr(self, attr, None)
+            if fn is not None and hasattr(fn, "clear_cache"):
+                fn.clear_cache()
+                cleared += 1
+        if self._comp_probe_fn is not None and hasattr(
+            self._comp_probe_fn, "clear_cache"
+        ):
+            self._comp_probe_fn.clear_cache()
+        self._c_comp_trips.inc()
+        self._g_comp_on.set(0)
+        self.recorder.record(
+            "engine.comp_drift_trip", drift=frac,
+            budget=comp.drift_budget, programs_cleared=cleared,
+        )
+
+    @property
     def degradation_level(self) -> int:
         """Current graceful-degradation level (0 when no ladder is
         attached): 0 normal, 1 speculation off, 2 reduced
@@ -4853,6 +5107,7 @@ class ContinuousEngine:
                     # _on_dispatch_fault. Infrastructure errors propagate.
                     self._on_dispatch_fault(e)
                 self._apply_degradation()
+                self._comp_maintain(params)
             self._g_active.set(int(self._active.sum()))
             self._g_queue.set(len(self._queue))
         return retired
@@ -5173,12 +5428,26 @@ class ContinuousEngine:
 
     def contract_name(self, program: str) -> str:
         base = self.CONTRACT_NAMES.get(program, program)
+        comp = self._comp
         if program in (
             "kv_export", "kv_ingest", "kv_page_spill", "kv_page_fill"
         ):
             # The handoff programs are only dispatchable on non-spec
             # engines (export/ingest raise otherwise) — one golden each.
+            # A KV codec does not change the DEVICE program (the codec
+            # runs in the host transfer plan), but a compression engine
+            # contracts under ``*_q8`` names anyway: the golden set must
+            # say, checkably, which byte-movement regime it was pinned
+            # under.
+            if comp is not None and comp.kv_codec is not None:
+                return f"{base}_q8"
             return base
+        if comp is not None and comp.active:
+            # Apply-family programs compile the quantized TP matmul in:
+            # a DIFFERENT steady-state program with its own golden. A
+            # drift trip flips ``comp.enabled`` off and the retraced
+            # programs contract under the plain names again.
+            base = f"{base}_q8"
         if program == "decode_block":
             # The plain decode program keeps its plain golden even on a
             # speculative engine: the degradation ladder dispatches it

@@ -67,6 +67,54 @@ def resolve_remat_policy(name: Optional[str]):
     return policies[name]
 
 
+class _CompressedDense(nn.Module):
+    """Param-compatible stand-in for a projection ``nn.Dense`` whose TP
+    reduction ships int8 blocks instead of floats.
+
+    Declares the identical ``kernel`` (and ``bias``) parameters — same
+    name, shape, dtype, init, and logical axes — so a checkpoint or a
+    born-sharded init transfers verbatim across the ``comm_compress_fn``
+    flag, exactly like :class:`~..models.quantize.Int4Dense` mirrors its
+    plain twin. The compute is delegated to ``compress_fn`` (built by
+    ``parallel.compression.make_compressed_matmul_fn``), which reads the
+    live :class:`~..parallel.compression.CommCompression` policy at TRACE
+    time: compression on → shard_map with quantized all-gathers;
+    off (never configured, axis not wire-bound, or drift-tripped) → the
+    very ``dot_general`` ``nn.Dense`` lowers to, bit-identical.
+    """
+
+    features: int
+    kernel_axes: tuple
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    compress_fn: Any = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param(
+            "kernel",
+            nn.with_logical_partitioning(self.kernel_init, self.kernel_axes),
+            (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        x = x.astype(self.dtype)
+        kernel = kernel.astype(self.dtype)
+        y = self.compress_fn(x, kernel, kernel_axes=tuple(self.kernel_axes))
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), (self.kernel_axes[-1],)
+                ),
+                (self.features,),
+                self.param_dtype,
+            )
+            y = y + bias.astype(y.dtype)
+        return y
+
+
 class FeedForward(nn.Module):
     """Position-wise FF: up-project → GELU → down-project.
 
@@ -86,6 +134,10 @@ class FeedForward(nn.Module):
     quantization: Optional[str] = None       # "int4" → fused-kernel serving
     quantization_group: int = 128
     quantized_matmul_fn: Optional[Callable] = None
+    comm_compress_fn: Optional[Callable] = None  # int8-wire TP reduction for
+                                  # the down projection (the block's one
+                                  # all-reduce site); built by
+                                  # parallel.compression.make_compressed_matmul_fn
 
     def _dense(self, features: int, kernel_axes, name: str):
         from learning_jax_sharding_tpu.models.quantize import projection_dense
@@ -132,7 +184,23 @@ class FeedForward(nn.Module):
         h = self._dense(self.hidden, (EMBED, MLP), "up")(x)
         h = nn.with_logical_constraint(h, (BATCH, SEQ, HIDDEN))
         h = nn.gelu(h)
-        out = self._dense(self.features, (MLP, EMBED), "down")(h)
+        if self.comm_compress_fn is not None and self.quantization is None:
+            # The down projection is the block's one all-reduce site (the
+            # up projection is column-parallel, collective-free): swap in
+            # the param-identical compressed dense so the reduction ships
+            # int8 blocks when the engine's CommCompression policy is live.
+            out = _CompressedDense(
+                features=self.features,
+                kernel_axes=(MLP, EMBED),
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=self.kernel_init,
+                compress_fn=self.comm_compress_fn,
+                name="down",
+            )(h)
+        else:
+            out = self._dense(self.features, (MLP, EMBED), "down")(h)
         return nn.with_logical_constraint(out, (BATCH, SEQ, EMBED))
 
     def _use_fused_ff(self, k: int) -> bool:
@@ -263,6 +331,7 @@ class TransformerBlock(nn.Module):
     quantization: Optional[str] = None   # "int4" → fused-kernel projections
     quantization_group: int = 128
     quantized_matmul_fn: Optional[Callable] = None
+    comm_compress_fn: Optional[Callable] = None  # int8-wire FF down reduction
     norm: str = "layernorm"       # "layernorm" | "rmsnorm"
     fused_norm: bool = False      # block boundaries through the Pallas
                                   # fused residual+norm kernel (param-tree
@@ -345,6 +414,7 @@ class TransformerBlock(nn.Module):
                 quantization=self.quantization,
                 quantization_group=self.quantization_group,
                 quantized_matmul_fn=self.quantized_matmul_fn,
+                comm_compress_fn=self.comm_compress_fn,
                 name="ff",
             )(h)
         x = nn.with_logical_constraint(x, (BATCH, SEQ, EMBED))
@@ -442,6 +512,12 @@ class TransformerConfig:
     quantized_matmul_fn: Optional[Callable] = None  # mesh-aware fused-int4
                                      # matmul (make_int4_matmul_fn); injected
                                      # by make_generate_fn on >1-device meshes
+    comm_compress_fn: Optional[Callable] = None  # int8-wire TP reduction for
+                                     # the FF down projection
+                                     # (parallel/compression.py's
+                                     # make_compressed_matmul_fn); injected by
+                                     # ContinuousEngine(comm_compression=...);
+                                     # param-tree identical to the plain path
 
     def __post_init__(self):
         # Fail fast on typos; 'nothing' IS the default, so only a policy that
@@ -664,6 +740,7 @@ class Transformer(nn.Module):
             quantization=cfg.quantization,
             quantization_group=cfg.quantization_group,
             quantized_matmul_fn=cfg.quantized_matmul_fn,
+            comm_compress_fn=cfg.comm_compress_fn,
             norm=cfg.norm,
             fused_norm=cfg.fused_norm,
         )

@@ -137,10 +137,12 @@ def quantized_all_reduce(
             f"contribs leading dim {contribs.shape[0]} != mesh axis size {n}"
         )
 
-    def quant(v):
-        absmax = jnp.max(jnp.abs(v))
-        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-        return jnp.clip(jnp.round(v / scale), -127, 127).astype(jnp.int8), scale
+    # THE stack-wide quantizer (parallel/compression.py) — one
+    # implementation shared with the compressed serving matmul and the KV
+    # codecs, so error models and fixed-point behavior cannot drift apart.
+    # Identical math to the inline original; the zero1_update_q8 golden and
+    # the <=0.02% dev-accuracy gate pin that the hoist changed nothing.
+    from learning_jax_sharding_tpu.parallel.compression import quantize_absmax as quant
 
     def send(payload, scale):
         # Ring hop to the RIGHT neighbor: source j → dest j+1 (the chunk

@@ -121,6 +121,7 @@ class TransferPlan:
     seq_dim: int | None
     page_tokens: int | None
     segments: tuple[Segment, ...]
+    codec: Any = None            # parallel.compression.Codec, or None = raw
 
     @property
     def bytes_total(self) -> int:
@@ -135,6 +136,7 @@ class TransferPlan:
             "bytes_total": self.bytes_total,
             "seq_dim": self.seq_dim,
             "page_tokens": self.page_tokens,
+            "codec": getattr(self.codec, "name", None),
         }
 
     def domain_split(self, topology: Any) -> dict:
@@ -198,6 +200,7 @@ def plan_transfer(
     *,
     seq_dim: int | None = None,
     page_tokens: int | None = DEFAULT_PAGE_TOKENS,
+    codec: Any = None,
 ) -> TransferPlan:
     """Decompose ``src_sharding → dst_sharding`` into block copies.
 
@@ -207,7 +210,17 @@ def plan_transfer(
     element is written exactly once). With ``seq_dim`` set, segments
     split into ``page_tokens``-sized pages along it — the streaming
     unit ``stop`` clipping operates on.
+
+    ``codec`` (a ``parallel.compression`` codec name or instance)
+    compresses every segment's payload at execution: the plan is the ONE
+    gate compressed bytes pass through, so they stay counted — execution
+    stats then report ``bytes`` as *wire* bytes with the pre-codec volume
+    in ``raw_bytes``.
     """
+    if isinstance(codec, str):
+        from learning_jax_sharding_tpu.parallel.compression import get_codec
+
+        codec = get_codec(codec)
     shape = tuple(int(s) for s in shape)
     src_map = src_sharding.devices_indices_map(shape)
     dst_map = dst_sharding.devices_indices_map(shape)
@@ -259,13 +272,13 @@ def plan_transfer(
         shape=shape, itemsize=int(itemsize),
         src_sharding=src_sharding, dst_sharding=dst_sharding,
         seq_dim=seq_dim, page_tokens=page_tokens,
-        segments=tuple(segments),
+        segments=tuple(segments), codec=codec,
     )
 
 
 def execute_transfer(
     plan: TransferPlan, x: jax.Array, *, stop: int | None = None,
-    topology: Any | None = None,
+    topology: Any | None = None, base: Any | None = None,
 ) -> tuple[jax.Array, dict]:
     """Run ``plan`` on ``x``: assemble every destination shard from its
     source-shard slices and commit the result under the destination
@@ -274,12 +287,18 @@ def execute_transfer(
     regions stay zero in the destination buffer, which the engine's
     causal-at-index masks never read.
 
-    Returns ``(array, stats)`` with ``stats = {"bytes", "segments",
-    "segments_skipped"}`` — the actual wire volume of THIS transfer.
-    With ``topology`` set (two-tier domain carving), stats also carry
-    ``"dcn_bytes"``: the subset of the actual (clipped) bytes whose
-    segment crossed an ICI-domain boundary — what the fleet meters as
-    cross-host traffic.
+    Returns ``(array, stats)`` with ``stats = {"bytes", "raw_bytes",
+    "segments", "segments_skipped"}`` — the actual wire volume of THIS
+    transfer. With a plan ``codec``, every segment's payload is encoded
+    then decoded through it (the data that lands really took the lossy
+    trip) and ``bytes`` counts the encoded wire volume while
+    ``raw_bytes`` keeps the pre-codec volume; without one the two are
+    equal. ``base`` (a full-shape array, e.g. the receiver's stale
+    version-stamped copy) feeds delta codecs — each segment's slice of
+    it is handed to encode AND decode. With ``topology`` set (two-tier
+    domain carving), stats also carry ``"dcn_bytes"``: the subset of the
+    actual (clipped, wire) bytes whose segment crossed an ICI-domain
+    boundary — what the fleet meters as cross-host traffic.
     """
     shape, dtype = plan.shape, x.dtype
     if tuple(x.shape) != shape:
@@ -310,7 +329,12 @@ def execute_transfer(
             dst_bufs[dbox] = np.zeros(
                 tuple(hi - lo for lo, hi in dbox), dtype
             )
-    copied = skipped = nbytes = dcn_bytes = 0
+    base_np = None if base is None else np.asarray(base)
+    if base_np is not None and tuple(base_np.shape) != shape:
+        raise ValueError(
+            f"codec base shape {base_np.shape} != plan shape {shape}"
+        )
+    copied = skipped = nbytes = raw_bytes = dcn_bytes = 0
     for seg in plan.segments:
         box = seg.box
         if stop is not None and plan.seq_dim is not None:
@@ -332,15 +356,31 @@ def execute_transfer(
             slice(lo - dlo, hi - dlo)
             for (lo, hi), (dlo, _) in zip(box, seg.dst_box)
         )
-        dst_bufs[seg.dst_box][dst_sl] = src[src_sl]
+        seg_raw = math.prod(hi - lo for lo, hi in box) * plan.itemsize
+        if plan.codec is not None:
+            # The segment's data really takes the lossy trip: encode →
+            # count the wire payload → decode is what lands. Delta codecs
+            # see the receiver's slice of ``base`` on both ends.
+            seg_base = None if base_np is None else base_np[
+                tuple(slice(lo, hi) for lo, hi in box)
+            ]
+            payload = plan.codec.encode(src[src_sl], base=seg_base)
+            seg_bytes = payload["wire_bytes"]
+            dst_bufs[seg.dst_box][dst_sl] = plan.codec.decode(
+                payload, base=seg_base
+            )
+        else:
+            seg_bytes = seg_raw
+            dst_bufs[seg.dst_box][dst_sl] = src[src_sl]
         copied += 1
-        seg_bytes = math.prod(hi - lo for lo, hi in box) * plan.itemsize
         nbytes += seg_bytes
+        raw_bytes += seg_raw
         if topology is not None and _crosses_domain(seg, topology):
             dcn_bytes += seg_bytes
 
     stats = {
-        "bytes": nbytes, "segments": copied, "segments_skipped": skipped,
+        "bytes": nbytes, "raw_bytes": raw_bytes,
+        "segments": copied, "segments_skipped": skipped,
     }
     if topology is not None:
         stats["dcn_bytes"] = dcn_bytes
@@ -365,6 +405,7 @@ def transfer_tree(
     page_tokens: int | None = DEFAULT_PAGE_TOKENS,
     plan_cache: dict | None = None,
     topology: Any | None = None,
+    codec: Any = None,
 ) -> tuple[Any, dict]:
     """Redistribute a whole exported cache-row tree (``export_kv``) into
     ``dst_shardings`` (``kv_row_shardings`` of the destination engine).
@@ -377,31 +418,41 @@ def transfer_tree(
     plans, and ``-1`` leaves move whole. Without ``seq_dims`` every
     rank ≥ 2 leaf is ASSUMED sequence-major on dim 0 — only safe for
     dense-backend rows or plain arrays. ``plan_cache`` (any dict)
-    memoizes plans across handoffs of the same layout. Returns
+    memoizes plans across handoffs of the same layout. ``codec``
+    compresses every leaf's segments (see :func:`plan_transfer`) — the
+    summed ``bytes`` are then wire bytes, ``raw_bytes`` the pre-codec
+    volume. Returns
     ``(tree, stats)`` with the summed bytes/segments telemetry; with
     ``topology`` set the totals also carry ``"dcn_bytes"`` — the
     cross-ICI-domain share of the moved bytes.
     """
-    totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
+    if isinstance(codec, str):
+        from learning_jax_sharding_tpu.parallel.compression import get_codec
+
+        codec = get_codec(codec)
+    totals = {"bytes": 0, "raw_bytes": 0, "segments": 0, "segments_skipped": 0}
     if topology is not None:
         totals["dcn_bytes"] = 0
     if seq_dims is None:
         seq_dims = jax.tree.map(
             lambda x: 0 if getattr(x, "ndim", 0) >= 2 else -1, rows,
         )
+    codec_key = None if codec is None else (
+        codec.name, getattr(codec, "block", 0)
+    )
 
     def one(x, dst, seq_dim):
         x = x if isinstance(x, jax.Array) else jnp.asarray(x)
         seq_dim = None if seq_dim is None or seq_dim < 0 else int(seq_dim)
         key = (
             tuple(x.shape), str(x.dtype), x.sharding, dst, seq_dim,
-            page_tokens,
+            page_tokens, codec_key,
         )
         plan = plan_cache.get(key) if plan_cache is not None else None
         if plan is None:
             plan = plan_transfer(
                 x.shape, x.dtype.itemsize, x.sharding, dst,
-                seq_dim=seq_dim, page_tokens=page_tokens,
+                seq_dim=seq_dim, page_tokens=page_tokens, codec=codec,
             )
             if plan_cache is not None:
                 plan_cache[key] = plan
@@ -456,8 +507,10 @@ def device_reshard(tree: Any, dst_shardings: Any, *, jit_cache: dict | None = No
         if jit_cache is not None:
             jit_cache[key] = fn
     out = fn(tree)
+    nbytes = sum(x.nbytes for x in leaves)
     stats = {
-        "bytes": sum(x.nbytes for x in leaves),
+        "bytes": nbytes,
+        "raw_bytes": nbytes,
         "segments": len(leaves),
         "segments_skipped": 0,
         "mode": "device",
@@ -472,6 +525,7 @@ def reshard_tree(
     plan_cache: dict | None = None,
     jit_cache: dict | None = None,
     mode: str = "auto",
+    codec: Any = None,
 ) -> tuple[Any, dict]:
     """Redistribute an arbitrary parameter tree into ``dst_shardings`` —
     the weight-hot-swap shape of the problem: training layout or
@@ -492,12 +546,21 @@ def reshard_tree(
 
     Host-path non-``jax.Array`` leaves (numpy from a checkpoint restore)
     are committed straight under the destination sharding shard-by-shard
-    — still no full-array device materialization. Returns
+    — still no full-array device materialization. ``codec`` compresses
+    the host plan path's segments (cross-mesh swap resharding ships int8
+    blocks; wire bytes in ``stats["bytes"]``, pre-codec in
+    ``raw_bytes``) — note float leaves then land on the codec's int8
+    grid, so bit-exactness holds only for the raw (``codec=None``)
+    default and for non-float leaves, which codecs pass through. Returns
     ``(tree, stats)`` with summed ``bytes``/``segments`` telemetry and
     ``stats["mode"]``.
     """
     if mode not in ("auto", "host", "device"):
         raise ValueError(f"reshard_tree: unknown mode {mode!r}")
+    if isinstance(codec, str):
+        from learning_jax_sharding_tpu.parallel.compression import get_codec
+
+        codec = get_codec(codec)
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     dst_leaves = treedef.flatten_up_to(dst_shardings)
     if mode == "device" or (
@@ -508,9 +571,15 @@ def reshard_tree(
             for x, d in zip(leaves, dst_leaves)
         )
     ):
+        # The device fast path is one compiled identity — its collectives
+        # are the swap_reshard golden's business, not the codec's; only
+        # the explicit host plan path compresses.
         return device_reshard(tree, dst_shardings, jit_cache=jit_cache)
 
-    totals = {"bytes": 0, "segments": 0, "segments_skipped": 0}
+    totals = {"bytes": 0, "raw_bytes": 0, "segments": 0, "segments_skipped": 0}
+    codec_key = None if codec is None else (
+        codec.name, getattr(codec, "block", 0)
+    )
 
     def one(x, dst):
         if not isinstance(x, jax.Array) or not hasattr(x, "sharding"):
@@ -522,14 +591,18 @@ def reshard_tree(
                 buf.shape, dst, lambda idx, b=buf: b[idx]
             )
             totals["bytes"] += buf.nbytes
+            totals["raw_bytes"] += buf.nbytes
             totals["segments"] += 1
             return out
-        key = (tuple(x.shape), str(x.dtype), x.sharding, dst, None, None)
+        key = (
+            tuple(x.shape), str(x.dtype), x.sharding, dst, None, None,
+            codec_key,
+        )
         plan = plan_cache.get(key) if plan_cache is not None else None
         if plan is None:
             plan = plan_transfer(
                 x.shape, x.dtype.itemsize, x.sharding, dst,
-                seq_dim=None, page_tokens=None,
+                seq_dim=None, page_tokens=None, codec=codec,
             )
             if plan_cache is not None:
                 plan_cache[key] = plan

@@ -129,8 +129,11 @@ def make_zero1_update(
     * ``quantized_comm=True`` — :func:`parallel.collectives.
       quantized_all_reduce`: the EQuARX-style (arXiv 2506.17615) int8
       ring reduce-scatter + all-gather whose wire payloads are int8
-      chunks with per-chunk fp32 scales — ~4x less ICI traffic per grad
-      sync, at a bounded requantization error per reduce hop (measured
+      chunks with per-chunk fp32 scales (the stack-wide quantizer from
+      ``parallel/compression.py`` — the same codec the serving engine's
+      compressed TP matmul and the KV-movement paths use) — ~4x less ICI
+      traffic per grad sync, at a bounded requantization error per
+      reduce hop (measured
       ~1.6% L2 at D=8; gradients tolerate it, the quantized-collective
       literature's premise — ``tests/test_zero1.py`` gates the loss
       trajectory against the fp32-sync baseline on the tiny config).

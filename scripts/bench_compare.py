@@ -217,6 +217,25 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      "overlap_predicted_vs_realized_pp", False),
     (re.compile(r"topo argmin gap ([\d,.]+)%"), "topo_argmin_gap_pct",
      True),
+    # Round-22 comm-compression gates (bench.py's `[bench] comm
+    # compression ...` lines): `compressed N tok/s` is the int8-wire
+    # mixed engine's throughput (higher — on the emulated host it pays
+    # the codec without the wire win, so the gate catches the codec
+    # path bloating); `q8 agreement` is the greedy token match vs the
+    # plain engine, which the drift oracle holds at 100% (phrased
+    # distinctly from the speculative pass's `agreement vs plain:`);
+    # `kv wire` is the post-codec kB the tier ladder actually moved per
+    # request (lower; distinct from round-15's pre-codec `kv moved`);
+    # `compression ratio` is raw/wire over the same window (higher —
+    # it collapsing toward 1 means pages stopped compressing, e.g. a
+    # dtype or codec regression upstream of the ledger).
+    (re.compile(r"compressed ([\d,.]+)\s*tok/s"), "compressed_tok_s",
+     True),
+    (re.compile(r"q8 agreement ([\d,.]+)%"), "q8_agreement_pct", True),
+    (re.compile(r"kv wire ([\d,.]+)\s*kB/req"),
+     "kv_wire_bytes_per_req_kb", False),
+    (re.compile(r"compression ratio ([\d,.]+)x"),
+     "comm_compression_ratio", True),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

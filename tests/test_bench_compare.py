@@ -541,6 +541,63 @@ class TestExtraction:
                       ":overlap_predicted_vs_realized_pp"]["regressed"]
         assert not by["topo_argmin:topo_argmin_gap_pct"]["regressed"]
 
+    def test_compression_gates_direction_aware(self):
+        """The round-22 comm-compression gates: compressed tok/s and
+        q8 agreement regress DOWN (the drift oracle holds agreement at
+        100%, so any slip is a numerics change); the KV wire kB/req
+        regresses UP and the raw/wire compression ratio DOWN. `q8
+        agreement` must not ride the speculative pass's `agreement vs
+        plain:` pattern, `kv wire` must not ride round-15's pre-codec
+        `kv moved`, and the raw-kB context number on the same line
+        stays ungated (raw is the denominator, not the claim)."""
+        lines = [
+            "[bench] comm compression mixed 2x4: plain 738 tok/s, "
+            "compressed 634 tok/s (q8 agreement 100%)",
+            "[bench] comm compression kv K=2 (int8_delta): kv wire "
+            "0.8 kB/req vs 2.7 kB/req raw, compression ratio 3.56x "
+            "(8 demotions, 0 promotions)",
+        ]
+        m = bench_compare.extract_metrics(_doc(lines))
+        tp = "comm_compression_mixed_2x4"
+        kv = "comm_compression_kv_K=2_(int8_delta)"
+        assert m[f"{tp}:compressed_tok_s"] == (634.0, True)
+        assert m[f"{tp}:q8_agreement_pct"] == (100.0, True)
+        assert m[f"{kv}:kv_wire_bytes_per_req_kb"] == (0.8, False)
+        assert m[f"{kv}:comm_compression_ratio"] == (3.56, True)
+        # the plain tok/s rides the generic gate; no cross-matching
+        # into the speculative or round-15 byte patterns; the raw
+        # context number is extracted by nothing
+        assert m[f"{tp}:tok_s"] == (738.0, True)
+        assert not any(
+            k.endswith(":agreement_pct")
+            or k.endswith(":kv_bytes_moved_per_req_kb")
+            for k in m
+        )
+        assert not any(v[0] == 2.7 for v in m.values())
+        worse = _doc([
+            lines[0].replace("compressed 634 tok/s", "compressed 500 tok/s")
+            .replace("q8 agreement 100%", "q8 agreement 80%"),
+            lines[1].replace("kv wire 0.8 kB/req", "kv wire 2.6 kB/req")
+            .replace("compression ratio 3.56x", "compression ratio 1.04x"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{tp}:compressed_tok_s"]["regressed"]
+        assert by[f"{tp}:q8_agreement_pct"]["regressed"]
+        assert by[f"{kv}:kv_wire_bytes_per_req_kb"]["regressed"]
+        assert by[f"{kv}:comm_compression_ratio"]["regressed"]
+        better = _doc([
+            lines[0].replace("compressed 634 tok/s", "compressed 900 tok/s"),
+            lines[1].replace("kv wire 0.8 kB/req", "kv wire 0.5 kB/req")
+            .replace("compression ratio 3.56x", "compression ratio 5.00x"),
+        ])
+        rows, _, _ = bench_compare.compare(_doc(lines), better, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert not by[f"{tp}:compressed_tok_s"]["regressed"]
+        assert not by[f"{tp}:q8_agreement_pct"]["regressed"]
+        assert not by[f"{kv}:kv_wire_bytes_per_req_kb"]["regressed"]
+        assert not by[f"{kv}:comm_compression_ratio"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

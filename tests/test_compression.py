@@ -1,0 +1,205 @@
+"""The wire codec's numerics contract (round 22, ``parallel/compression.py``).
+
+Pure host/trace-level tests — no engines (the engine-level drift gate and
+page-boundary oracles live in ``tests/test_zcompression.py``, sorted last
+with the other engine suites). Pinned here:
+
+* block quantization round-trip error ≤ scale/2 per element, per dtype
+  and block size — and int arrays pass through raw (quantizing a block
+  table would corrupt it);
+* **fp32 requantization is an exact fixed point**: encode∘decode∘encode
+  ships a bit-identical payload, the property every compressed
+  spill→fill→re-spill cycle and the ZeRO ring's gather phase stand on;
+* the delta codec ships ONLY the blocks a version bump changed, decodes
+  bit-identically to the full int8 encode, and refuses a wrong-shaped
+  base loudly;
+* the traced (:func:`quantize_blocks`) and host (:func:`_np_quantize`)
+  quantizers agree bit-for-bit on the same data — one codec, two wires;
+* ``wire_scale`` matches what the payloads actually weigh, so the
+  costmodel's priced compression and the codec's real compression
+  cannot drift apart.
+"""
+
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.parallel.compression import (
+    Codec,
+    CommCompression,
+    Int8Codec,
+    Int8DeltaCodec,
+    get_codec,
+    wire_scale,
+)
+
+
+def _rand(rng, shape, dtype=np.float32):
+    return (rng.standard_normal(shape) * 3.0).astype(dtype)
+
+
+class TestInt8RoundTrip:
+    @pytest.mark.parametrize("dtype", [np.float32, np.float16, np.float64])
+    @pytest.mark.parametrize("block", [8, 32, 64])
+    def test_error_bounded_by_half_scale(self, rng, dtype, block):
+        x = _rand(rng, (7, 33), dtype)          # deliberately ragged
+        codec = Int8Codec(block=block)
+        p = codec.encode(x)
+        y = codec.decode(p)
+        assert y.shape == x.shape and y.dtype == x.dtype
+        flat = x.astype(np.float32).reshape(-1)
+        pad = (-flat.size) % block
+        blocks = np.pad(flat, (0, pad)).reshape(-1, block)
+        scales = np.max(np.abs(blocks), axis=1, keepdims=True) / 127.0
+        bound = np.repeat(
+            np.maximum(scales, 0), block, axis=1
+        ).reshape(-1)[: flat.size] / 2.0
+        err = np.abs(y.astype(np.float32).reshape(-1) - flat)
+        # half-ulp slack for the low-precision dtypes' own rounding
+        eps = np.finfo(dtype).eps * np.abs(flat)
+        assert np.all(err <= bound + eps + 1e-12)
+
+    def test_int_arrays_pass_through_raw(self, rng):
+        x = rng.integers(0, 100, size=(16,)).astype(np.int32)
+        p = Int8Codec().encode(x)
+        assert p["codec"] == "raw"
+        assert p["wire_bytes"] == x.nbytes
+        np.testing.assert_array_equal(Int8Codec().decode(p), x)
+
+    def test_zero_blocks_quantize_exactly(self):
+        x = np.zeros((64,), np.float32)
+        p = Int8Codec().encode(x)
+        np.testing.assert_array_equal(Int8Codec().decode(p), x)
+        assert np.all(p["scales"] == 1.0)       # no 0/0
+
+    def test_f32_requantization_is_fixed_point(self, rng):
+        x = _rand(rng, (256,))
+        codec = Int8Codec()
+        p1 = codec.encode(x)
+        y = codec.decode(p1)
+        p2 = codec.encode(y)
+        np.testing.assert_array_equal(p1["q"], p2["q"])
+        np.testing.assert_array_equal(p1["scales"], p2["scales"])
+        np.testing.assert_array_equal(y, codec.decode(p2))
+
+    def test_wire_bytes_match_wire_scale(self, rng):
+        # Block-aligned f32 input: payload weight must equal the factor
+        # the costmodel prices with, exactly.
+        x = _rand(rng, (4, 256))
+        p = Int8Codec(block=32).encode(x)
+        assert p["raw_bytes"] == x.nbytes
+        assert p["wire_bytes"] == int(x.nbytes * wire_scale(4, 32))
+        assert p["wire_bytes"] < p["raw_bytes"] / 3   # ≥ 3x reduction
+
+
+class TestTracedHostAgreement:
+    def test_quantize_blocks_matches_np_quantize(self, rng):
+        import jax.numpy as jnp
+
+        from learning_jax_sharding_tpu.parallel.compression import (
+            _np_quantize,
+            dequantize_blocks,
+            quantize_blocks,
+        )
+
+        x = _rand(rng, (5, 37))
+        qj, sj = quantize_blocks(jnp.asarray(x), 32)
+        qn, sn = _np_quantize(x.reshape(-1), 32)
+        np.testing.assert_array_equal(np.asarray(qj), qn)
+        np.testing.assert_array_equal(np.asarray(sj), sn)
+        y = dequantize_blocks(qj, sj, x.shape, jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(y), Int8Codec().decode(Int8Codec().encode(x))
+        )
+
+
+class TestDeltaCodec:
+    def test_no_base_degrades_to_full_int8(self, rng):
+        x = _rand(rng, (128,))
+        full = Int8Codec().encode(x)
+        p = Int8DeltaCodec().encode(x, base=None)
+        assert p["codec"] == "int8"
+        np.testing.assert_array_equal(p["q"], full["q"])
+
+    def test_identical_base_ships_zero_blocks(self, rng):
+        x = _rand(rng, (128,))
+        codec = Int8DeltaCodec()
+        p = codec.encode(x, base=x.copy())
+        assert p["codec"] == "int8_delta"
+        assert p["idx"].size == 0
+        assert p["wire_bytes"] == 0
+        np.testing.assert_array_equal(
+            codec.decode(p, base=x.copy()),
+            Int8Codec().decode(Int8Codec().encode(x)),
+        )
+
+    def test_version_bump_ships_only_changed_blocks(self, rng):
+        # A page re-spilled after a weights bump: the first 3 blocks are
+        # untouched, the last block carries the new version's rows.
+        base = _rand(rng, (128,))
+        new = base.copy()
+        new[96:] = _rand(rng, (32,))
+        codec = Int8DeltaCodec()
+        p = codec.encode(new, base=base)
+        assert list(p["idx"]) == [3]
+        assert p["wire_bytes"] < Int8Codec().encode(new)["wire_bytes"]
+        np.testing.assert_array_equal(
+            codec.decode(p, base=base),
+            Int8Codec().decode(Int8Codec().encode(new)),
+        )
+
+    def test_chained_version_bumps_stay_bit_identical(self, rng):
+        # v0 -> v1 -> v2, each delta decoded against the PREVIOUS decoded
+        # copy (exactly the TierStore re-demotion flow): every hop must
+        # land on the full encode's grid, or drift would compound.
+        codec = Int8DeltaCodec()
+        cur = _rand(rng, (256,))
+        held = codec.decode(codec.encode(cur))      # v0 full
+        for lo in (64, 192):
+            nxt = held.copy()
+            nxt[lo : lo + 32] = _rand(rng, (32,))
+            p = codec.encode(nxt, base=held)
+            held = codec.decode(p, base=held)
+            np.testing.assert_array_equal(
+                held, Int8Codec().decode(Int8Codec().encode(nxt))
+            )
+
+    def test_wrong_base_refuses_loudly(self, rng):
+        codec = Int8DeltaCodec()
+        x = _rand(rng, (128,))
+        p = codec.encode(x, base=x.copy())
+        with pytest.raises(ValueError, match="base"):
+            codec.decode(p, base=None)
+        with pytest.raises(ValueError, match="blocks"):
+            codec.decode(p, base=_rand(rng, (256,)))
+
+    def test_shape_mismatched_base_degrades_to_full(self, rng):
+        x = _rand(rng, (128,))
+        p = Int8DeltaCodec().encode(x, base=_rand(rng, (64,)))
+        assert p["codec"] == "int8"
+
+
+class TestRegistryAndConfig:
+    def test_get_codec_resolution(self):
+        assert get_codec(None) is None
+        assert isinstance(get_codec("none"), Codec)
+        assert get_codec("int8").name == "int8"
+        assert get_codec("int8_delta", block=16).block == 16
+        with pytest.raises(ValueError, match="unknown codec"):
+            get_codec("zstd")
+
+    def test_comm_compression_validation(self):
+        with pytest.raises(ValueError):
+            CommCompression(block=0)
+        with pytest.raises(ValueError):
+            CommCompression(kv_codec="nope")
+        comp = CommCompression()
+        assert comp.active
+        comp.enabled = False                # the drift ladder's flip
+        assert not comp.active
+        assert not CommCompression(collectives=False).active
+
+    def test_wire_scale_table(self):
+        assert wire_scale(4, 32) == pytest.approx(0.28125)
+        assert wire_scale(2, 32) == pytest.approx(0.5625)
+        # bigger blocks amortize the scales further
+        assert wire_scale(4, 64) < wire_scale(4, 32)

@@ -1,0 +1,459 @@
+"""Engine/fleet oracles for the comm compression layer (round 22).
+
+Named to sort LAST alongside ``test_zfleet``/``test_zkv_economy`` (same
+rationale: these build real engines and compile real programs, so they
+live at the tail of the suite where the tier-1 wall budget can absorb
+them). What they pin:
+
+* **drift gate** — the quantized TP all-reduce agrees with the plain
+  engine token-for-token under greedy decoding; the forced-trip hook
+  (negative budget) fires the degradation ladder, flips
+  ``comm_compression_active`` off, and the NEXT serve retraces to the
+  plain programs and is bit-identical to an engine that never
+  compressed;
+* **page boundaries** — compressed spill→fill→re-spill round-trips
+  bit-identically (f32 requantization fixed point, pinned at the codec
+  level in ``test_compression.py``) and the ``_q8`` contract names land
+  on the kv programs;
+* **delta-vs-base across version bumps** — the tier store's stale entry
+  earns its RAM as the delta codec's base: re-spilling unchanged rows
+  against it ships near-zero wire bytes and decodes bit-identically;
+* **priced and searchable** — the costmodel's quantized event variants
+  and codec-overhead charge, plus the seeded layout-search case: flat
+  pricing DECLINES quantization (codec passes cost more than the wire
+  they save when link ≈ HBM), two-tier pricing flips the DCN grad-sync
+  axis to int8;
+* the ``uncounted-compression`` source-lint rule fires on codec calls
+  outside the counted seams and stays quiet inside them.
+"""
+
+import dataclasses as dc
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_jax_sharding_tpu.analysis import costmodel
+from learning_jax_sharding_tpu.analysis.entrypoints import (
+    _sharded_serving_params,
+)
+from learning_jax_sharding_tpu.models.serving import ContinuousEngine
+from learning_jax_sharding_tpu.models.transformer import (
+    CONFIG_TINY,
+    Transformer,
+)
+from learning_jax_sharding_tpu.parallel import build_mesh
+from learning_jax_sharding_tpu.parallel.compression import CommCompression
+from learning_jax_sharding_tpu.parallel.logical import (
+    RULES_DP_TP,
+    RULES_TP_SERVING,
+)
+
+CFG = dc.replace(CONFIG_TINY, dtype=jnp.float32)
+
+
+def _same_tokens(a, b):
+    return all(
+        (np.asarray(x) == np.asarray(y)).all() for x, y in zip(a, b)
+    )
+
+
+@pytest.fixture(scope="module")
+def tp_mesh():
+    return build_mesh((2, 4), ("data", "model"))
+
+
+@pytest.fixture(scope="module")
+def served(tp_mesh):
+    params = _sharded_serving_params(
+        Transformer(CFG), tp_mesh, RULES_TP_SERVING
+    )
+    prng = np.random.default_rng(0)
+    prompts = [
+        prng.integers(1, CFG.vocab_size, size=(n,)).astype(np.int32)
+        for n in (20, 5)
+    ]
+    return params, prompts
+
+
+def _mixed_engine(mesh, comm=None):
+    return ContinuousEngine(
+        CFG, mesh, RULES_TP_SERVING, batch_size=2, max_new_tokens=8,
+        refill_chunk=16, decode_block_steps=4, mixed=True,
+        comm_compression=comm,
+    )
+
+
+@pytest.fixture(scope="module")
+def plain_tokens(tp_mesh, served):
+    params, prompts = served
+    return _mixed_engine(tp_mesh).serve(params, prompts)
+
+
+class TestQuantizedCollectives:
+    def test_greedy_agreement_with_plain_engine(
+        self, tp_mesh, served, plain_tokens
+    ):
+        params, prompts = served
+        # probe every maintain tick so even this short serve exercises
+        # the drift oracle (the default cadence is every 8th tick)
+        eng = _mixed_engine(tp_mesh, CommCompression(drift_check_every=1))
+        out = eng.serve(params, prompts)
+        assert _same_tokens(plain_tokens, out)
+        assert eng._c_comp_probes.value >= 1
+        assert eng._c_comp_disagree.value == 0
+        assert eng._c_comp_trips.value == 0
+        names = {
+            k: eng.contract_name(k)
+            for k, _, _ in eng._dispatched_programs()
+        }
+        assert any(v.endswith("_q8") for v in names.values())
+
+    def test_forced_trip_disables_then_matches_plain(
+        self, tp_mesh, served, plain_tokens
+    ):
+        # Negative drift budget = the deterministic trip hook: the first
+        # probe burns infinite budget, the ladder disables compression.
+        params, prompts = served
+        eng = _mixed_engine(
+            tp_mesh,
+            CommCompression(drift_budget=-1.0, drift_check_every=1),
+        )
+        eng.serve(params, prompts)
+        assert eng._c_comp_trips.value == 1
+        assert not eng.comm_compression_active
+        # next serve retraces to the plain programs: bit-identical
+        # fallback, contract names revert
+        out = eng.serve(params, prompts)
+        assert _same_tokens(plain_tokens, out)
+        names = {
+            k: eng.contract_name(k)
+            for k, _, _ in eng._dispatched_programs()
+        }
+        assert not any(v.endswith("_q8") for v in names.values())
+
+    def test_collectives_require_mixed_steps(self, tp_mesh):
+        with pytest.raises(ValueError):
+            ContinuousEngine(
+                CFG, tp_mesh, RULES_TP_SERVING, batch_size=2,
+                max_new_tokens=8, comm_compression=CommCompression(),
+            )
+
+
+# --------------------------------------------------------------------- #
+# compressed KV pages — 1-device engine, cheap (test_zkv_economy idiom)
+# --------------------------------------------------------------------- #
+
+CFG_PAGED = dc.replace(CFG, decode_attention="blocked")
+
+
+@pytest.fixture(scope="module")
+def paged_params():
+    model = Transformer(CFG_PAGED)
+    return nn.meta.unbox(
+        jax.jit(lambda r, t: model.init({"params": r}, t))(
+            jax.random.key(3), np.zeros((2, 8), np.int32)
+        )["params"]
+    )
+
+
+@pytest.fixture(scope="module")
+def paged_engine(paged_params):
+    mesh = build_mesh(
+        (1, 1), ("data", "model"), devices=jax.devices()[:1]
+    )
+    eng = ContinuousEngine(
+        CFG_PAGED, mesh, RULES_DP_TP, batch_size=2, max_new_tokens=4,
+        refill_chunk=8, paged_pages=12, page_size=4, prefix_cache=True,
+        comm_compression=CommCompression(collectives=False),
+    )
+    prng = np.random.default_rng(23)
+    prompt = prng.integers(
+        1, CFG_PAGED.vocab_size, size=(9,)
+    ).astype(np.int32)
+    eng.serve(paged_params, [prompt])
+    return eng
+
+
+class TestCompressedKvPages:
+    def test_spill_fill_respill_bit_identical(self, paged_engine):
+        eng = paged_engine
+        key = next(iter(eng.retained_prefixes()))
+        rows, st = eng.spill_page(key, drop=True)
+        assert st["raw_bytes"] > st["bytes"] > 0
+        assert st["raw_bytes"] / st["bytes"] > 3  # f32 → ≈ 3.6× wire
+        eng.fill_page(key, rows)
+        rows2, _ = eng.spill_page(key, drop=True)
+        for a, b in zip(rows, rows2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        eng.fill_page(key, rows2)
+
+    def test_kv_programs_carry_q8_contracts(self, paged_engine):
+        names = {
+            paged_engine.contract_name(k)
+            for k, _, _ in paged_engine._dispatched_programs()
+        }
+        assert "kv_page_spill_q8" in names
+        assert "kv_page_fill_q8" in names
+
+
+# --------------------------------------------------------------------- #
+# tiered economy: delta codec against the version-stamped base
+# --------------------------------------------------------------------- #
+
+
+class TestTieredDeltaEconomy:
+    def test_demote_delta_promote_cycle(self, paged_params):
+        from learning_jax_sharding_tpu.fleet import (
+            FleetPolicy,
+            FleetRouter,
+            KvEconomy,
+            make_replicas,
+        )
+        from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+            FlightRecorder,
+        )
+
+        prng = np.random.default_rng(23)
+        base = prng.integers(
+            1, CFG_PAGED.vocab_size, size=(9,)
+        ).astype(np.int32)
+        reps = make_replicas(
+            CFG_PAGED, RULES_DP_TP, paged_params, count=2,
+            mesh_shape=(1, 1), batch_size=2, max_new_tokens=4,
+            refill_chunk=8, paged_pages=12, page_size=4,
+            prefix_cache=True,
+            comm_compression=CommCompression(
+                collectives=False, kv_codec="int8_delta"
+            ),
+        )
+        econ = KvEconomy(hbm_retained_target=0, burn_threshold=1e9)
+        router = FleetRouter(
+            reps, policy=FleetPolicy(prefix_weight=0.5),
+            kv_economy=econ, recorder=FlightRecorder(),
+        )
+        router.add_request(base)
+        router.drain()  # drain() runs maintain(): pages tier eagerly
+        rep = econ.tier_report()
+        assert rep["demotions"] >= 2
+        assert rep["raw_bytes"] > rep["spill_bytes"] > 0
+        assert rep["compression_ratio"] > 1.5
+        # already tiered at the live version → nothing left to demote
+        assert econ.maintain() == 0
+
+        # the stale entry is the delta base: unchanged rows re-spilled
+        # against it ship (near) zero wire bytes and decode bit-identical
+        hits = econ.predicted_hits(base)
+        home = max(hits, key=hits.get)
+        eng = router.replicas[home].engine
+        tier = econ.tier_of(home)
+        key = base[:4].tobytes()
+        held = tier.base_rows(key)
+        assert held is not None
+        rows2, st = eng.spill_page(key, drop=False, base_rows=held)
+        assert st["bytes"] < st["raw_bytes"] / 8
+        for a, b in zip(held, rows2):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        # promotion books raw bytes alongside wire bytes
+        for k in (base[:8].tobytes(), base[:4].tobytes()):
+            eng.spill_page(k, drop=True)
+        assert econ.promote(router.replicas[home], base) == 2
+        rep2 = econ.tier_report()
+        assert rep2["fill_bytes"] > 0
+        assert rep2["raw_bytes"] > rep["raw_bytes"]
+        assert router.goodput_report()["reconcile_ok"]
+
+    def test_prefill_decode_handoff_ships_compressed(self, paged_params):
+        from learning_jax_sharding_tpu.fleet import (
+            FleetRouter,
+            make_replicas,
+        )
+        from learning_jax_sharding_tpu.telemetry.flight_recorder import (
+            FlightRecorder,
+        )
+
+        prng = np.random.default_rng(23)
+        base = prng.integers(
+            1, CFG_PAGED.vocab_size, size=(9,)
+        ).astype(np.int32)
+        pre = make_replicas(
+            CFG_PAGED, RULES_DP_TP, paged_params, count=1,
+            mesh_shape=(1, 1), role="prefill", batch_size=2,
+            max_new_tokens=1, refill_chunk=8,
+        )
+        dec = make_replicas(
+            CFG_PAGED, RULES_DP_TP, paged_params, count=1,
+            mesh_shape=(1, 1), role="decode", offset=1, batch_size=2,
+            max_new_tokens=4, refill_chunk=8,
+        )
+        router = FleetRouter(
+            pre + dec, kv_codec="int8", recorder=FlightRecorder()
+        )
+        router.add_request(base)
+        router.drain()
+        snap = router.registry.snapshot()
+        wire = snap["fleet_kv_transfer_bytes_total"]
+        raw = snap["fleet_kv_raw_bytes_total"]
+        assert raw > wire > 0
+        # acceptance: wire bytes per request ≥ 1.8× reduced
+        assert snap["fleet_kv_compression_ratio"] >= 1.8
+        assert router.goodput_report()["reconcile_ok"]
+
+
+# --------------------------------------------------------------------- #
+# priced and searchable
+# --------------------------------------------------------------------- #
+
+
+def _reduce_event(nbytes=1 << 20, axis="model", in_loop=False, trip=None):
+    from learning_jax_sharding_tpu.analysis.shardflow import CommEvent
+
+    return CommEvent(
+        kind="reduce", axes=(axis,), bytes=nbytes, where="x.py:1",
+        primitive="dot_general", reason="pending partial sum",
+        realizations=(("all-reduce", axis),), in_loop=in_loop, trip=trip,
+    )
+
+
+class TestPricedCompression:
+    def test_quantize_events_reweights_reduces_only(self):
+        from learning_jax_sharding_tpu.analysis.shardflow import CommEvent
+        from learning_jax_sharding_tpu.parallel.compression import (
+            wire_scale,
+        )
+
+        red = _reduce_event()
+        gather = CommEvent(
+            kind="reshard", axes=("model",), bytes=1 << 20,
+            where="x.py:2", primitive="dot_general", reason="gather",
+            realizations=(("all-gather", "model"),),
+        )
+        out = costmodel.quantize_events([red, gather], ("model",))
+        assert out[0].bytes == int(
+            np.ceil(red.bytes * wire_scale(4, 32))
+        )
+        assert "[int8 block-scaled wire]" in out[0].reason
+        assert out[1].bytes == gather.bytes  # pure movement: untouched
+        # idempotent — the reason marker guards double quantization
+        again = costmodel.quantize_events(out, ("model",))
+        assert again[0].bytes == out[0].bytes
+        # other axes untouched
+        flat = costmodel.quantize_events([red], ("data",))
+        assert flat[0].bytes == red.bytes
+
+    def test_codec_overhead_scales_with_trip(self):
+        prof = costmodel.table_profile("TPU v5 lite")
+        once = costmodel.codec_overhead_s(
+            [_reduce_event()], ("model",), prof
+        )
+        looped = costmodel.codec_overhead_s(
+            [_reduce_event(in_loop=True, trip=7)], ("model",), prof
+        )
+        assert once > 0
+        assert looped == pytest.approx(7 * once)
+
+    def test_seeded_case_flat_declines_two_tier_accepts(self):
+        # The headline search story: on flat pricing (CPU-calibrated,
+        # link ≈ HBM) the codec passes cost more than the 1.08× wire
+        # they save, so the move is declined; under two-tier pricing
+        # the leading (DCN) axis all-reduce flips to int8.
+        from learning_jax_sharding_tpu.analysis.entrypoints import (
+            build_search_inputs,
+        )
+        from learning_jax_sharding_tpu.analysis.layout_search import (
+            search_layout,
+        )
+        from learning_jax_sharding_tpu.analysis.topology import (
+            reference_two_tier,
+        )
+
+        si = build_search_inputs("train_step")
+        mesh = si["mesh"]
+        common = dict(
+            mesh=mesh, budget=8, max_sweeps=1,
+            while_trip_hint=si.get("while_trip_hint"),
+        )
+        flat = search_layout(
+            si["name"], si["fn"], *si["args"], **common, **si["kwargs"]
+        )
+        assert flat.quantized_axes == ()
+        assert flat.quantize_comm_s is None
+
+        topo = reference_two_tier(
+            tuple(str(a) for a in mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+        )
+        tiered = search_layout(
+            si["name"], si["fn"], *si["args"], **common,
+            topology=topo,
+            profile=costmodel.table_profile("TPU v5 lite"),
+            **si["kwargs"],
+        )
+        assert "data" in tiered.quantized_axes  # the DCN grad-sync axis
+        qs = tiered.quantize_comm_s
+        assert qs["q8_wire_s"] + qs["codec_overhead_s"] < qs["fp_wire_s"]
+        assert tiered.to_dict()["quantized_axes"] == list(
+            tiered.quantized_axes
+        )
+
+
+class TestUncountedCompressionLint:
+    def test_codec_calls_outside_seams_flagged(self):
+        from learning_jax_sharding_tpu.analysis.source_lint import (
+            lint_source,
+        )
+
+        text = (
+            "from learning_jax_sharding_tpu.parallel.compression import"
+            " quantize_blocks, Int8Codec\n"
+            "codec = Int8Codec()\n"
+            "q, s = quantize_blocks(x, 32)\n"
+            "p = codec.encode(x)\n"
+            "y = self._kv_codec.decode(p)\n"
+            "b = name.encode('utf-8')\n"          # str.encode: exempt
+            "t = tokenizer.decode(ids)\n"         # not a codec: exempt
+        )
+        hits = [
+            f for f in lint_source(
+                "learning_jax_sharding_tpu/models/example.py", text=text
+            )
+            if f.rule == "uncounted-compression"
+        ]
+        assert len(hits) == 3
+
+    def test_seam_files_exempt(self):
+        from learning_jax_sharding_tpu.analysis.source_lint import (
+            lint_source,
+        )
+
+        hits = [
+            f for f in lint_source(
+                "learning_jax_sharding_tpu/parallel/compression.py",
+                text="q, s = quantize_blocks(x, 32)\n",
+            )
+            if f.rule == "uncounted-compression"
+        ]
+        assert hits == []
+
+    def test_current_tree_is_clean(self):
+        # the rule ships with ZERO baseline suppressions: every codec
+        # call in the repo flows through a counted seam
+        import pathlib
+
+        from learning_jax_sharding_tpu.analysis.source_lint import (
+            lint_source,
+        )
+
+        root = pathlib.Path(
+            "learning_jax_sharding_tpu"
+        )
+        bad = []
+        for p in sorted(root.rglob("*.py")):
+            bad += [
+                f for f in lint_source(p.as_posix())
+                if f.rule == "uncounted-compression"
+            ]
+        assert bad == [], [f.where for f in bad]
