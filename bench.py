@@ -1207,6 +1207,47 @@ def bench_economics():
     }
 
 
+def bench_autoscale():
+    """Elastic fleet (round 23): the canonical day replayed twice under
+    identical pacing — a static oracle at the planner's best K (the
+    SLO-burn threshold) and the elastic fleet (plan floor fed forward,
+    SLO-burn loop above it). Like ``bench_economics``, the replay needs
+    device multiplicity, so it runs on the emulated mesh in a
+    subprocess and its ``[bench]`` line is relayed.
+    ``scripts/bench_compare.py`` gates ``elastic uusd/tok`` (lower),
+    ``drain p99`` (lower) and ``planner gap`` (lower); peak/final burn
+    vs the oracle print for context only (the settled comparison is
+    stable, the 50 ms-sample peak jitters with wall-clock pacing on a
+    loaded host — a trajectory gate on it would flake)."""
+    import os
+    import pathlib
+    import subprocess
+
+    script = (
+        pathlib.Path(__file__).resolve().parent / "scripts" / "replay.py"
+    )
+    proc = subprocess.run(
+        [sys.executable, str(script), "--autoscale", "--json"],
+        capture_output=True, text=True, timeout=1800,
+        env={**os.environ, "JAX_PLATFORMS": ""},
+    )
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or proc.stdout).splitlines()[-5:])
+        raise RuntimeError(f"autoscale replay exited {proc.returncode}: {tail}")
+    res = json.loads(proc.stdout)
+    _log(res["bench_line"])
+    return {
+        k: res[k] for k in (
+            "k0", "k_max", "speed", "generated_tokens", "shed",
+            "elastic_cost_per_token_usd", "static_cost_per_token_usd",
+            "best_static_k", "peak_burn", "static_oracle_peak_burn",
+            "worst_tenant_burn_rate", "static_oracle_final_burn",
+            "drain_ms_p99", "planner_gap_pct", "decisions",
+            "conservation_ok",
+        )
+    }
+
+
 def bench_multistep():
     """Multi-step scheduling horizon ladder (round 16): the fused
     ``multi_step`` program (one dispatch per N engine iterations, host
@@ -1614,6 +1655,11 @@ def main():
     except Exception as e:
         _log(f"[bench] topology bench skipped: {type(e).__name__}: {e}")
         topology_block = None
+    try:
+        autoscale_block = bench_autoscale()
+    except Exception as e:
+        _log(f"[bench] autoscale bench skipped: {type(e).__name__}: {e}")
+        autoscale_block = None
 
     watch.stop()
     run_report = watch.report()
@@ -1689,6 +1735,12 @@ def main():
         # gated by bench_compare's `topo err` / `dcn B/token` /
         # `overlap gap` / `topo argmin gap` patterns).
         "topology": topology_block,
+        # Round-23 elastic fleet: the canonical day on the autoscaled
+        # fleet vs the planner's best static K under identical pacing
+        # (fleet/autoscaler.py + fleet/capacity.py; gated by
+        # bench_compare's `elastic uusd/tok` / `drain p99` /
+        # `planner gap` patterns), with burn-vs-oracle context.
+        "autoscale": autoscale_block,
         # Round-14 goodput ledger: where the tracked serving window's
         # wall-clock went (exclusive buckets, Σ == wall reconciled),
         # host_share / goodput_ratio vs the decode roofline, and the

@@ -73,6 +73,19 @@ structure matters:
   of those names; definition-site and fixture literals ride the
   baseline with reasons.
 
+* ``unguarded-scale-decision`` — a fleet scale action
+  (``adopt_replica`` / ``retire_replica`` / ``preempt_replica`` /
+  ``kill_replica`` / ``rolling_swap``) called from inside an
+  ``*Autoscaler`` class outside a ``with ..._decision(...)`` frame:
+  the autoscaler's contract is that EVERY action it takes is a logged
+  decision — flight-recorded, counted, and appended to the timeline
+  the replay artifact and the planner-vs-live score are built from
+  (``fleet/autoscaler.py``'s ``_decision`` context manager). An
+  unframed action mutates the fleet invisibly: the scale_timeline
+  artifact, the ``fleet_scale_decisions_total`` counter, and the K(t)
+  integral all silently miss it. Zero suppressions — the decision log
+  is complete by construction, not by baseline budget.
+
 * ``uncounted-compression`` — a direct call to the wire codec's
   primitives (``quantize_blocks``/``quantize_absmax`` and friends, or
   ``<codec>.encode``/``<codec>.decode`` on a codec-named receiver)
@@ -195,6 +208,32 @@ def _is_ledger_frame(item: ast.withitem) -> bool:
     return name.endswith(".measure") or name.endswith("_led_device")
 
 
+#: Fleet scale actions the ``unguarded-scale-decision`` rule polices:
+#: every call to one of these from inside an ``*Autoscaler`` class must
+#: sit lexically inside a ``with ..._decision(...)`` frame. Kept
+#: textually in sync with :class:`~..fleet.router.FleetRouter`'s
+#: elastic surface (same deliberate-copy rationale as RAW_CLOCKS: the
+#: lint must not import jax-loading modules).
+_SCALE_ACTIONS = frozenset({
+    "adopt_replica", "retire_replica", "preempt_replica",
+    "kill_replica", "rolling_swap",
+})
+#: Classes whose scale actions must be logged decisions.
+_AUTOSCALER_CLASS_RE = re.compile(r"Autoscaler")
+
+
+def _is_decision_frame(item: ast.withitem) -> bool:
+    """Does one ``with`` item open an autoscaler decision frame?
+    Matches ``<anything>._decision(...)`` (the Autoscaler's own frame)
+    and a public ``.decision(...)`` spelling, so a future rename from
+    private to public does not orphan the rule."""
+    expr = item.context_expr
+    if not isinstance(expr, ast.Call):
+        return False
+    name = _dotted(expr.func)
+    return name.endswith("._decision") or name.endswith(".decision")
+
+
 def _host_sync_name(node: ast.Call) -> str | None:
     """The sync idiom a call spells, or None."""
     name = _dotted(node.func)
@@ -268,6 +307,9 @@ class _Visitor(ast.NodeVisitor):
         # method, and how many ledger frames enclose the current node?
         self.phase_stack: list[bool] = []
         self.ledger_depth = 0
+        # unguarded-scale-decision state: how many `with ..._decision`
+        # frames enclose the current node?
+        self.decision_depth = 0
         # Names bound at MODULE scope to device-array-producing calls —
         # function-local `x = jnp...` bindings must not poison the set
         # (a jitted function elsewhere reading an unrelated global `x`
@@ -289,9 +331,14 @@ class _Visitor(ast.NodeVisitor):
 
     def _with(self, node):
         opened = sum(1 for item in node.items if _is_ledger_frame(item))
+        decisions = sum(
+            1 for item in node.items if _is_decision_frame(item)
+        )
         self.ledger_depth += opened
+        self.decision_depth += decisions
         self.generic_visit(node)
         self.ledger_depth -= opened
+        self.decision_depth -= decisions
 
     visit_With = visit_AsyncWith = _with
 
@@ -347,6 +394,25 @@ class _Visitor(ast.NodeVisitor):
                 "round-trip; batch the readback outside the loop or "
                 "keep the value on device (ROADMAP item 1 host-loop "
                 "overhead)",
+            ))
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _SCALE_ACTIONS
+            and any(
+                _AUTOSCALER_CLASS_RE.search(c) for c in self.class_stack
+            )
+            and self.decision_depth == 0
+        ):
+            self.findings.append(Finding(
+                "ast", "unguarded-scale-decision",
+                f"{self.path}:{node.lineno}",
+                f"scale action `{_dotted(node.func)}(...)` inside an "
+                "autoscaler outside any `with ..._decision(...)` frame "
+                "— the action never reaches the decision timeline, the "
+                "fleet_scale_decisions_total counter, or the flight "
+                "recorder, so the scale_timeline artifact and the "
+                "planner-vs-live score silently miss it; wrap it in "
+                "`with self._decision(action, ...)`",
             ))
         self._check_untimed(node)
         self.generic_visit(node)

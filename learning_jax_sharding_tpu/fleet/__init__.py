@@ -9,9 +9,23 @@ Layers: :mod:`.policies` (placement + fleet shedding policy),
 rides), :mod:`.kv_economy` (round 15: prefix-aware placement + the
 HBM → host → peer KV tier ladder), :mod:`.loadgen` (round 20: the
 deterministic trace-driven load generator + replay harness behind the
-workload observatory).
+workload observatory), :mod:`.autoscaler` + :mod:`.capacity` (round 23:
+the SLO-burn control loop that grows/shrinks the fleet through graceful
+drain-and-migrate, and the static planner it is scored against).
 """
 
+from learning_jax_sharding_tpu.fleet.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+)
+from learning_jax_sharding_tpu.fleet.capacity import (  # noqa: F401
+    PlannerAssumptions,
+    check_fit,
+    plan_capacity,
+    replica_throughput,
+    score_timeline,
+    timeline_replica_seconds,
+)
 from learning_jax_sharding_tpu.fleet.kv_economy import (  # noqa: F401
     KvEconomy,
     TierStore,

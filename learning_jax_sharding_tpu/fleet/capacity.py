@@ -1,0 +1,365 @@
+"""The static capacity planner: "what fleet shape does this trace
+need?" — answered OFFLINE, before a single device is provisioned.
+
+The autoscaler (:mod:`.autoscaler`) reacts to live signals; this module
+is its yardstick. It composes the repo's existing models —
+:mod:`..analysis.costmodel` rooflines for per-replica throughput,
+:mod:`..analysis.topology` for carve feasibility, the engine's KV
+geometry for HBM fit, and KV-economy stats for the prefix discount —
+into a windowed demand plan over a load trace:
+
+* **demand** — the trace's arrivals bucket into fixed windows; each
+  request contributes its decode budget plus its prompt tokens
+  discounted by the measured prefix-hit ratio (warm KV is prefill the
+  fleet never pays for — the round-15 economy, priced into planning);
+* **supply** — one replica's token throughput from the roofline: the
+  max of the compute term (2·P FLOPs/token against the profile's
+  effective peak) and the memory term (the decode step streams the
+  whole parameter tree once per batch) over the sub-mesh's devices.
+  Replays on the emulated CPU fleet pass a MEASURED ``replica_tok_s``
+  instead — the plan's shape logic is identical, only the supply
+  number changes;
+* **feasibility** — the plan refuses shapes that cannot exist: a
+  replica must fit HBM (params + KV page pool) and, under a topology
+  profile, fit inside one ICI domain with enough whole domains for
+  ``max_replicas`` (the :func:`~.replica.sub_meshes` rule, checked
+  before money is spent instead of at boot);
+* **pricing** — replica-seconds × devices × the economics rate
+  (:class:`~..telemetry.economics.CostRates`); the ELASTIC cost
+  integrates K(t) over the windows, each STATIC cost holds K flat, and
+  the best static fleet is the cheapest one that still covers peak
+  demand — the bar the autoscaler must beat.
+
+:func:`score_timeline` closes the loop: the autoscaler's live decision
+timeline replays into the same K(t) integral and the planner-vs-live
+gap (in provisioned replica-seconds) is reported — and bench-gated, so
+a regression in EITHER the planner's model or the controller's
+judgement shows up as the gap widening.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+from learning_jax_sharding_tpu.analysis.costmodel import (
+    Profile,
+    table_profile,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannerAssumptions:
+    """What the offline plan takes as given. Defaults line up with the
+    rest of the repo: the ``TPU v5 lite`` pricing profile the cost
+    model tables carry and the ``CostRates`` device-hour rate the
+    economics roll-ups price with."""
+
+    profile: str = "TPU v5 lite"
+    usd_per_device_hour: float = 1.20
+    hbm_bytes_per_device: float = 16e9
+    #: Demand-window width in trace seconds.
+    window_s: float = 2.0
+    #: Plan to run replicas at most this fraction of roofline — the
+    #: slack that absorbs within-window burstiness without queueing.
+    headroom: float = 0.7
+    #: Prefill tokens already warm in a KV tier cost nothing; this is
+    #: the measured (or assumed) hit ratio applied as a discount.
+    prefix_hit_ratio: float = 0.0
+
+
+def _param_count(config: Any) -> int:
+    """Parameters of the repo's transformer from its config alone —
+    the planner must not need an initialized tree to size a fleet.
+    Exact for the dense model family (``test_zautoscaler`` pins it
+    against a real initialized tree): untied ``lm_head``, layernorm
+    carrying scale+bias (rmsnorm scale only), optional dense biases."""
+    f = int(config.features)
+    h = int(config.num_heads) * int(config.head_dim)
+    kv_h = int(config.num_kv_heads or config.num_heads)
+    kv = kv_h * int(config.head_dim)
+    hidden = int(config.hidden)
+    norm = (
+        2 * f if str(getattr(config, "norm", "layernorm")) == "layernorm"
+        else f
+    )
+    bias_attn = (h + 2 * kv + f) if config.use_bias else 0
+    bias_mlp = (hidden + f) if config.use_bias else 0
+    per_layer = (
+        f * h + 2 * f * kv        # q + k + v projections
+        + h * f + bias_attn       # output projection
+        + 2 * f * hidden + bias_mlp   # mlp up + down
+        + 2 * norm                # the two layer norms
+    )
+    embed = int(config.vocab_size) * f
+    unembed = f * int(config.vocab_size)   # lm_head is NOT tied
+    pos = 0 if config.rope else int(config.max_seq_len) * f
+    return (
+        embed + unembed + pos + int(config.num_layers) * per_layer + norm
+    )
+
+
+def _kv_bytes_per_token(config: Any, dtype_bytes: int) -> int:
+    kv_h = int(config.num_kv_heads or config.num_heads)
+    return 2 * int(config.num_layers) * kv_h * int(config.head_dim) * (
+        dtype_bytes
+    )
+
+
+def replica_throughput(
+    config: Any,
+    *,
+    mesh_shape: Sequence[int] = (1, 2),
+    batch_size: int = 4,
+    dtype_bytes: int = 4,
+    profile: Profile | None = None,
+) -> dict:
+    """Roofline tokens/second for ONE replica serving decode on its
+    sub-mesh: per step the batch pays ``batch·2P`` FLOPs against the
+    profile's effective compute peak while streaming the parameter
+    tree once from HBM (decode's classic memory bound; the batch
+    amortizes the stream). The step estimate is the max of the two
+    terms — same discipline as ``costmodel.price``."""
+    if profile is None:
+        profile = table_profile("TPU v5 lite")
+    n_dev = max(1, math.prod(int(s) for s in mesh_shape))
+    p = _param_count(config)
+    flops_per_tok = 2.0 * p
+    compute_s = (batch_size * flops_per_tok / n_dev) / max(
+        profile.peak_flops * profile.mfu_eff, 1.0
+    )
+    param_bytes = p * dtype_bytes
+    memory_s = (param_bytes / n_dev) / max(
+        profile.hbm_bw * profile.mbu_eff, 1.0
+    )
+    step_s = max(compute_s, memory_s)
+    return {
+        "params": p,
+        "param_bytes": param_bytes,
+        "n_dev": n_dev,
+        "step_s": step_s,
+        "bound": "compute" if compute_s >= memory_s else "memory",
+        "tok_s": batch_size / step_s if step_s > 0 else float("inf"),
+    }
+
+
+def check_fit(
+    config: Any,
+    *,
+    mesh_shape: Sequence[int] = (1, 2),
+    batch_size: int = 4,
+    paged_pages: int | None = None,
+    page_size: int = 4,
+    dtype_bytes: int = 4,
+    max_replicas: int = 4,
+    assumptions: PlannerAssumptions | None = None,
+    topology: Any = None,
+    total_devices: int | None = None,
+) -> dict:
+    """Static feasibility of one fleet shape: HBM fit per replica
+    (params + the KV page pool, or ``batch·max_seq`` rows unpaged) and
+    the topology carve (``max_replicas`` whole sub-meshes, each inside
+    one ICI domain). Returns the audit dict; ``ok`` gates the plan."""
+    a = assumptions or PlannerAssumptions()
+    n_dev = max(1, math.prod(int(s) for s in mesh_shape))
+    p_bytes = _param_count(config) * dtype_bytes
+    per_tok = _kv_bytes_per_token(config, dtype_bytes)
+    if paged_pages is not None:
+        kv_bytes = paged_pages * page_size * per_tok
+    else:
+        kv_bytes = batch_size * int(config.max_seq_len) * per_tok
+    need = p_bytes + kv_bytes
+    have = a.hbm_bytes_per_device * n_dev
+    hbm_ok = need <= have
+    carve_ok = True
+    carve_why = None
+    if total_devices is not None and max_replicas * n_dev > total_devices:
+        carve_ok = False
+        carve_why = (
+            f"{max_replicas} replicas × {n_dev} devices exceed the "
+            f"{total_devices} available"
+        )
+    if topology is not None and carve_ok:
+        dom = int(topology.ici_domain_devices)
+        if n_dev > dom:
+            carve_ok = False
+            carve_why = (
+                f"sub-mesh of {n_dev} devices straddles the "
+                f"{dom}-device ICI domain (every collective would ride "
+                "DCN)"
+            )
+        elif total_devices is not None:
+            whole = (total_devices // dom) * (dom // n_dev)
+            if whole < max_replicas:
+                carve_ok = False
+                carve_why = (
+                    f"only {whole} intra-domain sub-meshes of {n_dev} "
+                    f"fit; {max_replicas} wanted"
+                )
+    return {
+        "hbm_ok": bool(hbm_ok),
+        "hbm_need_bytes": float(need),
+        "hbm_have_bytes": float(have),
+        "carve_ok": bool(carve_ok),
+        "carve_why": carve_why,
+        "ok": bool(hbm_ok and carve_ok),
+    }
+
+
+def plan_capacity(
+    events: Sequence[dict],
+    config: Any,
+    *,
+    max_new_tokens: int,
+    mesh_shape: Sequence[int] = (1, 2),
+    batch_size: int = 4,
+    min_replicas: int = 1,
+    max_replicas: int = 4,
+    assumptions: PlannerAssumptions | None = None,
+    replica_tok_s: float | None = None,
+    topology: Any = None,
+    total_devices: int | None = None,
+    paged_pages: int | None = None,
+    page_size: int = 4,
+    dtype_bytes: int = 4,
+) -> dict:
+    """The offline answer: K(t) over ``events`` (trace-event dicts with
+    ``t`` and ``prompt_len``), each window's demand divided by one
+    replica's deliverable throughput (roofline × headroom, or the
+    caller's measured ``replica_tok_s``), clamped to the fleet bounds.
+
+    The returned plan prices every static fleet size against the
+    elastic K(t) and names the BEST STATIC fleet — the smallest K
+    covering peak demand; smaller fleets are priced but flagged
+    infeasible (they queue without bound at peak, so their "cost" buys
+    an SLO breach). ``scripts/replay.py --autoscale`` persists this as
+    ``capacity_plan.json`` and scores the live controller against it.
+    """
+    a = assumptions or PlannerAssumptions()
+    if not events:
+        raise ValueError("cannot plan capacity over an empty trace")
+    profile = table_profile(a.profile)
+    tput = replica_throughput(
+        config, mesh_shape=mesh_shape, batch_size=batch_size,
+        dtype_bytes=dtype_bytes, profile=profile,
+    )
+    supply = (
+        replica_tok_s if replica_tok_s is not None else tput["tok_s"]
+    )
+    deliverable = supply * a.headroom
+    if deliverable <= 0:
+        raise ValueError(f"non-positive deliverable throughput {supply}")
+    fit = check_fit(
+        config, mesh_shape=mesh_shape, batch_size=batch_size,
+        paged_pages=paged_pages, page_size=page_size,
+        dtype_bytes=dtype_bytes, max_replicas=max_replicas,
+        assumptions=a, topology=topology, total_devices=total_devices,
+    )
+    duration = max(float(e["t"]) for e in events)
+    n_windows = max(1, math.ceil(duration / a.window_s))
+    demand = [0.0] * n_windows
+    total_tokens = 0.0
+    for e in events:
+        w = min(n_windows - 1, int(float(e["t"]) // a.window_s))
+        toks = (
+            float(e["prompt_len"]) * (1.0 - a.prefix_hit_ratio)
+            + float(max_new_tokens)
+        )
+        demand[w] += toks
+        total_tokens += toks
+    windows = []
+    elastic_replica_s = 0.0
+    peak_k = min_replicas
+    for w, toks in enumerate(demand):
+        w_s = a.window_s
+        need = toks / w_s / deliverable
+        k = min(max_replicas, max(min_replicas, math.ceil(need)))
+        peak_k = max(peak_k, k)
+        elastic_replica_s += k * w_s
+        windows.append({
+            "t0": w * a.window_s,
+            "t1": (w + 1) * a.window_s,
+            "demand_tok_s": toks / w_s,
+            "k": k,
+        })
+    n_dev = tput["n_dev"]
+    rate_s = a.usd_per_device_hour / 3600.0
+    horizon_s = n_windows * a.window_s
+    statics = {}
+    for k in range(min_replicas, max_replicas + 1):
+        statics[str(k)] = {
+            "replica_s": k * horizon_s,
+            "cost_usd": k * horizon_s * n_dev * rate_s,
+            "covers_peak": k >= peak_k,
+        }
+    elastic_cost = elastic_replica_s * n_dev * rate_s
+    best_static = str(peak_k)
+    return {
+        "assumptions": dataclasses.asdict(a),
+        "throughput": {**tput, "profile": profile.name,
+                       "measured_tok_s": replica_tok_s,
+                       "deliverable_tok_s": deliverable},
+        "fit": fit,
+        "windows": windows,
+        "horizon_s": horizon_s,
+        "total_tokens": total_tokens,
+        "peak_k": peak_k,
+        "elastic": {
+            "replica_s": elastic_replica_s,
+            "cost_usd": elastic_cost,
+        },
+        "static": statics,
+        "best_static_k": best_static,
+        "elastic_vs_best_static_saving_pct": (
+            100.0 * (1.0 - elastic_replica_s / (peak_k * horizon_s))
+            if peak_k * horizon_s > 0 else 0.0
+        ),
+    }
+
+
+def timeline_replica_seconds(
+    timeline: Sequence[dict], *, k0: int, duration_s: float,
+) -> float:
+    """Integrate K(t) from an autoscaler decision timeline: ``k0``
+    replicas at t=0, each grow/shrink entry (``t``, ``k``) steps the
+    count, held to ``duration_s``. Decisions that move no capacity
+    (canary, rebalance, preempt, holds) do not change K."""
+    k = k0
+    t = 0.0
+    total = 0.0
+    for e in timeline:
+        if e.get("action") not in ("grow", "shrink") or "k" not in e:
+            continue
+        et = min(max(float(e.get("t", 0.0)), t), duration_s)
+        total += k * (et - t)
+        t, k = et, int(e["k"])
+    total += k * max(0.0, duration_s - t)
+    return total
+
+
+def score_timeline(
+    plan: dict, timeline: Sequence[dict], *, k0: int,
+    duration_s: float,
+) -> dict:
+    """Planner vs live: both sides reduce to provisioned
+    replica-seconds over the SAME horizon, so the gap is a single
+    percentage — how far the live controller's provisioning landed
+    from the offline optimum (either direction is a miss: over is
+    money, under is queued SLO risk)."""
+    horizon = float(plan["horizon_s"])
+    scale = horizon / duration_s if duration_s > 0 else 1.0
+    live = timeline_replica_seconds(
+        timeline, k0=k0, duration_s=duration_s,
+    ) * scale
+    planned = float(plan["elastic"]["replica_s"])
+    gap = (
+        abs(live - planned) / planned * 100.0 if planned > 0 else 0.0
+    )
+    return {
+        "planned_replica_s": planned,
+        "live_replica_s": live,
+        "live_raw_replica_s": live / scale if scale else live,
+        "time_scale": scale,
+        "gap_pct": gap,
+    }

@@ -239,6 +239,14 @@ class KvEconomy:
         self._c_evictions = r.counter(
             "fleet_tier_evictions_total",
             "host-tier entries LRU-evicted past the byte budget")
+        self._c_migrated_pages = r.counter(
+            "fleet_tier_migrated_pages_total",
+            "host-tier pages moved whole to a survivor's tier by "
+            "graceful scale-in (migrate_tier)")
+        self._c_migrated_bytes = r.counter(
+            "fleet_tier_migrated_bytes_total",
+            "bytes those migrated pages carried (host→host, no device "
+            "transfer — the write-back spills are counted separately)")
         self._c_spill_bytes = r.counter(
             "fleet_tier_spill_bytes_total",
             "WIRE bytes moved HBM → host by demotion sweeps (post-codec "
@@ -528,6 +536,104 @@ class KvEconomy:
             "fleet.tier_dropped", replica=name, bytes=dropped,
         )
 
+    def on_replica_adopt(self, rep) -> None:
+        """A replica joining (or rejoining) the fleet gets an EMPTY
+        host tier: entries it could inherit were either migrated to a
+        survivor at its graceful exit or died with its process — a
+        tier must never hold KV its owner cannot vouch for. Page-size
+        agreement is enforced exactly like :meth:`attach` does."""
+        if not self.eligible(rep):
+            return
+        ps = rep.engine._page_size
+        if self._page_size is not None and ps != self._page_size:
+            raise ValueError(
+                f"adopted replica {rep.name!r} disagrees on page_size "
+                f"({ps} != {self._page_size})"
+            )
+        if self._page_size is None:
+            self._page_size = ps
+        self._tiers.setdefault(
+            rep.name, TierStore(self.host_bytes_per_replica)
+        )
+
+    def migrate_tier(self, rep) -> tuple[int, int]:
+        """GRACEFUL scale-in's KV half: hand the retiring replica's
+        warm pages to a survivor instead of letting them die with it.
+
+        Two movements, both counted:
+
+        * retained HBM prefix pages WRITE BACK into the retiring
+          replica's own host tier first (the counted, codec-compressed
+          ``spill_page`` plan — same wire as every demotion sweep), so
+          the migration carries the full warm set, not just whatever
+          earlier sweeps happened to demote;
+        * the assembled host tier then moves WHOLE to the best live
+          survivor's tier — version stamps ride along, and the
+          destination's LRU byte budget applies (the coldest migrated
+          pages may evict; counted).
+
+        Returns ``(pages_migrated, bytes_migrated)``. No live survivor
+        tier → the entries drop (recorded), exactly the
+        :meth:`on_replica_death` outcome."""
+        name = rep.name
+        tier = self._tiers.pop(name, None)
+        if tier is None:
+            return (0, 0)
+        eng = rep.engine
+        for key in eng.retained_prefixes():
+            if tier.has(key, version=eng.weights_version):
+                continue
+            try:
+                rows, st = eng.spill_page(
+                    key, drop=False, base_rows=tier.base_rows(key),
+                )
+            except (KeyError, RuntimeError):
+                continue   # became shared/unregistered since listing
+            raw = st.get("raw_bytes", st["bytes"])
+            tier.put(key, rows, version=eng.weights_version, nbytes=raw)
+            self._c_demotions.inc()
+            self._c_spill_bytes.inc(st["bytes"])
+            self._c_raw_bytes.inc(raw)
+        dest_name = None
+        router = self._router
+        for peer in sorted(self._tiers):
+            r = router.replicas.get(peer)
+            if (
+                r is not None and r.alive
+                and peer not in router._draining
+            ):
+                dest_name = peer
+                break
+        pages = moved = 0
+        if dest_name is None:
+            dropped = tier.drop_all()
+            router.recorder.record(
+                "fleet.tier_dropped", replica=name, bytes=dropped,
+            )
+        else:
+            dest = self._tiers[dest_name]
+            for key, ent in list(tier._pages.items()):
+                evicted = dest.put(
+                    key, ent["rows"], version=ent["version"],
+                    nbytes=ent["bytes"],
+                )
+                pages += 1
+                moved += ent["bytes"]
+                if evicted:
+                    self._c_evictions.inc()
+            tier.drop_all()
+            self._c_migrated_pages.inc(pages)
+            self._c_migrated_bytes.inc(moved)
+            router.recorder.record(
+                "fleet.tier_migrated", src=name, dst=dest_name,
+                pages=pages, bytes=moved,
+            )
+        self._g_host_pages.set(sum(len(t) for t in self._tiers.values()))
+        self._g_host_bytes.set(
+            sum(t.bytes_held for t in self._tiers.values())
+        )
+        return (pages, moved)
+
     def on_finish(self, predicted: int, realized: int | None) -> None:
         """Predicted-vs-realized books, fed by ``FleetRouter._finish``."""
         self._c_pred_tokens.inc(int(predicted))
@@ -564,6 +670,8 @@ class KvEconomy:
             "promotions": int(self._c_promotions.value),
             "peer_promotions": int(self._c_peer.value),
             "host_evictions": int(self._c_evictions.value),
+            "migrated_pages": int(self._c_migrated_pages.value),
+            "migrated_bytes": int(self._c_migrated_bytes.value),
             "spill_bytes": int(self._c_spill_bytes.value),
             "fill_bytes": int(self._c_fill_bytes.value),
             "raw_bytes": int(self._c_raw_bytes.value),

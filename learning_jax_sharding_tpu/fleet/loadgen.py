@@ -351,6 +351,7 @@ def replay_trace(
     speed: float = 1.0,
     pace: bool = True,
     on_tick: Callable[[float], None] | None = None,
+    step_hz: float | None = None,
     max_iters: int = 500_000,
 ) -> dict:
     """Drive ``router`` with a generated/loaded trace.
@@ -367,6 +368,16 @@ def replay_trace(
     ``_CLONE_RID_BASE`` — collision-free with trace rids). Fleet-level
     sheds (:class:`AdmissionError`) are tallied, never raised.
 
+    ``step_hz`` (paced mode) is a SERVICE-RATE throttle: at most that
+    many ``router.step()`` calls per wall second. One router step steps
+    every live replica once, so under the throttle fleet throughput is
+    proportional to live replica count — on hosts whose emulated
+    engines outrun the compressed trace this restores the resource
+    model the capacity planner prices (K is the binding resource), and
+    it is what makes the elastic replay's scale decisions load-bearing.
+    Token streams stay bit-identical (recompute-exact engines; only the
+    step *schedule* changes).
+
     Returns ``{"results", "admission_order", "tenant_of", "source_of",
     "shed", "offered", "wall_s"}`` — results keyed by rid;
     ``source_of`` maps every admitted rid (clones included) back to the
@@ -378,6 +389,11 @@ def replay_trace(
 
     if speed <= 0:
         raise ValueError(f"speed must be > 0, got {speed}")
+    if step_hz is not None and (not pace or step_hz <= 0):
+        raise ValueError(
+            f"step_hz needs paced mode and a positive rate, got "
+            f"step_hz={step_hz} pace={pace}"
+        )
     events = sorted(events, key=lambda e: (e["t"], e["rid"]))
     results: dict[int, Any] = {}
     admission_order: list[int] = []
@@ -385,7 +401,7 @@ def replay_trace(
     source_of: dict[int, int] = {}
     shed: list[dict] = []
     t0 = time.perf_counter()
-    i = iters = 0
+    i = iters = steps = 0
     while i < len(events) or router.has_work():
         while i < len(events):
             ev = events[i]
@@ -422,8 +438,17 @@ def replay_trace(
                 tenant_of[got] = ev.get("tenant")
                 source_of[got] = ev["rid"]
         if router.has_work():
-            router.step()
-            results.update(router.pop_finished())
+            if step_hz is not None and steps >= (
+                time.perf_counter() - t0
+            ) * step_hz:
+                # Over the service-rate budget: hold the step (the
+                # queue builds — that IS the signal) but keep polling
+                # admissions and ticking the control loop.
+                time.sleep(min(2e-3, 1.0 / step_hz))
+            else:
+                router.step()
+                steps += 1
+                results.update(router.pop_finished())
         elif pace and i < len(events):
             # Idle gap before the next scheduled arrival: sleep a
             # sliver of it instead of busy-spinning the admission poll.

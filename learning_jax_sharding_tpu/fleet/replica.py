@@ -56,6 +56,12 @@ class EngineReplica:
     draft_params: Any = None
     role: str = "unified"
     alive: bool = True
+    #: Spot semantics: a preemptible replica may receive an eviction
+    #: notice (the ``fleet.preempt`` chaos seam) at any step. The router
+    #: then runs the GRACEFUL drain-and-migrate path within the grace
+    #: window instead of the crash path — capacity is cheaper, work is
+    #: never silently dropped. On-demand replicas never see the seam.
+    preemptible: bool = False
 
     def __post_init__(self):
         if self.role not in ROLES:
@@ -158,6 +164,7 @@ def make_replicas(
     devices: Sequence[jax.Device] | None = None,
     draft_params: Any = None,
     place_params: bool = True,
+    preemptible: bool = False,
     **engine_kwargs: Any,
 ) -> list[EngineReplica]:
     """Build ``count`` identical replicas on disjoint sub-meshes.
@@ -183,6 +190,6 @@ def make_replicas(
         out.append(EngineReplica(
             name=f"{prefix}{i}",
             engine=ContinuousEngine(config, mesh, rules, **engine_kwargs),
-            params=p, draft_params=d, role=role,
+            params=p, draft_params=d, role=role, preemptible=preemptible,
         ))
     return out

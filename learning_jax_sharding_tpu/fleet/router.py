@@ -25,6 +25,16 @@ that makes K of them ONE service —
   generated position), so a replica swap cannot change a token —
   the round-10 ``_unadmit`` guarantee, now fleet-wide and exercised by
   the ``replica_kill`` chaos-matrix cell);
+* **elastic scale** — the fleet's shape is mutable at runtime:
+  :meth:`adopt_replica` admits a warmed replica (scale-out / spot
+  re-admission), :meth:`retire_replica` runs the GRACEFUL inverse —
+  drain-and-migrate: in-flight requests requeue on survivors
+  (recompute-exact, like failover) while the retiring replica's warm
+  KV migrates through the counted tier plans instead of dying with it;
+  a ``preemptible`` replica's eviction notice (the ``fleet.preempt``
+  chaos seam, or :meth:`preempt_replica`) starts a grace-window
+  countdown that ends in the same graceful retire — spot capacity
+  never silently drops work (:mod:`.autoscaler` drives all of this);
 * **fleet telemetry** — per-replica registries merge through
   ``parallel.multihost.merge_registry_snapshots(labels=...)`` into one
   snapshot/Prometheus exposition with ``{replica="..."}`` labels, and
@@ -114,6 +124,7 @@ class FleetRouter:
         kv_economy: Any | None = None,
         topology: Any | None = None,
         kv_codec: Any | None = None,
+        preempt_grace_steps: int = 2,
     ):
         reps = list(replicas)
         if not reps:
@@ -225,6 +236,16 @@ class FleetRouter:
         self._c_swaps = r.counter(
             "fleet_swaps_total",
             "replica weight swaps committed by rolling_swap")
+        self._c_scale_outs = r.counter(
+            "fleet_scale_outs_total",
+            "replicas adopted into the fleet (scale-out and spot "
+            "re-admission, adopt_replica)")
+        self._c_scale_ins = r.counter(
+            "fleet_scale_ins_total",
+            "replicas retired by graceful drain-and-migrate scale-in")
+        self._c_preempts = r.counter(
+            "fleet_preemptions_total",
+            "eviction notices honored on preemptible replicas")
         self._g_alive = r.gauge(
             "fleet_replicas_alive", "replicas currently taking work")
         self._g_inflight = r.gauge(
@@ -246,6 +267,20 @@ class FleetRouter:
         # Replicas mid-swap: excluded from placement (admission AND
         # handoff destinations) so they drain — rolling_swap's lever.
         self._swapping: set[str] = set()
+        # Replicas draining toward a graceful exit (a preemption grace
+        # window): placement-excluded like _swapping, but the countdown
+        # ends in retire_replica (drain-and-migrate), not a weight
+        # commit.
+        self._draining: set[str] = set()
+        # Preemption notices in flight: name → grace steps remaining
+        # before the router force-retires the replica. The window lets
+        # near-done decodes finish in place; everything still unfinished
+        # at expiry drains and requeues (recompute-exact).
+        self._preempting: dict[str, int] = {}
+        self.preempt_grace_steps = int(preempt_grace_steps)
+        # Wall-clock cost of every graceful scale-in drain (ms) — the
+        # elastic story's tail-latency evidence (bench gates its p99).
+        self.drain_ms: list[float] = []
         self._requests: dict[int, _FleetRequest] = {}
         self._finished: dict[int, Any] = {}
         self._next_rid = 0
@@ -279,6 +314,7 @@ class FleetRouter:
                 "prefill" if self.disaggregated else "unified"
             )
             if r.name not in self._swapping
+            and r.name not in self._draining
         ]
 
     def inflight(self) -> int:
@@ -289,7 +325,10 @@ class FleetRouter:
         return len(self._requests)
 
     def has_work(self) -> bool:
-        return self.inflight() > 0
+        # A pending preemption grace window is fleet work: the drain
+        # loop must keep stepping until the countdown resolves, or an
+        # idle fleet would strand the eviction half-delivered.
+        return self.inflight() > 0 or bool(self._preempting)
 
     def reset_stats(self):
         """Start a router-side latency window (``latency_stats``) and a
@@ -423,9 +462,25 @@ class FleetRouter:
         failed over; real infrastructure errors propagate — recovery
         must never guess."""
         before = set(self._finished)
+        self._tick_preemptions()
         self._flush_handoffs()
         for name in sorted(self.replicas):
             rep = self.replicas[name]
+            if (
+                rep.alive and rep.preemptible
+                and name not in self._preempting
+            ):
+                # The spot eviction seam: an InjectedFault here is the
+                # provider's notice, not a crash — the replica keeps
+                # stepping through its grace window while placement
+                # routes around it, then retires gracefully.
+                try:
+                    chaos_hook(
+                        "fleet.preempt", replica=name,
+                        rids=[q for q in rep.engine._req if q >= 0],
+                    )
+                except InjectedFault as e:
+                    self.preempt_replica(name, error=str(e))
             if not rep.alive or not rep.has_work():
                 continue
             if (
@@ -671,6 +726,7 @@ class FleetRouter:
             decodes = [
                 r for r in self._by_role("decode")
                 if r.alive and r.name not in self._swapping
+                and r.name not in self._draining
             ]
             if not decodes and any(
                 r.alive for r in self._by_role("decode")
@@ -836,6 +892,217 @@ class FleetRouter:
         )
         return timeline
 
+    # --- elastic scale (round 23) --------------------------------------------
+
+    def adopt_replica(self, rep: EngineReplica) -> None:
+        """Scale-out: admit a warmed replica into the fleet — a brand
+        new :class:`EngineReplica`, or the REVIVAL of one this router
+        retired earlier (spot re-admission after a preemption; the
+        drained engine is clean by construction). The caller warms and
+        probes the replica first (:class:`~.autoscaler.Autoscaler` runs
+        the canary); adoption itself is bookkeeping — wiring, liveness,
+        tier, gauges — and is recorded. Elastic adoption is unified-only:
+        reshaping a disaggregated fleet means re-planning roles, which
+        is a deployment, not a scale action."""
+        existing = self.replicas.get(rep.name)
+        if existing is not None and existing is not rep:
+            raise ValueError(
+                f"replica name {rep.name!r} is already taken by a "
+                "different replica"
+            )
+        if existing is rep and rep.alive:
+            raise ValueError(f"replica {rep.name!r} is already serving")
+        if self.disaggregated or rep.role != "unified":
+            raise ValueError(
+                "elastic adoption supports unified fleets only "
+                f"(fleet disaggregated={self.disaggregated}, "
+                f"replica role={rep.role!r})"
+            )
+        if rep.engine._max_new != self.max_new_tokens:
+            raise ValueError(
+                f"adopted replica {rep.name!r} disagrees on "
+                f"max_new_tokens ({rep.engine._max_new} != "
+                f"{self.max_new_tokens}) — failover requeue could not "
+                "recompute bit-identically"
+            )
+        if rep.engine._eos != self.eos_id:
+            raise ValueError(
+                f"adopted replica {rep.name!r} disagrees on eos_id "
+                f"({rep.engine._eos} != {self.eos_id})"
+            )
+        rep.alive = True
+        rep.engine.trace_sink = self.traces
+        rep.engine.trace_replica = rep.name
+        if existing is None:
+            # A fresh engine's stats/ledger window starts NOW, aligned
+            # with the fleet's measurement interval — warmup and canary
+            # work must not book into the serving economics. A revived
+            # replica keeps its window: its earlier serving already
+            # belongs to this interval's books.
+            rep.engine.reset_stats()
+        self.replicas[rep.name] = rep
+        self._draining.discard(rep.name)
+        self._preempting.pop(rep.name, None)
+        if self.kv_economy is not None:
+            self.kv_economy.on_replica_adopt(rep)
+        self._g_alive.set(
+            sum(1 for r in self.replicas.values() if r.alive)
+        )
+        self._c_scale_outs.inc()
+        self.recorder.record(
+            "fleet.scale_out", replica=rep.name,
+            revived=existing is rep, preemptible=rep.preemptible,
+        )
+
+    def retire_replica(
+        self, name: str, *, reason: str = "scale_in",
+        force: bool = False,
+    ) -> dict:
+        """Graceful scale-in: DRAIN-AND-MIGRATE, never a silent drop.
+
+        In order: the retiring replica's warm KV migrates to a survivor
+        (retained HBM pages write back through the counted
+        ``spill_page`` plans, then its host tier moves whole —
+        :meth:`~.kv_economy.KvEconomy.migrate_tier`); its queued and
+        in-flight requests drain with visible ``"rerouted"`` terminals
+        and requeue on survivors, where they RECOMPUTE BIT-IDENTICALLY
+        (the same guarantee failover rides — sampling is keyed by
+        (rid, position), never by replica); results that finished
+        before the drain surface normally. The replica stays in
+        ``replicas`` with ``alive=False`` — its ledger window and
+        completed-request history belong to the fleet's books — and
+        :meth:`adopt_replica` can revive it later.
+
+        Retiring the LAST live replica of a role would strand work, so
+        it raises unless ``force=True`` (the preemption path forces:
+        the eviction takes the machine regardless)."""
+        rep = self.replicas[name]
+        if not rep.alive:
+            raise ValueError(f"replica {name!r} is not alive")
+        peers = [
+            r for r in self.replicas.values()
+            if r.alive and r.name != name and r.role == rep.role
+        ]
+        if not peers and not force:
+            raise ValueError(
+                f"cannot retire {name!r}: it is the last live "
+                f"{rep.role!r} replica (force=True drops capacity to "
+                "zero anyway)"
+            )
+        t0 = time.perf_counter()
+        self._draining.discard(name)
+        self._preempting.pop(name, None)
+        migrated_pages = migrated_bytes = 0
+        if self.kv_economy is not None:
+            migrated_pages, migrated_bytes = (
+                self.kv_economy.migrate_tier(rep)
+            )
+        records = rep.engine.drain_requests(
+            status="rerouted", error=f"scale-in: {reason}"
+        )
+        # Pre-drain finished results surface before the liveness flip —
+        # including finished PREFILLS, whose exported rows hand off
+        # normally: a graceful exit keeps its HBM until the drain ends,
+        # so nothing restarts that does not have to.
+        self._collect(rep)
+        rep.alive = False
+        self._g_alive.set(
+            sum(1 for r in self.replicas.values() if r.alive)
+        )
+        rerouted = [r["rid"] for r in records]
+        self._requeue_records(
+            rep, rerouted, error=f"scale-in: {reason}"
+        )
+        drain_ms = (time.perf_counter() - t0) * 1e3
+        self.drain_ms.append(drain_ms)
+        self._c_scale_ins.inc()
+        if reason == "preempted":
+            self._c_preempts.inc()
+        info = dict(
+            replica=name, reason=reason, rerouted=rerouted,
+            migrated_pages=migrated_pages,
+            migrated_bytes=migrated_bytes, drain_ms=drain_ms,
+        )
+        self.recorder.record("fleet.scale_in", **info)
+        return info
+
+    def preempt_replica(
+        self, name: str, *, grace_steps: int | None = None,
+        error: str = "preemption notice",
+    ) -> None:
+        """Deliver a SIGTERM-style eviction notice: the replica leaves
+        the placement pool NOW (``_draining``) but keeps stepping for
+        ``grace_steps`` fleet iterations so near-done work finishes in
+        place; at expiry — or as soon as it runs dry — it retires
+        through the graceful drain-and-migrate path. ``grace_steps<=0``
+        retires immediately (the no-grace eviction)."""
+        rep = self.replicas[name]
+        if not rep.alive:
+            raise ValueError(f"replica {name!r} is not alive")
+        if name in self._preempting:
+            return
+        grace = (
+            self.preempt_grace_steps if grace_steps is None
+            else int(grace_steps)
+        )
+        self.recorder.record(
+            "fleet.preempt_notice", replica=name, grace_steps=grace,
+            error=str(error),
+        )
+        if grace <= 0:
+            self.retire_replica(name, reason="preempted", force=True)
+            return
+        self._draining.add(name)
+        self._preempting[name] = grace
+
+    def _tick_preemptions(self) -> None:
+        """Advance every grace window one fleet step; a window that
+        expires (or whose replica ran dry early) ends in the graceful
+        retire. ``force=True`` because the eviction takes the machine
+        whether or not a peer exists — the requeue path then
+        terminalizes homeless work honestly (``failover_failed``)."""
+        for name in sorted(self._preempting):
+            rep = self.replicas[name]
+            if not rep.alive:
+                self._preempting.pop(name)
+                self._draining.discard(name)
+                continue
+            self._preempting[name] -= 1
+            if self._preempting[name] <= 0 or not rep.engine.has_work():
+                self._preempting.pop(name)
+                self.retire_replica(
+                    name, reason="preempted", force=True,
+                )
+
+    def _requeue_records(
+        self, rep: EngineReplica, rids: Sequence[int], *, error: str,
+    ) -> None:
+        """Requeue drained work on survivors — same rid + original
+        arrival clock, so sampling streams, deadlines, and queue-wait
+        telemetry are those of the ORIGINAL request and survivors
+        recompute it bit-identically. Shared by crash failover and
+        graceful scale-in: one requeue path, one guarantee."""
+        for rid in rids:
+            freq = self._requests.get(rid)
+            if freq is None:      # already finished and popped
+                continue
+            freq.reroutes += 1
+            self._c_reroutes.inc()
+            self.traces.instant(
+                freq.rid, "reroute", replica=rep.name,
+                error=error, reroutes=freq.reroutes,
+            )
+            try:
+                self._route(freq, requeue=True)
+            except AdmissionError as e:
+                # No survivor can take it: terminal, never silent — and
+                # under its OWN status: "rerouted" is the internal
+                # requeue marker pop_finished callers may ignore, so a
+                # request the fleet actually LOST must not wear it.
+                self._finish(freq, RequestFailure(
+                    rid=freq.rid, status="failover_failed", error=str(e),
+                ))
+
     # --- failover ------------------------------------------------------------
 
     def kill_replica(self, name: str, error: str = "replica killed"):
@@ -848,6 +1115,9 @@ class FleetRouter:
         if not rep.alive:
             return
         rep.alive = False
+        # A crash mid-grace-window outruns the graceful countdown.
+        self._draining.discard(rep.name)
+        self._preempting.pop(rep.name, None)
         self._g_alive.set(
             sum(1 for r in self.replicas.values() if r.alive)
         )
@@ -894,32 +1164,15 @@ class FleetRouter:
             rerouted=[r["rid"] for r in records]
             + [h["freq"].rid for h in dead_handoffs],
         )
-        # 3. Requeue on survivors: same rid + original arrival clock, so
-        #    sampling streams, deadlines, and queue-wait telemetry are
-        #    those of the ORIGINAL request — survivors recompute it
-        #    bit-identically (the drain_requests guarantee).
-        for rec in records + [
-            dict(rid=h["freq"].rid) for h in dead_handoffs
-        ]:
-            freq = self._requests.get(rec["rid"])
-            if freq is None:      # already finished and popped
-                continue
-            freq.reroutes += 1
-            self._c_reroutes.inc()
-            self.traces.instant(
-                freq.rid, "reroute", replica=rep.name,
-                error=str(error), reroutes=freq.reroutes,
-            )
-            try:
-                self._route(freq, requeue=True)
-            except AdmissionError as e:
-                # No survivor can take it: terminal, never silent — and
-                # under its OWN status: "rerouted" is the internal
-                # requeue marker pop_finished callers may ignore, so a
-                # request the fleet actually LOST must not wear it.
-                self._finish(freq, RequestFailure(
-                    rid=freq.rid, status="failover_failed", error=str(e),
-                ))
+        # 3. Requeue on survivors (the shared scale-in/failover path:
+        #    same rid + original arrival clock → bit-identical
+        #    recompute, the drain_requests guarantee).
+        self._requeue_records(
+            rep,
+            [r["rid"] for r in records]
+            + [h["freq"].rid for h in dead_handoffs],
+            error=str(error),
+        )
 
     # --- telemetry ------------------------------------------------------------
 

@@ -40,6 +40,17 @@ is one module-global ``None`` check when no injector is installed):
                           dying mid-dispatch: the router declares it dead
                           and fails its work over to survivors (the
                           ``replica_kill`` matrix cell).
+``fleet.preempt``         before the router steps a PREEMPTIBLE replica
+                          (``replica=``, ``rids=``). Kind ``raise`` is the
+                          provider's eviction notice: the replica leaves
+                          placement, steps through its grace window, then
+                          retires via graceful drain-and-migrate (the
+                          ``spot_preempt_mid_decode`` matrix cell).
+``fleet.scale_signal``    inside each autoscaler evaluation; ``value`` is
+                          the worst-burn reading. Kind ``mutate`` replays
+                          a flapping sensor against the real hysteresis
+                          (the ``autoscaler_flap`` matrix cell: zero
+                          churn, only counted holds).
 ========================  ====================================================
 
 Checkpoint corruption does not need a hook — the files are host-visible;

@@ -35,6 +35,8 @@ dispatch paths it drives are already pinned by ``tests/test_serving.py``
 | overload_h4       | offered load > bound, horizon=4   | shed + ladder at horizon boundaries |
 | boundary_preempt  | SIGTERM while a horizon is in flight | boundary drain: commit the horizon, requeue, zero token loss |
 | dcn_degrade       | cross-domain (DCN) link degrades mid-run | topology-aware placement shifts intra-domain, DCN bytes stop |
+| spot_preempt_mid_decode | spot replica evicted mid-decode | grace window + graceful drain-and-migrate, never failover |
+| autoscaler_flap   | flapping burn sensor (scale seam) | hysteresis + bounds: zero churn, counted holds |
 
 The ``*_h4`` rows are the round-16 multi-step variants: with ``horizon=4``
 the host dispatches ONE fused program per 4 engine iterations, so every
@@ -831,6 +833,141 @@ def run_matrix(verbose: bool = False) -> list[dict]:
             "post_commit_version": eng.finished_versions[100],
         }
 
+    def spot_preempt():
+        # Elastic fleet (round 23): a PREEMPTIBLE (spot) replica gets
+        # the provider's eviction notice mid-decode — the
+        # ``fleet.preempt`` seam raises while the replica carries
+        # in-flight work. The response must be the graceful ladder, not
+        # the failover hammer: the replica leaves placement, keeps
+        # serving through its grace window, then retires via
+        # drain-and-migrate — in-flight work requeues on the survivor
+        # with a VISIBLE "rerouted" status and recomputes bit-identically
+        # to the fault-free single-engine run. Never a silent drop.
+        from learning_jax_sharding_tpu.fleet import (
+            FleetRouter,
+            make_replicas,
+        )
+
+        reps = make_replicas(
+            cfg, rules, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+            decode_block_steps=1, recorder=rec,
+        )   # single-token blocks: the grace window expires MID-decode
+        reps[1].preemptible = True
+        router = FleetRouter(reps, recorder=rec, preempt_grace_steps=2)
+        for rid, p in reqs.items():
+            router.add_request(p, rid=rid)
+        router.step()           # work admitted and mid-flight fleet-wide
+        assert reps[1].engine.has_work(), "spot replica must hold work"
+        notices0 = count("fleet.preempt_notice")
+        si0 = count("fleet.scale_in")
+        fo0 = count("fleet.failover")
+        with ChaosInjector(
+            Fault("fleet.preempt", "raise", count=1), recorder=rec,
+        ):
+            out = router.drain(max_steps=400)
+        assert not reps[1].alive, "the evicted spot replica must retire"
+        assert reps[0].alive, "the on-demand survivor must stay up"
+        assert count("fleet.preempt_notice") == notices0 + 1
+        scale_ins = rec.events("fleet.scale_in")[si0:]
+        assert len(scale_ins) == 1 and (
+            scale_ins[0]["reason"] == "preempted"
+        ), scale_ins
+        assert int(
+            router.registry.counter("fleet_preemptions_total").value
+        ) == 1
+        assert count("fleet.failover") == fo0, (
+            "an eviction notice must NEVER take the failover path"
+        )
+        rerouted = int(
+            reps[1].engine.registry.counter("engine_rerouted_total").value
+        )
+        assert rerouted >= 1, "the drain must be visible as rerouted"
+        assert sorted(out) == sorted(reqs), "zero drops across eviction"
+        for rid, v in out.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, clean[rid])
+        return {
+            "evicted": reps[1].name,
+            "grace_steps": router.preempt_grace_steps,
+            "rerouted": rerouted,
+        }
+
+    def autoscaler_flap():
+        # Elastic fleet (round 23): a FLAPPING burn sensor — the
+        # ``fleet.scale_signal`` seam alternates the autoscaler's burn
+        # reading between "the sky is falling" (50x budget) and clean on
+        # every evaluation. Hysteresis must eat it whole: with room to
+        # grow (max 4) and a floor to hold (min 2), the loop commits
+        # ZERO scale actions — only counted holds — and every stream
+        # still comes out bit-identical.
+        from learning_jax_sharding_tpu.fleet import (
+            Autoscaler,
+            AutoscalerConfig,
+            FleetRouter,
+            make_replicas,
+        )
+
+        reps = make_replicas(
+            cfg, rules, params, count=2, mesh_shape=(1, 1),
+            batch_size=2, max_new_tokens=NEW, refill_chunk=8,
+            recorder=rec,
+        )
+        router = FleetRouter(reps, recorder=rec)
+        asc = Autoscaler(router, config=AutoscalerConfig(
+            hot_evals=3, cold_evals=6, cooldown_s=0.0,
+            min_replicas=2, max_replicas=4,
+        ), recorder=rec)
+        for rid, p in reqs.items():
+            router.add_request(p, rid=rid)
+        osc = {"n": 0}
+
+        def flap(_burn):
+            osc["n"] += 1
+            return 50.0 if osc["n"] % 2 else 0.0
+
+        evals = 0
+        with ChaosInjector(
+            Fault("fleet.scale_signal", "mutate", count=-1, mutate=flap),
+            recorder=rec,
+        ):
+            out: dict[int, Any] = {}
+            steps = 0
+            while router.has_work():
+                # The control plane evaluates FASTER than the service
+                # drains — a non-flapping hot signal would clear
+                # hot_evals=3 many times over in this loop.
+                for _ in range(4):
+                    asc.step(now=0.1 * evals)
+                    evals += 1
+                router.step()
+                out.update(router.pop_finished())
+                steps += 1
+                assert steps <= 400, "fleet wedged under sensor flap"
+            out.update(router.pop_finished())
+            for _ in range(8):      # idle tail: the cold floor must hold
+                asc.step(now=0.1 * evals)
+                evals += 1
+        assert osc["n"] == evals >= 12, (
+            "every evaluation must read the (flapping) sensor",
+            osc["n"], evals,
+        )
+        assert asc.timeline == [], (
+            "an oscillating signal must commit ZERO scale actions",
+            asc.timeline,
+        )
+        holds = int(
+            router.registry.counter("fleet_scale_holds_total").value
+        )
+        assert holds > 0, "held evaluations must be counted"
+        assert all(r.alive for r in reps), "the fleet must not churn"
+        assert sorted(out) == sorted(reqs)
+        for rid, v in out.items():
+            assert not isinstance(v, RequestFailure), (rid, v)
+            np.testing.assert_array_equal(v, clean[rid])
+        return {"sensor_reads": osc["n"], "holds": holds,
+                "decisions": len(asc.timeline)}
+
     # --- training cells ---------------------------------------------------
 
     model = Transformer(cfg)
@@ -955,6 +1092,12 @@ def run_matrix(verbose: bool = False) -> list[dict]:
          "tier drop + recompute from prompt", tier_miss_kill)
     cell("dcn_degrade", "cross-domain (DCN) link degrades mid-run",
          "topology-aware placement shifts intra-domain", dcn_degrade)
+    cell("spot_preempt_mid_decode",
+         "spot replica evicted mid-decode (provider notice)",
+         "grace window + graceful drain-and-migrate", spot_preempt)
+    cell("autoscaler_flap", "flapping burn sensor at the scale seam",
+         "hysteresis + bounds: zero churn, counted holds",
+         autoscaler_flap)
     cell("nan_logits_h4", "NaN in logits at a fused horizon=4 dispatch",
          "quarantine within one horizon", nan_logits_h4)
     cell("hung_dispatch_h4", "hung fused dispatch (watchdog abort)",

@@ -266,6 +266,22 @@ class SLOMonitor:
             if tenant is not None:
                 self._observe_tenant(t, tenant, bad)
 
+    def reset_window(self) -> None:
+        """Open a fresh burn/percentile window: drop every ring (global,
+        per-tenant) and estimator, keep the LIFETIME events/breaches
+        counters. The serving-side analogue of ``ledger.begin_window``
+        — warm-up and calibration traffic observed before a measurement
+        (or a control loop) starts must not keep reading as burn for
+        the next 2048 events."""
+        self._est = {}
+        for name in self._burn:
+            self._burn[name] = collections.deque(maxlen=self._window)
+            self._burn_bad[name] = 0
+        for per in self._tenants.values():
+            for s in per.values():
+                s["ring"] = collections.deque(maxlen=self._window)
+                s["bad"] = 0
+
     def burn_rate(self, name: str) -> float:
         """Windowed breach fraction over the error budget ``1-objective``
         (O(1): the window's breach count is maintained incrementally).

@@ -236,6 +236,25 @@ _PATTERNS: list[tuple[re.Pattern, str, bool]] = [
      "kv_wire_bytes_per_req_kb", False),
     (re.compile(r"compression ratio ([\d,.]+)x"),
      "comm_compression_ratio", True),
+    # Round-23 elastic-fleet gates (scripts/replay.py --autoscale's
+    # `[bench] autoscale replay ...` line): `elastic N uusd/tok` is the
+    # autoscaled fleet's provisioned cost per generated token on the
+    # canonical day (lower — and the same line carries the best static
+    # fleet's number as ungated context, phrased `static N uusd/tok`,
+    # deliberately NOT matching round-20's `cost/token N u$` serving-
+    # cost gate); `drain p99` is the scale-in drain-and-migrate wall
+    # tail, THE latency the elastic path adds (lower); `planner gap`
+    # is the capacity planner's K(t) integral vs the live controller's,
+    # in % of planned replica-seconds (lower — widening means either
+    # the planner's model or the controller's judgement drifted;
+    # phrased distinctly from `layout gap` / `overlap gap` / `topo
+    # argmin gap` so no two gap gates double-match one line).
+    (re.compile(r"elastic ([\d,.]+)\s*uusd/tok"),
+     "autoscale_cost_per_token_uusd", False),
+    (re.compile(r"drain p99 ([\d,.]+)\s*ms"), "scale_in_drain_ms_p99",
+     False),
+    (re.compile(r"planner gap ([\d,.]+)%"), "planner_vs_live_gap_pct",
+     False),
 ]
 
 _NAME_RE = re.compile(r"\[bench\]\s+([^:]+):")

@@ -18,9 +18,21 @@ conservation verdict), ``burn_timeline.json`` (per-tenant SLO burn
 sampled ~2 Hz across the replay), ``replay_trace.json`` (the merged
 Perfetto timeline with tenant lanes).
 
+``--autoscale`` (round 23) replays the same trace through the ELASTIC
+fleet instead: a pre-warmed pool of ``--k`` replicas is drained down to
+one, and the SLO-burn autoscaler revives/retires capacity live while
+the static capacity planner's offline prediction
+(:func:`~learning_jax_sharding_tpu.fleet.capacity.plan_capacity`, fed
+the measured per-replica throughput) is scored against the realized
+scale timeline. Extra artifacts: ``capacity_plan.json`` and
+``scale_timeline.json``; the bench line carries the elastic
+cost-per-token, the scale-in drain p99, and the planner-vs-live gap —
+all three bench-gated.
+
 Usage:
     python scripts/replay.py [--trace PATH] [--regen] [--speed S]
-                             [--k K] [--out DIR] [--bench-lines] [--json]
+                             [--k K] [--out DIR] [--autoscale]
+                             [--bench-lines] [--json]
 """
 
 from __future__ import annotations
@@ -189,6 +201,284 @@ def run_replay(
     return [line], summary, econ
 
 
+#: Service-rate throttle for the ELASTIC replay: router steps per wall
+#: second. One router step steps every live replica once, so fleet
+#: throughput is ~proportional to live K — without it the emulated CPU
+#: engines outrun the compressed trace ~20x and no fleet size is ever
+#: the binding resource (the autoscaler would correctly decide nothing).
+STEP_HZ = 10.0
+
+
+def _calibrate(router, cfg, *, step_hz=None) -> float:
+    """Measured per-replica tokens/second on THIS machine, under the
+    same service-rate throttle the replay will run — the supply number
+    the planner needs (the TPU roofline in the cost tables says nothing
+    about the emulated CPU fleet's pace). One short saturated burst on
+    one warmed replica, stats reset afterwards."""
+    name = sorted(router.replicas)[0]
+    rep = router.replicas[name]
+    rng = np.random.default_rng(11)
+    n = 2 * rep.engine._b
+    t0 = time.perf_counter()
+    for i in range(n):
+        rep.engine.add_request(
+            rng.integers(1, cfg.vocab_size, size=(8,)).astype(np.int32),
+            rid=980_000 + i,
+        )
+    steps = 0
+    while rep.engine.has_work():
+        if step_hz is not None:
+            while steps >= (time.perf_counter() - t0) * step_hz:
+                time.sleep(1.0 / (4 * step_hz))
+        rep.step()
+        steps += 1
+    fin = rep.engine.pop_finished()
+    toks = sum(len(r) - 8 for r in fin.values())
+    wall = time.perf_counter() - t0
+    rep.engine.reset_stats()
+    return toks / wall if wall > 0 else float("inf")
+
+
+def run_autoscale_replay(
+    trace_path, *, k_max: int = 4, speed: float = 2.0, out_dir=None,
+):
+    """The elastic replay: same trace, same engines — but the fleet
+    opens at the capacity plan's first-window K (the rest pre-warmed
+    into standby, so a mid-traffic grow never pays a compile) and the
+    autoscaler reshapes it live. Returns (bench lines, summary,
+    economics)."""
+    from learning_jax_sharding_tpu.fleet import (
+        Autoscaler,
+        AutoscalerConfig,
+        FleetRouter,
+        make_replicas,
+        plan_capacity,
+        read_trace,
+        replay_trace,
+        score_timeline,
+        timeline_replica_seconds,
+    )
+    from learning_jax_sharding_tpu.parallel.logical import RULES_DP_TP
+    from learning_jax_sharding_tpu.telemetry import (
+        SLOMonitor,
+        fleet_economics,
+        write_economics,
+    )
+    from learning_jax_sharding_tpu.telemetry.economics import CostRates
+
+    header, events = read_trace(trace_path)
+    cfg, params = _build()
+    # A SHORT burn window for the control loop: the autoscaler must see
+    # burn decay once a crowd passes (2048 events is a day at this
+    # trace's rate — a thermostat stuck on yesterday's heat).
+    slo = SLOMonitor(_targets(), window=48)
+    kw = dict(
+        batch_size=4, max_new_tokens=NEW, refill_chunk=16,
+        decode_block_steps=8, slo=slo,
+    )
+    reps = make_replicas(
+        cfg, RULES_DP_TP, params, count=k_max, mesh_shape=(1, 2), **kw,
+    )
+    # The upper half of the pool is SPOT capacity: preemptible, cheaper
+    # in spirit, first to go at scale-in — the elastic fleet's shape.
+    for rep in reps[k_max // 2:]:
+        rep.preemptible = True
+    router = FleetRouter(reps)
+    _warm(router, cfg)
+    replica_tok_s = _calibrate(router, cfg, step_hz=STEP_HZ)
+
+    # The offline plan, in REPLAY WALL TIME (trace instants compress by
+    # --speed), fed the measured supply — K(t) then compares 1:1 with
+    # the live controller's wall-clock timeline.
+    wall_events = [{**e, "t": float(e["t"]) / speed} for e in events]
+    plan = plan_capacity(
+        wall_events, cfg, max_new_tokens=NEW, mesh_shape=(1, 2),
+        batch_size=kw["batch_size"], min_replicas=1,
+        max_replicas=k_max, replica_tok_s=replica_tok_s,
+        total_devices=8, paged_pages=None,
+    )
+    # STATIC ORACLE under the SAME pacing and service-rate throttle:
+    # the best fixed-K fleet the planner could buy, replayed first. Its
+    # realized SLO burn is the threshold the elastic fleet must stay
+    # within — "cheaper AND no worse on burn" is the acceptance bar,
+    # and an unpaced baseline (engines outrunning the trace) would
+    # measure a meaningless zero.
+    best_k = int(plan["best_static_k"])
+    for name in sorted(router.replicas)[best_k:]:
+        router.retire_replica(name, reason="static_oracle")
+    router.reset_stats()
+    slo.reset_window()
+    static_peak = [0.0]
+
+    def _static_tick(elapsed: float) -> None:
+        for rates in slo.tenant_burn_rates().values():
+            for v in rates.values():
+                static_peak[0] = max(static_peak[0], float(v))
+
+    replay_trace(
+        router, events, seed=header["seed"], vocab_size=cfg.vocab_size,
+        speed=speed, pace=True, on_tick=_static_tick, step_hz=STEP_HZ,
+    )
+    static_burn = {
+        tenant: max((float(v) for v in rates.values()), default=0.0)
+        for tenant, rates in slo.tenant_burn_rates().items()
+    }
+    static_final_burn = max(static_burn.values(), default=0.0)
+    static_peak_burn = static_peak[0]
+
+    # Open the ELASTIC run at the plan's first-window K — cold-starting
+    # below the planned shape just manufactures queue-wait burn the
+    # window ring then carries for most of the replay. The planner sets
+    # the opening shape; the control loop owns everything after t=0.
+    # The rest of the pool returns to the warm standby bench.
+    k0 = max(1, min(int(plan["windows"][0]["k"]), k_max))
+    for name in sorted(router.replicas):
+        if not router.replicas[name].alive:
+            router.adopt_replica(router.replicas[name])
+    for name in sorted(router.replicas)[k0:]:
+        router.retire_replica(name, reason="standby")
+    router.reset_stats()
+    slo.reset_window()        # oracle/calibration waits are not burn
+    router.drain_ms.clear()   # setup drains are not scale-in evidence
+    # Asymmetric hysteresis: grow on the FIRST hot eval (queue-wait
+    # budget at this speed is 0.25 s — a second confirming eval eats
+    # it), shrink after 0.4 s sustained cold. Eager shrink is safe
+    # HERE because the plan floor already holds the fleet up through
+    # every burst the planner priced — the reactive loop only sheds
+    # headroom the plan never asked for.
+    asc = Autoscaler(router, config=AutoscalerConfig(
+        hot_evals=1, cold_evals=8, cooldown_s=0.4,
+        min_replicas=1, max_replicas=k_max,
+    ))
+
+    timeline: list[dict] = []
+    last = [-1.0, -1.0]      # [burn sample t, autoscaler eval t]
+
+    # Feed-forward: the plan's per-window K is the controller's FLOOR
+    # (proactive — the planner priced these bursts offline), and the
+    # reactive burn/occupancy loop buys headroom above it.
+    def _plan_floor(t: float) -> int:
+        for w in plan["windows"]:
+            if w["t0"] <= t < w["t1"]:
+                return int(w["k"])
+        return 1
+
+    def _tick(elapsed: float) -> None:
+        if elapsed - last[1] >= 0.05:
+            last[1] = elapsed
+            asc.step(elapsed, floor=_plan_floor(elapsed))
+        if elapsed - last[0] < 0.5:
+            return
+        last[0] = elapsed
+        timeline.append({
+            "t_s": round(elapsed, 3),
+            "burn": slo.tenant_burn_rates(),
+            "k": sum(1 for r in router.replicas.values() if r.alive),
+        })
+
+    rep = replay_trace(
+        router, events, seed=header["seed"], vocab_size=cfg.vocab_size,
+        speed=speed, pace=True, on_tick=_tick, step_hz=STEP_HZ,
+    )
+    econ = fleet_economics(router, replay=rep, slo=slo)
+    m = econ["measured"]
+    gen = sum(
+        t["generated_tokens"]
+        for t in econ["deterministic"]["tenants"].values()
+    )
+
+    # PROVISIONED cost — what an operator pays for the machines that
+    # exist, elastic K(t) vs the best feasible static K, both priced on
+    # the same rate and the same realized token count (the streams are
+    # bit-identical across fleet shapes, so tokens cancel nothing).
+    wall = float(rep["wall_s"])
+    n_dev = int(plan["throughput"]["n_dev"])
+    rate_s = CostRates().usd_per_device_hour / 3600.0
+    live_rs = timeline_replica_seconds(
+        asc.timeline, k0=k0, duration_s=wall,
+    )
+    static_rs = best_k * wall
+    elastic_cpt = live_rs * n_dev * rate_s / gen if gen else 0.0
+    static_cpt = static_rs * n_dev * rate_s / gen if gen else 0.0
+    score = score_timeline(plan, asc.timeline, k0=k0, duration_s=wall)
+    # True peak over the sampled burn timeline (worst tenant×objective
+    # at any sample) — the end-of-replay window read alone hides the
+    # transient the autoscaler actually fought.
+    peak_burn, peak_tenant = 0.0, "-"
+    for s in timeline:
+        for tenant, rates in s["burn"].items():
+            for v in rates.values():
+                if float(v) > peak_burn:
+                    peak_burn, peak_tenant = float(v), tenant
+    drains = router.drain_ms
+    drain_p99 = (
+        float(np.percentile(np.asarray(drains), 99)) if drains else 0.0
+    )
+
+    if out_dir is not None:
+        out_dir = pathlib.Path(out_dir)
+        out_dir.mkdir(parents=True, exist_ok=True)
+        write_economics(out_dir / "economics.json", econ)
+        with open(out_dir / "capacity_plan.json", "w") as f:
+            json.dump(plan, f, indent=2)
+        with open(out_dir / "scale_timeline.json", "w") as f:
+            json.dump({
+                "k0": k0, "speed": speed, "wall_s": wall,
+                "decisions": asc.timeline,
+                "burn_samples": timeline,
+                "score": score,
+                "autoscaler": asc.report(),
+                "static_oracle": {
+                    "k": best_k,
+                    "peak_burn": static_peak_burn,
+                    "final_burn": static_final_burn,
+                    "burn_by_tenant": static_burn,
+                },
+            }, f, indent=2)
+        with open(out_dir / "burn_timeline.json", "w") as f:
+            json.dump({"speed": speed, "samples": timeline}, f, indent=2)
+
+    line = (
+        f"[bench] autoscale replay K<={k_max} (canonical day, "
+        f"speed {speed:g}x): "
+        f"elastic {elastic_cpt * 1e6:,.3f} uusd/tok vs static "
+        f"{static_cpt * 1e6:,.3f} uusd/tok (best K={best_k}), "
+        f"drain p99 {drain_p99:,.2f} ms, "
+        f"planner gap {score['gap_pct']:,.1f}%, "
+        f"peak burn {peak_burn:.2f} ({peak_tenant}) vs static oracle "
+        f"{static_peak_burn:.2f}, "
+        f"final burn {m['worst_tenant_burn_rate']:.2f} vs "
+        f"{static_final_burn:.2f}, "
+        f"{len(rep['admission_order'])} requests "
+        f"({len(rep['shed'])} shed), {gen} tok, "
+        f"decisions {len(asc.timeline)}"
+    )
+    summary = dict(
+        bench_line=line,
+        k0=k0, peak_burn=peak_burn, peak_burn_tenant=peak_tenant,
+        static_oracle_peak_burn=static_peak_burn,
+        static_oracle_final_burn=static_final_burn,
+        static_oracle_burn_by_tenant=static_burn,
+        k_max=k_max, speed=speed, offered=rep["offered"],
+        admitted=len(rep["admission_order"]), shed=len(rep["shed"]),
+        generated_tokens=gen,
+        replica_tok_s=replica_tok_s,
+        elastic_cost_per_token_usd=elastic_cpt,
+        static_cost_per_token_usd=static_cpt,
+        best_static_k=best_k,
+        live_replica_s=live_rs,
+        drain_ms_p99=drain_p99,
+        planner_gap_pct=score["gap_pct"],
+        decisions=len(asc.timeline),
+        actions=asc.report()["actions"],
+        worst_tenant=m["worst_tenant"],
+        worst_tenant_burn_rate=m["worst_tenant_burn_rate"],
+        conservation_ok=m["conservation"]["ok"],
+        replay_wall_s=wall,
+    )
+    return [line], summary, econ
+
+
 def main(argv=None) -> int:
     from learning_jax_sharding_tpu.fleet import (
         canonical_day_spec,
@@ -208,6 +498,13 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None,
                     help="artifact directory (economics.json, "
                          "burn_timeline.json, replay_trace.json)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic replay: open at the capacity plan's "
+                         "first-window K and let the SLO-burn autoscaler "
+                         "(plan floor fed forward) reshape the fleet; a "
+                         "paced static oracle at the planner's best K "
+                         "runs first as the burn threshold (--k becomes "
+                         "the pool ceiling)")
     ap.add_argument("--bench-lines", action="store_true",
                     help="print only the [bench] lines (for bench.py)")
     ap.add_argument("--json", action="store_true", help="machine output")
@@ -220,9 +517,14 @@ def main(argv=None) -> int:
     trace = args.trace or canonical_trace_path()
 
     t0 = time.perf_counter()
-    lines, summary, _ = run_replay(
-        trace, k=args.k, speed=args.speed, out_dir=args.out,
-    )
+    if args.autoscale:
+        lines, summary, _ = run_autoscale_replay(
+            trace, k_max=args.k, speed=args.speed, out_dir=args.out,
+        )
+    else:
+        lines, summary, _ = run_replay(
+            trace, k=args.k, speed=args.speed, out_dir=args.out,
+        )
     if args.json:
         print(json.dumps(summary, indent=2))
     else:

@@ -598,6 +598,69 @@ class TestExtraction:
         assert not by[f"{kv}:kv_wire_bytes_per_req_kb"]["regressed"]
         assert not by[f"{kv}:comm_compression_ratio"]["regressed"]
 
+    def test_autoscaler_gates_direction_aware(self):
+        """The round-23 elastic-fleet gates: autoscaled cost per token,
+        scale-in drain p99, and the planner-vs-live gap all regress UP.
+        No cross-matching: `elastic N uusd/tok` must not ride round-20's
+        `cost/token N u$` serving-cost gate (and vice versa — the
+        economics line must not produce an autoscale cost metric), the
+        `static N uusd/tok` context number on the same line is extracted
+        by nothing, `peak burn` must not ride `worst tenant burn`, and
+        `planner gap` must not collide with the layout/overlap/argmin
+        gap gates."""
+        line = (
+            "[bench] autoscale replay K<=4 (canonical day, speed 2x): "
+            "elastic 9.787 uusd/tok vs static 12.251 uusd/tok "
+            "(best K=2), drain p99 0.53 ms, planner gap 6.6%, peak "
+            "burn 0.00 (interactive), 79 requests (0 shed), 1264 tok, "
+            "decisions 12"
+        )
+        m = bench_compare.extract_metrics(_doc([line]))
+        name = "autoscale_replay_K<=4_(canonical_day,_speed_2x)"
+        assert m[f"{name}:autoscale_cost_per_token_uusd"] == (9.787, False)
+        assert m[f"{name}:scale_in_drain_ms_p99"] == (0.53, False)
+        assert m[f"{name}:planner_vs_live_gap_pct"] == (6.6, False)
+        assert not any(
+            k.endswith(":cost_per_token_uusd")
+            or k.endswith(":worst_tenant_burn_rate")
+            or k.endswith(":layout_search_gap_pct")
+            or k.endswith(":overlap_predicted_vs_realized_pp")
+            or k.endswith(":topo_argmin_gap_pct")
+            for k in m
+        )
+        assert not any(v[0] == 12.251 for v in m.values())
+        econ = (
+            "[bench] economics replay K=4 (canonical day, speed 2x): "
+            "goodput_ratio 1.1%, cost/token 12.291 u$, worst tenant "
+            "burn 0.00 (interactive), 79 requests (0 shed), 1264 tok"
+        )
+        assert not any(
+            k.endswith(":autoscale_cost_per_token_uusd")
+            or k.endswith(":scale_in_drain_ms_p99")
+            or k.endswith(":planner_vs_live_gap_pct")
+            for k in bench_compare.extract_metrics(_doc([econ]))
+        )
+        worse = _doc([
+            line.replace("elastic 9.787 uusd/tok", "elastic 14.000 uusd/tok")
+            .replace("drain p99 0.53 ms", "drain p99 4.20 ms")
+            .replace("planner gap 6.6%", "planner gap 31.0%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), worse, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert by[f"{name}:autoscale_cost_per_token_uusd"]["regressed"]
+        assert by[f"{name}:scale_in_drain_ms_p99"]["regressed"]
+        assert by[f"{name}:planner_vs_live_gap_pct"]["regressed"]
+        better = _doc([
+            line.replace("elastic 9.787 uusd/tok", "elastic 7.000 uusd/tok")
+            .replace("drain p99 0.53 ms", "drain p99 0.30 ms")
+            .replace("planner gap 6.6%", "planner gap 2.0%")
+        ])
+        rows, _, _ = bench_compare.compare(_doc([line]), better, 0.10)
+        by = {r["metric"]: r for r in rows}
+        assert not by[f"{name}:autoscale_cost_per_token_uusd"]["regressed"]
+        assert not by[f"{name}:scale_in_drain_ms_p99"]["regressed"]
+        assert not by[f"{name}:planner_vs_live_gap_pct"]["regressed"]
+
 
 class TestCompare:
     def test_regressions_follow_direction(self):

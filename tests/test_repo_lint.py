@@ -86,6 +86,63 @@ def test_unbounded_host_buffer_rule_is_live():
     ]
 
 
+def test_unguarded_scale_decision_rule_is_live():
+    """The round-23 rule fires on its target pattern: a fleet scale
+    action called from inside an ``*Autoscaler`` class outside a
+    ``with ..._decision(...)`` frame. It carries ZERO suppressions by
+    design (the autoscaler's decision log is complete by construction),
+    so like ``unbounded-host-buffer`` the repo-wide clean gate passes
+    vacuously if the rule is unwired — this pins that it is live, that
+    the decision frame actually guards, and that the same calls OUTSIDE
+    an autoscaler class (the router's own methods, test drivers) stay
+    out of scope."""
+    import textwrap
+
+    from learning_jax_sharding_tpu.analysis.source_lint import lint_source
+
+    unframed = textwrap.dedent(
+        """
+        class Autoscaler:
+            def _shrink(self, victim):
+                info = self.router.retire_replica(victim)
+                return info
+
+            def panic(self):
+                self.router.kill_replica("unified0")
+        """
+    )
+    found = lint_source("demo.py", unframed)
+    assert [f.rule for f in found] == ["unguarded-scale-decision"] * 2
+    lines = sorted(int(f.where.rsplit(":", 1)[1]) for f in found)
+    assert lines == [4, 8]
+
+    framed = textwrap.dedent(
+        """
+        class SpotAutoscaler:
+            def _shrink(self, victim):
+                with self._decision("shrink", replica=victim) as entry:
+                    entry["info"] = self.router.retire_replica(victim)
+
+            def _grow(self, rep):
+                with self._decision("grow"):
+                    self.router.adopt_replica(rep)
+        """
+    )
+    assert not lint_source("demo.py", framed)
+
+    out_of_scope = textwrap.dedent(
+        """
+        class FleetRouter:
+            def _tick_preemptions(self):
+                self.retire_replica("unified1", force=True)
+
+        def drive(router):
+            router.preempt_replica("unified0", grace_steps=2)
+        """
+    )
+    assert not lint_source("demo.py", out_of_scope)
+
+
 def test_axis_literal_rule_fires_in_scoped_dirs():
     """The round-21 rule on its target pattern: a bare mesh-axis name
     in a fleet/ (or analysis/) source file — one finding per literal,

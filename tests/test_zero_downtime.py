@@ -519,7 +519,7 @@ class TestFaultMatrix:
         assert not bad, "unrecovered cells:\n" + "\n".join(
             f"  {r['cell']}: {r['error']}" for r in bad
         )
-        assert len(results) == 19
+        assert len(results) == 21
         # Every cell that injects through a chaos seam recorded it
         # (ckpt_corruption corrupts the filesystem directly; the
         # overload cells' fault IS the offered load — none cross a seam).
